@@ -1,0 +1,42 @@
+"""keystone_tpu: a TPU-native ML pipeline framework.
+
+A ground-up JAX/XLA re-design of the capabilities of KeystoneML
+(reference: amplab/keystone — Scala/Spark): lazily-executed typed pipeline
+DAGs of Transformers and Estimators, a whole-pipeline rule-based optimizer
+with cross-pipeline state reuse, a library of featurization nodes and
+distributed solvers, and example end-to-end workloads — with sharded
+`jax.Array`s over a TPU device mesh in place of RDDs over a Spark cluster.
+"""
+
+from keystone_tpu.data import Dataset, LabeledData
+from keystone_tpu.workflow import (
+    Chainable,
+    Estimator,
+    FittedPipeline,
+    Identity,
+    LabelEstimator,
+    Pipeline,
+    PipelineDataset,
+    PipelineDatum,
+    PipelineEnv,
+    Transformer,
+    transformer,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset",
+    "LabeledData",
+    "Chainable",
+    "Estimator",
+    "FittedPipeline",
+    "Identity",
+    "LabelEstimator",
+    "Pipeline",
+    "PipelineDataset",
+    "PipelineDatum",
+    "PipelineEnv",
+    "Transformer",
+    "transformer",
+]

@@ -1,0 +1,3 @@
+"""Data plane: the Dataset abstraction and data loaders."""
+
+from .dataset import Dataset, LabeledData

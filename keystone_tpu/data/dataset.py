@@ -1,0 +1,200 @@
+"""Dataset: the distributed-collection abstraction replacing RDDs.
+
+The reference moves `RDD[DenseVector]` (or `RDD[Image]`, `RDD[String]`...)
+through pipelines, packing rows into per-partition matrices for BLAS-3
+(reference: utils/MatrixUtils.scala:48-61, workflow/Operator.scala:25-38).
+The TPU-native analog is batch-major arrays:
+
+  - **Array form** (the common case): ``data`` is a pytree of arrays sharing a
+    leading example axis, usually one ``(n, d)`` array; it may be zero-padded
+    to a multiple of the mesh ``data`` axis and sharded over the mesh. Padding
+    rows are all-zero so Gramians/moment sums are unaffected; ``n`` tracks the
+    true example count.
+  - **Host form**: a Python list of arbitrary objects (images before decode,
+    token sequences) for stages that must run host-side.
+
+Transformers consume and produce Datasets; solvers read ``.array`` +
+``.n`` directly and run jit-compiled sharded computations on them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.parallel import mesh as mesh_lib
+
+
+def _is_arraylike(x: Any) -> bool:
+    return isinstance(x, (np.ndarray, jax.Array)) or (
+        hasattr(x, "shape") and hasattr(x, "dtype")
+    )
+
+
+class Dataset:
+    """A batch of n examples, in device-array or host-list form."""
+
+    def __init__(self, data: Any, n: Optional[int] = None, mesh=None):
+        if isinstance(data, Dataset):
+            raise TypeError("Dataset(data) may not wrap another Dataset")
+        self.data = data
+        self.mesh = mesh
+        if isinstance(data, list):
+            self.n = len(data) if n is None else n
+        else:
+            leaves = jax.tree_util.tree_leaves(data)
+            if not leaves:
+                raise ValueError("Array dataset must contain at least one array")
+            self.n = int(leaves[0].shape[0]) if n is None else n
+
+    # -- constructors -------------------------------------------------------
+
+    @staticmethod
+    def of(data: Any, mesh=None) -> "Dataset":
+        """Wrap a list (host form) or array-like / pytree (array form)."""
+        if isinstance(data, Dataset):
+            return data
+        if isinstance(data, list) and not (data and _is_arraylike(data[0])):
+            return Dataset(list(data))
+        if isinstance(data, list):
+            # list of per-example arrays with identical shapes -> stack;
+            # ragged -> host form
+            shapes = {np.shape(x) for x in data}
+            if len(shapes) == 1:
+                return Dataset(np.stack([np.asarray(x) for x in data]), mesh=mesh)
+            return Dataset(list(data))
+        return Dataset(data, mesh=mesh)
+
+    @staticmethod
+    def gather(branches: List["Dataset"]) -> "Dataset":
+        """Zip branches into a dataset of tuples (GatherTransformerOperator.scala:9-18)."""
+        ns = {b.n for b in branches}
+        if len(ns) != 1:
+            raise ValueError(f"Gathered branches must have equal sizes, got {ns}")
+        if all(not b.is_host for b in branches):
+            return Dataset(tuple(b.data for b in branches), n=branches[0].n,
+                           mesh=branches[0].mesh)
+        items = [b.to_list() for b in branches]
+        return Dataset([tuple(vals) for vals in zip(*items)])
+
+    # -- properties ---------------------------------------------------------
+
+    @property
+    def is_host(self) -> bool:
+        return isinstance(self.data, list)
+
+    @property
+    def array(self):
+        """The single underlying array (errors for host/tuple datasets)."""
+        if self.is_host:
+            arr = np.stack([np.asarray(x) for x in self.data])
+            return arr
+        leaves = jax.tree_util.tree_leaves(self.data)
+        if isinstance(self.data, (tuple, list)) or len(leaves) != 1:
+            raise ValueError("Dataset holds a pytree; use .data")
+        return leaves[0]
+
+    @property
+    def num_padded(self) -> int:
+        if self.is_host:
+            return len(self.data)
+        return int(jax.tree_util.tree_leaves(self.data)[0].shape[0])
+
+    def __len__(self) -> int:
+        return self.n
+
+    # -- transforms ---------------------------------------------------------
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Apply `fn` per example. Host form: Python map. Array form: vmap,
+        falling back to a host loop if `fn` is not traceable."""
+        if self.is_host:
+            out = [fn(x) for x in self.data]
+            return Dataset.of(out)
+        try:
+            mapped = jax.vmap(fn)(self.data)
+            return Dataset(mapped, n=self.n, mesh=self.mesh)._rezero_padding()
+        except Exception:
+            items = self.to_list()
+            return Dataset.of([fn(x) for x in items])
+
+    def map_batch(self, fn: Callable[[Any], Any]) -> "Dataset":
+        """Apply a whole-batch (vectorized) function to the array form."""
+        out = fn(self.data)
+        return Dataset(out, n=self.n, mesh=self.mesh)._rezero_padding()
+
+    def _rezero_padding(self) -> "Dataset":
+        """Restore the all-zero-padding invariant after a non-zero-preserving
+        transform (padding rows must not pollute Gramians/moment sums)."""
+        if self.is_host or self.num_padded == self.n:
+            return self
+        mask = jnp.arange(self.num_padded) < self.n
+
+        def zero(leaf):
+            m = mask.reshape((-1,) + (1,) * (leaf.ndim - 1))
+            return jnp.where(m, leaf, jnp.zeros((), dtype=leaf.dtype))
+
+        data = jax.tree_util.tree_map(zero, self.data)
+        return Dataset(data, n=self.n, mesh=self.mesh)
+
+    def to_list(self) -> List[Any]:
+        """Materialize as a host list of per-example values (padding dropped)."""
+        if self.is_host:
+            return list(self.data)
+        if isinstance(self.data, tuple):
+            parts = [np.asarray(leaf)[: self.n] for leaf in self.data]
+            return [tuple(p[i] for p in parts) for i in range(self.n)]
+        return list(np.asarray(self.array)[: self.n])
+
+    def to_numpy(self) -> np.ndarray:
+        """The underlying array with padding rows dropped, as numpy."""
+        return np.asarray(self.array)[: self.n]
+
+    # -- distribution -------------------------------------------------------
+
+    def shard(self, mesh=None, axis: str = mesh_lib.DATA_AXIS) -> "Dataset":
+        """Pad to divisibility and shard the leading axis over the mesh."""
+        if self.is_host:
+            raise ValueError("Host datasets cannot be device-sharded; vectorize first")
+        mesh = mesh or mesh_lib.default_mesh()
+        size = mesh_lib.axis_size(mesh, axis)
+
+        def place(leaf):
+            padded, _ = mesh_lib.pad_rows(np.asarray(leaf), size)
+            return mesh_lib.shard_rows(padded, mesh, axis)
+
+        data = jax.tree_util.tree_map(place, self.data)
+        return Dataset(data, n=self.n, mesh=mesh)
+
+    def cache(self) -> "Dataset":
+        """Force materialization now (the Cacher analog). Device arrays are
+        already materialized eagerly by JAX; this just blocks until ready."""
+        if not self.is_host:
+            jax.block_until_ready(jax.tree_util.tree_leaves(self.data))
+        return self
+
+    def valid_mask(self):
+        """(num_padded,) float mask: 1 for real rows, 0 for padding."""
+        npad = self.num_padded
+        return (jnp.arange(npad) < self.n).astype(jnp.float32)
+
+    def __repr__(self) -> str:
+        if self.is_host:
+            return f"Dataset(host, n={self.n})"
+        shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), self.data)
+        return f"Dataset(array, n={self.n}, shapes={shapes})"
+
+
+class LabeledData:
+    """A (data, labels) pair of aligned Datasets (loaders/LabeledData.scala:12-15)."""
+
+    def __init__(self, data: Any, labels: Any):
+        self.data = Dataset.of(data)
+        self.labels = Dataset.of(labels)
+        if self.data.n != self.labels.n:
+            raise ValueError(
+                f"data ({self.data.n}) and labels ({self.labels.n}) must align"
+            )

@@ -1,0 +1,1 @@
+"""Node library: featurizers, solvers, and plumbing nodes."""

@@ -1,0 +1,14 @@
+"""Parallel substrate: device meshes, sharding helpers, distributed linear algebra."""
+
+from . import mesh
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    default_mesh,
+    make_mesh,
+    pad_rows,
+    replicate,
+    set_default_mesh,
+    shard_rows,
+    use_mesh,
+)

@@ -1,0 +1,286 @@
+"""Cache-placement optimization (reference: workflow/AutoCacheRule.scala:18-664).
+
+The reference decides which RDDs to ``.cache()`` by profiling sampled
+sub-pipelines (wall time + storage size) and greedily minimizing estimated
+total runtime under a memory budget. The TPU analog of "caching" is keeping a
+computed Dataset resident in device HBM (and publishing it into the prefix
+state table) versus recomputing it on each downstream pass.
+
+Two strategies, as in the reference:
+  - AggressiveCache: cache every dataset-producing node whose weighted direct
+    successor count exceeds 1 (AutoCacheRule.scala:503-518).
+  - GreedyCache(max_mem_bytes, scales, trials): profile sampled execution and
+    greedily add the cache that most reduces estimated runtime while the
+    cached set fits the memory budget (AutoCacheRule.scala:559-602).
+
+Node weights come from the ``weight`` attribute of operators (the
+WeightedOperator contract, reference: workflow/WeightedOperator.scala): the
+number of passes the operator makes over its inputs.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+import jax
+import numpy as np
+
+from . import analysis
+from .env import Prefix
+from .graph import Graph, NodeId, SinkId, SourceId
+from .operators import (
+    DatasetExpression,
+    DatasetOperator,
+    DatumExpression,
+    EstimatorOperator,
+    Expression,
+    TransformerExpression,
+    TransformerOperator,
+)
+from .optimizer import Plan, Rule
+
+
+def node_weight(op) -> int:
+    """Number of passes an operator makes over its input (default 1)."""
+    return int(getattr(op, "weight", 1))
+
+
+@dataclass
+class Profile:
+    """Measured cost of computing one node (AutoCacheRule.scala:12-16)."""
+
+    ns: float = 0.0
+    mem_bytes: int = 0
+
+    def __add__(self, other: "Profile") -> "Profile":
+        return Profile(self.ns + other.ns, self.mem_bytes + other.mem_bytes)
+
+
+@dataclass(frozen=True)
+class AggressiveCache:
+    pass
+
+
+@dataclass(frozen=True)
+class GreedyCache:
+    max_mem_bytes: Optional[int] = None  # default: 75% of device memory
+    samples_per_shard: int = 3
+
+
+def _dataset_nodes(graph: Graph) -> Set[NodeId]:
+    """Nodes that produce datasets: transformer-ish nodes not downstream of sources."""
+    out = set()
+    for node, op in graph.operators.items():
+        if isinstance(op, EstimatorOperator):
+            continue
+        ancestors = analysis.get_ancestors(graph, node)
+        if any(isinstance(a, SourceId) for a in ancestors):
+            continue
+        out.add(node)
+    return out
+
+
+def compute_runs(graph: Graph, cached: Set[NodeId]) -> Dict[NodeId, int]:
+    """Times each node's result gets *computed*, given a cached set
+    (the analog of AutoCacheRule.getRuns, AutoCacheRule.scala:57-81).
+
+    A node's result is accessed once per (child run × child weight); caching a
+    node bounds its compute count at 1.
+    """
+    accesses: Dict[NodeId, int] = {}
+
+    def runs(gid) -> int:
+        """Times the node at `gid` executes."""
+        if isinstance(gid, SinkId):
+            return 1
+        if gid in accesses:
+            return accesses[gid]
+        total = 0
+        for child in analysis.get_children(graph, gid):
+            if isinstance(child, SinkId):
+                total += 1
+            elif isinstance(child, NodeId):
+                child_runs = 1 if child in cached else runs(child)
+                total += child_runs * node_weight(graph.get_operator(child))
+        result = max(total, 1)
+        accesses[gid] = result
+        return result
+
+    out: Dict[NodeId, int] = {}
+    for node in graph.nodes:
+        out[node] = 1 if node in cached else runs(node)
+    return out
+
+
+def _insert_cachers(plan: Graph, nodes: Set[NodeId]) -> Graph:
+    """Splice a Cacher node after each selected node (AutoCacheRule.scala:492-501)."""
+    from keystone_tpu.ops.util import Cacher
+
+    graph = plan
+    for node in nodes:
+        op = graph.get_operator(node)
+        if isinstance(op, Cacher):
+            continue
+        graph, cacher_id = graph.add_node(Cacher(), [node])
+        # Point all other dependents of `node` at the cacher.
+        for child in list(analysis.get_children(graph, node)):
+            if child == cacher_id:
+                continue
+            if isinstance(child, NodeId):
+                deps = [cacher_id if d == node else d for d in graph.get_dependencies(child)]
+                graph = graph.set_dependencies(child, deps)
+            elif isinstance(child, SinkId):
+                graph = graph.set_sink_dependency(child, cacher_id)
+    return graph
+
+
+def profile_nodes(
+    graph: Graph, nodes: Set[NodeId], samples_per_shard: int = 3
+) -> Dict[NodeId, Profile]:
+    """Execute sampled ancestor chains, measuring per-node wall time and output size
+    (the analog of AutoCacheRule.profileNodes, AutoCacheRule.scala:153-465)."""
+    from keystone_tpu.data import Dataset
+
+    memo: Dict[NodeId, object] = {}
+    profiles: Dict[NodeId, Profile] = {}
+
+    def sample_dataset(ds: Dataset) -> Tuple[Dataset, float]:
+        k = min(ds.n, max(samples_per_shard, 1))
+        scale = ds.n / max(k, 1)
+        if ds.is_host:
+            return Dataset.of(ds.to_list()[:k]), scale
+        data = jax.tree_util.tree_map(lambda x: x[:k], ds.data)
+        return Dataset(data, n=k), scale
+
+    scales: Dict[NodeId, float] = {}
+
+    def evaluate(gid):
+        if gid in memo:
+            return memo[gid]
+        op = graph.get_operator(gid)
+        dep_values = [evaluate(d) for d in graph.get_dependencies(gid)]
+        t0 = time.perf_counter()
+        if isinstance(op, DatasetOperator):
+            value, scale = sample_dataset(Dataset.of(op.dataset))
+            scales[gid] = scale
+        else:
+            exprs = [_wrap(v) for v in dep_values]
+            value = op.execute(exprs).get()
+            if isinstance(value, Dataset):
+                value.cache()
+            dep_scales = [
+                scales.get(d, 1.0) for d in graph.get_dependencies(gid)
+            ]
+            scales[gid] = max(dep_scales, default=1.0)
+        elapsed_ns = (time.perf_counter() - t0) * 1e9
+        mem = _estimate_bytes(value)
+        scale = scales.get(gid, 1.0)
+        profiles[gid] = Profile(ns=elapsed_ns * scale, mem_bytes=int(mem * scale))
+        memo[gid] = value
+        return value
+
+    def _wrap(value) -> Expression:
+        if isinstance(value, Dataset):
+            return DatasetExpression(lambda v=value: v)
+        if isinstance(value, TransformerOperator):
+            return TransformerExpression(lambda v=value: v)
+        return DatumExpression(lambda v=value: v)
+
+    for node in nodes:
+        try:
+            evaluate(node)
+        except Exception:
+            profiles.setdefault(node, Profile())
+    return {n: profiles.get(n, Profile()) for n in nodes}
+
+
+def _estimate_bytes(value) -> int:
+    from keystone_tpu.data import Dataset
+
+    if isinstance(value, Dataset):
+        if value.is_host:
+            return sum(getattr(np.asarray(x), "nbytes", 64) for x in value.data[:16]) * max(
+                len(value.data) // 16, 1
+            )
+        return sum(leaf.size * leaf.dtype.itemsize for leaf in jax.tree_util.tree_leaves(value.data))
+    return 64
+
+
+class AutoCacheRule(Rule):
+    """Insert Cacher nodes per the configured strategy."""
+
+    def __init__(self, strategy=None):
+        self.strategy = strategy or GreedyCache()
+
+    def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        candidates = _dataset_nodes(plan)
+        if not candidates:
+            return plan, prefixes
+
+        if isinstance(self.strategy, AggressiveCache):
+            to_cache = self._aggressive(plan, candidates)
+        else:
+            to_cache = self._greedy(plan, candidates, self.strategy)
+
+        return _insert_cachers(plan, to_cache), prefixes
+
+    def _aggressive(self, plan: Graph, candidates: Set[NodeId]) -> Set[NodeId]:
+        """Cache every dataset node with >1 weighted direct successor access."""
+        out = set()
+        for node in candidates:
+            accesses = 0
+            for child in analysis.get_children(plan, node):
+                if isinstance(child, NodeId):
+                    accesses += node_weight(plan.get_operator(child))
+                else:
+                    accesses += 1
+            if accesses > 1:
+                out.add(node)
+        return out
+
+    def _greedy(
+        self, plan: Graph, candidates: Set[NodeId], strategy: GreedyCache
+    ) -> Set[NodeId]:
+        profiles = profile_nodes(plan, candidates, strategy.samples_per_shard)
+        max_mem = strategy.max_mem_bytes
+        if max_mem is None:
+            max_mem = _default_mem_budget()
+
+        def total_cost(cached: Set[NodeId]) -> float:
+            runs = compute_runs(plan, cached)
+            return sum(runs[n] * profiles[n].ns for n in candidates)
+
+        def mem_used(cached: Set[NodeId]) -> int:
+            return sum(profiles[n].mem_bytes for n in cached)
+
+        cached: Set[NodeId] = set()
+        cur_cost = total_cost(cached)
+        improved = True
+        while improved:
+            improved = False
+            best_node, best_cost = None, cur_cost
+            for node in candidates - cached:
+                if mem_used(cached | {node}) > max_mem:
+                    continue
+                cost = total_cost(cached | {node})
+                if cost < best_cost:
+                    best_cost, best_node = cost, node
+            if best_node is not None:
+                cached.add(best_node)
+                cur_cost = best_cost
+                improved = True
+        return cached
+
+
+def _default_mem_budget() -> int:
+    """75% of per-device memory (AutoCacheRule's default of 75% of free cluster mem)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit * 0.75)
+    except Exception:
+        pass
+    return 8 << 30

@@ -1,0 +1,46 @@
+"""Test fixtures: force an 8-device CPU platform so distributed solvers run on
+real XLA collectives without TPU hardware — the analog of the reference's
+"Spark local mode" fixture (reference:
+src/test/scala/keystoneml/workflow/PipelineContext.scala:9-42).
+"""
+
+import os
+
+# Must be set before jax import.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest
+
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.workflow import PipelineEnv
+
+
+@pytest.fixture(autouse=True)
+def clean_pipeline_env():
+    """Reset global prefix state + optimizer around every test."""
+    PipelineEnv.get_or_create().reset()
+    mesh_lib.set_default_mesh(None)
+    yield
+    PipelineEnv.get_or_create().reset()
+    mesh_lib.set_default_mesh(None)
+
+
+@pytest.fixture
+def mesh8():
+    """An 8-device 1-D data mesh."""
+    return mesh_lib.make_mesh()
+
+
+@pytest.fixture
+def mesh4x2():
+    """A 4×2 data×model mesh."""
+    return mesh_lib.make_mesh((4, 2), (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))

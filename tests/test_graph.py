@@ -1,0 +1,195 @@
+"""Structural tests of Graph surgery ops (contract from reference GraphSuite.scala:41-711)."""
+
+import pytest
+
+from keystone_tpu.workflow import (
+    Graph,
+    GraphError,
+    NodeId,
+    SinkId,
+    SourceId,
+)
+from keystone_tpu.workflow import analysis
+from keystone_tpu.workflow.operators import DatumOperator
+
+
+def op(tag):
+    return DatumOperator(tag)
+
+
+def build_chain():
+    """source -> n1 -> n2 -> sink"""
+    g = Graph(sources=frozenset({SourceId(1)}))
+    g, n1 = g.add_node(op("a"), [SourceId(1)])
+    g, n2 = g.add_node(op("b"), [n1])
+    g, sink = g.add_sink(n2)
+    return g, n1, n2, sink
+
+
+class TestAddNode:
+    def test_adds_with_fresh_id(self):
+        g, n1, n2, _ = build_chain()
+        g2, n3 = g.add_node(op("c"), [n2])
+        assert n3 not in g.nodes
+        assert n3 in g2.nodes
+        assert g2.get_dependencies(n3) == (n2,)
+
+    def test_requires_existing_deps(self):
+        g, *_ = build_chain()
+        with pytest.raises(GraphError):
+            g.add_node(op("c"), [NodeId(999)])
+
+    def test_zero_dep_node(self):
+        g, *_ = build_chain()
+        g2, n = g.add_node(op("c"), [])
+        assert g2.get_dependencies(n) == ()
+
+
+class TestSinksAndSources:
+    def test_add_sink(self):
+        g, n1, _, _ = build_chain()
+        g2, s = g.add_sink(n1)
+        assert g2.get_sink_dependency(s) == n1
+
+    def test_add_sink_requires_existing(self):
+        g, *_ = build_chain()
+        with pytest.raises(GraphError):
+            g.add_sink(NodeId(999))
+
+    def test_add_source(self):
+        g, *_ = build_chain()
+        g2, s = g.add_source()
+        assert s in g2.sources
+        assert s not in g.sources
+
+    def test_remove_sink(self):
+        g, _, _, sink = build_chain()
+        g2 = g.remove_sink(sink)
+        assert sink not in g2.sinks
+        with pytest.raises(GraphError):
+            g2.remove_sink(sink)
+
+    def test_remove_node_requires_exists(self):
+        g, n1, _, _ = build_chain()
+        g2 = g.remove_node(n1)
+        with pytest.raises(GraphError):
+            g2.remove_node(n1)
+
+
+class TestSetters:
+    def test_set_dependencies(self):
+        g, n1, n2, _ = build_chain()
+        g2 = g.set_dependencies(n2, [SourceId(1)])
+        assert g2.get_dependencies(n2) == (SourceId(1),)
+
+    def test_set_dependencies_checks_ids(self):
+        g, n1, n2, _ = build_chain()
+        with pytest.raises(GraphError):
+            g.set_dependencies(n2, [NodeId(999)])
+        with pytest.raises(GraphError):
+            g.set_dependencies(NodeId(999), [n1])
+
+    def test_set_operator(self):
+        g, n1, _, _ = build_chain()
+        new_op = op("z")
+        g2 = g.set_operator(n1, new_op)
+        assert g2.get_operator(n1) is new_op
+
+    def test_replace_dependency(self):
+        g, n1, n2, sink = build_chain()
+        g2 = g.replace_dependency(n2, n1)
+        assert g2.get_sink_dependency(sink) == n1
+
+
+class TestAddGraph:
+    def test_remaps_ids(self):
+        g1, *_ = build_chain()
+        g2, *_ = build_chain()
+        combined, src_map, node_map, sink_map = g1.add_graph(g2)
+        assert len(combined.nodes) == 4
+        assert len(combined.sources) == 2
+        assert len(combined.sinks) == 2
+        # No id collisions between original and remapped.
+        assert set(node_map.values()).isdisjoint(g1.nodes)
+        # Structure preserved under remap
+        for old, new in node_map.items():
+            old_deps = g2.get_dependencies(old)
+            new_deps = combined.get_dependencies(new)
+            assert len(old_deps) == len(new_deps)
+
+
+class TestConnectGraph:
+    def test_splices_sink_to_source(self):
+        g1, _, n2, sink1 = build_chain()
+        g2, *_ = build_chain()
+        combined, src_map, node_map, sink_map = g1.connect_graph(
+            g2, {SourceId(1): sink1}
+        )
+        # Spliced source and sink gone:
+        assert sink1 not in combined.sinks
+        assert len(combined.sources) == 1
+        # The first node of g2 now depends on n2 (sink1's dep):
+        remapped_first = node_map[NodeId(1)]
+        assert combined.get_dependencies(remapped_first) == (n2,)
+        assert SourceId(1) not in src_map  # spliced sources removed from mapping
+
+    def test_requires_valid_splice(self):
+        g1, *_ = build_chain()
+        g2, *_ = build_chain()
+        with pytest.raises(GraphError):
+            g1.connect_graph(g2, {SourceId(42): SinkId(1)})
+
+
+class TestReplaceNodes:
+    def test_swap_middle_node(self):
+        g, n1, n2, sink = build_chain()
+        # Replacement: source -> r1 -> sink
+        rep = Graph(sources=frozenset({SourceId(1)}))
+        rep, r1 = rep.add_node(op("r"), [SourceId(1)])
+        rep, rsink = rep.add_sink(r1)
+
+        out = g.replace_nodes(
+            nodes_to_remove={n2},
+            replacement=rep,
+            replacement_source_splice={SourceId(1): n1},
+            replacement_sink_splice={n2: rsink},
+        )
+        assert len(out.nodes) == 2
+        # The sink now tracks through the replacement node, which feeds off n1.
+        new_node = next(n for n in out.nodes if n != n1)
+        assert out.get_operator(new_node).datum == "r"
+        assert out.get_sink_dependency(sink) == new_node
+        assert out.get_dependencies(new_node) == (n1,)
+
+    def test_rejects_incomplete_splice(self):
+        g, n1, n2, sink = build_chain()
+        rep = Graph(sources=frozenset({SourceId(1)}))
+        rep, r1 = rep.add_node(op("r"), [SourceId(1)])
+        rep, rsink = rep.add_sink(r1)
+        with pytest.raises(GraphError):
+            g.replace_nodes({n2}, rep, {}, {n2: rsink})
+
+
+class TestAnalysis:
+    def test_parents_children(self):
+        g, n1, n2, sink = build_chain()
+        assert analysis.get_parents(g, n2) == {n1}
+        assert analysis.get_children(g, n1) == {n2}
+        assert analysis.get_children(g, n2) == {sink}
+        assert analysis.get_parents(g, SourceId(1)) == set()
+
+    def test_ancestors_descendants(self):
+        g, n1, n2, sink = build_chain()
+        assert analysis.get_ancestors(g, sink) == {SourceId(1), n1, n2}
+        assert analysis.get_descendants(g, SourceId(1)) == {n1, n2, sink}
+
+    def test_linearize_is_topological(self):
+        g, n1, n2, sink = build_chain()
+        order = analysis.linearize(g, sink)
+        assert order.index(n1) < order.index(n2) < order.index(sink)
+
+    def test_dot_export(self):
+        g, *_ = build_chain()
+        dot = g.to_dot()
+        assert dot.startswith("digraph pipeline")
+        assert "Source_1" in dot
