@@ -1,0 +1,104 @@
+"""Benchmark: TIMIT-shaped CosineRandomFeatures -> BlockLeastSquares.
+
+The reference's headline number (BASELINE.md, scripts/solver-comparisons-final.csv:26):
+TIMIT d=16384 block least squares on a 16-node r3.4xlarge Spark cluster:
+580,555 ms at n=2.2e6 rows (440 input dims, 147 classes, blockSize 1024-4096).
+
+This bench runs the same computation shape on the available TPU (single chip
+under the driver) at a row count that fits in HBM, and compares against the
+baseline wall-clock scaled linearly by row count (the solver's cost is linear
+in n: per-block Gramian + correlation + residual GEMMs).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": <seconds>, "unit": "s", "vs_baseline": <speedup x>}
+vs_baseline > 1 means faster than the (n-scaled) 16-node Spark cluster.
+"""
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# TIMIT shapes (BASELINE.md; reference: TimitFeaturesDataLoader.scala:16-70)
+TIMIT_INPUT_DIMS = 440
+TIMIT_NUM_CLASSES = 147
+BASELINE_N = 2_200_000
+BASELINE_MS = 580_555.0  # scripts/solver-comparisons-final.csv:26 (d=16384, Block)
+NUM_FEATURES = 16384
+BLOCK_SIZE = 4096  # reference TimitPipeline blockSize (TimitPipeline.scala:37-109)
+NUM_EPOCHS = 1
+
+
+def main():
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    n = int(131072 * scale)
+    dtype = jnp.float32
+
+    rng = np.random.default_rng(0)
+    X_np = rng.normal(size=(n, TIMIT_INPUT_DIMS)).astype(np.float32)
+    y_np = rng.integers(0, TIMIT_NUM_CLASSES, size=n)
+
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.parallel import linalg
+
+    X = jnp.asarray(X_np, dtype=dtype)
+    Y = 2.0 * jax.nn.one_hot(y_np, TIMIT_NUM_CLASSES, dtype=dtype) - 1.0
+
+    # One CosineRandomFeatures branch per feature block, mirroring the
+    # reference TimitPipeline's gather of numCosines branches
+    # (TimitPipeline.scala:37-109). Features are generated per block so the
+    # full (n, 16384) matrix is the only large resident buffer.
+    num_blocks = NUM_FEATURES // BLOCK_SIZE
+    rfs = [
+        CosineRandomFeatures(TIMIT_INPUT_DIMS, BLOCK_SIZE, gamma=0.05, seed=i)
+        for i in range(num_blocks)
+    ]
+
+    @jax.jit
+    def featurize_block(X, W, b):
+        return jnp.cos(X @ W.T.astype(dtype) + b.astype(dtype))
+
+    def run_once():
+        blocks = [featurize_block(X, rf.W, rf.b) for rf in rfs]
+        Ws = linalg.bcd_least_squares(blocks, Y, lam=1e-4, num_iter=NUM_EPOCHS)
+        # Force execution end-to-end: on the tunneled TPU backend,
+        # block_until_ready is not a reliable barrier — a host transfer is.
+        checksum = float(sum(jnp.sum(jnp.abs(W)) for W in Ws))
+        assert np.isfinite(checksum) and checksum > 0, f"bad solve: {checksum}"
+        return Ws
+
+    run_once()  # warmup (compile)
+    t0 = time.perf_counter()
+    run_once()  # timed: featurization + solve (the pipeline's compute body)
+    elapsed = time.perf_counter() - t0
+
+    baseline_scaled_s = (BASELINE_MS / 1000.0) * (n / BASELINE_N)
+    speedup = baseline_scaled_s / elapsed
+
+    print(
+        json.dumps(
+            {
+                "metric": "timit_cosine_blockls_d16384_wallclock",
+                "value": round(elapsed, 3),
+                "unit": "s",
+                "vs_baseline": round(speedup, 2),
+                "detail": {
+                    "n": n,
+                    "d": NUM_FEATURES,
+                    "k": TIMIT_NUM_CLASSES,
+                    "block_size": BLOCK_SIZE,
+                    "epochs": NUM_EPOCHS,
+                    "baseline": "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), n-scaled",
+                    "baseline_scaled_s": round(baseline_scaled_s, 3),
+                    "device": str(jax.devices()[0]),
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,141 @@
+"""Data loaders (reference: loaders/ — CsvDataLoader.scala, CifarLoader.scala,
+TimitFeaturesDataLoader.scala, NewsgroupsDataLoader.scala, ...).
+
+Loaders read host-side (files → numpy) and produce Datasets; placement onto
+the device mesh happens via ``Dataset.shard``. Synthetic generators stand in
+for each workload's data so pipelines and benchmarks run hermetically.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import tarfile
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .dataset import Dataset, LabeledData
+
+
+def csv_data_loader(path: str) -> Dataset:
+    """CSV of comma-separated numbers -> Dataset of rows
+    (reference: loaders/CsvDataLoader.scala:10-31)."""
+    rows = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    return Dataset.of(rows)
+
+
+def load_labeled_csv(path: str, label_offset: int = 0) -> LabeledData:
+    """CSV rows of [label, features...] -> LabeledData.
+
+    label_offset shifts labels (the MNIST files are 1-indexed; the pipelines
+    subtract 1, reference: pipelines/images/mnist/MnistRandomFFT.scala:34-37).
+    """
+    rows = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    labels = rows[:, 0].astype(np.int64) + label_offset
+    return LabeledData(rows[:, 1:], labels)
+
+
+CIFAR_LABEL_SIZE = 1
+CIFAR_IMAGE_BYTES = 3072  # 32*32*3
+CIFAR_RECORD_BYTES = CIFAR_LABEL_SIZE + CIFAR_IMAGE_BYTES
+
+
+def load_cifar_binary(path: str) -> LabeledData:
+    """CIFAR-10 binary format: 3073-byte records of [label, 3072 pixel bytes]
+    (reference: loaders/CifarLoader.scala:14-53). Images come out as
+    (n, 32, 32, 3) float64 in [0, 255]."""
+    raw = np.fromfile(path, dtype=np.uint8)
+    if raw.size % CIFAR_RECORD_BYTES != 0:
+        raise ValueError(f"{path}: not a multiple of {CIFAR_RECORD_BYTES} bytes")
+    records = raw.reshape(-1, CIFAR_RECORD_BYTES)
+    labels = records[:, 0].astype(np.int64)
+    # CIFAR stores channel-planar (RGB planes); convert to HWC.
+    images = (
+        records[:, 1:]
+        .reshape(-1, 3, 32, 32)
+        .transpose(0, 2, 3, 1)
+        .astype(np.float64)
+    )
+    return LabeledData(images, labels)
+
+
+class TimitFeaturesDataLoader:
+    """TIMIT: CSV feature frames (440 dims) + sparse label files, 147 classes
+    (reference: loaders/TimitFeaturesDataLoader.scala:16-70)."""
+
+    num_classes = 147
+    num_features = 440
+
+    def __init__(self, feature_path: str, label_path: str):
+        feats = np.loadtxt(feature_path, delimiter=",", dtype=np.float64, ndmin=2)
+        labels = self._parse_sparse_labels(label_path, feats.shape[0])
+        self.labeled = LabeledData(feats, labels)
+
+    @staticmethod
+    def _parse_sparse_labels(path: str, n: int) -> np.ndarray:
+        """Label file lines: ``row_index label`` (sparse row labels)."""
+        labels = np.zeros(n, dtype=np.int64)
+        with open(path) as f:
+            for line in f:
+                parts = line.replace(",", " ").split()
+                if len(parts) >= 2:
+                    labels[int(parts[0])] = int(parts[1])
+        return labels
+
+
+def load_newsgroups(path: str, class_dirs: Optional[List[str]] = None) -> LabeledData:
+    """20-newsgroups layout: one directory per class of text files
+    (reference: loaders/NewsgroupsDataLoader.scala:9-57)."""
+    class_dirs = class_dirs or sorted(
+        d for d in os.listdir(path) if os.path.isdir(os.path.join(path, d))
+    )
+    texts, labels = [], []
+    for label, cls in enumerate(class_dirs):
+        cls_path = os.path.join(path, cls)
+        for fname in sorted(os.listdir(cls_path)):
+            with open(os.path.join(cls_path, fname), errors="replace") as f:
+                texts.append(f.read())
+            labels.append(label)
+    return LabeledData(Dataset(texts), Dataset.of(np.asarray(labels)))
+
+
+# ---------------------------------------------------------------------------
+# Synthetic data (hermetic stand-ins for the reference workloads)
+# ---------------------------------------------------------------------------
+
+
+def synthetic_classification(
+    n: int,
+    d: int,
+    num_classes: int,
+    seed: int = 0,
+    class_sep: float = 1.0,
+    means_seed: int = 1234,
+) -> LabeledData:
+    """Gaussian blobs: one mean per class, unit covariance.
+
+    The class means are drawn from ``means_seed`` (fixed across train/test
+    splits); ``seed`` only drives the sampling, so different seeds give i.i.d.
+    draws from the *same* distribution.
+    """
+    means = np.random.default_rng(means_seed).normal(
+        scale=class_sep, size=(num_classes, d)
+    )
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    X = means[labels] + rng.normal(size=(n, d))
+    return LabeledData(X, labels.astype(np.int64))
+
+
+def synthetic_mnist(n: int = 4096, seed: int = 0) -> LabeledData:
+    """MNIST-shaped synthetic data: 784-dim, 10 classes."""
+    return synthetic_classification(n, 784, 10, seed=seed, class_sep=0.5)
+
+
+def synthetic_timit(n: int = 8192, seed: int = 0) -> LabeledData:
+    """TIMIT-shaped synthetic data: 440-dim frames, 147 classes."""
+    return synthetic_classification(
+        n, TimitFeaturesDataLoader.num_features, TimitFeaturesDataLoader.num_classes,
+        seed=seed, class_sep=0.6,
+    )

@@ -1,0 +1,9 @@
+"""Evaluation: metrics and evaluators for pipeline outputs."""
+
+from .metrics import (
+    BinaryClassificationMetrics,
+    BinaryClassifierEvaluator,
+    Evaluator,
+    MulticlassClassifierEvaluator,
+    MulticlassMetrics,
+)

@@ -1,0 +1,197 @@
+"""Evaluators (reference: evaluation/ — Evaluator.scala:19-35,
+MulticlassClassifierEvaluator.scala:23-161, BinaryClassifierEvaluator.scala:17-79).
+
+Confusion-matrix accumulation is a single device pass (scatter-add over the
+sharded batch), the analog of the reference's one-pass ``aggregate``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Generic, TypeVar
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow import PipelineDataset
+
+P = TypeVar("P")
+L = TypeVar("L")
+E = TypeVar("E")
+
+
+def _as_dataset(x) -> Dataset:
+    if isinstance(x, PipelineDataset):
+        return x.get()
+    return Dataset.of(x)
+
+
+class Evaluator(Generic[P, L, E]):
+    """Computes a metric of predictions vs labels (Evaluator.scala:19-35)."""
+
+    def evaluate(self, predictions: Any, labels: Any) -> E:
+        return self._evaluate(_as_dataset(predictions), _as_dataset(labels))
+
+    def _evaluate(self, predictions: Dataset, labels: Dataset) -> E:
+        raise NotImplementedError
+
+
+class MulticlassMetrics:
+    """Derived metrics over a confusion matrix
+    (reference: MulticlassClassifierEvaluator.scala:44-161).
+
+    confusion[i, j] = count of items with true class i predicted as class j.
+    """
+
+    def __init__(self, confusion: np.ndarray):
+        self.confusion = np.asarray(confusion, dtype=np.float64)
+        self.num_classes = self.confusion.shape[0]
+        self.total = self.confusion.sum()
+
+    # -- per-class --
+
+    def class_precision(self, c: int) -> float:
+        denom = self.confusion[:, c].sum()
+        return float(self.confusion[c, c] / denom) if denom > 0 else 0.0
+
+    def class_recall(self, c: int) -> float:
+        denom = self.confusion[c, :].sum()
+        return float(self.confusion[c, c] / denom) if denom > 0 else 0.0
+
+    def class_f1(self, c: int) -> float:
+        p, r = self.class_precision(c), self.class_recall(c)
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+    # -- aggregate --
+
+    @property
+    def accuracy(self) -> float:
+        return float(np.trace(self.confusion) / self.total) if self.total > 0 else 0.0
+
+    @property
+    def total_error(self) -> float:
+        return 1.0 - self.accuracy
+
+    @property
+    def macro_precision(self) -> float:
+        return float(np.mean([self.class_precision(c) for c in range(self.num_classes)]))
+
+    @property
+    def macro_recall(self) -> float:
+        return float(np.mean([self.class_recall(c) for c in range(self.num_classes)]))
+
+    @property
+    def macro_f1(self) -> float:
+        return float(np.mean([self.class_f1(c) for c in range(self.num_classes)]))
+
+    @property
+    def micro_precision(self) -> float:
+        # Micro P == micro R == accuracy for single-label multiclass.
+        return self.accuracy
+
+    micro_recall = micro_precision
+
+    @property
+    def micro_f1(self) -> float:
+        return self.accuracy
+
+    def summary(self, class_names=None) -> str:
+        """Mahout-style pretty print (MulticlassClassifierEvaluator.scala:85-105)."""
+        names = class_names or [str(i) for i in range(self.num_classes)]
+        lines = [
+            "=" * 48,
+            "Summary Statistics",
+            "-" * 48,
+            f"Accuracy          {self.accuracy:.4f}",
+            f"Total Error       {self.total_error:.4f}",
+            f"Macro Precision   {self.macro_precision:.4f}",
+            f"Macro Recall      {self.macro_recall:.4f}",
+            f"Macro F1          {self.macro_f1:.4f}",
+            "-" * 48,
+            "Per-class (precision / recall / f1):",
+        ]
+        for c in range(self.num_classes):
+            lines.append(
+                f"  {names[c]:>8}: {self.class_precision(c):.4f} / "
+                f"{self.class_recall(c):.4f} / {self.class_f1(c):.4f}"
+            )
+        lines.append("=" * 48)
+        return "\n".join(lines)
+
+    def __repr__(self) -> str:
+        return f"MulticlassMetrics(accuracy={self.accuracy:.4f}, n={int(self.total)})"
+
+
+class MulticlassClassifierEvaluator(Evaluator):
+    """Single-pass confusion matrix from predicted/true int labels."""
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def _evaluate(self, predictions: Dataset, labels: Dataset) -> MulticlassMetrics:
+        preds = jnp.asarray(predictions.array).reshape(-1).astype(jnp.int32)
+        labs = jnp.asarray(labels.array).reshape(-1).astype(jnp.int32)
+        npad = preds.shape[0]
+        if labs.shape[0] != npad:
+            # Align physical shapes (padding may differ between the two).
+            preds = preds[: predictions.n]
+            labs = labs[: labels.n]
+            mask = jnp.ones_like(preds, dtype=jnp.int32)
+        else:
+            mask = (jnp.arange(npad) < predictions.n).astype(jnp.int32)
+        conf = jnp.zeros((self.num_classes, self.num_classes), dtype=jnp.int32)
+        conf = conf.at[labs, preds].add(mask)
+        return MulticlassMetrics(np.asarray(conf))
+
+
+@dataclass
+class BinaryClassificationMetrics:
+    """Contingency counts (reference: BinaryClassifierEvaluator.scala:17-79)."""
+
+    tp: float
+    fp: float
+    tn: float
+    fn: float
+
+    @property
+    def accuracy(self) -> float:
+        total = self.tp + self.fp + self.tn + self.fn
+        return (self.tp + self.tn) / total if total > 0 else 0.0
+
+    @property
+    def error(self) -> float:
+        return 1.0 - self.accuracy
+
+    @property
+    def precision(self) -> float:
+        denom = self.tp + self.fp
+        return self.tp / denom if denom > 0 else 0.0
+
+    @property
+    def recall(self) -> float:
+        denom = self.tp + self.fn
+        return self.tp / denom if denom > 0 else 0.0
+
+    @property
+    def specificity(self) -> float:
+        denom = self.tn + self.fp
+        return self.tn / denom if denom > 0 else 0.0
+
+    @property
+    def f1(self) -> float:
+        p, r = self.precision, self.recall
+        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+
+
+class BinaryClassifierEvaluator(Evaluator):
+    """Predictions/labels are booleans (or {0,1} ints)."""
+
+    def _evaluate(self, predictions: Dataset, labels: Dataset) -> BinaryClassificationMetrics:
+        preds = jnp.asarray(predictions.array).reshape(-1).astype(bool)[: predictions.n]
+        labs = jnp.asarray(labels.array).reshape(-1).astype(bool)[: labels.n]
+        tp = float(jnp.sum(preds & labs))
+        fp = float(jnp.sum(preds & ~labs))
+        tn = float(jnp.sum(~preds & ~labs))
+        fn = float(jnp.sum(~preds & labs))
+        return BinaryClassificationMetrics(tp, fp, tn, fn)

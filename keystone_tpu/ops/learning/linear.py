@@ -1,0 +1,108 @@
+"""Dense linear models and exact least-squares estimators.
+
+Reference: nodes/learning/LinearMapper.scala (apply + NormalEquations solve),
+nodes/learning/LocalLeastSquaresEstimator.scala (collect-and-solve).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.stats import StandardScaler, StandardScalerModel
+from keystone_tpu.parallel import linalg
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+
+class LinearMapper(Transformer):
+    """x -> xᵀX + b, with optional feature scaling
+    (reference: LinearMapper.scala:45-62)."""
+
+    def __init__(self, x, b_opt=None, feature_scaler: Optional[StandardScalerModel] = None):
+        self.x = jnp.asarray(x)
+        self.b_opt = None if b_opt is None else jnp.asarray(b_opt)
+        self.feature_scaler = feature_scaler
+
+    def apply(self, v):
+        v = jnp.asarray(v)
+        if self.feature_scaler is not None:
+            v = self.feature_scaler.apply(v)
+        out = v @ self.x
+        if self.b_opt is not None:
+            out = out + self.b_opt
+        return out
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self.apply)
+
+
+class LinearMapEstimator(LabelEstimator):
+    """Exact OLS/ridge via distributed normal equations
+    (reference: LinearMapper.scala:64-98): mean-center features and labels,
+    solve (AᵀA + λI) X = AᵀB, keep the label mean as intercept."""
+
+    def __init__(self, lam: Optional[float] = None):
+        self.lam = lam
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        feature_scaler = StandardScaler(normalize_std_dev=False).fit(data)
+        label_scaler = StandardScaler(normalize_std_dev=False).fit(labels)
+
+        A = jnp.asarray(feature_scaler.batch_apply(data).array)
+        B = jnp.asarray(label_scaler.batch_apply(labels).array)
+
+        x = linalg.normal_equations_solve(A, B, self.lam or 0.0)
+        return LinearMapper(x, b_opt=label_scaler.mean, feature_scaler=feature_scaler)
+
+    def cost(
+        self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight
+    ) -> float:
+        """Analytic cost model (LinearMapper.scala:100-115)."""
+        flops = n * d * (d + k) / num_machines
+        bytes_scanned = n * d / num_machines + d * d
+        network = d * (d + k)
+        return max(cpu_weight * flops, mem_weight * bytes_scanned) + network_weight * network
+
+    @staticmethod
+    def compute_cost(data: Dataset, labels: Dataset, lam: float, x, b_opt=None) -> float:
+        """Ridge loss ||Ax+b - y||²/(2n) + λ/2 ||x||²
+        (reference: LinearMapper.scala:124-160)."""
+        X = jnp.asarray(data.array)
+        Y = jnp.asarray(labels.array)
+        preds = X @ jnp.asarray(x)
+        if b_opt is not None:
+            preds = preds + jnp.asarray(b_opt)
+        # Padding rows are zero in X and Y; (0@x + b) - 0 would pollute the sum,
+        # so mask to real rows.
+        mask = data.valid_mask().astype(preds.dtype)[:, None]
+        cost = jnp.sum(((preds - Y) * mask) ** 2) / (2.0 * data.n)
+        if lam != 0:
+            cost = cost + lam / 2.0 * jnp.sum(jnp.asarray(x) ** 2)
+        return float(cost)
+
+
+class LocalLeastSquaresEstimator(LabelEstimator):
+    """Collect-to-host exact least squares via LAPACK lstsq
+    (reference: LocalLeastSquaresEstimator.scala:16-61)."""
+
+    def __init__(self, lam: float = 0.0):
+        self.lam = lam
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        A = np.asarray(data.to_numpy(), dtype=np.float64)
+        B = np.asarray(labels.to_numpy(), dtype=np.float64)
+        a_mean = A.mean(axis=0)
+        b_mean = B.mean(axis=0)
+        A = A - a_mean
+        B = B - b_mean
+        if self.lam > 0:
+            d = A.shape[1]
+            A = np.vstack([A, np.sqrt(self.lam) * np.eye(d)])
+            B = np.vstack([B, np.zeros((d, B.shape[1]))])
+        x, *_ = np.linalg.lstsq(A, B, rcond=None)
+        return LinearMapper(
+            x, b_opt=b_mean, feature_scaler=StandardScalerModel(a_mean)
+        )

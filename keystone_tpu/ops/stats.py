@@ -1,0 +1,252 @@
+"""Statistical featurization nodes (reference: nodes/stats/).
+
+All dense nodes operate whole-batch on (n, d) arrays so XLA fuses the
+elementwise work into surrounding GEMMs; per-item ``apply`` handles single
+datums. Randomized nodes take explicit integer seeds (JAX PRNG keys derive
+from them), replacing the reference's implicit global RNG draws.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+# ---------------------------------------------------------------------------
+# StandardScaler
+# ---------------------------------------------------------------------------
+
+
+class StandardScalerModel(Transformer):
+    """Subtract column means (and optionally divide by stds)
+    (reference: nodes/stats/StandardScaler.scala:16-32)."""
+
+    def __init__(self, mean, std=None):
+        self.mean = jnp.asarray(mean)
+        self.std = None if std is None else jnp.asarray(std)
+
+    def apply(self, x):
+        out = jnp.asarray(x) - self.mean
+        if self.std is not None:
+            out = out / self.std
+        return out
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self.apply)
+
+
+class StandardScaler(Estimator):
+    """Column mean/std via a single sharded pass — sums compile to per-shard
+    reductions + all-reduce, replacing treeAggregate(MultivariateOnlineSummarizer)
+    (reference: nodes/stats/StandardScaler.scala:37-60)."""
+
+    def __init__(self, normalize_std_dev: bool = True, eps: float = 1e-12):
+        self.normalize_std_dev = normalize_std_dev
+        self.eps = eps
+
+    def fit(self, data: Dataset) -> StandardScalerModel:
+        X = jnp.asarray(data.array)
+        n = data.n
+        # Padding rows are zero: sums are exact; divide by the true count.
+        total = jnp.sum(X, axis=0)
+        mean = total / n
+        if not self.normalize_std_dev:
+            return StandardScalerModel(mean)
+        # Sample variance with the zero-padding correction:
+        # sum((x - mean)^2) over real rows = sum(x^2) - n*mean^2.
+        sumsq = jnp.sum(X * X, axis=0)
+        var = (sumsq - n * mean * mean) / max(n - 1, 1)
+        std = jnp.sqrt(jnp.maximum(var, 0.0))
+        std = jnp.where(
+            jnp.isnan(std) | jnp.isinf(std) | (jnp.abs(std) < self.eps), 1.0, std
+        )
+        return StandardScalerModel(mean, std)
+
+
+# ---------------------------------------------------------------------------
+# Random features
+# ---------------------------------------------------------------------------
+
+
+class CosineRandomFeaturesModel(Transformer):
+    """x -> cos(x Wᵀ + b): Rahimi-Recht random features
+    (reference: nodes/stats/CosineRandomFeatures.scala:19-45).
+
+    The (num_out, num_in) projection is a single batch GEMM — the per-partition
+    broadcast-W GEMM of the reference becomes one MXU matmul over the sharded
+    batch with W replicated.
+    """
+
+    def __init__(self, W, b):
+        self.W = jnp.asarray(W)
+        self.b = jnp.asarray(b)
+        if self.b.shape[0] != self.W.shape[0]:
+            raise ValueError("# of rows in W and size of b should match")
+
+    def apply(self, x):
+        return jnp.cos(jnp.asarray(x) @ self.W.T + self.b)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(lambda X: jnp.cos(X @ self.W.T + self.b))._rezero_padding()
+
+
+def CosineRandomFeatures(
+    num_input_features: int,
+    num_output_features: int,
+    gamma: float,
+    seed: int = 0,
+    cauchy: bool = False,
+) -> CosineRandomFeaturesModel:
+    """Draw W ~ gaussian(·γ) (or cauchy(·γ)), b ~ U[0, 2π]
+    (reference: CosineRandomFeatures.scala:50-61)."""
+    kw, kb = jax.random.split(jax.random.key(seed))
+    if cauchy:
+        W = jax.random.cauchy(kw, (num_output_features, num_input_features)) * gamma
+    else:
+        W = jax.random.normal(kw, (num_output_features, num_input_features)) * gamma
+    b = jax.random.uniform(kb, (num_output_features,)) * (2 * jnp.pi)
+    return CosineRandomFeaturesModel(W, b)
+
+
+@dataclass(frozen=True)
+class PaddedFFT(Transformer):
+    """Zero-pad to the next power of two, FFT, keep the real parts of the first
+    half (reference: nodes/stats/PaddedFFT.scala:13-21)."""
+
+    def _padded_size(self, n: int) -> int:
+        return 1 << max(int(n - 1).bit_length(), 1)
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        p = self._padded_size(x.shape[-1])
+        padded = jnp.pad(x, [(0, p - x.shape[-1])])
+        return jnp.real(jnp.fft.fft(padded))[: p // 2]
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        def f(X):
+            p = self._padded_size(X.shape[-1])
+            padded = jnp.pad(X, [(0, 0), (0, p - X.shape[-1])])
+            return jnp.real(jnp.fft.fft(padded, axis=-1))[:, : p // 2]
+
+        return data.map_batch(f)
+
+
+class RandomSignNode(Transformer):
+    """Elementwise multiply by a fixed random ±1 vector
+    (reference: nodes/stats/RandomSignNode.scala:11-24)."""
+
+    def __init__(self, signs):
+        self.signs = jnp.asarray(signs)
+
+    @staticmethod
+    def create(num_features: int, seed: int = 0) -> "RandomSignNode":
+        signs = jax.random.rademacher(
+            jax.random.key(seed), (num_features,), dtype=jnp.float32
+        )
+        return RandomSignNode(signs)
+
+    def apply(self, x):
+        return jnp.asarray(x) * self.signs
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(lambda X: X * self.signs)
+
+
+# ---------------------------------------------------------------------------
+# Elementwise stats nodes
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class LinearRectifier(Transformer):
+    """max(maxVal, x - alpha) (reference: nodes/stats/LinearRectifier.scala:12-17)."""
+
+    max_val: float = 0.0
+    alpha: float = 0.0
+
+    def apply(self, x):
+        return jnp.maximum(jnp.asarray(x) - self.alpha, self.max_val)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        out = data.map_batch(lambda X: jnp.maximum(X - self.alpha, self.max_val))
+        return out._rezero_padding() if (self.max_val != 0.0 or self.alpha != 0.0) else out
+
+
+@dataclass(frozen=True)
+class SignedHellingerMapper(Transformer):
+    """sign(x)·√|x| (reference: nodes/stats/SignedHellingerMapper.scala:11-22)."""
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(lambda X: jnp.sign(X) * jnp.sqrt(jnp.abs(X)))
+
+
+@dataclass(frozen=True)
+class NormalizeRows(Transformer):
+    """Divide by L2 norm, eps-floored (reference: nodes/stats/NormalizeRows.scala:10-14)."""
+
+    eps: float = 2.2e-16
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        norm = jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), self.eps)
+        return x / norm
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self.apply)
+
+
+@dataclass(frozen=True)
+class TermFrequency(Transformer):
+    """Seq of items -> {item: weighting(count)} (host-side;
+    reference: nodes/stats/TermFrequency.scala:18-20)."""
+
+    weighting: Callable = field(default=lambda x: x)
+
+    def apply(self, items):
+        counts = {}
+        for item in items:
+            counts[item] = counts.get(item, 0) + 1
+        return {k: self.weighting(v) for k, v in counts.items()}
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return Dataset.of([self.apply(x) for x in data.to_list()])
+
+
+class ColumnSampler(Transformer):
+    """Sample columns of per-item (d, cols) matrices
+    (reference: nodes/stats/Sampling.scala:12-25)."""
+
+    def __init__(self, num_samples: int, seed: int = 0):
+        self.num_samples = num_samples
+        self.seed = seed
+
+    def apply(self, x):
+        x = jnp.asarray(x)
+        idx = jax.random.randint(
+            jax.random.key(self.seed), (self.num_samples,), 0, x.shape[1]
+        )
+        return x[:, idx]
+
+
+def sample_dataset(data: Dataset, num_items: int, seed: int = 0) -> Dataset:
+    """Random row sample (the RDD.takeSample FunctionNode analog,
+    reference: nodes/stats/Sampling.scala:27-32)."""
+    k = min(num_items, data.n)
+    if data.is_host:
+        rng = np.random.default_rng(seed)
+        items = data.to_list()
+        idx = rng.choice(len(items), size=k, replace=False)
+        return Dataset.of([items[i] for i in idx])
+    idx = jax.random.choice(jax.random.key(seed), data.n, (k,), replace=False)
+    return Dataset(jnp.asarray(data.array)[: data.n][idx], n=k)

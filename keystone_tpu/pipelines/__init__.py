@@ -1,0 +1,1 @@
+"""Example end-to-end pipelines (reference: pipelines/ — the acceptance workloads)."""

@@ -1,0 +1,108 @@
+"""MnistRandomFFT: random-FFT featurization + block least squares on MNIST
+(reference: pipelines/images/mnist/MnistRandomFFT.scala:21-115).
+
+Composition: gather(numFFTs × [RandomSignNode → PaddedFFT → LinearRectifier])
+→ VectorCombiner → BlockLeastSquares(blockSize, 1, λ) → MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.data import Dataset, LabeledData
+from keystone_tpu.data.loaders import load_labeled_csv, synthetic_mnist
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.ops.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.mnist")
+
+NUM_CLASSES = 10
+MNIST_IMAGE_SIZE = 784
+
+
+@dataclass
+class MnistRandomFFTConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_ffts: int = 4
+    block_size: int = 2048
+    lam: Optional[float] = None
+    seed: int = 0
+    synthetic_n: int = 4096  # used when no train_location given
+
+
+def build_featurizer(config: MnistRandomFFTConfig) -> Pipeline:
+    branches = [
+        RandomSignNode.create(MNIST_IMAGE_SIZE, seed=config.seed + i)
+        .and_then(PaddedFFT())
+        .and_then(LinearRectifier(0.0))
+        for i in range(config.num_ffts)
+    ]
+    return Pipeline.gather(branches).and_then(VectorCombiner())
+
+
+def run(config: MnistRandomFFTConfig):
+    """Build, train, and evaluate; returns (pipeline, train_metrics, test_metrics)."""
+    start = time.time()
+    if config.train_location:
+        # File labels are 1-indexed (MnistRandomFFT.scala:34-37).
+        train = load_labeled_csv(config.train_location, label_offset=-1)
+        test = load_labeled_csv(config.test_location, label_offset=-1)
+    else:
+        train = synthetic_mnist(config.synthetic_n, seed=config.seed)
+        test = synthetic_mnist(max(config.synthetic_n // 4, 256), seed=config.seed + 1)
+
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+
+    featurizer = build_featurizer(config)
+    pipeline = featurizer.and_then(
+        BlockLeastSquaresEstimator(config.block_size, 1, config.lam or 0.0),
+        train.data,
+        labels,
+    ).and_then(MaxClassifier())
+
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    logger.info("TRAIN Error is %.2f%%", 100 * train_eval.total_error)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info("TEST Error is %.2f%%", 100 * test_eval.total_error)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("MnistRandomFFT")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--numFFTs", type=int, default=4)
+    parser.add_argument("--blockSize", type=int, default=2048)
+    parser.add_argument("--lambda", dest="lam", type=float, default=None)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = MnistRandomFFTConfig(
+        train_location=args.trainLocation,
+        test_location=args.testLocation,
+        num_ffts=args.numFFTs,
+        block_size=args.blockSize,
+        lam=args.lam,
+        seed=args.seed,
+    )
+    _, train_eval, test_eval = run(config)
+    print(f"TRAIN Error is {100 * train_eval.total_error:.2f}%")
+    print(f"TEST Error is {100 * test_eval.total_error:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

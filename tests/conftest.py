@@ -6,17 +6,26 @@ src/test/scala/keystoneml/workflow/PipelineContext.scala:9-42).
 
 import os
 
-# Must be set before jax import.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA flag must be set before jax initializes its CPU client.
 flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
+_we_set_count = "xla_force_host_platform_device_count" not in flags
+if _we_set_count:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
 import jax
 
+# NOTE: the JAX_PLATFORMS env var is overridden by the axon TPU plugin's site
+# customization; the config update below is the reliable way to pin CPU.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+if _we_set_count:
+    assert len(jax.devices()) == 8, (
+        f"expected 8 forced CPU devices, got {jax.devices()} — "
+        "the XLA flag was not picked up before jax client init"
+    )
 
 import pytest
 

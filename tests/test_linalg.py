@@ -1,0 +1,91 @@
+"""Distributed linalg parity vs closed forms on an 8-device CPU mesh
+(the analog of the reference's Spark-local-mode solver tests,
+e.g. BlockLinearMapperSuite.scala:18-56)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.parallel import linalg, mesh as mesh_lib
+
+
+@pytest.fixture
+def problem():
+    rng = np.random.default_rng(42)
+    A = rng.normal(size=(256, 24))
+    W_true = rng.normal(size=(24, 4))
+    B = A @ W_true + 0.01 * rng.normal(size=(256, 4))
+    return A, B
+
+
+def ridge_solution(A, B, lam):
+    d = A.shape[1]
+    return np.linalg.solve(A.T @ A + lam * np.eye(d), A.T @ B)
+
+
+class TestNormalEquations:
+    def test_unsharded(self, problem):
+        A, B = problem
+        W = np.asarray(linalg.normal_equations_solve(A, B, 0.1))
+        np.testing.assert_allclose(W, ridge_solution(A, B, 0.1), atol=1e-8)
+
+    def test_sharded_matches_unsharded(self, problem, mesh8):
+        A, B = problem
+        As = mesh_lib.shard_rows(A, mesh8)
+        Bs = mesh_lib.shard_rows(B, mesh8)
+        W = np.asarray(linalg.normal_equations_solve(As, Bs, 0.1))
+        np.testing.assert_allclose(W, ridge_solution(A, B, 0.1), atol=1e-8)
+
+    def test_zero_padding_invariant(self, problem, mesh8):
+        """Zero rows contribute nothing: padded shard == exact solve."""
+        A, B = problem
+        Ap = np.vstack([A, np.zeros((8, A.shape[1]))])
+        Bp = np.vstack([B, np.zeros((8, B.shape[1]))])
+        W = np.asarray(linalg.normal_equations_solve(
+            mesh_lib.shard_rows(Ap, mesh8), mesh_lib.shard_rows(Bp, mesh8), 0.1))
+        np.testing.assert_allclose(W, ridge_solution(A, B, 0.1), atol=1e-8)
+
+
+class TestBCD:
+    def test_converges_to_ridge(self, problem, mesh8):
+        A, B = problem
+        lam = 0.5
+        As = mesh_lib.shard_rows(A, mesh8)
+        blocks = [As[:, :8], As[:, 8:16], As[:, 16:]]
+        Ws = linalg.bcd_least_squares(blocks, mesh_lib.shard_rows(B, mesh8),
+                                      lam=lam, num_iter=60)
+        W = np.vstack([np.asarray(w) for w in Ws])
+        np.testing.assert_allclose(W, ridge_solution(A, B, lam), atol=1e-6)
+
+    def test_single_block_one_iter_is_exact(self, problem):
+        """With one block, a single BCD sweep is the exact normal-equation solve."""
+        A, B = problem
+        Ws = linalg.bcd_least_squares([A], B, lam=0.1, num_iter=1)
+        np.testing.assert_allclose(
+            np.asarray(Ws[0]), ridge_solution(A, B, 0.1), atol=1e-8)
+
+    def test_warm_start(self, problem):
+        A, B = problem
+        lam = 0.5
+        blocks = [A[:, :12], A[:, 12:]]
+        Ws1 = linalg.bcd_least_squares(blocks, B, lam=lam, num_iter=30)
+        Ws2 = linalg.bcd_least_squares(blocks, B, lam=lam, num_iter=30, W_init=Ws1)
+        W = np.vstack([np.asarray(w) for w in Ws2])
+        np.testing.assert_allclose(W, ridge_solution(A, B, lam), atol=1e-9)
+
+
+class TestTSQR:
+    def test_r_matches_numpy(self, mesh8):
+        rng = np.random.default_rng(7)
+        A = rng.normal(size=(512, 12))
+        R = np.asarray(linalg.tsqr_r(mesh_lib.shard_rows(A, mesh8), mesh8))
+        Rref = np.linalg.qr(A, mode="r")
+        signs = np.sign(np.diag(Rref))
+        Rref = Rref * signs[:, None]
+        np.testing.assert_allclose(R, Rref, atol=1e-10)
+
+    def test_gram_identity(self, mesh8):
+        """RᵀR == AᵀA (the invariant the PCA path depends on)."""
+        rng = np.random.default_rng(8)
+        A = rng.normal(size=(256, 10))
+        R = np.asarray(linalg.tsqr_r(mesh_lib.shard_rows(A, mesh8), mesh8))
+        np.testing.assert_allclose(R.T @ R, A.T @ A, atol=1e-9)

@@ -1,0 +1,138 @@
+"""Solver-node tests: parity vs closed forms (contract from the reference's
+BlockLinearMapperSuite / LinearMapperSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.loaders import synthetic_classification
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator, BlockLinearMapper
+from keystone_tpu.ops.learning.linear import (
+    LinearMapEstimator,
+    LinearMapper,
+    LocalLeastSquaresEstimator,
+)
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels, MaxClassifier
+
+
+@pytest.fixture
+def regression_problem():
+    rng = np.random.default_rng(0)
+    X = rng.normal(size=(200, 16)) + 1.5  # nonzero mean exercises centering
+    W = rng.normal(size=(16, 3))
+    Y = X @ W + 0.5 + 0.01 * rng.normal(size=(200, 3))
+    return X, Y
+
+
+def centered_ridge(X, Y, lam):
+    xm, ym = X.mean(0), Y.mean(0)
+    Xc, Yc = X - xm, Y - ym
+    W = np.linalg.solve(Xc.T @ Xc + lam * np.eye(X.shape[1]), Xc.T @ Yc)
+    return W, xm, ym
+
+
+class TestLinearMapEstimator:
+    def test_matches_centered_ridge(self, regression_problem):
+        X, Y = regression_problem
+        lam = 0.3
+        model = LinearMapEstimator(lam).fit(Dataset.of(X), Dataset.of(Y))
+        W, xm, ym = centered_ridge(X, Y, lam)
+        preds = model.batch_apply(Dataset.of(X)).to_numpy()
+        expected = (X - xm) @ W + ym
+        np.testing.assert_allclose(preds, expected, atol=1e-7)
+
+    def test_matches_local_solver(self, regression_problem):
+        X, Y = regression_problem
+        dist = LinearMapEstimator(None).fit(Dataset.of(X), Dataset.of(Y))
+        local = LocalLeastSquaresEstimator(0.0).fit(Dataset.of(X), Dataset.of(Y))
+        p1 = dist.batch_apply(Dataset.of(X)).to_numpy()
+        p2 = local.batch_apply(Dataset.of(X)).to_numpy()
+        np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+class TestBlockLeastSquares:
+    def test_block_model_matches_full_model(self, regression_problem):
+        """A BlockLinearMapper over a split model equals the unsplit LinearMapper
+        (BlockLinearMapperSuite.scala:18-56)."""
+        X, Y = regression_problem
+        rng = np.random.default_rng(1)
+        W = rng.normal(size=(16, 3))
+        full = LinearMapper(W)
+        block = BlockLinearMapper([W[:6], W[6:12], W[12:]], block_size=6)
+        p_full = full.batch_apply(Dataset.of(X)).to_numpy()
+        p_block = block.batch_apply(Dataset.of(X)).to_numpy()
+        np.testing.assert_allclose(p_block, p_full, atol=1e-9)
+
+    def test_many_iters_converges_to_exact(self, regression_problem):
+        X, Y = regression_problem
+        lam = 0.5
+        est = BlockLeastSquaresEstimator(block_size=6, num_iter=60, lam=lam)
+        model = est.fit(Dataset.of(X), Dataset.of(Y))
+        W, xm, ym = centered_ridge(X, Y, lam)
+        preds = model.batch_apply(Dataset.of(X)).to_numpy()
+        expected = (X - xm) @ W + ym
+        np.testing.assert_allclose(preds, expected, atol=1e-5)
+
+    def test_sharded_matches_unsharded(self, regression_problem, mesh8):
+        X, Y = regression_problem
+        est = BlockLeastSquaresEstimator(block_size=8, num_iter=3, lam=0.1)
+        m1 = est.fit(Dataset.of(X), Dataset.of(Y))
+        m2 = est.fit(Dataset.of(X).shard(mesh8), Dataset.of(Y).shard(mesh8))
+        p1 = m1.batch_apply(Dataset.of(X)).to_numpy()
+        p2 = m2.batch_apply(Dataset.of(X).shard(mesh8)).to_numpy()
+        np.testing.assert_allclose(p1, p2, atol=1e-7)
+
+    def test_weight(self):
+        assert BlockLeastSquaresEstimator(10, 5, 0.0).weight == 16
+
+    def test_apply_and_evaluate_streams_partials(self, regression_problem):
+        X, _ = regression_problem
+        rng = np.random.default_rng(2)
+        W = rng.normal(size=(16, 3))
+        block = BlockLinearMapper([W[:8], W[8:]], block_size=8)
+        seen = []
+        block.apply_and_evaluate(Dataset.of(X), lambda ds: seen.append(ds.to_numpy()))
+        assert len(seen) == 2
+        np.testing.assert_allclose(seen[-1], X @ W, atol=1e-9)
+
+
+class TestStandardScaler:
+    def test_mean_std(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(loc=2.0, scale=3.0, size=(500, 5))
+        model = StandardScaler().fit(Dataset.of(X))
+        np.testing.assert_allclose(np.asarray(model.mean), X.mean(0), atol=1e-9)
+        np.testing.assert_allclose(
+            np.asarray(model.std), X.std(0, ddof=1), atol=1e-9)
+        out = model.batch_apply(Dataset.of(X)).to_numpy()
+        np.testing.assert_allclose(out.mean(0), 0, atol=1e-9)
+        np.testing.assert_allclose(out.std(0, ddof=1), 1, atol=1e-9)
+
+    def test_sharded_padding_correct(self, mesh8):
+        """Stats over a padded sharded dataset match the unpadded host stats."""
+        rng = np.random.default_rng(4)
+        X = rng.normal(size=(101, 5))  # 101 % 8 != 0 -> padding
+        ds = Dataset.of(X).shard(mesh8)
+        model = StandardScaler().fit(ds)
+        np.testing.assert_allclose(np.asarray(model.mean), X.mean(0), atol=1e-9)
+        np.testing.assert_allclose(np.asarray(model.std), X.std(0, ddof=1), atol=1e-9)
+
+    def test_zero_std_guard(self):
+        X = np.ones((10, 3))
+        model = StandardScaler().fit(Dataset.of(X))
+        np.testing.assert_allclose(np.asarray(model.std), 1.0)
+
+
+class TestEndToEndClassification:
+    def test_block_ls_classifier(self):
+        train = synthetic_classification(512, 20, 4, seed=0)
+        test = synthetic_classification(256, 20, 4, seed=1)
+        labels = ClassLabelIndicatorsFromIntLabels(4)(train.labels)
+        est = BlockLeastSquaresEstimator(block_size=10, num_iter=3, lam=1.0)
+        model = est.fit(train.data, labels)
+        preds = MaxClassifier()(model.batch_apply(test.data))
+        metrics = MulticlassClassifierEvaluator(4).evaluate(preds, test.labels)
+        assert metrics.accuracy > 0.9
+        assert "Accuracy" in metrics.summary()
