@@ -1,0 +1,45 @@
+"""Solver and model nodes (reference: nodes/learning/)."""
+
+from .block import BlockLeastSquaresEstimator, BlockLinearMapper
+from .bwls import (
+    BlockWeightedLeastSquaresEstimator,
+    PerClassWeightedLeastSquaresEstimator,
+)
+from .classifiers import (
+    LinearDiscriminantAnalysis,
+    LogisticRegressionEstimator,
+    LogisticRegressionModel,
+    NaiveBayesEstimator,
+    NaiveBayesModel,
+)
+from .clustering import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+    KMeansModel,
+    KMeansPlusPlusEstimator,
+)
+from .cost import (
+    CostModel,
+    LeastSquaresEstimator,
+    TransformerLabelEstimatorChain,
+)
+from .kernel import (
+    GaussianKernelGenerator,
+    GaussianKernelTransformer,
+    KernelBlockLinearMapper,
+    KernelRidgeRegression,
+)
+from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, run_lbfgs
+from .linear import LinearMapEstimator, LinearMapper, LocalLeastSquaresEstimator
+from .pca import (
+    ApproximatePCAEstimator,
+    BatchPCATransformer,
+    ColumnPCAEstimator,
+    DistributedColumnPCAEstimator,
+    DistributedPCAEstimator,
+    LocalColumnPCAEstimator,
+    PCAEstimator,
+    PCATransformer,
+    ZCAWhitener,
+    ZCAWhitenerEstimator,
+)

@@ -1,0 +1,188 @@
+"""Probabilistic / discriminant classifiers.
+
+Reference: nodes/learning/NaiveBayesModel.scala:21-69 (multinomial NB),
+LogisticRegressionModel.scala:42-94 (wraps MLlib LogisticRegressionWithLBFGS —
+here an in-tree LBFGS-optimized softmax regression),
+LinearDiscriminantAnalysis.scala:17-68 (multi-class LDA via eigendecomposition).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.linear import LinearMapper
+from keystone_tpu.ops.sparse import densify_dataset, is_sparse_dataset
+from keystone_tpu.workflow import LabelEstimator, Transformer
+
+logger = logging.getLogger("keystone_tpu.classifiers")
+
+
+class NaiveBayesModel(Transformer):
+    """x -> log-prior + log-likelihood·x (unnormalized class log-posteriors)
+    (reference: NaiveBayesModel.scala:21-54)."""
+
+    def __init__(self, pi, theta):
+        self.pi = jnp.asarray(pi)  # (k,) log priors, indexed by class
+        self.theta = jnp.asarray(theta)  # (k, d) log feature likelihoods
+
+    def apply(self, x):
+        return self.pi + self.theta @ jnp.asarray(x)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        data = densify_dataset(data, self.theta.shape[1])
+        return data.map_batch(lambda X: X @ self.theta.T + self.pi)
+
+
+class NaiveBayesEstimator(LabelEstimator):
+    """Multinomial naive Bayes with additive smoothing λ
+    (reference: NaiveBayesModel.scala:56-69, matching MLlib NaiveBayes.train)."""
+
+    def __init__(self, num_classes: int, lam: float = 1.0):
+        self.num_classes = num_classes
+        self.lam = lam
+
+    def fit(self, data: Dataset, labels: Dataset) -> NaiveBayesModel:
+        X = jnp.asarray(densify_dataset(data).array)
+        y = jnp.asarray(labels.array).reshape(-1).astype(jnp.int32)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=X.dtype)
+        # Padding rows are zero in X and map to class 0 in y; mask them out.
+        npad = X.shape[0]
+        mask = (jnp.arange(npad) < data.n).astype(X.dtype)
+        onehot = onehot * mask[:, None]
+
+        class_counts = jnp.sum(onehot, axis=0)  # (k,)
+        feature_sums = onehot.T @ X  # (k, d)
+
+        pi = jnp.log(class_counts + self.lam) - jnp.log(
+            data.n + self.num_classes * self.lam
+        )
+        d = X.shape[1]
+        theta = jnp.log(feature_sums + self.lam) - jnp.log(
+            jnp.sum(feature_sums, axis=1, keepdims=True) + d * self.lam
+        )
+        return NaiveBayesModel(pi, theta)
+
+
+class LogisticRegressionModel(Transformer):
+    """x -> argmax class under softmax weights
+    (reference: LogisticRegressionModel.scala:27-40)."""
+
+    def __init__(self, weights):
+        self.weights = jnp.asarray(weights)  # (d, k)
+
+    def apply(self, x):
+        return jnp.argmax(jnp.asarray(x) @ self.weights, axis=-1)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        data = densify_dataset(data, self.weights.shape[0])
+        return data.map_batch(lambda X: jnp.argmax(X @ self.weights, axis=-1))
+
+
+class LogisticRegressionEstimator(LabelEstimator):
+    """Softmax regression by L-BFGS over the full sharded batch — the in-tree
+    replacement for MLlib's LogisticRegressionWithLBFGS
+    (reference: LogisticRegressionModel.scala:42-94)."""
+
+    def __init__(
+        self,
+        num_classes: int,
+        reg_param: float = 0.0,
+        num_iters: int = 100,
+        convergence_tol: float = 1e-4,
+        num_features: Optional[int] = None,
+    ):
+        self.num_classes = num_classes
+        self.reg_param = reg_param
+        self.num_iters = num_iters
+        self.convergence_tol = convergence_tol
+        self.num_features = num_features
+
+    @property
+    def weight(self) -> int:
+        return self.num_iters + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> LogisticRegressionModel:
+        data = densify_dataset(data, self.num_features)
+        X = jnp.asarray(data.array)
+        y = jnp.asarray(labels.array).reshape(-1).astype(jnp.int32)
+        n = data.n
+        npad = X.shape[0]
+        mask = (jnp.arange(npad) < n).astype(X.dtype)
+        onehot = jax.nn.one_hot(y, self.num_classes, dtype=X.dtype) * mask[:, None]
+        lam = self.reg_param
+
+        def loss_fn(W):
+            logits = X @ W
+            # log-sum-exp over classes; padding rows masked out of the sum.
+            lse = jax.nn.logsumexp(logits, axis=1)
+            ll = jnp.sum(logits * onehot, axis=1) - lse * mask
+            nll = -jnp.sum(ll) / n
+            return nll + 0.5 * lam * jnp.sum(W * W)
+
+        solver = optax.lbfgs()
+        W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=X.dtype)
+
+        @jax.jit
+        def optimize(W0):
+            value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+            def step(carry):
+                W, state, _ = carry
+                value, grad = value_and_grad(W, state=state)
+                updates, state = solver.update(
+                    grad, state, W, value=value, grad=grad, value_fn=loss_fn
+                )
+                return optax.apply_updates(W, updates), state, grad
+
+            def cond(carry):
+                _, state, grad = carry
+                count = optax.tree_utils.tree_get(state, "count")
+                return (count < self.num_iters) & (
+                    optax.tree_utils.tree_norm(grad) > self.convergence_tol
+                )
+
+            state = solver.init(W0)
+            g0 = jax.grad(loss_fn)(W0)
+            W, _, _ = jax.lax.while_loop(cond, step, (W0, state, g0))
+            return W
+
+        W = optimize(W0)
+        logger.info("logistic final loss: %s", float(loss_fn(W)))
+        return LogisticRegressionModel(W)
+
+
+class LinearDiscriminantAnalysis(LabelEstimator):
+    """Multi-class LDA: top eigenvectors of Sw⁻¹·Sb
+    (reference: LinearDiscriminantAnalysis.scala:17-68)."""
+
+    def __init__(self, num_dimensions: int):
+        self.num_dimensions = num_dimensions
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        X = np.asarray(data.to_numpy(), dtype=np.float64)
+        y = np.asarray(labels.to_numpy()).reshape(-1).astype(np.int64)
+        classes = np.unique(y)
+        d = X.shape[1]
+        total_mean = X.mean(axis=0)
+
+        Sw = np.zeros((d, d))
+        Sb = np.zeros((d, d))
+        for c in classes:
+            Xc = X[y == c]
+            mu = Xc.mean(axis=0)
+            centered = Xc - mu
+            Sw += centered.T @ centered
+            m = (mu - total_mean)[:, None]
+            Sb += Xc.shape[0] * (m @ m.T)
+
+        eigvals, eigvecs = np.linalg.eig(np.linalg.solve(Sw, Sb))
+        order = np.argsort(-np.abs(eigvals))[: self.num_dimensions]
+        W = np.real(eigvecs[:, order])
+        return LinearMapper(W)

@@ -1,0 +1,283 @@
+"""Clustering: k-means++ and diagonal-covariance GMM.
+
+Reference: nodes/learning/KMeansPlusPlus.scala:16-181,
+GaussianMixtureModel.scala:19-110, GaussianMixtureModelEstimator.scala:25-203.
+
+Lloyd's iterations and EM are expressed as whole-batch GEMMs (distance and
+responsibility computations are n×k matmuls on the MXU); the k-means++
+seeding's sequential multinomial draws run on host over the collected sample,
+as in the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow import Estimator, Transformer
+
+logger = logging.getLogger("keystone_tpu.clustering")
+
+
+class KMeansModel(Transformer):
+    """Assign each point a one-hot nearest-center indicator
+    (reference: KMeansPlusPlus.scala:16-70)."""
+
+    def __init__(self, means):
+        self.means = jnp.asarray(means)  # (k, d)
+
+    def apply(self, x):
+        return self.assignments(jnp.asarray(x)[None])[0]
+
+    def assignments(self, X):
+        sq_dist = (
+            0.5 * jnp.sum(X * X, axis=1, keepdims=True)
+            - X @ self.means.T
+            + 0.5 * jnp.sum(self.means * self.means, axis=1)[None, :]
+        )
+        nearest = jnp.argmin(sq_dist, axis=1)
+        return jax.nn.one_hot(nearest, self.means.shape[0], dtype=X.dtype)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self.assignments)
+
+
+class KMeansPlusPlusEstimator(Estimator):
+    """k-means++ seeding + Lloyd's iterations with cost-improvement stopping
+    (reference: KMeansPlusPlus.scala:83-180)."""
+
+    def __init__(
+        self,
+        num_means: int,
+        max_iterations: int,
+        stop_tolerance: float = 1e-3,
+        seed: int = 0,
+    ):
+        self.num_means = num_means
+        self.max_iterations = max_iterations
+        self.stop_tolerance = stop_tolerance
+        self.seed = seed
+
+    def fit(self, data: Dataset) -> KMeansModel:
+        X = np.asarray(data.to_numpy(), dtype=np.float64)
+        return self.fit_array(X)
+
+    def fit_array(self, X: np.ndarray) -> KMeansModel:
+        n = X.shape[0]
+        rng = np.random.default_rng(self.seed)
+        x_sq_half = 0.5 * np.sum(X * X, axis=1)
+
+        # -- k-means++ seeding: sequential multinomial draws over sq-distances.
+        centers = np.zeros(self.num_means, dtype=np.int64)
+        centers[0] = rng.integers(0, n)
+        cur_sq_dist = None
+        for k in range(self.num_means - 1):
+            c = X[centers[k]]
+            sq_to_new = x_sq_half - X @ c + 0.5 * (c @ c)
+            cur_sq_dist = (
+                sq_to_new if cur_sq_dist is None else np.minimum(sq_to_new, cur_sq_dist)
+            )
+            probs = np.maximum(cur_sq_dist, 0.0)
+            total = probs.sum()
+            if total <= 0:
+                centers[k + 1] = rng.integers(0, n)
+            else:
+                centers[k + 1] = rng.choice(n, p=probs / total)
+
+        means = jnp.asarray(X[centers])
+        Xd = jnp.asarray(X)
+
+        # -- Lloyd's iterations (device GEMMs), host-checked convergence.
+        @jax.jit
+        def lloyd_step(means):
+            sq_dist = (
+                0.5 * jnp.sum(Xd * Xd, axis=1, keepdims=True)
+                - Xd @ means.T
+                + 0.5 * jnp.sum(means * means, axis=1)[None, :]
+            )
+            cost = jnp.mean(jnp.min(sq_dist, axis=1))
+            assign = jax.nn.one_hot(
+                jnp.argmin(sq_dist, axis=1), self.num_means, dtype=Xd.dtype
+            )
+            mass = jnp.sum(assign, axis=0)
+            new_means = (assign.T @ Xd) / jnp.maximum(mass, 1e-12)[:, None]
+            # Keep empty clusters where they were rather than collapsing to 0.
+            new_means = jnp.where((mass > 0)[:, None], new_means, means)
+            return new_means, cost
+
+        prev_cost = None
+        for it in range(self.max_iterations):
+            means, cost = lloyd_step(means)
+            cost = float(cost)
+            logger.info("Iteration: %d current cost %f", it, cost)
+            if prev_cost is not None and (prev_cost - cost) < self.stop_tolerance * abs(
+                prev_cost
+            ):
+                break
+            prev_cost = cost
+        return KMeansModel(means)
+
+
+class GaussianMixtureModel(Transformer):
+    """Thresholded posterior assignments under a diagonal-covariance GMM
+    (reference: GaussianMixtureModel.scala:19-95).
+
+    means/variances: (d, k) as in the reference; weights: (k,).
+    """
+
+    def __init__(self, means, variances, weights, weight_threshold: float = 1e-4):
+        self.means = jnp.asarray(means)
+        self.variances = jnp.asarray(variances)
+        self.weights = jnp.asarray(weights)
+        self.weight_threshold = weight_threshold
+        if self.means.shape != self.variances.shape:
+            raise ValueError("GMM means and variances must be the same size.")
+        if self.weights.shape[0] != self.means.shape[1]:
+            raise ValueError("Every GMM center must have a weight.")
+
+    @property
+    def k(self) -> int:
+        return self.means.shape[1]
+
+    @property
+    def dim(self) -> int:
+        return self.means.shape[0]
+
+    def posteriors(self, X):
+        mu = self.means.T  # (k, d)
+        var = self.variances.T  # (k, d)
+        # Squared Mahalanobis via GEMMs (GaussianMixtureModel.scala:53-57).
+        sq_mahl = (
+            (X * X) @ (0.5 / var).T
+            - X @ (mu / var).T
+            + 0.5 * jnp.sum(mu * mu / var, axis=1)[None, :]
+        )
+        llh = (
+            -0.5 * X.shape[1] * jnp.log(2 * jnp.pi)
+            - 0.5 * jnp.sum(jnp.log(var), axis=1)[None, :]
+            + jnp.log(self.weights)[None, :]
+            - sq_mahl
+        )
+        llh = llh - jnp.max(llh, axis=1, keepdims=True)
+        post = jnp.exp(llh)
+        post = post / jnp.sum(post, axis=1, keepdims=True)
+        # Aggressive posterior thresholding (GaussianMixtureModel.scala:76-80).
+        post = jnp.where(post > self.weight_threshold, post, 0.0)
+        return post / jnp.sum(post, axis=1, keepdims=True)
+
+    def apply(self, x):
+        return self.posteriors(jnp.asarray(x)[None])[0]
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self.posteriors)
+
+    @staticmethod
+    def load(mean_file: str, vars_file: str, weights_file: str) -> "GaussianMixtureModel":
+        """CSV load (reference: GaussianMixtureModel.scala:103-110)."""
+        means = np.loadtxt(mean_file, delimiter=",", ndmin=2)
+        variances = np.loadtxt(vars_file, delimiter=",", ndmin=2)
+        weights = np.loadtxt(weights_file, delimiter=",").reshape(-1)
+        return GaussianMixtureModel(means, variances, weights)
+
+
+class GaussianMixtureModelEstimator(Estimator):
+    """Diagonal-covariance GMM via local EM over the collected sample, k-means++
+    (or random) init, variance lower bounds, min-cluster-size restarts
+    (reference: GaussianMixtureModelEstimator.scala:25-203)."""
+
+    def __init__(
+        self,
+        k: int,
+        max_iterations: int = 100,
+        tol: float = 1e-4,
+        min_cluster_size: int = 40,
+        absolute_variance_floor: float = 1e-9,
+        relative_variance_floor: float = 1e-4,
+        kmeans_init: bool = True,
+        seed: int = 0,
+    ):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.min_cluster_size = min_cluster_size
+        self.absolute_variance_floor = absolute_variance_floor
+        self.relative_variance_floor = relative_variance_floor
+        self.kmeans_init = kmeans_init
+        self.seed = seed
+
+    def fit(self, data: Dataset) -> GaussianMixtureModel:
+        X = np.asarray(data.to_numpy(), dtype=np.float64)
+        return self.fit_array(X)
+
+    def fit_array(self, X: np.ndarray) -> GaussianMixtureModel:
+        n, d = X.shape
+        rng = np.random.default_rng(self.seed)
+
+        if self.kmeans_init:
+            km = KMeansPlusPlusEstimator(self.k, 10, seed=self.seed).fit_array(X)
+            # np.array (copy): np.asarray of a jax array is a read-only view,
+            # and the restart logic below mutates mu in place.
+            mu = np.array(km.means)
+        else:
+            mu = X[rng.choice(n, self.k, replace=False)]
+        var = np.tile(X.var(axis=0), (self.k, 1)) + 1e-6
+        w = np.full(self.k, 1.0 / self.k)
+
+        Xd = jnp.asarray(X)
+
+        @jax.jit
+        def em_step(mu, var, w):
+            muj, varj = jnp.asarray(mu), jnp.asarray(var)
+            sq_mahl = (
+                (Xd * Xd) @ (0.5 / varj).T
+                - Xd @ (muj / varj).T
+                + 0.5 * jnp.sum(muj * muj / varj, axis=1)[None, :]
+            )
+            llh = (
+                -0.5 * d * jnp.log(2 * jnp.pi)
+                - 0.5 * jnp.sum(jnp.log(varj), axis=1)[None, :]
+                + jnp.log(w)[None, :]
+                - sq_mahl
+            )
+            m = jnp.max(llh, axis=1, keepdims=True)
+            log_norm = m + jnp.log(jnp.sum(jnp.exp(llh - m), axis=1, keepdims=True))
+            post = jnp.exp(llh - log_norm)
+            nk = jnp.sum(post, axis=0)
+            new_mu = (post.T @ Xd) / nk[:, None]
+            ex2 = (post.T @ (Xd * Xd)) / nk[:, None]
+            new_var = ex2 - new_mu * new_mu
+            new_w = nk / n
+            return new_mu, new_var, new_w, jnp.mean(log_norm), nk
+
+        prev_ll = -np.inf
+        for it in range(self.max_iterations):
+            mu_j, var_j, w_j, ll, nk = em_step(mu, var, w)
+            mu, var, w = np.array(mu_j), np.array(var_j), np.array(w_j)
+            nk = np.asarray(nk)
+            # Variance floors (GaussianMixtureModelEstimator variance bounds).
+            floor = np.maximum(
+                self.absolute_variance_floor,
+                self.relative_variance_floor * var.mean(axis=0, keepdims=True),
+            )
+            var = np.maximum(var, floor)
+            # Restart clusters that collapsed below the minimum size.
+            small = nk < min(self.min_cluster_size, n / (2 * self.k))
+            if small.any():
+                num_restarts = int(small.sum())
+                idx = rng.choice(n, num_restarts, replace=num_restarts > n)
+                mu[small] = X[idx]
+                var[small] = X.var(axis=0) + 1e-6
+                w[small] = 1.0 / self.k
+                w = w / w.sum()
+            ll = float(ll)
+            if abs(ll - prev_ll) < self.tol * max(abs(prev_ll), 1.0):
+                break
+            prev_ll = ll
+
+        # Reference layout: (d, k).
+        return GaussianMixtureModel(mu.T, var.T, w)

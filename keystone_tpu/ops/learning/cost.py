@@ -1,0 +1,156 @@
+"""Cost-model-driven solver selection.
+
+Reference: nodes/learning/CostModel.scala:6-16, LeastSquaresEstimator.scala:26-87,
+ChainUtils.scala (TransformerLabelEstimatorChain).
+
+The analytic cost(n, d, k, sparsity, numMachines, cpuW, memW, netW) models and
+the empirical weights (cpu=3.8e-4, mem=2.9e-1, net=1.32, fit on a 16-node
+r3.4xlarge cluster — LeastSquaresEstimator.scala:17,28-31) are kept verbatim
+as the starting point; `numMachines` maps to mesh device count. Re-fitting the
+weights for TPU is a bench-driven follow-up.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.sparse import Densify, Sparsify, is_sparse_dataset
+from keystone_tpu.workflow import LabelEstimator, Transformer
+from keystone_tpu.workflow.optimizable import OptimizableLabelEstimator
+
+logger = logging.getLogger("keystone_tpu.cost")
+
+# Empirical cost weights (LeastSquaresEstimator.scala:28-31).
+DEFAULT_CPU_WEIGHT = 3.8e-4
+DEFAULT_MEM_WEIGHT = 2.9e-1
+DEFAULT_NETWORK_WEIGHT = 1.32
+
+
+class CostModel:
+    """Analytic per-solver performance model (CostModel.scala:6-16)."""
+
+    def cost(
+        self,
+        n: int,
+        d: int,
+        k: int,
+        sparsity: float,
+        num_machines: int,
+        cpu_weight: float,
+        mem_weight: float,
+        network_weight: float,
+    ) -> float:
+        raise NotImplementedError
+
+
+class TransformerLabelEstimatorChain(LabelEstimator):
+    """Fuse a Transformer with a LabelEstimator into one LabelEstimator
+    (reference: ChainUtils.scala)."""
+
+    def __init__(self, transformer: Transformer, estimator: LabelEstimator):
+        self.transformer = transformer
+        self.estimator = estimator
+
+    def fit(self, data: Dataset, labels: Dataset):
+        transformed = self.transformer.batch_apply(data)
+        inner = self.estimator.fit(transformed, labels)
+
+        chain_transformer = self.transformer
+
+        class Chained(Transformer):
+            def apply(self, x):
+                return inner.apply(chain_transformer.apply(x))
+
+            def batch_apply(self, ds: Dataset) -> Dataset:
+                return inner.batch_apply(chain_transformer.batch_apply(ds))
+
+        return Chained()
+
+    @property
+    def weight(self) -> int:
+        return getattr(self.estimator, "weight", 1)
+
+
+class LeastSquaresEstimator(OptimizableLabelEstimator):
+    """Auto-selecting least-squares solver (LeastSquaresEstimator.scala:26-87).
+
+    Candidates: DenseLBFGS, Sparsify->SparseLBFGS, Densify->BlockLS(1000, 3),
+    Densify->Exact normal equations. ``optimize`` measures (n, d, k, sparsity,
+    num devices) from the sample and picks the cost-model argmin.
+    """
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        num_machines: Optional[int] = None,
+        cpu_weight: float = DEFAULT_CPU_WEIGHT,
+        mem_weight: float = DEFAULT_MEM_WEIGHT,
+        network_weight: float = DEFAULT_NETWORK_WEIGHT,
+    ):
+        from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+        from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
+        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+
+        self.lam = lam
+        self.num_machines = num_machines
+        self.cpu_weight = cpu_weight
+        self.mem_weight = mem_weight
+        self.network_weight = network_weight
+
+        dense_lbfgs = DenseLBFGSwithL2(lam=lam, num_iterations=20)
+        sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
+        block = BlockLeastSquaresEstimator(1000, 3, lam=lam)
+        exact = LinearMapEstimator(lam)
+
+        self.options: Sequence[Tuple[object, LabelEstimator]] = [
+            (dense_lbfgs, dense_lbfgs),
+            (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
+            (block, TransformerLabelEstimatorChain(Densify(), block)),
+            (exact, TransformerLabelEstimatorChain(Densify(), exact)),
+        ]
+        self._default = dense_lbfgs
+
+    @property
+    def default(self) -> LabelEstimator:
+        return self._default
+
+    @property
+    def weight(self) -> int:
+        return self._default.weight
+
+    def optimize(self, sample: Dataset, labels_sample: Dataset):
+        # total_n: the full dataset size attached by the sample collector;
+        # sample.n is just the handful of sampled rows.
+        n = getattr(sample, "total_n", sample.n)
+        if is_sparse_dataset(sample):
+            indices = np.asarray(sample.data["indices"])
+            d = int(indices.max()) + 1
+            sparsity = float((indices >= 0).sum() / (max(n, 1) * d))
+        elif sample.is_host:
+            first = sample.to_list()[0]
+            d = int(np.asarray(first).shape[-1])
+            X = np.stack([np.asarray(x) for x in sample.to_list()])
+            sparsity = float((X != 0).mean())
+        else:
+            d = int(np.asarray(sample.array).shape[-1])
+            sparsity = float(np.mean(np.asarray(sample.array[: n]) != 0))
+        k = int(np.asarray(labels_sample.array).shape[-1])
+        machines = self.num_machines or max(len(jax.devices()), 1)
+
+        logger.debug(
+            "LeastSquaresEstimator optimize: n=%d d=%d k=%d sparsity=%.4f machines=%d",
+            n, d, k, sparsity, machines,
+        )
+        best = min(
+            self.options,
+            key=lambda opt: opt[0].cost(
+                n, d, k, sparsity, machines,
+                self.cpu_weight, self.mem_weight, self.network_weight,
+            ),
+        )
+        return best[1]

@@ -162,13 +162,22 @@ class KernelRidgeRegression(LabelEstimator):
         self.block_permuter = block_permuter
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
-        transformer = self.kernel_generator.fit(data)
         n_train = data.n
-        n_pad = data.num_padded
-        Y = jnp.asarray(labels.array)
-        k = Y.shape[1]
         bs = self.block_size
         num_blocks = -(-n_train // bs)
+        # Pad rows to a whole number of blocks so block slices never clamp
+        # (dynamic_slice silently shifts a slice that runs past the end).
+        n_pad = max(data.num_padded, num_blocks * bs)
+
+        X = jnp.asarray(data.array)
+        Y = jnp.asarray(labels.array)
+        if X.shape[0] < n_pad:
+            X = jnp.pad(X, ((0, n_pad - X.shape[0]), (0, 0)))
+        if Y.shape[0] < n_pad:
+            Y = jnp.pad(Y, ((0, n_pad - Y.shape[0]), (0, 0)))
+
+        transformer = self.kernel_generator.fit(Dataset(X, n=n_train, mesh=data.mesh))
+        k = Y.shape[1]
 
         valid_row = (jnp.arange(n_pad) < n_train).astype(Y.dtype)
         W = jnp.zeros((n_pad, k), dtype=Y.dtype)

@@ -86,7 +86,7 @@ def run_lbfgs(
         def cond(carry):
             W, state, grad = carry
             count = optax.tree_utils.tree_get(state, "count")
-            gnorm = optax.tree_utils.tree_l2_norm(grad)
+            gnorm = optax.tree_utils.tree_norm(grad)
             return (count < num_iterations) & (gnorm > convergence_tol)
 
         state = solver.init(W0)
@@ -133,10 +133,12 @@ class DenseLBFGSwithL2(LabelEstimator):
     def cost(
         self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight
     ) -> float:
-        """Analytic cost model (LBFGS.scala:170-192)."""
+        """Analytic cost model (LBFGS.scala:175-191)."""
+        import math
+
         flops = n * d * k / num_machines
         bytes_scanned = n * d / num_machines
-        network = 2.0 * d * k
+        network = 2.0 * d * k * math.log2(max(num_machines, 2))
         return self.num_iterations * (
             max(cpu_weight * flops, mem_weight * bytes_scanned)
             + network_weight * network
@@ -184,3 +186,18 @@ class SparseLBFGSwithL2(LabelEstimator):
             n=data.n,
         )
         return LinearMapper(W1[:-1], b_opt=W1[-1])
+
+    def cost(
+        self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight,
+        sparse_overhead: float = 8.0,
+    ) -> float:
+        """Analytic cost model (LBFGS.scala:264-280)."""
+        import math
+
+        flops = n * sparsity * d * k / num_machines
+        bytes_scanned = n * d * sparsity / num_machines
+        network = 2.0 * d * k * math.log2(max(num_machines, 2))
+        return self.num_iterations * (
+            sparse_overhead * max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )

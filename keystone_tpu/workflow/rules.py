@@ -185,11 +185,17 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
             num_shards = mesh_lib.axis_size(ds.mesh, mesh_lib.DATA_AXIS)
         k = min(ds.n, samples_per_shard * max(num_shards, 1))
         if ds.is_host:
-            return Dataset.of(ds.to_list()[:k])
-        import jax.tree_util as jtu
+            out = Dataset.of(ds.to_list()[:k])
+        else:
+            import jax.tree_util as jtu
 
-        data = jtu.tree_map(lambda x: x[:k], ds.data)
-        return Dataset(data, n=k)
+            data = jtu.tree_map(lambda x: x[:k], ds.data)
+            out = Dataset(data, n=k)
+        # Cost models need the FULL dataset size (the reference passes it via
+        # numPerPartition, LeastSquaresEstimator.scala:60-64); the sample only
+        # supplies d, k, and sparsity.
+        out.total_n = ds.n
+        return out
 
     # Execute with a private memo table, sampling at every DatasetOperator.
     memo: Dict[NodeId, object] = {}
@@ -221,7 +227,13 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
     for node in nodes:
         try:
             dep_values = tuple(evaluate(d) for d in plan.get_dependencies(node))
-            out[node] = dep_values
+            # Optimization hooks take Dataset samples; datum-fed nodes keep
+            # their default implementation (the reference's sampling executor
+            # likewise only samples RDD inputs).
+            if not all(isinstance(v, Dataset) for v in dep_values):
+                out[node] = None
+            else:
+                out[node] = dep_values
         except Exception:
             out[node] = None
     return out

@@ -1,0 +1,155 @@
+"""Optimizer-layer tests: node-level optimization and auto-caching
+(contracts from the reference's NodeOptimizationRuleSuite.scala:12-75 and
+AutocCacheRuleSuite.scala:74-181)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.util import Cacher
+from keystone_tpu.workflow import (
+    Pipeline,
+    PipelineEnv,
+    Transformer,
+)
+from keystone_tpu.workflow.autocache import (
+    AggressiveCache,
+    AutoCacheRule,
+    GreedyCache,
+    compute_runs,
+    node_weight,
+)
+from keystone_tpu.workflow.graph import Graph, SourceId
+from keystone_tpu.workflow.operators import DatasetOperator
+from keystone_tpu.workflow.optimizable import (
+    OptimizableEstimator,
+    OptimizableTransformer,
+)
+from keystone_tpu.workflow.pipeline import PipelineDataset
+from keystone_tpu.workflow import Estimator
+
+
+class PlusOne(Transformer):
+    def apply(self, x):
+        return x + 1
+
+
+class TimesTen(Transformer):
+    def apply(self, x):
+        return x * 10
+
+
+class SwitchingTransformer(OptimizableTransformer):
+    """Optimizable stub: picks TimesTen for large samples, PlusOne otherwise
+    (the NodeOptimizationRuleSuite stub pattern)."""
+
+    def __init__(self, threshold=5):
+        self.threshold = threshold
+        self.optimize_calls = []
+
+    @property
+    def default(self):
+        return PlusOne()
+
+    def optimize(self, sample: Dataset):
+        self.optimize_calls.append(sample.n)
+        return TimesTen() if sample.n >= self.threshold else PlusOne()
+
+
+class TestNodeOptimization:
+    def test_swaps_implementation_based_on_sample(self):
+        data = Dataset.of(np.arange(16.0))
+        node = SwitchingTransformer(threshold=2)
+        pipe = node.to_pipeline()
+        out = pipe.apply(data).get().to_numpy()
+        # sample (3 per shard, 1 shard) >= 2 -> TimesTen chosen
+        np.testing.assert_allclose(out, np.arange(16.0) * 10)
+        assert len(node.optimize_calls) == 1
+
+    def test_not_optimized_when_downstream_of_source(self):
+        node = SwitchingTransformer(threshold=1)
+        pipe = node.to_pipeline()
+        # Datum-fed nodes are not sampled: the default implementation runs.
+        out = pipe.apply(3.0).get()
+        assert float(out) == 4.0
+        assert node.optimize_calls == []
+
+
+class CountingFitEstimator(Estimator):
+    def __init__(self):
+        self.fits = 0
+
+    def fit(self, data):
+        self.fits += 1
+        return PlusOne()
+
+
+class TestComputeRuns:
+    def test_weighted_runs(self):
+        # source-free chain: data -> a -> b(with weight 3) -> sink
+        ds = Dataset.of(np.arange(4.0))
+        g = Graph()
+        g, d = g.add_node(DatasetOperator(ds), [])
+        g, a = g.add_node(PlusOne(), [d])
+
+        class Heavy(Transformer):
+            weight = 3
+
+            def apply(self, x):
+                return x
+
+        g, b = g.add_node(Heavy(), [a])
+        g, sink = g.add_sink(b)
+
+        runs = compute_runs(g, cached=set())
+        assert runs[b] == 1
+        assert runs[a] == 3  # consumed 3 times by the weighted node
+        runs_cached = compute_runs(g, cached={a})
+        assert runs_cached[a] == 1
+
+    def test_aggressive_cache_inserts_cacher(self):
+        ds = Dataset.of(np.arange(4.0))
+        g = Graph()
+        g, d = g.add_node(DatasetOperator(ds), [])
+        g, a = g.add_node(PlusOne(), [d])
+
+        class Heavy(Transformer):
+            weight = 4
+
+            def apply(self, x):
+                return x
+
+        g, b = g.add_node(Heavy(), [a])
+        g, sink = g.add_sink(b)
+
+        rule = AutoCacheRule(AggressiveCache())
+        new_graph, _ = rule.apply(g, {})
+        cachers = [op for op in new_graph.operators.values() if isinstance(op, Cacher)]
+        assert len(cachers) >= 1
+
+    def test_greedy_cache_respects_memory_budget(self):
+        ds = Dataset.of(np.arange(1024.0))
+        g = Graph()
+        g, d = g.add_node(DatasetOperator(ds), [])
+        g, a = g.add_node(PlusOne(), [d])
+
+        class Heavy(Transformer):
+            weight = 5
+
+            def apply(self, x):
+                return x
+
+        g, b = g.add_node(Heavy(), [a])
+        g, sink = g.add_sink(b)
+
+        # Zero budget: nothing fits, no cachers inserted.
+        rule = AutoCacheRule(GreedyCache(max_mem_bytes=0))
+        new_graph, _ = rule.apply(g, {})
+        assert not any(isinstance(op, Cacher) for op in new_graph.operators.values())
+
+        # Big budget: caching the reused node is chosen.
+        rule = AutoCacheRule(GreedyCache(max_mem_bytes=1 << 30))
+        new_graph2, _ = rule.apply(g, {})
+        # Greedy may or may not cache depending on measured profile times, but
+        # the rule must at least run cleanly and keep the graph executable.
+        assert new_graph2.sinks == g.sinks
