@@ -1,0 +1,266 @@
+"""Convolution-family image nodes: Convolver, Pooler, Windower,
+SymmetricRectifier.
+
+The reference implements convolution as hand-rolled im2col into a reused
+patch-matrix buffer followed by one BLAS-3 GEMM per image (reference:
+nodes/images/Convolver.scala:128-220). Here the whole batch is one XLA
+program: patch extraction (``lax.conv_general_dilated_patches``), per-patch
+normalization, whitening-mean subtraction and the filter GEMM all fuse into a
+single MXU-friendly computation over ``(n, x, y, c)`` arrays.
+
+Layout note: the reference flattens patches/filters channel-fastest with its
+second spatial axis slowest (Convolver.scala:152-190). We flatten row-major
+over ``(x, y, c)`` — self-consistent between ``pack_filters`` and the patch
+extractor, and the natural order for XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow import Transformer
+
+
+def _as_batch(x) -> tuple:
+    """Return (batch array (n, X, Y, C), was_single)."""
+    x = jnp.asarray(x)
+    if x.ndim == 3:
+        return x[None], True
+    return x, False
+
+
+def im2col(images, patch_size: int):
+    """(n, X, Y, C) -> (n, X', Y', patch_size²·C) patches, flattened row-major
+    over (px, py, c)."""
+    n, X, Y, C = images.shape
+    patches = lax.conv_general_dilated_patches(
+        images,
+        filter_shape=(patch_size, patch_size),
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    # Feature order from XLA is channel-slowest: (c, px, py). Reorder.
+    xo, yo = X - patch_size + 1, Y - patch_size + 1
+    patches = patches.reshape(n, xo, yo, C, patch_size, patch_size)
+    patches = jnp.transpose(patches, (0, 1, 2, 4, 5, 3))
+    return patches.reshape(n, xo, yo, patch_size * patch_size * C)
+
+
+def normalize_patch_rows(patches, var_constant: float):
+    """Per-patch mean/variance normalization, matching the reference's
+    Stats.normalizeRows (utils/Stats.scala:112-123): subtract the mean, divide
+    by sqrt(var + alpha) with the (d-1) variance denominator."""
+    d = patches.shape[-1]
+    mean = jnp.mean(patches, axis=-1, keepdims=True)
+    centered = patches - mean
+    var = jnp.sum(centered * centered, axis=-1, keepdims=True) / (d - 1.0)
+    return centered / jnp.sqrt(var + var_constant)
+
+
+class Convolver(Transformer):
+    """Convolve images with a filter bank via im2col + one GEMM
+    (reference: nodes/images/Convolver.scala:20-221).
+
+    ``filters`` is ``(num_filters, patch_size²·channels)``, already whitened
+    if a whitener is supplied (see :meth:`build`). Output image is
+    ``(X-p+1, Y-p+1, num_filters)``.
+    """
+
+    def __init__(
+        self,
+        filters,
+        img_x: int,
+        img_y: int,
+        img_channels: int,
+        whitener=None,
+        normalize_patches: bool = True,
+        var_constant: float = 10.0,
+    ):
+        self.filters = jnp.asarray(filters, dtype=jnp.float32)
+        self.img_x = img_x
+        self.img_y = img_y
+        self.img_channels = img_channels
+        self.whitener = whitener
+        self.normalize_patches = normalize_patches
+        self.var_constant = var_constant
+        self.patch_size = int(round((self.filters.shape[1] / img_channels) ** 0.5))
+
+    @staticmethod
+    def pack_filters(filter_images) -> jnp.ndarray:
+        """(k, p, p, c) filter images -> (k, p·p·c) rows, row-major (x, y, c)
+        (reference: Convolver.packFilters, Convolver.scala:99-125)."""
+        f = jnp.asarray(filter_images, dtype=jnp.float32)
+        return f.reshape(f.shape[0], -1)
+
+    @classmethod
+    def build(
+        cls,
+        filter_images,
+        whitener=None,
+        normalize_patches: bool = True,
+        var_constant: float = 10.0,
+        flip_filters: bool = False,
+    ) -> "Convolver":
+        """User-facing factory: takes unwhitened filter images ``(k, p, p, c)``
+        and folds the whitening into the filter matrix
+        (reference: Convolver.apply, Convolver.scala:60-89)."""
+        f = jnp.asarray(filter_images, dtype=jnp.float32)
+        if flip_filters:
+            f = f[:, ::-1, ::-1, :]
+        packed = cls.pack_filters(f)
+        if whitener is not None:
+            packed = whitener.apply(packed) @ whitener.whitener.T
+        k, p = f.shape[0], f.shape[1]
+        c = f.shape[3]
+        # img dims are supplied at apply time from the data; record patch shape.
+        conv = cls(
+            packed,
+            img_x=-1,
+            img_y=-1,
+            img_channels=c,
+            whitener=whitener,
+            normalize_patches=normalize_patches,
+            var_constant=var_constant,
+        )
+        conv.patch_size = p
+        return conv
+
+    def _convolve(self, images):
+        patches = im2col(images, self.patch_size)
+        if self.normalize_patches:
+            patches = normalize_patch_rows(patches, self.var_constant)
+        if self.whitener is not None:
+            patches = patches - self.whitener.means
+        return jnp.einsum(
+            "nxyd,kd->nxyk", patches, self.filters,
+            preferred_element_type=jnp.float32,
+        )
+
+    def apply(self, img):
+        batch, single = _as_batch(img)
+        out = self._convolve(batch)
+        return out[0] if single else out
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(lambda X: self._convolve(jnp.asarray(X, jnp.float32)))
+
+
+class Pooler(Transformer):
+    """Strided spatial pooling with a pixel function applied first
+    (reference: nodes/images/Pooler.scala:21-69).
+
+    Pool k covers ``[k·stride, k·stride + pool_size)`` in each spatial axis
+    (the reference's strideStart = poolSize/2 with windows centered there),
+    truncated at the image edge. ``pool_function`` is "sum" or "max".
+    """
+
+    def __init__(
+        self,
+        stride: int,
+        pool_size: int,
+        pixel_function: Optional[Callable] = None,
+        pool_function: Union[str, Callable] = "sum",
+    ):
+        self.stride = stride
+        self.pool_size = pool_size
+        self.pixel_function = pixel_function
+        if callable(pool_function):
+            raise TypeError('pool_function must be "sum" or "max" (XLA reduce_window)')
+        if pool_function not in ("sum", "max"):
+            raise ValueError(f"unknown pool_function {pool_function}")
+        self.pool_function = pool_function
+
+    def _pool(self, images):
+        n, X, Y, C = images.shape
+        if self.pixel_function is not None:
+            images = self.pixel_function(images)
+        start = self.pool_size // 2
+        npx = -(-(X - start) // self.stride)  # ceil
+        npy = -(-(Y - start) // self.stride)
+        ext_x = (npx - 1) * self.stride + self.pool_size
+        ext_y = (npy - 1) * self.stride + self.pool_size
+        pad_val = -jnp.inf if self.pool_function == "max" else 0.0
+        images = jnp.pad(
+            images,
+            ((0, 0), (0, max(0, ext_x - X)), (0, max(0, ext_y - Y)), (0, 0)),
+            constant_values=pad_val,
+        )
+        images = images[:, :ext_x, :ext_y, :]
+        init, op = (
+            (-jnp.inf, lax.max) if self.pool_function == "max" else (0.0, lax.add)
+        )
+        return lax.reduce_window(
+            images,
+            jnp.asarray(init, images.dtype),
+            op,
+            window_dimensions=(1, self.pool_size, self.pool_size, 1),
+            window_strides=(1, self.stride, self.stride, 1),
+            padding="VALID",
+        )
+
+    def apply(self, img):
+        batch, single = _as_batch(img)
+        out = self._pool(batch)
+        return out[0] if single else out
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(lambda X: self._pool(jnp.asarray(X, jnp.float32)))
+
+
+class Windower(Transformer):
+    """Extract all stride-strided windows as separate images
+    (reference: nodes/images/Windower.scala:13-56). A batch of n images
+    becomes a batch of n·numWindows window images (RDD flatMap analog)."""
+
+    def __init__(self, stride: int, window_size: int):
+        self.stride = stride
+        self.window_size = window_size
+
+    def _windows(self, images):
+        n, X, Y, C = images.shape
+        w = self.window_size
+        xs = np.arange(0, X - w + 1, self.stride)
+        ys = np.arange(0, Y - w + 1, self.stride)
+        rows = xs[:, None] + np.arange(w)[None, :]  # (nx, w)
+        cols = ys[:, None] + np.arange(w)[None, :]  # (ny, w)
+        out = images[:, rows, :, :]  # (n, nx, w, Y, C)
+        out = out[:, :, :, cols, :]  # (n, nx, w, ny, w, C)
+        out = jnp.transpose(out, (0, 1, 3, 2, 4, 5))  # (n, nx, ny, w, w, C)
+        return out.reshape(n, len(xs) * len(ys), w, w, C)
+
+    def apply(self, img):
+        batch, single = _as_batch(img)
+        out = self._windows(batch)
+        return out[0] if single else out.reshape((-1,) + out.shape[2:])
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        out = self._windows(jnp.asarray(data.array, jnp.float32)[: data.n])
+        return Dataset(out.reshape((-1,) + out.shape[2:]))
+
+
+class SymmetricRectifier(Transformer):
+    """Two-sided ReLU doubling the channel count: channels c and c+C hold
+    max(maxVal, x−α) and max(maxVal, −x−α)
+    (reference: nodes/images/SymmetricRectifier.scala:7-32)."""
+
+    def __init__(self, max_val: float = 0.0, alpha: float = 0.0):
+        self.max_val = max_val
+        self.alpha = alpha
+
+    def _rectify(self, x):
+        pos = jnp.maximum(self.max_val, x - self.alpha)
+        neg = jnp.maximum(self.max_val, -x - self.alpha)
+        return jnp.concatenate([pos, neg], axis=-1)
+
+    def apply(self, img):
+        return self._rectify(jnp.asarray(img))
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self._rectify)

@@ -1,0 +1,128 @@
+"""DAISY dense descriptors, Tola et al. (reference:
+nodes/images/DaisyExtractor.scala:28-201).
+
+The per-angle orientation maps and their cascaded Gaussian blurs are batched
+XLA convolutions; ring sampling is a static set of gathers (Q·T offsets), so
+the whole extractor jits into one program per image shape.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.utils.images import separable_conv2d_same
+from keystone_tpu.workflow import Transformer
+
+_FILTER1 = np.array([1.0, 0.0, -1.0])
+_FILTER2 = np.array([1.0, 2.0, 1.0])
+
+
+class DaisyExtractor(Transformer):
+    """Image -> (H·(T·Q+1), numKeypoints) DAISY feature matrix
+    (reference: DaisyExtractor.scala:28-201)."""
+
+    def __init__(
+        self,
+        daisy_t: int = 8,
+        daisy_q: int = 3,
+        daisy_r: int = 7,
+        daisy_h: int = 8,
+        pixel_border: int = 16,
+        stride: int = 4,
+        patch_size: int = 24,
+    ):
+        self.T, self.Q, self.R, self.H = daisy_t, daisy_q, daisy_r, daisy_h
+        self.pixel_border = pixel_border
+        self.stride = stride
+        self.patch_size = patch_size
+        self.feature_threshold = 1e-8
+        self.conv_threshold = 1e-6
+
+        # Incremental blur kernels (DaisyExtractor.scala:49-64).
+        sigma_sq = [(self.R * n / (2.0 * self.Q)) ** 2 for n in range(self.Q + 1)]
+        diffs = [b - a for a, b in zip(sigma_sq, sigma_sq[1:])]
+        self.g = []
+        for t in diffs:
+            rad = int(
+                math.ceil(
+                    math.sqrt(-2 * t * math.log(self.conv_threshold) - t * math.log(2 * math.pi * t))
+                )
+            )
+            ns = np.arange(-rad, rad + 1, dtype=np.float64)
+            self.g.append(np.exp(-(ns**2) / (2 * t)) / math.sqrt(2 * math.pi * t))
+
+        # Ring sampling offsets per (level, angle)
+        # (DaisyExtractor.scala:75-92: radius R(1+l)/Q, angle 2π(t-1)/T).
+        self.offsets = np.zeros((self.Q, self.T, 2), dtype=np.int64)
+        for l in range(self.Q):
+            rad = self.R * (1.0 + l) / self.Q
+            for t in range(self.T):
+                # The reference evaluates 2π(angleCount−1)/T with angleCount
+                # in [0, T) — the (t−1) offset is kept for parity
+                # (DaisyExtractor.scala:82-88, 174).
+                theta = 2 * math.pi * (t - 1) / self.T
+                self.offsets[l, t, 0] = int(round(rad * math.sin(theta)))
+                self.offsets[l, t, 1] = int(round(rad * math.cos(theta)))
+        self._jit_features = jax.jit(self._features)
+
+    def _normalize(self, h, axis):
+        norm = jnp.sqrt(jnp.sum(h * h, axis=axis, keepdims=True))
+        return jnp.where(norm > self.feature_threshold, h / norm, 0.0)
+
+    def _features(self, image):
+        image = image[:, :, :1]  # single-channel (reference uses channel 0)
+        X, Y = image.shape[0], image.shape[1]
+        ix = separable_conv2d_same(image, _FILTER1, _FILTER2)[:, :, 0]
+        iy = separable_conv2d_same(image, _FILTER2, _FILTER1)[:, :, 0]
+
+        # Orientation layers with cascaded blurs (DaisyExtractor.scala:113-135).
+        angles = 2 * math.pi * np.arange(self.H) / self.H
+        layers = []  # Q levels of (H, X, Y)
+        level0 = []
+        for a in angles:
+            o = jnp.maximum(math.cos(a) * ix + math.sin(a) * iy, 0.0)
+            level0.append(separable_conv2d_same(o, self.g[0], self.g[0])[:, :, 0])
+        layers.append(jnp.stack(level0))
+        for l in range(1, self.Q):
+            prev = layers[-1]
+            cur = [
+                separable_conv2d_same(prev[h], self.g[l], self.g[l])[:, :, 0]
+                for h in range(self.H)
+            ]
+            layers.append(jnp.stack(cur))
+
+        xs = np.arange(self.pixel_border, X - self.pixel_border, self.stride)
+        ys = np.arange(self.pixel_border, Y - self.pixel_border, self.stride)
+        nx, ny = len(xs), len(ys)
+
+        center = self._normalize(layers[0][:, xs, :][:, :, ys], axis=0)  # (H, nx, ny)
+
+        # Column order: center, then angle-major/level-minor ring histograms
+        # (DaisyExtractor.scala:155-186).
+        blocks = [center]
+        for t in range(self.T):
+            for l in range(self.Q):
+                ox, oy = int(self.offsets[l, t, 0]), int(self.offsets[l, t, 1])
+                vals = layers[l][:, xs + ox, :][:, :, ys + oy]
+                blocks.append(self._normalize(vals, axis=0))
+        feats = jnp.concatenate(blocks, axis=0)  # (H(TQ+1), nx, ny)
+        return feats.reshape(feats.shape[0], nx * ny)
+
+    def apply(self, image):
+        image = jnp.asarray(image, jnp.float32)
+        if image.ndim == 2:
+            image = image[:, :, None]
+        return self._jit_features(image)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        if data.is_host:
+            return data.map(self.apply)
+        X = jnp.asarray(data.array, jnp.float32)
+        out = jax.vmap(self._features)(X)
+        return Dataset(out, n=data.n, mesh=data.mesh)

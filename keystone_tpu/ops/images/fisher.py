@@ -1,0 +1,110 @@
+"""Fisher-vector encoding (reference: nodes/images/FisherVector.scala:17-94 and
+the native enceval tier, src/main/cpp/EncEval.cxx:20-120).
+
+The reference has two implementations — a Breeze one and a JNI C++
+(enceval-toolkit) one picked by node-level optimization for k ≥ 32. On TPU
+the encoding is three GEMMs plus elementwise work, so the *native* tier is a
+single jit-compiled XLA program over the whole batch of descriptor matrices;
+the per-item path serves ragged host-form data.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.clustering import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+)
+from keystone_tpu.workflow import Estimator, Transformer
+from keystone_tpu.workflow.optimizable import OptimizableEstimator
+
+
+@partial(jax.jit, static_argnames=())
+def _fisher_encode(x, means, variances, weights, q):
+    """Sanchez et al. FV from posteriors.
+
+    x: (d, n) descriptors; q: (n, k) posteriors; means/variances: (d, k);
+    weights: (k,). Returns (d, 2k) (FisherVector.scala:33-52).
+    """
+    n = x.shape[1]
+    s0 = jnp.mean(q, axis=0)  # (k,)
+    s1 = (x @ q) / n  # (d, k)
+    s2 = ((x * x) @ q) / n  # (d, k)
+
+    fv1 = (s1 - means * s0[None, :]) / (jnp.sqrt(variances) * jnp.sqrt(weights)[None, :])
+    fv2 = (s2 - 2.0 * means * s1 + (means * means - variances) * s0[None, :]) / (
+        variances * jnp.sqrt(2.0 * weights)[None, :]
+    )
+    return jnp.concatenate([fv1, fv2], axis=1)
+
+
+class FisherVector(Transformer):
+    """FV encoding of a (d, numDescriptors) matrix against a trained GMM
+    (reference: FisherVector.scala:17-53). Output is (d, 2k)."""
+
+    def __init__(self, gmm: GaussianMixtureModel):
+        self.gmm = gmm
+
+    def apply(self, x):
+        x = jnp.asarray(x, jnp.float32)
+        q = self.gmm.posteriors(x.T)  # (n, k) thresholded posteriors
+        return _fisher_encode(
+            x, self.gmm.means, self.gmm.variances, self.gmm.weights, q
+        ).astype(jnp.float32)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        if data.is_host:
+            return data.map(self.apply)
+        X = jnp.asarray(data.array, jnp.float32)  # (b, d, n)
+
+        def one(x):
+            q = self.gmm.posteriors(x.T)
+            return _fisher_encode(
+                x, self.gmm.means, self.gmm.variances, self.gmm.weights, q
+            ).astype(jnp.float32)
+
+        return data.map_batch(lambda _: jax.vmap(one)(X))
+
+
+class ScalaGMMFisherVectorEstimator(Estimator):
+    """Fit a GMM treating every column of every input matrix as one training
+    vector, then encode (reference: FisherVector.scala:60-73). The name keeps
+    the reference's label; the implementation is the XLA path."""
+
+    def __init__(self, k: int, gmm_seed: int = 0):
+        self.k = k
+        self.gmm_seed = gmm_seed
+
+    def fit(self, data: Dataset) -> FisherVector:
+        mats = data.to_list()
+        cols = np.concatenate([np.asarray(m).T for m in mats], axis=0)  # (N, d)
+        gmm = GaussianMixtureModelEstimator(self.k, seed=self.gmm_seed).fit_array(
+            cols.astype(np.float64)
+        )
+        return FisherVector(gmm)
+
+
+class GMMFisherVectorEstimator(OptimizableEstimator):
+    """Optimizable FV estimator (reference: FisherVector.scala:85-94). The
+    reference swaps to the native enceval JNI tier for k >= 32; both tiers
+    here compile to the same fused XLA program, so optimize() keeps the
+    single implementation."""
+
+    def __init__(self, k: int, gmm_seed: int = 0):
+        self.k = k
+        self.gmm_seed = gmm_seed
+        self._default = ScalaGMMFisherVectorEstimator(k, gmm_seed)
+
+    @property
+    def default(self) -> Estimator:
+        return self._default
+
+    def optimize(self, sample: Dataset) -> Optional[Estimator]:
+        return self._default

@@ -1,0 +1,79 @@
+"""Local Color Statistics descriptors (reference:
+nodes/images/LCSExtractor.scala:25-130; Clinchant et al. 2007).
+
+Channel means/stds over subPatchSize boxes come from two box-filter
+convolutions (image and image²); descriptors are then pure gathers at the
+keypoint-neighborhood grid.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.utils.images import separable_conv2d_same
+from keystone_tpu.workflow import Transformer
+
+
+class LCSExtractor(Transformer):
+    """Image -> (numNeighborhood²·channels·2, numKeypoints) matrix of local
+    channel means and standard deviations (LCSExtractor.scala:49-129)."""
+
+    def __init__(self, stride: int, stride_start: int, sub_patch_size: int):
+        self.stride = stride
+        self.stride_start = stride_start
+        self.sub_patch_size = sub_patch_size
+        # The outermost neighborhood offset is -2s + s//2 - 1; keypoints closer
+        # than that to the border would wrap to the opposite image edge.
+        min_start = 2 * sub_patch_size - sub_patch_size // 2 + 1
+        if stride_start < min_start:
+            raise ValueError(
+                f"stride_start must be >= {min_start} for sub_patch_size="
+                f"{sub_patch_size} so neighborhoods stay inside the image"
+            )
+        self._jit_features = jax.jit(self._features)
+
+    def _features(self, image):
+        X, Y, C = image.shape
+        s = self.sub_patch_size
+        box = np.full(s, 1.0 / s)
+
+        means = separable_conv2d_same(image, box, box)  # (X, Y, C)
+        sq = separable_conv2d_same(image * image, box, box)
+        stds = jnp.sqrt(jnp.maximum(sq - means * means, 0.0))
+
+        xs = np.arange(self.stride_start, X - self.stride_start, self.stride)
+        ys = np.arange(self.stride_start, Y - self.stride_start, self.stride)
+
+        # Neighborhood offsets (LCSExtractor.scala:63-69).
+        start = -2 * s + s // 2 - 1
+        end = s + s // 2 - 1
+        offs = np.arange(start, end + 1, s)
+
+        # For each channel c, neighbor (nx, ny): interleave mean, std
+        # (LCSExtractor.scala:108-124).
+        rows = []
+        for c in range(C):
+            for ox in offs:
+                for oy in offs:
+                    m = means[:, :, c][xs + ox, :][:, ys + oy]
+                    sd = stds[:, :, c][xs + ox, :][:, ys + oy]
+                    rows.append(m)
+                    rows.append(sd)
+        feats = jnp.stack(rows)  # (C·|offs|²·2, nx, ny)
+        return feats.reshape(feats.shape[0], len(xs) * len(ys))
+
+    def apply(self, image):
+        image = jnp.asarray(image, jnp.float32)
+        if image.ndim == 2:
+            image = image[:, :, None]
+        return self._jit_features(image)
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        if data.is_host:
+            return data.map(self.apply)
+        X = jnp.asarray(data.array, jnp.float32)
+        out = jax.vmap(self._features)(X)
+        return Dataset(out, n=data.n, mesh=data.mesh)

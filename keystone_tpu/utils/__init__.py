@@ -1,0 +1,19 @@
+from .images import (
+    ImageMetadata,
+    conv2d_valid,
+    crop,
+    flip_horizontal,
+    flip_image,
+    load_image,
+    to_grayscale,
+)
+
+__all__ = [
+    "ImageMetadata",
+    "conv2d_valid",
+    "crop",
+    "flip_horizontal",
+    "flip_image",
+    "load_image",
+    "to_grayscale",
+]

@@ -1,0 +1,151 @@
+"""Image representation and utilities.
+
+The reference carries an ``Image`` trait with four array storage layouts and
+index arithmetic per layout (reference: utils/images/Image.scala,
+utils/ImageUtils.scala). On TPU there is exactly one right layout: a dense
+``(x, y, channel)`` float array — XLA lays HWC minor-to-major and
+``lax.conv_general_dilated`` maps it straight onto the MXU. So here an image
+IS an array:
+
+  - single image:  ``(xDim, yDim, numChannels)`` float32
+  - batch:         ``(n, xDim, yDim, numChannels)``
+
+Axis 0 corresponds to the reference's ``x`` index and axis 1 to ``y``, so
+``img[x, y, c]`` matches ``Image.get(x, y, c)``.
+
+``ImageMetadata`` survives as a plain shape record used by loaders and node
+factories.
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ImageMetadata:
+    """Shape record (reference: utils/images/Image.scala ImageMetadata)."""
+
+    x_dim: int
+    y_dim: int
+    num_channels: int
+
+    @property
+    def shape(self):
+        return (self.x_dim, self.y_dim, self.num_channels)
+
+
+def metadata_of(img) -> ImageMetadata:
+    x, y, c = np.shape(img)
+    return ImageMetadata(int(x), int(y), int(c))
+
+
+def load_image(source: Union[str, bytes]) -> np.ndarray:
+    """Decode an image file or byte buffer to an (x, y, c) float array
+    (replaces the reference's javax.imageio path, utils/ImageUtils.scala)."""
+    from PIL import Image as PILImage
+
+    if isinstance(source, (bytes, bytearray)):
+        pil = PILImage.open(io.BytesIO(source))
+    else:
+        pil = PILImage.open(source)
+    arr = np.asarray(pil, dtype=np.float32)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+# ---------------------------------------------------------------------------
+# Elementwise / geometric ops (reference: utils/ImageUtils.scala)
+# ---------------------------------------------------------------------------
+
+# ITU-R 601 luma weights, as used by the reference's grayscale conversion.
+_LUMA = np.array([0.299, 0.587, 0.114], dtype=np.float32)
+
+
+def to_grayscale(img):
+    """(x, y, c) -> (x, y, 1) luminance (ImageUtils.toGrayScale)."""
+    img = jnp.asarray(img)
+    if img.shape[-1] == 1:
+        return img
+    if img.shape[-1] == 3:
+        return jnp.tensordot(img, jnp.asarray(_LUMA), axes=[[-1], [0]])[..., None]
+    return jnp.mean(img, axis=-1, keepdims=True)
+
+
+def crop(img, start_x: int, start_y: int, end_x: int, end_y: int):
+    """Crop [start_x, end_x) × [start_y, end_y) (ImageUtils.crop)."""
+    return jnp.asarray(img)[start_x:end_x, start_y:end_y, :]
+
+
+def flip_horizontal(img):
+    """Mirror along the y (second) axis (ImageUtils.flipHorizontal)."""
+    return jnp.asarray(img)[:, ::-1, :]
+
+
+def flip_image(img):
+    """Flip both spatial axes (ImageUtils.flipImage; used for MATLAB-style
+    convolution filter flipping)."""
+    return jnp.asarray(img)[::-1, ::-1, :]
+
+
+def conv2d_valid(img, kernel):
+    """Per-channel 2-D valid cross-correlation of one (x, y, c) image with one
+    (kx, ky) kernel (ImageUtils.conv2D). Compiles to an XLA conv (MXU)."""
+    img = jnp.asarray(img, dtype=jnp.float32)
+    kernel = jnp.asarray(kernel, dtype=jnp.float32)
+    lhs = jnp.transpose(img, (2, 0, 1))[:, None, :, :]  # (c, 1, x, y)
+    rhs = kernel[None, None, :, :]  # (1, 1, kx, ky)
+    out = lax.conv_general_dilated(
+        lhs, rhs, window_strides=(1, 1), padding="VALID"
+    )  # (c, 1, x', y')
+    return jnp.transpose(out[:, 0, :, :], (1, 2, 0))
+
+
+def separable_conv2d_same(img, x_filter, y_filter):
+    """Separable same-size true convolution with zero padding, matching the
+    reference's ImageUtils.conv2D (utils/images/ImageUtils.scala:226-320):
+    kernels are flipped (convolution, not correlation) and the output has the
+    input's spatial size."""
+    img = jnp.asarray(img, dtype=jnp.float32)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    kx = jnp.asarray(x_filter, dtype=jnp.float32)[::-1]
+    ky = jnp.asarray(y_filter, dtype=jnp.float32)[::-1]
+    lx = kx.shape[0]
+    ly = ky.shape[0]
+    pad_xl, pad_xh = (lx - 1) // 2, lx - 1 - (lx - 1) // 2
+    pad_yl, pad_yh = (ly - 1) // 2, ly - 1 - (ly - 1) // 2
+    padded = jnp.pad(img, ((pad_xl, pad_xh), (0, 0), (0, 0)))
+    out = conv2d_valid(padded, kx[:, None])
+    padded = jnp.pad(out, ((0, 0), (pad_yl, pad_yh), (0, 0)))
+    return conv2d_valid(padded, ky[None, :])
+
+
+def gaussian_kernel_1d(sigma: float, radius: Optional[int] = None) -> np.ndarray:
+    if radius is None:
+        radius = max(1, int(np.ceil(3.0 * sigma)))
+    xs = np.arange(-radius, radius + 1, dtype=np.float32)
+    k = np.exp(-0.5 * (xs / sigma) ** 2)
+    return k / k.sum()
+
+
+def gaussian_blur(img, sigma: float):
+    """Separable Gaussian smoothing with edge replication (the role of
+    vl_imsmooth_f in the reference's native SIFT path,
+    src/main/cpp/VLFeat.cxx:38-180)."""
+    if sigma <= 0:
+        return jnp.asarray(img)
+    k = jnp.asarray(gaussian_kernel_1d(sigma))
+    r = (k.shape[0] - 1) // 2
+    img = jnp.asarray(img, dtype=jnp.float32)
+    padded = jnp.pad(img, ((r, r), (0, 0), (0, 0)), mode="edge")
+    img = conv2d_valid(padded, k[:, None].astype(jnp.float32))
+    padded = jnp.pad(img, ((0, 0), (r, r), (0, 0)), mode="edge")
+    return conv2d_valid(padded, k[None, :].astype(jnp.float32))

@@ -1,0 +1,271 @@
+"""Images subsystem tests (model: reference ConvolverSuite/PoolerSuite/
+WindowerSuite/HogExtractorSuite + golden checks vs scipy, mirroring the
+reference's scipy golden-file strategy, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+from scipy import signal
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.images import (
+    CenterCornerPatcher,
+    Convolver,
+    DaisyExtractor,
+    FisherVector,
+    GrayScaler,
+    HogExtractor,
+    ImageVectorizer,
+    LCSExtractor,
+    PixelScaler,
+    Pooler,
+    RandomPatcher,
+    SIFTExtractor,
+    ScalaGMMFisherVectorEstimator,
+    SymmetricRectifier,
+    Windower,
+)
+from keystone_tpu.ops.learning.clustering import GaussianMixtureModel
+
+
+def rand_image(rng, x=10, y=12, c=3):
+    return rng.random((x, y, c)).astype(np.float32)
+
+
+class TestConvolver:
+    def test_matches_scipy_correlation(self):
+        """Un-normalized, un-whitened Convolver == per-channel summed valid
+        cross-correlation (the reference's scipy golden-file test)."""
+        rng = np.random.default_rng(0)
+        img = rand_image(rng, 8, 9, 2)
+        k = 3
+        filters = rng.random((4, k, k, 2)).astype(np.float32)
+
+        conv = Convolver.build(filters, normalize_patches=False)
+        out = np.asarray(conv.apply(img))
+
+        expected = np.zeros((8 - k + 1, 9 - k + 1, 4))
+        for f in range(4):
+            for c in range(2):
+                expected[:, :, f] += signal.correlate(
+                    img[:, :, c], filters[f, :, :, c], mode="valid"
+                )
+        np.testing.assert_allclose(out, expected, rtol=1e-4, atol=1e-5)
+
+    def test_patch_normalization(self):
+        """normalize_patches matches the reference Stats.normalizeRows math."""
+        rng = np.random.default_rng(1)
+        img = rand_image(rng, 6, 6, 1)
+        k = 3
+        filters = rng.random((2, k, k, 1)).astype(np.float32)
+        var_constant = 10.0
+
+        conv = Convolver.build(filters, normalize_patches=True, var_constant=var_constant)
+        out = np.asarray(conv.apply(img))
+
+        fmat = filters.reshape(2, -1)
+        for ox in range(4):
+            for oy in range(4):
+                patch = img[ox : ox + k, oy : oy + k, 0].reshape(-1)
+                centered = patch - patch.mean()
+                sd = np.sqrt(centered @ centered / (len(patch) - 1) + var_constant)
+                norm_patch = centered / sd
+                np.testing.assert_allclose(
+                    out[ox, oy], fmat @ norm_patch, rtol=1e-4, atol=1e-5
+                )
+
+    def test_batch_matches_single(self):
+        rng = np.random.default_rng(2)
+        imgs = rng.random((3, 7, 7, 2)).astype(np.float32)
+        filters = rng.random((5, 3, 3, 2)).astype(np.float32)
+        conv = Convolver.build(filters)
+        batch = np.asarray(conv.batch_apply(Dataset.of(imgs)).array)
+        for i in range(3):
+            np.testing.assert_allclose(
+                batch[i], np.asarray(conv.apply(imgs[i])), rtol=1e-4, atol=1e-5
+            )
+
+
+class TestPooler:
+    def test_sum_pooling_reference_semantics(self):
+        """Pool k covers [k·stride, k·stride+poolSize) truncated at the edge
+        (Pooler.scala:39-64)."""
+        rng = np.random.default_rng(3)
+        img = rand_image(rng, 9, 9, 2)
+        stride, pool_size = 3, 4
+        out = np.asarray(Pooler(stride, pool_size).apply(img))
+
+        start = pool_size // 2
+        npools = int(np.ceil((9 - start) / stride))
+        assert out.shape == (npools, npools, 2)
+        for px in range(npools):
+            for py in range(npools):
+                xs = slice(px * stride, min(px * stride + pool_size, 9))
+                ys = slice(py * stride, min(py * stride + pool_size, 9))
+                np.testing.assert_allclose(
+                    out[px, py], img[xs, ys, :].sum(axis=(0, 1)), rtol=1e-5
+                )
+
+    def test_max_pooling_with_pixel_function(self):
+        rng = np.random.default_rng(4)
+        img = rand_image(rng, 8, 8, 1) - 0.5
+        out = np.asarray(Pooler(2, 2, pixel_function=abs, pool_function="max").apply(img))
+        expected = np.abs(img[:8, :8, 0]).reshape(4, 2, 4, 2).max(axis=(1, 3))
+        np.testing.assert_allclose(out[:, :, 0], expected, rtol=1e-5)
+
+
+class TestWindowerAndRectifier:
+    def test_windower_contents(self):
+        rng = np.random.default_rng(5)
+        img = rand_image(rng, 6, 6, 2)
+        wins = np.asarray(Windower(2, 4).apply(img))
+        assert wins.shape == (4, 4, 4, 2)  # 2x2 grid of windows, x-major
+        np.testing.assert_allclose(wins[0], img[0:4, 0:4, :])
+        np.testing.assert_allclose(wins[1], img[0:4, 2:6, :])  # y moves fastest
+        np.testing.assert_allclose(wins[2], img[2:6, 0:4, :])
+
+    def test_windower_batch_flattens(self):
+        rng = np.random.default_rng(6)
+        data = Dataset.of(rng.random((3, 6, 6, 1)).astype(np.float32))
+        out = Windower(2, 4).batch_apply(data)
+        assert out.n == 12
+
+    def test_symmetric_rectifier(self):
+        img = np.array([[[0.5, -0.3]]], dtype=np.float32)
+        out = np.asarray(SymmetricRectifier(alpha=0.1).apply(img))
+        np.testing.assert_allclose(out[0, 0], [0.4, 0.0, 0.0, 0.2], atol=1e-6)
+
+
+class TestPlumbing:
+    def test_grayscale_and_pixel_scaler(self):
+        img = np.full((2, 2, 3), 255.0, dtype=np.float32)
+        gray = np.asarray(GrayScaler().apply(PixelScaler().apply(img)))
+        np.testing.assert_allclose(gray, np.ones((2, 2, 1)), rtol=1e-5)
+
+    def test_vectorizer(self):
+        rng = np.random.default_rng(7)
+        img = rand_image(rng, 3, 4, 2)
+        v = np.asarray(ImageVectorizer().apply(img))
+        np.testing.assert_allclose(v, img.reshape(-1))
+
+    def test_center_corner_patcher(self):
+        rng = np.random.default_rng(8)
+        img = rand_image(rng, 8, 8, 1)
+        patches = np.asarray(CenterCornerPatcher(4, 4, horizontal_flips=False).apply(img))
+        assert patches.shape == (5, 4, 4, 1)
+        np.testing.assert_allclose(patches[0], img[0:4, 0:4, :])
+        np.testing.assert_allclose(patches[4], img[2:6, 2:6, :])  # center
+
+        flipped = CenterCornerPatcher(4, 4, horizontal_flips=True)
+        out = flipped.batch_apply(Dataset.of(img[None]))
+        assert out.n == 10
+
+    def test_random_patcher(self):
+        rng = np.random.default_rng(9)
+        data = Dataset.of(rng.random((2, 10, 10, 1)).astype(np.float32))
+        out = RandomPatcher(num_patches=3, patch_size_x=4, patch_size_y=4).batch_apply(data)
+        assert out.n == 6
+        assert np.asarray(out.array).shape == (6, 4, 4, 1)
+
+
+class TestExtractors:
+    def test_hog_shape_and_bounds(self):
+        rng = np.random.default_rng(10)
+        img = rand_image(rng, 24, 24, 3)
+        feats = np.asarray(HogExtractor(bin_size=4).apply(img))
+        # 6x6 cells -> 4x4 feature cells
+        assert feats.shape == (16, 32)
+        assert np.all(feats >= 0.0)
+        assert np.all(feats[:, :18] <= 0.4 + 1e-6)  # 0.5 * 4 * clip(0.2)
+        np.testing.assert_allclose(feats[:, 31], 0.0)
+        assert feats.sum() > 0
+
+    def test_hog_flat_image_is_zero(self):
+        img = np.full((16, 16, 3), 0.5, dtype=np.float32)
+        feats = np.asarray(HogExtractor(bin_size=4).apply(img))
+        np.testing.assert_allclose(feats, 0.0, atol=1e-5)
+
+    def test_daisy_shape_and_normalization(self):
+        rng = np.random.default_rng(11)
+        img = rand_image(rng, 40, 44, 1)
+        d = DaisyExtractor()
+        feats = np.asarray(d.apply(img))
+        nx = len(range(16, 40 - 16, 4))
+        ny = len(range(16, 44 - 16, 4))
+        assert feats.shape == (d.H * (d.T * d.Q + 1), nx * ny)
+        # Each H-block is L2-normalized (or zero).
+        norms = np.linalg.norm(feats[: d.H, :], axis=0)
+        assert np.all((norms < 1.0 + 1e-4))
+
+    def test_lcs_mean_matches_box_filter(self):
+        rng = np.random.default_rng(12)
+        img = rand_image(rng, 32, 32, 3)
+        s = 4
+        lcs = LCSExtractor(stride=5, stride_start=12, sub_patch_size=s)
+        feats = np.asarray(lcs.apply(img))
+        xs = list(range(12, 32 - 12, 5))
+        assert feats.shape[1] == len(xs) ** 2
+        # First row = channel-0 mean at neighbor offset (start, start) of the
+        # first keypoint.
+        start = -2 * s + s // 2 - 1
+        kx, ky = xs[0] + start, xs[0] + start
+        pad_lo = (s - 1) // 2
+        pad_hi = s - 1 - pad_lo
+        region = img[kx - pad_lo : kx + pad_hi + 1, ky - pad_lo : ky + pad_hi + 1, 0]
+        np.testing.assert_allclose(feats[0, 0], region.mean(), rtol=1e-4)
+
+    def test_sift_shape_and_range(self):
+        rng = np.random.default_rng(13)
+        img = rand_image(rng, 48, 48, 1)
+        feats = np.asarray(SIFTExtractor(step_size=4, bin_size=4, scales=2).apply(img))
+        assert feats.shape[0] == 128
+        assert feats.shape[1] > 0
+        assert np.all(feats >= 0) and np.all(feats <= 255)
+
+    def test_sift_batch_matches_single(self):
+        rng = np.random.default_rng(14)
+        imgs = rng.random((2, 32, 32, 1)).astype(np.float32)
+        ext = SIFTExtractor(step_size=6, bin_size=4, scales=1)
+        batch = np.asarray(ext.batch_apply(Dataset.of(imgs)).array)
+        np.testing.assert_allclose(
+            batch[0], np.asarray(ext.apply(imgs[0])), rtol=1e-4, atol=1e-4
+        )
+
+
+class TestFisherVector:
+    def _gmm(self, d=4, k=3, seed=15):
+        rng = np.random.default_rng(seed)
+        means = rng.random((d, k))
+        variances = 0.5 + rng.random((d, k))
+        weights = rng.random(k)
+        weights /= weights.sum()
+        return GaussianMixtureModel(means, variances, weights)
+
+    def test_fv_matches_manual(self):
+        gmm = self._gmm()
+        rng = np.random.default_rng(16)
+        x = rng.random((4, 10)).astype(np.float32)  # d x numDescriptors
+
+        fv = np.asarray(FisherVector(gmm).apply(x))
+        assert fv.shape == (4, 6)
+
+        q = np.asarray(gmm.posteriors(x.T))  # (n, k)
+        np.testing.assert_allclose(q.sum(axis=1), 1.0, rtol=1e-4)
+        means, variances = np.asarray(gmm.means), np.asarray(gmm.variances)
+        weights = np.asarray(gmm.weights)
+        n = x.shape[1]
+        s0 = q.mean(axis=0)
+        s1 = (x @ q) / n
+        s2 = ((x * x) @ q) / n
+        fv1 = (s1 - means * s0) / (np.sqrt(variances) * np.sqrt(weights))
+        fv2 = (s2 - 2 * means * s1 + (means**2 - variances) * s0) / (
+            variances * np.sqrt(2 * weights)
+        )
+        np.testing.assert_allclose(fv, np.hstack([fv1, fv2]), rtol=1e-4, atol=1e-5)
+
+    def test_estimator_end_to_end(self):
+        rng = np.random.default_rng(17)
+        mats = [rng.random((4, 30)).astype(np.float32) for _ in range(3)]
+        est = ScalaGMMFisherVectorEstimator(k=2)
+        fv = est.fit(Dataset.of(mats))
+        out = fv.apply(mats[0])
+        assert np.asarray(out).shape == (4, 4)
