@@ -1,3 +1,5 @@
 """Data plane: the Dataset abstraction and data loaders."""
 
 from .dataset import Dataset, LabeledData
+
+__all__ = ["Dataset", "LabeledData"]

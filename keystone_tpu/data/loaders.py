@@ -8,21 +8,39 @@ for each workload's data so pipelines and benchmarks run hermetically.
 
 from __future__ import annotations
 
+import io
+import json
 import os
 import struct
 import tarfile
-from typing import List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
 from .dataset import Dataset, LabeledData
 
 
+def _read_csv_matrix(path: str) -> np.ndarray:
+    """CSV -> (rows, cols) float matrix via the native parser when available
+    (keystone_tpu/native — the host-side data-plane tier), else numpy."""
+    from keystone_tpu import native
+
+    with open(path, "rb") as f:
+        text = f.read()
+    vals, ncols, nrows = native.parse_csv_floats(text)
+    if ncols <= 0 or vals.size != ncols * nrows:
+        raise ValueError(
+            f"{path}: ragged CSV — {vals.size} values over {nrows} rows "
+            f"do not form a rectangular {nrows}x{ncols} matrix"
+        )
+    return vals.reshape(nrows, ncols)
+
+
 def csv_data_loader(path: str) -> Dataset:
     """CSV of comma-separated numbers -> Dataset of rows
     (reference: loaders/CsvDataLoader.scala:10-31)."""
-    rows = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
-    return Dataset.of(rows)
+    return Dataset.of(_read_csv_matrix(path))
 
 
 def load_labeled_csv(path: str, label_offset: int = 0) -> LabeledData:
@@ -31,7 +49,7 @@ def load_labeled_csv(path: str, label_offset: int = 0) -> LabeledData:
     label_offset shifts labels (the MNIST files are 1-indexed; the pipelines
     subtract 1, reference: pipelines/images/mnist/MnistRandomFFT.scala:34-37).
     """
-    rows = np.loadtxt(path, delimiter=",", dtype=np.float64, ndmin=2)
+    rows = _read_csv_matrix(path)
     labels = rows[:, 0].astype(np.int64) + label_offset
     return LabeledData(rows[:, 1:], labels)
 
@@ -98,6 +116,146 @@ def load_newsgroups(path: str, class_dirs: Optional[List[str]] = None) -> Labele
                 texts.append(f.read())
             labels.append(label)
     return LabeledData(Dataset(texts), Dataset.of(np.asarray(labels)))
+
+
+def load_amazon_reviews(path: str, threshold: float = 3.5) -> LabeledData:
+    """Amazon product reviews: JSON-lines with "overall" and "reviewText";
+    rating >= threshold -> label 1 else 0
+    (reference: loaders/AmazonReviewsDataLoader.scala:7-28)."""
+    paths = [path]
+    if os.path.isdir(path):
+        paths = [
+            p
+            for f in sorted(os.listdir(path))
+            if os.path.isfile(p := os.path.join(path, f))
+        ]
+    texts: List[str] = []
+    labels: List[int] = []
+    for p in paths:
+        with open(p, errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                texts.append(rec.get("reviewText", ""))
+                labels.append(1 if float(rec.get("overall", 0.0)) >= threshold else 0)
+    return LabeledData(Dataset(texts), Dataset.of(np.asarray(labels, dtype=np.int64)))
+
+
+# ---------------------------------------------------------------------------
+# Image archive loading (reference: loaders/ImageLoaderUtils.scala:21-94,
+# VOCLoader.scala:16-53, ImageNetLoader.scala:12-39)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LabeledImage:
+    """(image, int label, filename) (reference: utils/LabeledImage)."""
+
+    image: np.ndarray
+    label: int
+    filename: str = ""
+
+
+@dataclass
+class MultiLabeledImage:
+    """(image, multi-label array, filename) (reference: utils/MultiLabeledImage)."""
+
+    image: np.ndarray
+    labels: np.ndarray = field(default_factory=lambda: np.zeros(0, dtype=np.int64))
+    filename: str = ""
+
+
+def decode_image_bytes(data: bytes) -> Optional[np.ndarray]:
+    """Decode image bytes to float32 (x, y, c). PNM rides the native C++
+    decoder (keystone_tpu/native); other formats decode via PIL — the role
+    javax.imageio plays in the reference (ImageLoaderUtils.scala:60-84)."""
+    if data[:2] in (b"P5", b"P6"):
+        from keystone_tpu import native
+
+        arr = native.decode_pnm(data)
+        if arr is not None:
+            return arr
+    try:
+        from keystone_tpu.utils.images import load_image
+
+        return np.asarray(load_image(data))
+    except Exception:
+        return None
+
+
+def iter_tar_images(tar_path: str):
+    """Yield (member_name, decoded image) from a tar of image files
+    (reference: ImageLoaderUtils.loadTarFiles)."""
+    with tarfile.open(tar_path) as tf:
+        for member in tf.getmembers():
+            if not member.isfile():
+                continue
+            f = tf.extractfile(member)
+            if f is None:
+                continue
+            img = decode_image_bytes(f.read())
+            if img is not None:
+                yield member.name, img
+
+
+def _tar_paths(data_path: str) -> List[str]:
+    if os.path.isdir(data_path):
+        return [
+            os.path.join(data_path, f)
+            for f in sorted(os.listdir(data_path))
+            if f.endswith(".tar")
+        ]
+    return [data_path]
+
+
+def load_imagenet(data_path: str, labels_path: str) -> Dataset:
+    """Tars of JPEGs under class-name directories + "classname label" map
+    file -> Dataset of LabeledImage (reference: ImageNetLoader.scala:12-39)."""
+    labels_map: Dict[str, int] = {}
+    with open(labels_path) as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2:
+                labels_map[parts[0]] = int(parts[1])
+
+    out: List[LabeledImage] = []
+    for tar_path in _tar_paths(data_path):
+        for name, img in iter_tar_images(tar_path):
+            cls = name.split("/")[0]
+            if cls in labels_map:
+                out.append(LabeledImage(img, labels_map[cls], name))
+    return Dataset(out)
+
+
+VOC_NUM_CLASSES = 20
+
+
+def load_voc(data_path: str, labels_path: str, name_prefix: str = "") -> Dataset:
+    """VOC2007 tar + CSV multi-labels -> Dataset of MultiLabeledImage
+    (reference: VOCLoader.scala:16-53). The CSV has a header; column 4 is the
+    quoted filename, column 1 the 1-based class id."""
+    labels_map: Dict[str, List[int]] = {}
+    with open(labels_path) as f:
+        next(f)  # header
+        for line in f:
+            parts = line.strip().split(",")
+            if len(parts) >= 5:
+                fname = parts[4].replace('"', "")
+                labels_map.setdefault(fname, []).append(int(parts[1]) - 1)
+
+    out: List[MultiLabeledImage] = []
+    for tar_path in _tar_paths(data_path):
+        for name, img in iter_tar_images(tar_path):
+            base = name.split("/")[-1]
+            if name_prefix and not base.startswith(name_prefix):
+                continue
+            if base in labels_map:
+                out.append(
+                    MultiLabeledImage(img, np.asarray(sorted(labels_map[base])), base)
+                )
+    return Dataset(out)
 
 
 # ---------------------------------------------------------------------------

@@ -1,9 +1,12 @@
 """Evaluation: metrics and evaluators for pipeline outputs."""
 
 from .metrics import (
+    AggregationPolicy,
+    AugmentedExamplesEvaluator,
     BinaryClassificationMetrics,
     BinaryClassifierEvaluator,
     Evaluator,
+    MeanAveragePrecisionEvaluator,
     MulticlassClassifierEvaluator,
     MulticlassMetrics,
 )

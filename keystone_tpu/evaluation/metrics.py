@@ -8,7 +8,7 @@ sharded batch), the analog of the reference's one-pass ``aggregate``.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Generic, TypeVar
+from typing import Any, Dict, Generic, TypeVar
 
 import jax.numpy as jnp
 import numpy as np
@@ -195,3 +195,104 @@ class BinaryClassifierEvaluator(Evaluator):
         tn = float(jnp.sum(~preds & ~labs))
         fn = float(jnp.sum(~preds & labs))
         return BinaryClassificationMetrics(tp, fp, tn, fn)
+
+
+class MeanAveragePrecisionEvaluator(Evaluator):
+    """VOC-style per-class average precision (reference:
+    evaluation/MeanAveragePrecisionEvaluator.scala:13-87, after the enceval
+    toolkit MATLAB code).
+
+    predictions: per-example class-score vectors (n, numClasses);
+    labels: per-example arrays of valid class ids (host list or (n, k) array).
+    Returns a (numClasses,) array of 11-point interpolated APs.
+    """
+
+    def __init__(self, num_classes: int):
+        self.num_classes = num_classes
+
+    def _evaluate(self, predictions: Dataset, labels: Dataset):
+        scores = np.asarray(predictions.to_numpy(), dtype=np.float64)  # (n, C)
+        actual = labels.to_list()
+        n = scores.shape[0]
+        # (n, C) membership indicators
+        gt = np.zeros((n, self.num_classes), dtype=np.float64)
+        for i, labs in enumerate(actual):
+            for l in np.atleast_1d(np.asarray(labs, dtype=np.int64)):
+                if 0 <= l < self.num_classes:
+                    gt[i, l] = 1.0
+
+        # Per class: sort by descending score (stable, matching the
+        # reference's sortBy(..).reverse tie order), accumulate tp/fp.
+        order = np.argsort(-scores, axis=0, kind="stable")  # (n, C)
+        gt_sorted = np.take_along_axis(gt, order, axis=0)
+        tps = np.cumsum(gt_sorted, axis=0)
+        fps = np.cumsum(1.0 - gt_sorted, axis=0)
+        totals = gt.sum(axis=0)  # positives per class
+
+        aps = np.zeros(self.num_classes)
+        with np.errstate(invalid="ignore", divide="ignore"):
+            recalls = tps / totals[None, :]
+            precisions = tps / (tps + fps)
+        for c in range(self.num_classes):
+            ap = 0.0
+            for t in np.linspace(0.0, 1.0, 11):
+                px = precisions[recalls[:, c] >= t, c]
+                ap += (px.max() if px.size else 0.0) / 11.0
+            aps[c] = ap
+        return jnp.asarray(aps)
+
+
+class AggregationPolicy:
+    """Vote-aggregation policies for augmented test copies
+    (reference: AugmentedExamplesEvaluator.scala:9-13)."""
+
+    AVERAGE = "average"
+    BORDA = "borda"
+
+
+class AugmentedExamplesEvaluator(Evaluator):
+    """Aggregate predictions of augmented copies of each underlying example
+    (grouped by name) before multiclass evaluation
+    (reference: evaluation/AugmentedExamplesEvaluator.scala:15-76)."""
+
+    def __init__(self, names, num_classes: int, policy: str = AggregationPolicy.AVERAGE):
+        self.names = names if isinstance(names, list) else list(names)
+        self.num_classes = num_classes
+        if policy not in (AggregationPolicy.AVERAGE, AggregationPolicy.BORDA):
+            raise ValueError(f"unknown aggregation policy {policy}")
+        self.policy = policy
+
+    @staticmethod
+    def _borda(preds: np.ndarray) -> np.ndarray:
+        # rank of each class per augmented copy, summed
+        # (AugmentedExamplesEvaluator.scala:31-39)
+        ranks = np.argsort(np.argsort(preds, axis=1, kind="stable"), axis=1)
+        return ranks.sum(axis=0).astype(np.float64)
+
+    def _evaluate(self, predictions: Dataset, labels: Dataset) -> MulticlassMetrics:
+        scores = np.asarray(predictions.to_numpy(), dtype=np.float64)
+        labs = np.asarray(labels.to_numpy()).reshape(-1).astype(np.int64)
+        if len(self.names) != scores.shape[0]:
+            raise ValueError("names must align with predictions")
+
+        groups: Dict[Any, list] = {}
+        for i, name in enumerate(self.names):
+            groups.setdefault(name, []).append(i)
+
+        agg_preds = []
+        agg_labels = []
+        for name, idxs in groups.items():
+            group_labels = labs[idxs]
+            if len(set(group_labels.tolist())) != 1:
+                raise AssertionError(f"conflicting labels for group {name}")
+            p = scores[idxs]
+            if self.policy == AggregationPolicy.BORDA:
+                agg = self._borda(p)
+            else:
+                agg = p.mean(axis=0)
+            agg_preds.append(int(np.argmax(agg)))
+            agg_labels.append(int(group_labels[0]))
+
+        return MulticlassClassifierEvaluator(self.num_classes).evaluate(
+            Dataset.of(np.asarray(agg_preds)), Dataset.of(np.asarray(agg_labels))
+        )

@@ -1,0 +1,136 @@
+"""Native host-side data plane (the analog of the reference's src/main/cpp
+tier, loaded there via System.loadLibrary — utils/external/VLFeat.scala:4).
+
+The C++ sources here are built on demand with g++ into a shared library inside
+the package directory and bound via ctypes. Everything degrades gracefully:
+if no compiler is available the pure-NumPy/PIL paths are used instead, so the
+library never hard-fails at import.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libkeystone_native.so")
+_SOURCES = [os.path.join(_DIR, "csv_loader.cpp")]
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH] + _SOURCES
+    try:
+        res = subprocess.run(cmd, capture_output=True, timeout=120)
+        return res.returncode == 0
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        newest_src = max(os.path.getmtime(s) for s in _SOURCES)
+        if not os.path.exists(_LIB_PATH) or os.path.getmtime(_LIB_PATH) < newest_src:
+            if not _build():
+                return None
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.ks_parse_csv.restype = ctypes.c_long
+        lib.ks_parse_csv.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_double),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        lib.ks_decode_pnm.restype = ctypes.c_int
+        lib.ks_decode_pnm.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
+        _lib = lib
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def parse_csv_floats(text: bytes) -> Tuple[np.ndarray, int, int]:
+    """Parse a CSV byte buffer into (flat float64 values, num_columns,
+    num_rows). Uses the native parser when available, else a NumPy fallback.
+    Callers should validate values.size == num_rows * num_columns to reject
+    ragged input."""
+    lib = get_lib()
+    if lib is not None:
+        # Upper bound on value count: every value is preceded by a separator
+        # or starts the buffer.
+        max_vals = (
+            text.count(b",")
+            + text.count(b"\n")
+            + text.count(b" ")
+            + text.count(b"\t")
+            + 2
+        )
+        out = np.empty(max_vals, dtype=np.float64)
+        ncols = ctypes.c_long(0)
+        nrows = ctypes.c_long(0)
+        n = lib.ks_parse_csv(
+            text,
+            len(text),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            max_vals,
+            ctypes.byref(ncols),
+            ctypes.byref(nrows),
+        )
+        return out[:n].copy(), int(ncols.value), int(nrows.value)
+    # Fallback
+    rows = [r for r in text.decode("utf-8", "ignore").splitlines() if r.strip()]
+    vals = []
+    ncols = 0
+    for r in rows:
+        parts = [p for p in r.replace(",", " ").split() if p]
+        if not ncols:
+            ncols = len(parts)
+        vals.extend(float(p) for p in parts)
+    return np.asarray(vals, dtype=np.float64), ncols, len(rows)
+
+
+def decode_pnm(data: bytes) -> Optional[np.ndarray]:
+    """Decode binary PPM/PGM bytes to a float32 (x, y, c) array via the
+    native decoder; None if the library is unavailable or decoding fails."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    max_vals = len(data) * 3
+    out = np.empty(max_vals, dtype=np.float32)
+    x = ctypes.c_long(0)
+    y = ctypes.c_long(0)
+    c = ctypes.c_long(0)
+    rc = lib.ks_decode_pnm(
+        data,
+        len(data),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        max_vals,
+        ctypes.byref(x),
+        ctypes.byref(y),
+        ctypes.byref(c),
+    )
+    if rc != 0:
+        return None
+    n = x.value * y.value * c.value
+    return out[:n].copy().reshape(x.value, y.value, c.value)

@@ -1,0 +1,501 @@
+"""NLP nodes: tokenization, n-grams, hashing TF, frequency encoding, n-gram
+indexers, and the Stupid Backoff language model
+(reference: nodes/nlp/{StringUtils,ngrams,HashingTF,NGramsHashingTF,
+WordFrequencyEncoder,indexers,StupidBackoff}.scala).
+
+Design stance: tokenization and n-gram bookkeeping are host-side work (they
+are in the reference too — Scala collections inside RDD maps); the device
+path begins once text becomes sparse/dense feature vectors. Hashes are
+deterministic FNV-1a (Python's builtin ``hash`` is salted per process, which
+would break cross-run reproducibility the reference gets from JVM ``.##``).
+"""
+
+from __future__ import annotations
+
+import re
+from collections import Counter
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow import Estimator, Transformer
+
+
+# ---------------------------------------------------------------------------
+# String transformers (reference: StringUtils.scala:13-29)
+# ---------------------------------------------------------------------------
+
+
+class Tokenizer(Transformer):
+    """Split on a regex (default: runs of punctuation/whitespace)."""
+
+    def __init__(self, sep: str = r"[^\w]+"):
+        self.sep = re.compile(sep)
+
+    def apply(self, s: str) -> List[str]:
+        tokens = self.sep.split(s)
+        # Java's String.split drops trailing empty strings but keeps leading
+        # ones; match that (StringUtils.scala:14).
+        while tokens and tokens[-1] == "":
+            tokens.pop()
+        return tokens
+
+
+class Trim(Transformer):
+    def apply(self, s: str) -> str:
+        return s.strip()
+
+
+class LowerCase(Transformer):
+    def apply(self, s: str) -> str:
+        return s.lower()
+
+
+# ---------------------------------------------------------------------------
+# NGram value type + featurizer (reference: ngrams.scala:20-136)
+# ---------------------------------------------------------------------------
+
+
+class NGram:
+    """Thin hashable wrapper over a tuple of words (ngrams.scala:100-131)."""
+
+    __slots__ = ("words",)
+
+    def __init__(self, words: Iterable):
+        self.words = tuple(words)
+
+    def __hash__(self) -> int:
+        return hash(self.words)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, NGram) and self.words == other.words
+
+    def __repr__(self) -> str:
+        return "[" + ",".join(str(w) for w in self.words) + "]"
+
+    def __len__(self) -> int:
+        return len(self.words)
+
+
+class NGramsFeaturizer(Transformer):
+    """Seq[T] -> all n-grams of the given consecutive orders, emitted in the
+    reference's order: for each start position, ascending order length
+    (ngrams.scala:20-97)."""
+
+    def __init__(self, orders: Sequence[int]):
+        self.orders = list(orders)
+        self.min_order = min(self.orders)
+        self.max_order = max(self.orders)
+        if self.min_order < 1:
+            raise ValueError(f"minimum order is not >= 1, found {self.min_order}")
+        for a, b in zip(self.orders, self.orders[1:]):
+            if b != a + 1:
+                raise ValueError(f"orders are not consecutive; contains {a} and {b}")
+
+    def apply(self, tokens: Sequence) -> List[Tuple]:
+        out = []
+        n = len(tokens)
+        for i in range(n - self.min_order + 1):
+            for order in range(self.min_order, self.max_order + 1):
+                if i + order > n:
+                    break
+                out.append(tuple(tokens[i : i + order]))
+        return out
+
+
+class NGramsCounts(Transformer):
+    """Count n-gram occurrences over the whole dataset, returning a Dataset of
+    (NGram, count) pairs sorted by descending count (ngrams.scala:152-185).
+
+    mode="default" aggregates + sorts; mode="no_add" emits per-item counts
+    without cross-item aggregation (NGramsCountsMode)."""
+
+    def __init__(self, mode: str = "default"):
+        if mode not in ("default", "no_add"):
+            raise ValueError('mode must be "default" or "no_add"')
+        self.mode = mode
+
+    def apply(self, ngram_lists):
+        counts = Counter(NGram(g) for g in ngram_lists)
+        return list(counts.items())
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        if self.mode == "no_add":
+            return Dataset.of([self.apply(item) for item in data.to_list()])
+        counts: Counter = Counter()
+        for item in data.to_list():
+            counts.update(NGram(g) for g in item)
+        ordered = sorted(counts.items(), key=lambda kv: -kv[1])
+        return Dataset.of(ordered)
+
+
+# ---------------------------------------------------------------------------
+# Hashing TF (reference: HashingTF.scala:15-31, NGramsHashingTF.scala:25-120)
+# ---------------------------------------------------------------------------
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+
+
+def stable_hash(term) -> int:
+    """Deterministic 64-bit FNV-1a over the term's string form (replaces the
+    JVM's ``.##``, which is stable; Python's ``hash`` is salted)."""
+    h = _FNV_OFFSET
+    for b in str(term).encode("utf-8"):
+        h ^= b
+        h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+def _ngram_hash(words: Tuple) -> int:
+    """Stable hash of an n-gram that can be computed rolling: FNV-1a over the
+    per-word hashes."""
+    h = _FNV_OFFSET
+    for w in words:
+        wh = stable_hash(w)
+        for _ in range(8):
+            h ^= wh & 0xFF
+            h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+            wh >>= 8
+    return h
+
+
+class HashingTF(Transformer):
+    """Terms -> {index: frequency} via the hashing trick
+    (HashingTF.scala:15-31). Single terms hash by value; tuple terms (n-grams)
+    hash by the rolling n-gram hash so NGramsHashingTF matches exactly."""
+
+    def __init__(self, num_features: int):
+        self.num_features = num_features
+
+    def term_index(self, term) -> int:
+        h = _ngram_hash(term) if isinstance(term, tuple) else stable_hash(term)
+        return h % self.num_features
+
+    def apply(self, document: Sequence) -> Dict[int, float]:
+        tf: Dict[int, float] = {}
+        for term in document:
+            i = self.term_index(term)
+            tf[i] = tf.get(i, 0.0) + 1.0
+        return tf
+
+
+class NGramsHashingTF(Transformer):
+    """Fused n-gram extraction + hashing TF, computing each n-gram's hash by
+    extending the (order-1) prefix hash instead of materializing tuples —
+    returns exactly HashingTF(NGramsFeaturizer(orders))
+    (NGramsHashingTF.scala:25-120)."""
+
+    def __init__(self, orders: Sequence[int], num_features: int):
+        self._featurizer = NGramsFeaturizer(orders)  # validates orders
+        self.orders = self._featurizer.orders
+        self.num_features = num_features
+
+    def apply(self, tokens: Sequence) -> Dict[int, float]:
+        min_o, max_o = self._featurizer.min_order, self._featurizer.max_order
+        n = len(tokens)
+        word_hashes = [stable_hash(t) for t in tokens]
+        tf: Dict[int, float] = {}
+        for i in range(n - min_o + 1):
+            h = _FNV_OFFSET
+            for j in range(i, min(i + max_o, n)):
+                wh = word_hashes[j]
+                for _ in range(8):
+                    h ^= wh & 0xFF
+                    h = (h * _FNV_PRIME) & 0xFFFFFFFFFFFFFFFF
+                    wh >>= 8
+                order = j - i + 1
+                if order >= min_o:
+                    idx = h % self.num_features
+                    tf[idx] = tf.get(idx, 0.0) + 1.0
+        return tf
+
+
+# ---------------------------------------------------------------------------
+# Word frequency encoding (reference: WordFrequencyEncoder.scala:7-62)
+# ---------------------------------------------------------------------------
+
+
+class WordFrequencyTransformer(Transformer):
+    """Token -> frequency-rank index; out-of-vocabulary -> −1."""
+
+    OOV_INDEX = -1
+
+    def __init__(self, word_index: Dict[str, int], unigram_counts: Dict[int, int]):
+        self.word_index = word_index
+        self.unigram_counts = unigram_counts
+
+    def apply(self, words: Sequence[str]) -> List[int]:
+        return [self.word_index.get(w, self.OOV_INDEX) for w in words]
+
+
+class WordFrequencyEncoder(Estimator):
+    """Fit the vocabulary sorted by descending frequency
+    (WordFrequencyEncoder.scala:11-30)."""
+
+    def fit(self, data: Dataset) -> WordFrequencyTransformer:
+        counts: Counter = Counter()
+        for tokens in data.to_list():
+            counts.update(tokens)
+        ordered = sorted(counts.items(), key=lambda kv: -kv[1])
+        word_index = {w: i for i, (w, _) in enumerate(ordered)}
+        unigram_counts = {word_index[w]: c for w, c in ordered}
+        return WordFrequencyTransformer(word_index, unigram_counts)
+
+
+# ---------------------------------------------------------------------------
+# Term frequency weighting lives in ops/stats.py (TermFrequency); lemmatizing
+# n-grams (reference: CoreNLPFeatureExtractor.scala:18 — an external CoreNLP
+# dependency) is provided as a pluggable-lemmatizer node.
+# ---------------------------------------------------------------------------
+
+
+_SUFFIXES = ("ing", "edly", "ed", "es", "s", "ly")
+
+
+def _default_lemmatizer(word: str) -> str:
+    w = word.lower()
+    for suf in _SUFFIXES:
+        if w.endswith(suf) and len(w) > len(suf) + 2:
+            return w[: -len(suf)]
+    return w
+
+
+class CoreNLPFeatureExtractor(Transformer):
+    """Sentence -> lemmatized n-grams. The reference shells out to Stanford
+    CoreNLP (CoreNLPFeatureExtractor.scala:18); here the lemmatizer is a
+    pluggable callable with a light rule-based default, keeping the node's
+    contract (lemma n-grams of orders 1..n) without the external dependency."""
+
+    def __init__(self, orders: Sequence[int], lemmatizer: Optional[Callable[[str], str]] = None):
+        self.featurizer = NGramsFeaturizer(orders)
+        self.lemmatizer = lemmatizer or _default_lemmatizer
+        self.tokenizer = Tokenizer()
+
+    def apply(self, sentence: str) -> List[Tuple]:
+        lemmas = [self.lemmatizer(t) for t in self.tokenizer.apply(sentence) if t]
+        return self.featurizer.apply(lemmas)
+
+
+# ---------------------------------------------------------------------------
+# N-gram indexers (reference: indexers.scala:5-135)
+# ---------------------------------------------------------------------------
+
+
+class NGramIndexer:
+    min_ngram_order = 1
+    max_ngram_order = 5
+
+    def pack(self, ngram: Sequence) -> Any:
+        raise NotImplementedError
+
+
+class BackoffIndexer(NGramIndexer):
+    def unpack(self, ngram, pos: int):
+        raise NotImplementedError
+
+    def remove_farthest_word(self, ngram):
+        raise NotImplementedError
+
+    def remove_current_word(self, ngram):
+        raise NotImplementedError
+
+    def ngram_order(self, ngram) -> int:
+        raise NotImplementedError
+
+
+class NGramIndexerImpl(BackoffIndexer):
+    """NGram-tuple indexer (indexers.scala:117-135)."""
+
+    def pack(self, ngram: Sequence) -> NGram:
+        return NGram(ngram)
+
+    def unpack(self, ngram: NGram, pos: int):
+        return ngram.words[pos]
+
+    def remove_farthest_word(self, ngram: NGram) -> NGram:
+        return NGram(ngram.words[1:])
+
+    def remove_current_word(self, ngram: NGram) -> NGram:
+        return NGram(ngram.words[:-1])
+
+    def ngram_order(self, ngram: NGram) -> int:
+        return len(ngram.words)
+
+
+class NaiveBitPackIndexer(BackoffIndexer):
+    """Packs up to 3 word ids (< 2^20) into one 64-bit int, 4 control bits +
+    three 20-bit fields, left-aligned (indexers.scala:43-115)."""
+
+    min_ngram_order = 1
+    max_ngram_order = 3
+    _MASK20 = (1 << 20) - 1
+
+    def pack(self, ngram: Sequence[int]) -> int:
+        for w in ngram:
+            if w >= 1 << 20:
+                raise ValueError(f"word id {w} >= 2^20")
+        n = len(ngram)
+        if n == 1:
+            return ngram[0] << 40
+        if n == 2:
+            return (ngram[1] << 20) | (ngram[0] << 40) | (1 << 60)
+        if n == 3:
+            return ngram[2] | (ngram[1] << 20) | (ngram[0] << 40) | (1 << 61)
+        raise ValueError("ngram order must be in {1, 2, 3}")
+
+    def unpack(self, ngram: int, pos: int) -> int:
+        if pos == 0:
+            return (ngram >> 40) & self._MASK20
+        if pos == 1:
+            return (ngram >> 20) & self._MASK20
+        if pos == 2:
+            return ngram & self._MASK20
+        raise ValueError("pos must be in {0, 1, 2}")
+
+    def ngram_order(self, ngram: int) -> int:
+        order = (ngram >> 60) & 0xF
+        if not (self.min_ngram_order <= order + 1 <= self.max_ngram_order):
+            raise ValueError(f"raw control bits {order} are invalid")
+        return order + 1
+
+    def remove_farthest_word(self, ngram: int) -> int:
+        order = self.ngram_order(ngram)
+        stripped = ngram & ((1 << 40) - 1)
+        shifted = stripped << 20
+        if order == 2:
+            return shifted & ~(0xF << 60)
+        if order == 3:
+            return (shifted & ~(0xF << 60)) | (1 << 60)
+        raise ValueError(f"ngram order not supported: {order}")
+
+    def remove_current_word(self, ngram: int) -> int:
+        order = self.ngram_order(ngram)
+        if order == 2:
+            return (ngram & ~((1 << 40) - 1)) & ~(0xF << 60)
+        if order == 3:
+            return ((ngram & ~((1 << 20) - 1)) & ~(0xF << 60)) | (1 << 60)
+        raise ValueError(f"ngram order not supported: {order}")
+
+
+# ---------------------------------------------------------------------------
+# Stupid Backoff LM (reference: StupidBackoff.scala:25-182; Brants et al. 2007)
+# ---------------------------------------------------------------------------
+
+
+def initial_bigram_partition(ngram, num_partitions: int, indexer: BackoffIndexer) -> int:
+    """Partition id by hashing the first two context words — groups n-grams
+    sharing their initial bigram (InitialBigramPartitioner,
+    StupidBackoff.scala:25-58). On TPU this is the host-side shard key for
+    multi-host score tables rather than a Spark shuffle partitioner."""
+    if indexer.ngram_order(ngram) > 1:
+        first = indexer.unpack(ngram, 0)
+        second = indexer.unpack(ngram, 1)
+        return _ngram_hash((first, second)) % num_partitions
+    return 0
+
+
+def _score_locally(
+    indexer: BackoffIndexer,
+    unigram_counts: Dict[Any, int],
+    get_ngram_count: Callable,
+    num_tokens: int,
+    alpha: float,
+    accum: float,
+    ngram,
+    ngram_freq: int,
+) -> float:
+    """Recursive backoff score S(w | context) (StupidBackoff.scala:62-93)."""
+    while True:
+        order = indexer.ngram_order(ngram)
+        if order == 1:
+            return accum * ngram_freq / num_tokens
+        if ngram_freq != 0:
+            context = indexer.remove_current_word(ngram)
+            if order != 2:
+                context_freq = get_ngram_count(context)
+            else:
+                context_freq = unigram_counts.get(indexer.unpack(context, 0), 0)
+            return accum * ngram_freq / context_freq
+        backoffed = indexer.remove_farthest_word(ngram)
+        if order != 2:
+            freq = get_ngram_count(backoffed)
+        else:
+            freq = unigram_counts.get(indexer.unpack(backoffed, 0), 0)
+        accum *= alpha
+        ngram = backoffed
+        ngram_freq = freq
+
+
+class StupidBackoffModel(Transformer):
+    """Query-only LM model: use ``score(ngram)``
+    (StupidBackoff.scala:96-125)."""
+
+    def __init__(
+        self,
+        scores: Dict[NGram, float],
+        ngram_counts: Dict[NGram, int],
+        indexer: BackoffIndexer,
+        unigram_counts: Dict[Any, int],
+        num_tokens: int,
+        alpha: float = 0.4,
+    ):
+        self.scores = scores
+        self.ngram_counts = ngram_counts
+        self.indexer = indexer
+        self.unigram_counts = unigram_counts
+        self.num_tokens = num_tokens
+        self.alpha = alpha
+
+    def score(self, ngram: NGram) -> float:
+        return _score_locally(
+            self.indexer,
+            self.unigram_counts,
+            lambda g: self.ngram_counts.get(g, 0),
+            self.num_tokens,
+            self.alpha,
+            1.0,
+            ngram,
+            self.ngram_counts.get(ngram, 0),
+        )
+
+    def apply(self, ignored):
+        raise NotImplementedError(
+            "Doesn't make sense to chain this node; use score(ngram) to query."
+        )
+
+
+class StupidBackoffEstimator(Estimator):
+    """Scores every observed n-gram (StupidBackoff.scala:128-182). Input: a
+    Dataset of (NGram, count) pairs, e.g. from NGramsCounts."""
+
+    def __init__(self, unigram_counts: Dict[Any, int], alpha: float = 0.4):
+        self.unigram_counts = unigram_counts
+        self.alpha = alpha
+        self.indexer = NGramIndexerImpl()
+
+    def fit(self, data: Dataset) -> StupidBackoffModel:
+        counts: Dict[NGram, int] = {}
+        for ngram, c in data.to_list():
+            key = ngram if isinstance(ngram, NGram) else NGram(ngram)
+            counts[key] = counts.get(key, 0) + int(c)
+        num_tokens = sum(self.unigram_counts.values())
+
+        get_count = lambda g: counts.get(g, 0)
+        scores: Dict[NGram, float] = {}
+        for ngram, freq in counts.items():
+            s = _score_locally(
+                self.indexer,
+                self.unigram_counts,
+                get_count,
+                num_tokens,
+                self.alpha,
+                1.0,
+                ngram,
+                freq,
+            )
+            if not (0.0 <= s <= 1.0):
+                raise ValueError(f"score = {s:.4f} not in [0,1], ngram = {ngram}")
+            scores[ngram] = s
+        return StupidBackoffModel(
+            scores, counts, self.indexer, self.unigram_counts, num_tokens, self.alpha
+        )

@@ -1,0 +1,75 @@
+"""MAP + AugmentedExamples evaluator tests (model: reference
+MeanAveragePrecisionSuite, AugmentedExamplesEvaluatorSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.evaluation import (
+    AggregationPolicy,
+    AugmentedExamplesEvaluator,
+    MeanAveragePrecisionEvaluator,
+)
+
+
+class TestMeanAveragePrecision:
+    def test_perfect_ranking_is_ap_one(self):
+        # class 0 positives scored above negatives -> AP = 1
+        scores = np.array([[0.9, 0.1], [0.8, 0.2], [0.1, 0.9], [0.2, 0.8]])
+        labels = [[0], [0], [1], [1]]
+        aps = np.asarray(
+            MeanAveragePrecisionEvaluator(2).evaluate(Dataset.of(scores), Dataset.of(labels))
+        )
+        np.testing.assert_allclose(aps, [1.0, 1.0])
+
+    def test_known_interpolated_ap(self):
+        # One class, 2 positives among 4; ranking: pos, neg, pos, neg.
+        # precision at recalls: r=0.5 -> p=1.0; r=1.0 -> p=2/3.
+        # 11-point AP = (6*1.0 + 5*(2/3))/11
+        scores = np.array([[0.9], [0.8], [0.7], [0.6]])
+        labels = [[0], [], [0], []]
+        aps = np.asarray(
+            MeanAveragePrecisionEvaluator(1).evaluate(Dataset.of(scores), Dataset.of(labels))
+        )
+        expected = (6 * 1.0 + 5 * (2 / 3)) / 11
+        np.testing.assert_allclose(aps, [expected], rtol=1e-6)
+
+    def test_multilabel_examples(self):
+        scores = np.array([[0.9, 0.9], [0.1, 0.8]])
+        labels = [[0, 1], [1]]
+        aps = np.asarray(
+            MeanAveragePrecisionEvaluator(2).evaluate(Dataset.of(scores), Dataset.of(labels))
+        )
+        np.testing.assert_allclose(aps, [1.0, 1.0])
+
+
+class TestAugmentedExamplesEvaluator:
+    def test_average_policy_recovers_label(self):
+        # two underlying images, three augmented copies each
+        names = ["a", "a", "a", "b", "b", "b"]
+        preds = np.array(
+            [
+                [0.6, 0.4], [0.4, 0.6], [0.8, 0.2],  # a -> avg favors 0
+                [0.1, 0.9], [0.6, 0.4], [0.2, 0.8],  # b -> avg favors 1
+            ]
+        )
+        labels = np.array([0, 0, 0, 1, 1, 1])
+        m = AugmentedExamplesEvaluator(names, 2).evaluate(Dataset.of(preds), Dataset.of(labels))
+        assert m.accuracy == pytest.approx(1.0)
+
+    def test_borda_policy(self):
+        names = ["a", "a"]
+        preds = np.array([[0.55, 0.45, 0.0], [0.0, 0.6, 0.4]])
+        labels = np.array([1, 1])
+        m = AugmentedExamplesEvaluator(
+            names, 3, policy=AggregationPolicy.BORDA
+        ).evaluate(Dataset.of(preds), Dataset.of(labels))
+        # ranks: copy1 -> [2,1,0], copy2 -> [0,2,1]; sums [2,3,1] -> argmax 1
+        assert m.accuracy == pytest.approx(1.0)
+
+    def test_conflicting_labels_raise(self):
+        with pytest.raises(AssertionError):
+            AugmentedExamplesEvaluator(["a", "a"], 2).evaluate(
+                Dataset.of(np.array([[1.0, 0.0], [1.0, 0.0]])),
+                Dataset.of(np.array([0, 1])),
+            )

@@ -1,0 +1,177 @@
+"""NLP node tests (model: reference nodes/nlp test suites: TokenizerSuite,
+NGramSuite, NGramsFeaturizerSuite, NGramsHashingTFSuite, WordFrequencyEncoderSuite,
+NaiveBitPackIndexerSuite, StupidBackoffSuite)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.nlp import (
+    CoreNLPFeatureExtractor,
+    HashingTF,
+    LowerCase,
+    NaiveBitPackIndexer,
+    NGram,
+    NGramIndexerImpl,
+    NGramsCounts,
+    NGramsFeaturizer,
+    NGramsHashingTF,
+    StupidBackoffEstimator,
+    Tokenizer,
+    Trim,
+    WordFrequencyEncoder,
+    initial_bigram_partition,
+)
+
+
+class TestStringNodes:
+    def test_tokenizer(self):
+        assert Tokenizer().apply("Hello, world  foo") == ["Hello", "world", "foo"]
+
+    def test_trim_lowercase_chain(self):
+        pipe = Trim().and_then(LowerCase()).and_then(Tokenizer())
+        out = pipe.apply("  Hello World ").get()
+        assert out == ["hello", "world"]
+
+
+class TestNGrams:
+    def test_featurizer_reference_order(self):
+        grams = NGramsFeaturizer([1, 2]).apply(["a", "b", "c"])
+        assert grams == [("a",), ("a", "b"), ("b",), ("b", "c"), ("c",)]
+
+    def test_featurizer_validation(self):
+        with pytest.raises(ValueError):
+            NGramsFeaturizer([0, 1])
+        with pytest.raises(ValueError):
+            NGramsFeaturizer([1, 3])
+
+    def test_ngram_equality_hash(self):
+        assert NGram(["a", "b"]) == NGram(("a", "b"))
+        assert hash(NGram([1, 2])) == hash(NGram((1, 2)))
+        assert NGram(["a"]) != NGram(["a", "a"])
+
+    def test_counts_sorted_desc(self):
+        data = Dataset.of([[("a",), ("b",), ("a",)], [("a",), ("c",)]])
+        out = NGramsCounts().batch_apply(data).to_list()
+        assert out[0] == (NGram(("a",)), 3)
+        assert set(dict(out).values()) == {3, 1}
+
+    def test_counts_no_add(self):
+        data = Dataset.of([[("a",), ("a",)], [("a",)]])
+        out = NGramsCounts(mode="no_add").batch_apply(data).to_list()
+        assert dict(out[0])[NGram(("a",))] == 2
+        assert dict(out[1])[NGram(("a",))] == 1
+
+
+class TestHashing:
+    def test_hashing_tf_counts(self):
+        tf = HashingTF(64).apply(["x", "y", "x"])
+        assert sum(tf.values()) == 3.0
+        assert max(tf.values()) == 2.0
+
+    def test_ngrams_hashing_tf_matches_composition(self):
+        """Rolling-hash fusion must equal HashingTF ∘ NGramsFeaturizer
+        (NGramsHashingTF.scala contract)."""
+        rng = np.random.default_rng(0)
+        vocab = ["alpha", "beta", "gamma", "delta", "eps"]
+        for trial in range(5):
+            tokens = [vocab[i] for i in rng.integers(0, len(vocab), size=12)]
+            for orders in ([1, 2], [2, 3], [1, 2, 3]):
+                fused = NGramsHashingTF(orders, 128).apply(tokens)
+                grams = NGramsFeaturizer(orders).apply(tokens)
+                composed = HashingTF(128).apply(grams)
+                assert fused == composed
+
+
+class TestWordFrequencyEncoder:
+    def test_rank_and_oov(self):
+        data = Dataset.of([["a", "b", "a"], ["a", "c", "b"]])
+        enc = WordFrequencyEncoder().fit(data)
+        assert enc.apply(["a", "b", "c", "zzz"]) == [0, 1, 2, -1]
+        # unigram counts keyed by rank
+        assert enc.unigram_counts[0] == 3
+        assert enc.unigram_counts[1] == 2
+
+
+class TestIndexers:
+    def test_bitpack_roundtrip(self):
+        idx = NaiveBitPackIndexer()
+        for gram in ([5], [5, 9], [5, 9, 13]):
+            packed = idx.pack(gram)
+            assert idx.ngram_order(packed) == len(gram)
+            for pos, w in enumerate(gram):
+                assert idx.unpack(packed, pos) == w
+
+    def test_bitpack_remove_words(self):
+        idx = NaiveBitPackIndexer()
+        tri = idx.pack([5, 9, 13])
+        no_far = idx.remove_farthest_word(tri)
+        assert idx.ngram_order(no_far) == 2
+        assert idx.unpack(no_far, 0) == 9 and idx.unpack(no_far, 1) == 13
+        no_cur = idx.remove_current_word(tri)
+        assert idx.ngram_order(no_cur) == 2
+        assert idx.unpack(no_cur, 0) == 5 and idx.unpack(no_cur, 1) == 9
+
+    def test_bitpack_vocab_limit(self):
+        with pytest.raises(ValueError):
+            NaiveBitPackIndexer().pack([1 << 20])
+
+    def test_ngram_indexer_impl(self):
+        idx = NGramIndexerImpl()
+        g = idx.pack(["x", "y", "z"])
+        assert idx.remove_farthest_word(g) == NGram(["y", "z"])
+        assert idx.remove_current_word(g) == NGram(["x", "y"])
+        assert idx.ngram_order(g) == 3
+
+    def test_initial_bigram_partition_groups_shared_context(self):
+        idx = NGramIndexerImpl()
+        a = initial_bigram_partition(NGram(["u", "v", "w"]), 7, idx)
+        b = initial_bigram_partition(NGram(["u", "v", "x"]), 7, idx)
+        assert a == b
+        assert initial_bigram_partition(NGram(["u"]), 7, idx) == 0
+
+
+class TestStupidBackoff:
+    def _fit(self):
+        corpus = [["the", "cat", "sat"], ["the", "cat", "ran"], ["the", "dog", "sat"]]
+        data = Dataset.of(corpus)
+        grams = NGramsFeaturizer([1, 2, 3]).batch_apply(data)
+        counts = NGramsCounts().batch_apply(grams)
+        unigrams = {w: c for (ng, c) in counts.to_list() if len(ng) == 1 for w in ng.words}
+        model = StupidBackoffEstimator(unigram_counts=unigrams).fit(
+            Dataset.of([kv for kv in counts.to_list() if len(kv[0]) > 1])
+        )
+        return model, unigrams
+
+    def test_seen_bigram_score(self):
+        model, unigrams = self._fit()
+        # S(cat | the) = freq(the cat)/freq(the) = 2/3
+        assert model.score(NGram(["the", "cat"])) == pytest.approx(2 / 3)
+
+    def test_seen_trigram_score(self):
+        model, _ = self._fit()
+        # S(sat | the cat) = freq(the cat sat)/freq(the cat) = 1/2
+        assert model.score(NGram(["the", "cat", "sat"])) == pytest.approx(1 / 2)
+
+    def test_unseen_backs_off_to_unigram(self):
+        model, unigrams = self._fit()
+        n_tokens = sum(unigrams.values())
+        # "dog ran" unseen -> alpha * S(ran) = 0.4 * freq(ran)/N
+        expected = 0.4 * unigrams_count("ran", unigrams) / n_tokens
+        assert model.score(NGram(["dog", "ran"])) == pytest.approx(expected)
+
+    def test_scores_in_unit_interval(self):
+        model, _ = self._fit()
+        for g, s in model.scores.items():
+            assert 0.0 <= s <= 1.0
+
+
+def unigrams_count(w, unigrams):
+    return unigrams[w]
+
+
+class TestCoreNLP:
+    def test_lemmatized_ngrams(self):
+        out = CoreNLPFeatureExtractor([1, 2]).apply("The cats running")
+        assert ("cat",) in out
+        assert ("runn",) in out or ("run",) in out
