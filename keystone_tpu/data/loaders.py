@@ -297,3 +297,70 @@ def synthetic_timit(n: int = 8192, seed: int = 0) -> LabeledData:
         n, TimitFeaturesDataLoader.num_features, TimitFeaturesDataLoader.num_classes,
         seed=seed, class_sep=0.6,
     )
+
+
+def synthetic_cifar(n: int = 256, seed: int = 0, num_classes: int = 10) -> LabeledData:
+    """CIFAR-shaped synthetic images: (n, 32, 32, 3) in [0, 255] with a
+    class-dependent low-frequency pattern plus noise, so convolutional
+    featurizers have signal to find."""
+    rng = np.random.default_rng(seed)
+    labels = rng.integers(0, num_classes, size=n)
+    yy, xx = np.meshgrid(np.arange(32), np.arange(32), indexing="ij")
+    # One spatial frequency/phase pattern per class (fixed across splits).
+    pat_rng = np.random.default_rng(1234)
+    freqs = pat_rng.uniform(0.2, 1.2, size=(num_classes, 2))
+    phases = pat_rng.uniform(0, 2 * np.pi, size=(num_classes, 3))
+    images = np.empty((n, 32, 32, 3), dtype=np.float64)
+    for c in range(num_classes):
+        base = np.stack(
+            [
+                np.sin(freqs[c, 0] * xx + freqs[c, 1] * yy + phases[c, ch])
+                for ch in range(3)
+            ],
+            axis=-1,
+        )
+        mask = labels == c
+        images[mask] = 127.5 + 90.0 * base
+    images += rng.normal(scale=25.0, size=images.shape)
+    return LabeledData(np.clip(images, 0, 255), labels.astype(np.int64))
+
+
+def synthetic_documents(
+    n: int,
+    num_classes: int,
+    seed: int = 0,
+    doc_len: int = 40,
+    vocab_per_class: int = 30,
+    shared_vocab: int = 60,
+) -> LabeledData:
+    """Synthetic text classification corpus: each class has a private vocab
+    mixed with a shared vocab; documents are whitespace-joined word samples.
+    Data is a host list of strings (the loaders' wholeTextFiles analog)."""
+    rng = np.random.default_rng(seed)
+    shared = [f"word{i}" for i in range(shared_vocab)]
+    private = [
+        [f"c{c}term{i}" for i in range(vocab_per_class)] for c in range(num_classes)
+    ]
+    labels = rng.integers(0, num_classes, size=n)
+    docs = []
+    for lab in labels:
+        k_private = rng.binomial(doc_len, 0.5)
+        words = list(rng.choice(private[lab], size=k_private)) + list(
+            rng.choice(shared, size=doc_len - k_private)
+        )
+        rng.shuffle(words)
+        docs.append(" ".join(words))
+    return LabeledData(list(docs), labels.astype(np.int64))
+
+
+def synthetic_sentences(n: int = 200, seed: int = 0, sentence_len: int = 12) -> Dataset:
+    """Synthetic corpus of sentences over a small Zipf-ish vocabulary (for the
+    StupidBackoff language-model pipeline)."""
+    rng = np.random.default_rng(seed)
+    vocab = [f"w{i}" for i in range(50)]
+    probs = 1.0 / np.arange(1, len(vocab) + 1)
+    probs /= probs.sum()
+    sents = [
+        " ".join(rng.choice(vocab, size=sentence_len, p=probs)) for _ in range(n)
+    ]
+    return Dataset.of(sents)

@@ -1,1 +1,21 @@
-"""Example end-to-end pipelines (reference: pipelines/ — the acceptance workloads)."""
+"""Example end-to-end pipelines (reference: pipelines/ — the acceptance
+workloads; see SURVEY.md §2.9).
+
+Each module follows the reference skeleton: a Config dataclass, a
+``run(config)`` returning (pipeline, metrics...), and a flag-parsing
+``main``. Launch by name via ``python -m keystone_tpu.run <Name>``.
+
+Modules are imported lazily (by run.py or by the user) so launching one
+pipeline does not pay the import cost of all of them.
+"""
+
+__all__ = [
+    "amazon_reviews",
+    "cifar",
+    "imagenet_sift_lcs_fv",
+    "mnist_random_fft",
+    "newsgroups",
+    "stupid_backoff",
+    "timit",
+    "voc_sift_fisher",
+]

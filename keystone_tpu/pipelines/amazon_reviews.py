@@ -1,0 +1,106 @@
+"""AmazonReviewsPipeline: ngram term-frequency features + logistic regression
+for binary sentiment (reference: pipelines/text/AmazonReviewsPipeline.scala:27-79).
+
+Composition: Trim → LowerCase → Tokenizer → NGramsFeaturizer(1..n) →
+TermFrequency(binary) → CommonSparseFeatures(topK) → LogisticRegression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from keystone_tpu.data import Dataset, LabeledData
+from keystone_tpu.data.loaders import load_amazon_reviews, synthetic_documents
+from keystone_tpu.evaluation import BinaryClassifierEvaluator
+from keystone_tpu.ops.learning.classifiers import LogisticRegressionEstimator
+from keystone_tpu.ops.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from keystone_tpu.ops.sparse import CommonSparseFeatures
+from keystone_tpu.ops.stats import TermFrequency
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.amazon")
+
+
+@dataclass
+class AmazonReviewsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    threshold: float = 3.5
+    n_grams: int = 2
+    common_features: int = 1000
+    num_iters: int = 20
+    seed: int = 0
+    synthetic_n: int = 256
+
+
+def build_featurizer(config: AmazonReviewsConfig) -> Pipeline:
+    return (
+        Trim()
+        .to_pipeline()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(range(1, config.n_grams + 1)))
+        .and_then(TermFrequency(weighting=lambda x: 1))
+    )
+
+
+def run(config: AmazonReviewsConfig):
+    start = time.time()
+    if config.train_location:
+        train = load_amazon_reviews(config.train_location, config.threshold)
+        test = load_amazon_reviews(config.test_location, config.threshold)
+    else:
+        train = synthetic_documents(config.synthetic_n, 2, seed=config.seed)
+        test = synthetic_documents(
+            max(config.synthetic_n // 4, 64), 2, seed=config.seed + 1
+        )
+
+    featurizer = build_featurizer(config)
+    pipeline = featurizer.and_then(
+        CommonSparseFeatures(config.common_features), train.data
+    ).and_then(
+        LogisticRegressionEstimator(2, num_iters=config.num_iters),
+        train.data,
+        train.labels,
+    )
+
+    evaluator = BinaryClassifierEvaluator()
+    train_preds = pipeline.apply(train.data)
+    train_eval = evaluator.evaluate(train_preds, train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info("TRAIN accuracy %.4f", train_eval.accuracy)
+    logger.info("TEST accuracy %.4f", test_eval.accuracy)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("AmazonReviewsPipeline")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--threshold", type=float, default=3.5)
+    parser.add_argument("--nGrams", type=int, default=2)
+    parser.add_argument("--commonFeatures", type=int, default=1000)
+    parser.add_argument("--numIters", type=int, default=20)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = AmazonReviewsConfig(
+        train_location=args.trainLocation,
+        test_location=args.testLocation,
+        threshold=args.threshold,
+        n_grams=args.nGrams,
+        common_features=args.commonFeatures,
+        num_iters=args.numIters,
+    )
+    _, train_eval, test_eval = run(config)
+    print(f"TRAIN accuracy is {train_eval.accuracy:.4f}")
+    print(f"TEST accuracy is {test_eval.accuracy:.4f}")
+
+
+if __name__ == "__main__":
+    main()

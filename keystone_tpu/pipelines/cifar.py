@@ -1,0 +1,374 @@
+"""CIFAR-10 pipelines (reference: pipelines/images/cifar/).
+
+- LinearPixels: grayscale pixels → exact least squares
+  (LinearPixels.scala:18-56).
+- RandomCifar: random gaussian conv filters → rectify → pool → least squares
+  (RandomCifar.scala:20-77).
+- RandomPatchCifar: ZCA-whitened random training patches as conv filters →
+  rectify → pool → standardize → block least squares
+  (RandomPatchCifar.scala:21-86).
+- RandomPatchCifarKernel: same featurization → Gaussian-kernel ridge
+  regression (RandomPatchCifarKernel.scala:33-76).
+- RandomPatchCifarAugmented: random train crops + center/corner test crops,
+  vote over augmented copies (RandomPatchCifarAugmented.scala:27-90).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset, LabeledData
+from keystone_tpu.data.loaders import load_cifar_binary, synthetic_cifar
+from keystone_tpu.evaluation import (
+    AugmentedExamplesEvaluator,
+    MulticlassClassifierEvaluator,
+)
+from keystone_tpu.ops.images.conv import Convolver, Pooler, SymmetricRectifier
+from keystone_tpu.ops.images.core import (
+    CenterCornerPatcher,
+    GrayScaler,
+    ImageVectorizer,
+    PixelScaler,
+    RandomPatcher,
+)
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.kernel import (
+    GaussianKernelGenerator,
+    KernelRidgeRegression,
+)
+from keystone_tpu.ops.learning.linear import LinearMapEstimator
+from keystone_tpu.ops.learning.pca import ZCAWhitenerEstimator
+from keystone_tpu.ops.stats import StandardScaler
+from keystone_tpu.ops.util import (
+    Cacher,
+    ClassLabelIndicatorsFromIntLabels,
+    MaxClassifier,
+)
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.cifar")
+
+NUM_CLASSES = 10
+
+
+@dataclass
+class CifarConfig:
+    train_location: str = ""
+    test_location: str = ""
+    num_filters: int = 100
+    whitener_size: int = 1000  # patches sampled for the ZCA fit
+    patch_size: int = 6
+    patch_steps: int = 1
+    pool_size: int = 10
+    pool_stride: int = 9
+    alpha: float = 0.25
+    lam: float = 10.0
+    # Kernel variant (RandomPatchCifarKernel.scala:33-76)
+    kernel_gamma: float = 5e-4
+    block_size: int = 512
+    num_epochs: int = 1
+    # Augmented variant (RandomPatchCifarAugmented.scala:27-90)
+    augment_patch_size: int = 24
+    augment_patches: int = 8
+    seed: int = 0
+    synthetic_n: int = 512
+
+
+def _load(config: CifarConfig):
+    if config.train_location:
+        train = load_cifar_binary(config.train_location)
+        test = load_cifar_binary(config.test_location)
+    else:
+        train = synthetic_cifar(config.synthetic_n, seed=config.seed)
+        test = synthetic_cifar(max(config.synthetic_n // 4, 128), seed=config.seed + 1)
+    return train, test
+
+
+def _sample_whitened_filters(train: LabeledData, config: CifarConfig):
+    """Random training patches, row-normalized, ZCA-whitened, subsampled to a
+    conv filter bank (RandomPatchCifar.scala:36-58)."""
+    images = np.asarray(train.data.array, dtype=np.float64)[: train.data.n]
+    per_image = max(1, config.whitener_size // images.shape[0] + 1)
+    patcher = RandomPatcher(
+        per_image, config.patch_size, config.patch_size, seed=config.seed + 7
+    )
+    patches = np.asarray(patcher.batch_apply(train.data).array)
+    patches = patches.reshape(patches.shape[0], -1)[: config.whitener_size]
+    # Row normalization with the reference's variance floor (Stats.normalizeRows)
+    norms = np.sqrt(np.maximum(np.var(patches, axis=1) * patches.shape[1], 10.0))
+    patches = (patches - patches.mean(axis=1, keepdims=True)) / norms[:, None]
+    whitener = ZCAWhitenerEstimator(eps=0.1).fit_single(jnp.asarray(patches))
+    rng = np.random.default_rng(config.seed + 13)
+    idx = rng.choice(patches.shape[0], size=config.num_filters, replace=False)
+    sampled = np.array(whitener.apply(jnp.asarray(patches[idx])))
+    # Renormalize whitened filters (RandomPatchCifar.scala:52-57).
+    sampled /= np.linalg.norm(sampled, axis=1, keepdims=True) + 1e-12
+    filters = sampled.reshape(
+        config.num_filters, config.patch_size, config.patch_size, 3
+    )
+    return filters, whitener
+
+
+def _conv_featurizer(filters, whitener, config: CifarConfig) -> Pipeline:
+    """Convolver → SymmetricRectifier → Pooler(sum) → vectorize."""
+    conv = Convolver(
+        jnp.asarray(filters, jnp.float32).reshape(len(filters), -1),
+        img_x=32,
+        img_y=32,
+        img_channels=3,
+        whitener=whitener,
+        normalize_patches=True,
+    )
+    conv.patch_size = config.patch_size
+    return (
+        conv.to_pipeline()
+        .and_then(SymmetricRectifier(alpha=config.alpha))
+        .and_then(
+            Pooler(config.pool_stride, config.pool_size, pool_function="sum")
+        )
+        .and_then(ImageVectorizer())
+        .and_then(Cacher())
+    )
+
+
+def run_linear_pixels(config: CifarConfig):
+    """GrayScaler → vectorize → exact least squares → argmax
+    (LinearPixels.scala:18-56)."""
+    start = time.time()
+    train, test = _load(config)
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+    pipeline = (
+        PixelScaler()
+        .to_pipeline()
+        .and_then(GrayScaler())
+        .and_then(ImageVectorizer())
+        .and_then(LinearMapEstimator(lam=None), train.data, labels)
+        .and_then(MaxClassifier())
+    )
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info(
+        "LinearPixels train %.2f%% test %.2f%% (%.1fs)",
+        100 * train_eval.total_error,
+        100 * test_eval.total_error,
+        time.time() - start,
+    )
+    return pipeline, train_eval, test_eval
+
+
+def run_random_cifar(config: CifarConfig):
+    """Random (unwhitened) gaussian filters (RandomCifar.scala:20-77)."""
+    start = time.time()
+    train, test = _load(config)
+    rng = np.random.default_rng(config.seed)
+    filters = rng.normal(
+        size=(config.num_filters, config.patch_size, config.patch_size, 3)
+    )
+    filters /= np.linalg.norm(filters.reshape(config.num_filters, -1), axis=1)[
+        :, None, None, None
+    ]
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+    pipeline = (
+        _conv_featurizer(filters, None, config)
+        .and_then(StandardScaler(), train.data)
+        .and_then(
+            BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+            train.data,
+            labels,
+        )
+        .and_then(MaxClassifier())
+    )
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info(
+        "RandomCifar train %.2f%% test %.2f%% (%.1fs)",
+        100 * train_eval.total_error,
+        100 * test_eval.total_error,
+        time.time() - start,
+    )
+    return pipeline, train_eval, test_eval
+
+
+def run_random_patch_cifar(config: CifarConfig):
+    """Whitened random-patch filters + block least squares
+    (RandomPatchCifar.scala:21-86)."""
+    start = time.time()
+    train, test = _load(config)
+    filters, whitener = _sample_whitened_filters(train, config)
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+    pipeline = (
+        _conv_featurizer(filters, whitener, config)
+        .and_then(StandardScaler(), train.data)
+        .and_then(
+            BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+            train.data,
+            labels,
+        )
+        .and_then(MaxClassifier())
+    )
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info(
+        "RandomPatchCifar train %.2f%% test %.2f%% (%.1fs)",
+        100 * train_eval.total_error,
+        100 * test_eval.total_error,
+        time.time() - start,
+    )
+    return pipeline, train_eval, test_eval
+
+
+def run_random_patch_cifar_kernel(config: CifarConfig):
+    """Same featurization, Gaussian-kernel ridge regression solver
+    (RandomPatchCifarKernel.scala:33-76)."""
+    start = time.time()
+    train, test = _load(config)
+    filters, whitener = _sample_whitened_filters(train, config)
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+    featurizer = _conv_featurizer(filters, whitener, config).and_then(
+        StandardScaler(), train.data
+    )
+    pipeline = featurizer.and_then(
+        KernelRidgeRegression(
+            GaussianKernelGenerator(config.kernel_gamma),
+            config.lam,
+            config.block_size,
+            config.num_epochs,
+        ),
+        train.data,
+        labels,
+    ).and_then(MaxClassifier())
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info(
+        "RandomPatchCifarKernel train %.2f%% test %.2f%% (%.1fs)",
+        100 * train_eval.total_error,
+        100 * test_eval.total_error,
+        time.time() - start,
+    )
+    return pipeline, train_eval, test_eval
+
+
+def run_random_patch_cifar_augmented(config: CifarConfig):
+    """Random train crops; center/corner+flip test crops voted per image
+    (RandomPatchCifarAugmented.scala:27-90)."""
+    start = time.time()
+    train, test = _load(config)
+
+    aug = config.augment_patch_size
+    train_patcher = RandomPatcher(config.augment_patches, aug, aug, seed=config.seed)
+    test_patcher = CenterCornerPatcher(aug, aug, horizontal_flips=True)
+
+    train_images = train_patcher.batch_apply(train.data)
+    train_label_ints = np.repeat(
+        np.asarray(train.labels.array)[: train.labels.n], config.augment_patches
+    )
+    test_images = test_patcher.batch_apply(test.data)
+    n_test = test.labels.n
+    per_image = test_patcher.patches_per_image
+    test_names = list(np.repeat(np.arange(n_test), per_image))
+
+    filters, whitener = _sample_whitened_filters(
+        LabeledData(np.asarray(train_images.array), train_label_ints), config
+    )
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(
+        Dataset.of(train_label_ints)
+    )
+
+    conv_cfg = config
+    conv = Convolver(
+        jnp.asarray(filters, jnp.float32).reshape(len(filters), -1),
+        img_x=aug,
+        img_y=aug,
+        img_channels=3,
+        whitener=whitener,
+        normalize_patches=True,
+    )
+    conv.patch_size = conv_cfg.patch_size
+    featurizer = (
+        conv.to_pipeline()
+        .and_then(SymmetricRectifier(alpha=config.alpha))
+        .and_then(Pooler(config.pool_stride, config.pool_size, pool_function="sum"))
+        .and_then(ImageVectorizer())
+        .and_then(Cacher())
+        .and_then(StandardScaler(), train_images)
+    )
+    # Keep raw scores (no MaxClassifier) so the evaluator can vote.
+    pipeline = featurizer.and_then(
+        BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+        train_images,
+        labels,
+    )
+    evaluator = AugmentedExamplesEvaluator(test_names, NUM_CLASSES)
+    # Labels align with the augmented copies (one per patch).
+    test_label_copies = np.repeat(
+        np.asarray(test.labels.array)[:n_test], per_image
+    )
+    test_eval = evaluator.evaluate(
+        pipeline.apply(test_images), Dataset.of(test_label_copies)
+    )
+    logger.info(
+        "RandomPatchCifarAugmented test %.2f%% (%.1fs)",
+        100 * test_eval.total_error,
+        time.time() - start,
+    )
+    return pipeline, test_eval
+
+
+RUNNERS = {
+    "LinearPixels": run_linear_pixels,
+    "RandomCifar": run_random_cifar,
+    "RandomPatchCifar": run_random_patch_cifar,
+    "RandomPatchCifarKernel": run_random_patch_cifar_kernel,
+    "RandomPatchCifarAugmented": run_random_patch_cifar_augmented,
+}
+
+
+def main(argv=None, variant: str = "RandomPatchCifar"):
+    parser = argparse.ArgumentParser(f"Cifar:{variant}")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--numFilters", type=int, default=100)
+    parser.add_argument("--whitenerSize", type=int, default=1000)
+    parser.add_argument("--patchSize", type=int, default=6)
+    parser.add_argument("--poolSize", type=int, default=10)
+    parser.add_argument("--poolStride", type=int, default=9)
+    parser.add_argument("--alpha", type=float, default=0.25)
+    parser.add_argument("--lambda", dest="lam", type=float, default=10.0)
+    parser.add_argument("--gamma", type=float, default=5e-4)
+    parser.add_argument("--blockSize", type=int, default=512)
+    parser.add_argument("--numEpochs", type=int, default=1)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = CifarConfig(
+        train_location=args.trainLocation,
+        test_location=args.testLocation,
+        num_filters=args.numFilters,
+        whitener_size=args.whitenerSize,
+        patch_size=args.patchSize,
+        pool_size=args.poolSize,
+        pool_stride=args.poolStride,
+        alpha=args.alpha,
+        lam=args.lam,
+        kernel_gamma=args.gamma,
+        block_size=args.blockSize,
+        num_epochs=args.numEpochs,
+        seed=args.seed,
+    )
+    results = RUNNERS[variant](config)
+    test_eval = results[-1]
+    print(f"TEST Error is {100 * test_eval.total_error:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

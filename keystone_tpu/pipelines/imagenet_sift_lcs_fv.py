@@ -1,0 +1,214 @@
+"""ImageNetSiftLcsFV: two featurization branches (dense SIFT + LCS), each
+PCA → GMM Fisher vector → normalize; gathered, combined, and solved with
+block weighted least squares; top-5 evaluation
+(reference: pipelines/images/imagenet/ImageNetSiftLcsFV.scala:33-135).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.loaders import LabeledImage, load_imagenet
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.images.core import (
+    GrayScaler,
+    ImageExtractor,
+    LabelExtractor,
+    PixelScaler,
+)
+from keystone_tpu.ops.images.fisher import GMMFisherVectorEstimator
+from keystone_tpu.ops.images.lcs import LCSExtractor
+from keystone_tpu.ops.images.sift import SIFTExtractor
+from keystone_tpu.ops.learning.bwls import BlockWeightedLeastSquaresEstimator
+from keystone_tpu.ops.learning.pca import ColumnPCAEstimator
+from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+from keystone_tpu.ops.util import (
+    Cacher,
+    ClassLabelIndicatorsFromIntLabels,
+    FloatToDouble,
+    MatrixVectorizer,
+    TopKClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.imagenet")
+
+
+@dataclass
+class ImageNetConfig:
+    train_location: str = ""
+    train_labels: str = ""
+    test_location: str = ""
+    test_labels: str = ""
+    num_classes: int = 1000
+    lam: float = 6e-5
+    mixture_weight: float = 0.25
+    sift_pca_dim: int = 64  # ImageNetSiftLcsFV.scala:41
+    lcs_pca_dim: int = 64
+    lcs_stride: int = 4
+    lcs_border: int = 16
+    lcs_patch: int = 6
+    vocab_size: int = 16
+    block_size: int = 4096
+    num_iters: int = 1
+    seed: int = 0
+    synthetic_n: int = 24
+    synthetic_classes: int = 5
+    synthetic_image_size: int = 48
+
+
+def synthetic_imagenet(
+    n: int, num_classes: int, seed: int, image_size: int = 48
+) -> Dataset:
+    rng = np.random.default_rng(seed)
+    pat_rng = np.random.default_rng(7)
+    freqs = pat_rng.uniform(0.2, 1.5, size=(num_classes, 2))
+    yy, xx = np.meshgrid(np.arange(image_size), np.arange(image_size), indexing="ij")
+    items = []
+    for i in range(n):
+        c = int(rng.integers(0, num_classes))
+        img = np.stack(
+            [np.sin(freqs[c, 0] * xx + freqs[c, 1] * yy)] * 3, axis=-1
+        )
+        img = 127.5 + 70.0 * img + rng.normal(scale=20.0, size=img.shape)
+        items.append(LabeledImage(np.clip(img, 0, 255), c, f"img{i}"))
+    return Dataset.of(items)
+
+
+def _fv_suffix() -> list:
+    """FloatToDouble → MatrixVectorizer → NormalizeRows → SignedHellinger →
+    NormalizeRows (ImageNetSiftLcsFV.scala:60-72)."""
+    return [
+        FloatToDouble(),
+        MatrixVectorizer(),
+        NormalizeRows(),
+        SignedHellingerMapper(),
+        NormalizeRows(),
+    ]
+
+
+def build_featurizer(train_images: Dataset, config: ImageNetConfig) -> Pipeline:
+    sift_branch = (
+        PixelScaler()
+        .to_pipeline()
+        .and_then(GrayScaler())
+        .and_then(SIFTExtractor(scale_step=1))
+        .and_then(ColumnPCAEstimator(config.sift_pca_dim), train_images)
+        .and_then(
+            GMMFisherVectorEstimator(config.vocab_size, gmm_seed=config.seed),
+            train_images,
+        )
+    )
+    lcs_branch = (
+        PixelScaler()
+        .to_pipeline()
+        .and_then(
+            LCSExtractor(config.lcs_stride, config.lcs_border, config.lcs_patch)
+        )
+        .and_then(ColumnPCAEstimator(config.lcs_pca_dim), train_images)
+        .and_then(
+            GMMFisherVectorEstimator(config.vocab_size, gmm_seed=config.seed + 1),
+            train_images,
+        )
+    )
+    for node in _fv_suffix():
+        sift_branch = sift_branch.and_then(node)
+        lcs_branch = lcs_branch.and_then(node)
+    return (
+        Pipeline.gather([sift_branch, lcs_branch])
+        .and_then(VectorCombiner())
+        .and_then(Cacher())
+    )
+
+
+def run(config: ImageNetConfig):
+    start = time.time()
+    if config.train_location:
+        train = load_imagenet(config.train_location, config.train_labels)
+        test = load_imagenet(config.test_location, config.test_labels)
+        num_classes = config.num_classes
+    else:
+        num_classes = config.synthetic_classes
+        train = synthetic_imagenet(
+            config.synthetic_n, num_classes, config.seed, config.synthetic_image_size
+        )
+        test = synthetic_imagenet(
+            max(config.synthetic_n // 2, 8),
+            num_classes,
+            config.seed + 1,
+            config.synthetic_image_size,
+        )
+
+    train_images = ImageExtractor().batch_apply(train)
+    test_images = ImageExtractor().batch_apply(test)
+    train_label_ints = LabelExtractor().batch_apply(train)
+    test_label_ints = LabelExtractor().batch_apply(test)
+
+    labels = ClassLabelIndicatorsFromIntLabels(num_classes).batch_apply(
+        train_label_ints
+    )
+
+    featurizer = build_featurizer(train_images, config)
+    top_k = min(5, num_classes)
+    pipeline = featurizer.and_then(
+        BlockWeightedLeastSquaresEstimator(
+            config.block_size, config.num_iters, config.lam, config.mixture_weight
+        ),
+        train_images,
+        labels,
+    ).and_then(TopKClassifier(top_k))
+
+    test_preds = pipeline.apply(test_images).get()
+    top5 = np.asarray(Dataset.of(test_preds).to_numpy())
+    actual = np.asarray(test_label_ints.to_numpy()).reshape(-1)
+    top5_err = 1.0 - np.mean([actual[i] in top5[i] for i in range(len(actual))])
+    top1 = top5[:, 0]
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+    top1_eval = evaluator.evaluate(
+        Dataset.of(top1), Dataset.of(actual)
+    )
+    logger.info("TEST top-1 error %.2f%%", 100 * top1_eval.total_error)
+    logger.info("TEST top-5 error %.2f%%", 100 * top5_err)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, top1_eval, top5_err
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("ImageNetSiftLcsFV")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--trainLabels", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--testLabels", default="")
+    parser.add_argument("--numClasses", type=int, default=1000)
+    parser.add_argument("--lambda", dest="lam", type=float, default=6e-5)
+    parser.add_argument("--mixtureWeight", type=float, default=0.25)
+    parser.add_argument("--vocabSize", type=int, default=16)
+    parser.add_argument("--blockSize", type=int, default=4096)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = ImageNetConfig(
+        train_location=args.trainLocation,
+        train_labels=args.trainLabels,
+        test_location=args.testLocation,
+        test_labels=args.testLabels,
+        num_classes=args.numClasses,
+        lam=args.lam,
+        mixture_weight=args.mixtureWeight,
+        vocab_size=args.vocabSize,
+        block_size=args.blockSize,
+        seed=args.seed,
+    )
+    _, top1_eval, top5_err = run(config)
+    print(f"TEST top-5 error is {100 * top5_err:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

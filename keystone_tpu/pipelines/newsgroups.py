@@ -1,0 +1,100 @@
+"""NewsgroupsPipeline: ngram term-frequency features + naive Bayes on
+20-newsgroups (reference: pipelines/text/NewsgroupsPipeline.scala:25-72).
+
+Composition: Trim → LowerCase → Tokenizer → NGramsFeaturizer(1..n) →
+TermFrequency(log1p) → AllSparseFeatures → NaiveBayes → MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from keystone_tpu.data.loaders import load_newsgroups, synthetic_documents
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.learning.classifiers import NaiveBayesEstimator
+from keystone_tpu.ops.nlp import LowerCase, NGramsFeaturizer, Tokenizer, Trim
+from keystone_tpu.ops.sparse import AllSparseFeatures
+from keystone_tpu.ops.stats import TermFrequency
+from keystone_tpu.ops.util import MaxClassifier
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.newsgroups")
+
+NUM_CLASSES = 20
+
+
+@dataclass
+class NewsgroupsConfig:
+    train_location: str = ""
+    test_location: str = ""
+    n_grams: int = 2
+    seed: int = 0
+    synthetic_n: int = 400
+    synthetic_classes: int = NUM_CLASSES
+
+
+def build_featurizer(config: NewsgroupsConfig) -> Pipeline:
+    # log-scaled term frequency (NewsgroupsPipeline.scala:31: x => log(x + 1))
+    return (
+        Trim()
+        .to_pipeline()
+        .and_then(LowerCase())
+        .and_then(Tokenizer())
+        .and_then(NGramsFeaturizer(range(1, config.n_grams + 1)))
+        .and_then(TermFrequency(weighting=lambda x: np.log1p(x)))
+    )
+
+
+def run(config: NewsgroupsConfig):
+    start = time.time()
+    if config.train_location:
+        train = load_newsgroups(config.train_location)
+        test = load_newsgroups(config.test_location)
+        num_classes = NUM_CLASSES
+    else:
+        num_classes = config.synthetic_classes
+        train = synthetic_documents(
+            config.synthetic_n, num_classes, seed=config.seed
+        )
+        test = synthetic_documents(
+            max(config.synthetic_n // 4, 64), num_classes, seed=config.seed + 1
+        )
+
+    featurizer = build_featurizer(config)
+    pipeline = featurizer.and_then(AllSparseFeatures(), train.data).and_then(
+        NaiveBayesEstimator(num_classes), train.data, train.labels
+    ).and_then(MaxClassifier())
+
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info("TRAIN error %.2f%%", 100 * train_eval.total_error)
+    logger.info("TEST error %.2f%%", 100 * test_eval.total_error)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("NewsgroupsPipeline")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--nGrams", type=int, default=2)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = NewsgroupsConfig(
+        train_location=args.trainLocation,
+        test_location=args.testLocation,
+        n_grams=args.nGrams,
+    )
+    _, train_eval, test_eval = run(config)
+    print(f"TRAIN error is {100 * train_eval.total_error:.2f}%")
+    print(f"TEST error is {100 * test_eval.total_error:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,133 @@
+"""TimitPipeline: cosine random features + block least squares on TIMIT
+(reference: pipelines/speech/TimitPipeline.scala:37-130).
+
+Composition: gather(numCosines × CosineRandomFeatures(440→4096, γ,
+gaussian|cauchy)) → VectorCombiner → BlockLeastSquares(4096, numEpochs, λ)
+→ MaxClassifier.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+from keystone_tpu.data.loaders import TimitFeaturesDataLoader, synthetic_timit
+from keystone_tpu.evaluation import MulticlassClassifierEvaluator
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import CosineRandomFeatures
+from keystone_tpu.ops.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow import Pipeline
+
+logger = logging.getLogger("keystone_tpu.pipelines.timit")
+
+NUM_CLASSES = TimitFeaturesDataLoader.num_classes  # 147
+NUM_INPUT_FEATURES = TimitFeaturesDataLoader.num_features  # 440
+
+
+@dataclass
+class TimitConfig:
+    train_data_location: str = ""
+    train_labels_location: str = ""
+    test_data_location: str = ""
+    test_labels_location: str = ""
+    num_parts: int = 512  # kept for flag parity; sharding is mesh-driven
+    num_cosines: int = 50
+    gamma: float = 0.05555
+    rf_type: str = "gaussian"  # or "cauchy" (TimitPipeline.scala Distributions)
+    block_size: int = 4096
+    num_epochs: int = 5
+    lam: float = 0.0
+    seed: int = 123
+    synthetic_n: int = 4096
+
+
+def build_featurizer(config: TimitConfig) -> Pipeline:
+    """numCosines branches of 4096 random features each
+    (TimitPipeline.scala:61-78: numCosineFeatures = 4096 per batch)."""
+    branches = [
+        CosineRandomFeatures(
+            NUM_INPUT_FEATURES,
+            config.block_size,
+            config.gamma,
+            seed=config.seed + i,
+            cauchy=(config.rf_type == "cauchy"),
+        ).to_pipeline()
+        for i in range(config.num_cosines)
+    ]
+    return Pipeline.gather(branches).and_then(VectorCombiner())
+
+
+def run(config: TimitConfig):
+    start = time.time()
+    if config.train_data_location:
+        train = TimitFeaturesDataLoader(
+            config.train_data_location, config.train_labels_location
+        ).labeled
+        test = TimitFeaturesDataLoader(
+            config.test_data_location, config.test_labels_location
+        ).labeled
+    else:
+        train = synthetic_timit(config.synthetic_n, seed=config.seed)
+        test = synthetic_timit(max(config.synthetic_n // 4, 256), seed=config.seed + 1)
+
+    labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
+
+    pipeline = build_featurizer(config).and_then(
+        BlockLeastSquaresEstimator(config.block_size, config.num_epochs, config.lam),
+        train.data,
+        labels,
+    ).and_then(MaxClassifier())
+
+    evaluator = MulticlassClassifierEvaluator(NUM_CLASSES)
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    logger.info("TRAIN Error is %.2f%%", 100 * train_eval.total_error)
+    test_eval = evaluator.evaluate(pipeline.apply(test.data), test.labels)
+    logger.info("TEST Error is %.2f%%", 100 * test_eval.total_error)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, train_eval, test_eval
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("Timit")
+    parser.add_argument("--trainDataLocation", default="")
+    parser.add_argument("--trainLabelsLocation", default="")
+    parser.add_argument("--testDataLocation", default="")
+    parser.add_argument("--testLabelsLocation", default="")
+    parser.add_argument("--numParts", type=int, default=512)
+    parser.add_argument("--numCosines", type=int, default=50)
+    parser.add_argument("--gamma", type=float, default=0.05555)
+    parser.add_argument("--rfType", default="gaussian", choices=["gaussian", "cauchy"])
+    parser.add_argument("--blockSize", type=int, default=4096)
+    parser.add_argument("--numEpochs", type=int, default=5)
+    parser.add_argument("--lambda", dest="lam", type=float, default=0.0)
+    parser.add_argument("--seed", type=int, default=123)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = TimitConfig(
+        train_data_location=args.trainDataLocation,
+        train_labels_location=args.trainLabelsLocation,
+        test_data_location=args.testDataLocation,
+        test_labels_location=args.testLabelsLocation,
+        num_parts=args.numParts,
+        num_cosines=args.numCosines,
+        gamma=args.gamma,
+        rf_type=args.rfType,
+        block_size=args.blockSize,
+        num_epochs=args.numEpochs,
+        lam=args.lam,
+        seed=args.seed,
+    )
+    _, train_eval, test_eval = run(config)
+    print(f"TRAIN Error is {100 * train_eval.total_error:.2f}%")
+    print(f"TEST Error is {100 * test_eval.total_error:.2f}%")
+
+
+if __name__ == "__main__":
+    main()

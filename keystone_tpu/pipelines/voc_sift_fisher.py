@@ -1,0 +1,188 @@
+"""VOCSIFTFisher: dense SIFT → PCA → GMM Fisher vectors → block least squares,
+evaluated by VOC mean average precision
+(reference: pipelines/images/voc/VOCSIFTFisher.scala:23-105).
+
+Composition: PixelScaler → GrayScaler → Cacher → SIFTExtractor →
+ColumnPCAEstimator → GMMFisherVectorEstimator → FloatToDouble →
+MatrixVectorizer → NormalizeRows → SignedHellingerMapper → NormalizeRows →
+Cacher → BlockLeastSquares → MAP eval.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.loaders import MultiLabeledImage, load_voc
+from keystone_tpu.evaluation import MeanAveragePrecisionEvaluator
+from keystone_tpu.ops.images.core import GrayScaler, PixelScaler
+from keystone_tpu.ops.images.fisher import GMMFisherVectorEstimator
+from keystone_tpu.ops.images.sift import SIFTExtractor
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.pca import ColumnPCAEstimator
+from keystone_tpu.ops.stats import NormalizeRows, SignedHellingerMapper
+from keystone_tpu.ops.util import (
+    Cacher,
+    ClassLabelIndicatorsFromIntArrayLabels,
+    FloatToDouble,
+    MatrixVectorizer,
+)
+from keystone_tpu.workflow import Pipeline, Transformer
+
+logger = logging.getLogger("keystone_tpu.pipelines.voc")
+
+NUM_CLASSES = 20  # VOC 2007 (reference: loaders/VOCLoader.scala:16-53)
+
+
+@dataclass
+class VOCConfig:
+    train_location: str = ""
+    train_labels: str = ""
+    test_location: str = ""
+    test_labels: str = ""
+    lam: float = 0.5
+    descriptor_dim: int = 80  # PCA dims (VOCSIFTFisher.scala:58)
+    vocab_size: int = 16  # GMM centers (reference default 64)
+    sift_scale_step: int = 1
+    block_size: int = 4096
+    seed: int = 0
+    synthetic_n: int = 24
+    synthetic_image_size: int = 48
+
+
+class _MultiLabeledImageExtractor(Transformer):
+    """MultiLabeledImage -> image (reference: LabeledImageExtractors.scala)."""
+
+    def apply(self, x: MultiLabeledImage):
+        return x.image
+
+
+def synthetic_voc(n: int, seed: int, image_size: int = 48) -> Dataset:
+    """Multi-labeled synthetic images with class-dependent textures."""
+    rng = np.random.default_rng(seed)
+    pat_rng = np.random.default_rng(99)
+    freqs = pat_rng.uniform(0.2, 1.5, size=(NUM_CLASSES, 2))
+    yy, xx = np.meshgrid(
+        np.arange(image_size), np.arange(image_size), indexing="ij"
+    )
+    items = []
+    for i in range(n):
+        k = rng.integers(1, 3)
+        classes = rng.choice(NUM_CLASSES, size=k, replace=False)
+        img = np.zeros((image_size, image_size, 3))
+        for c in classes:
+            img += np.stack(
+                [np.sin(freqs[c, 0] * xx + freqs[c, 1] * yy)] * 3, axis=-1
+            )
+        img = 127.5 + 60.0 * img / k + rng.normal(scale=20.0, size=img.shape)
+        items.append(
+            MultiLabeledImage(np.clip(img, 0, 255), np.sort(classes), f"img{i}")
+        )
+    return Dataset.of(items)
+
+
+def build_featurizer(train_images: Dataset, config: VOCConfig) -> Pipeline:
+    sift = SIFTExtractor(scale_step=config.sift_scale_step)
+    prefix = (
+        PixelScaler()
+        .to_pipeline()
+        .and_then(GrayScaler())
+        .and_then(Cacher())
+        .and_then(sift)
+    )
+    return (
+        prefix.and_then(ColumnPCAEstimator(config.descriptor_dim), train_images)
+        .and_then(
+            GMMFisherVectorEstimator(config.vocab_size, gmm_seed=config.seed),
+            train_images,
+        )
+        .and_then(FloatToDouble())
+        .and_then(MatrixVectorizer())
+        .and_then(NormalizeRows())
+        .and_then(SignedHellingerMapper())
+        .and_then(NormalizeRows())
+        .and_then(Cacher())
+    )
+
+
+def run(config: VOCConfig):
+    start = time.time()
+    if config.train_location:
+        train = load_voc(config.train_location, config.train_labels)
+        test = load_voc(config.test_location, config.test_labels)
+    else:
+        train = synthetic_voc(
+            config.synthetic_n, config.seed, config.synthetic_image_size
+        )
+        test = synthetic_voc(
+            max(config.synthetic_n // 2, 8),
+            config.seed + 1,
+            config.synthetic_image_size,
+        )
+
+    extractor = _MultiLabeledImageExtractor()
+    train_images = extractor.batch_apply(train)
+    test_images = extractor.batch_apply(test)
+    train_label_arrays = [item.labels for item in train.to_list()]
+    test_label_arrays = [item.labels for item in test.to_list()]
+
+    labels = ClassLabelIndicatorsFromIntArrayLabels(NUM_CLASSES).batch_apply(
+        Dataset.of(train_label_arrays)
+    )
+
+    featurizer = build_featurizer(train_images, config)
+    # No MaxClassifier: MAP evaluation consumes raw per-class scores.
+    pipeline = featurizer.and_then(
+        BlockLeastSquaresEstimator(config.block_size, 1, config.lam),
+        train_images,
+        labels,
+    )
+
+    evaluator = MeanAveragePrecisionEvaluator(NUM_CLASSES)
+    aps = evaluator.evaluate(
+        pipeline.apply(test_images), Dataset.of(test_label_arrays)
+    )
+    mean_ap = float(np.mean(np.asarray(aps)))
+    logger.info("TEST APs: %s", np.round(np.asarray(aps), 3))
+    logger.info("TEST Mean Average Precision: %.4f", mean_ap)
+    logger.info("Pipeline took %.1f s", time.time() - start)
+    return pipeline, aps, mean_ap
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("VOCSIFTFisher")
+    parser.add_argument("--trainLocation", default="")
+    parser.add_argument("--trainLabels", default="")
+    parser.add_argument("--testLocation", default="")
+    parser.add_argument("--testLabels", default="")
+    parser.add_argument("--lambda", dest="lam", type=float, default=0.5)
+    parser.add_argument("--descDim", type=int, default=80)
+    parser.add_argument("--vocabSize", type=int, default=16)
+    parser.add_argument("--scaleStep", type=int, default=1)
+    parser.add_argument("--blockSize", type=int, default=4096)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+    config = VOCConfig(
+        train_location=args.trainLocation,
+        train_labels=args.trainLabels,
+        test_location=args.testLocation,
+        test_labels=args.testLabels,
+        lam=args.lam,
+        descriptor_dim=args.descDim,
+        vocab_size=args.vocabSize,
+        sift_scale_step=args.scaleStep,
+        block_size=args.blockSize,
+        seed=args.seed,
+    )
+    _, _, mean_ap = run(config)
+    print(f"TEST Mean Average Precision is {mean_ap:.4f}")
+
+
+if __name__ == "__main__":
+    main()

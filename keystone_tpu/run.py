@@ -1,0 +1,104 @@
+"""CLI launcher: ``python -m keystone_tpu.run <PipelineName> --flags``
+(reference: bin/run-pipeline.sh:1-55 — spark-submit wrapper resolving a
+pipeline class name and forwarding flags).
+
+Pipeline names accept the reference's fully-qualified class names
+(``keystoneml.pipelines.images.mnist.MnistRandomFFT``) or the bare name.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Callable, Dict
+
+
+def _mnist(argv):
+    from keystone_tpu.pipelines import mnist_random_fft
+
+    mnist_random_fft.main(argv)
+
+
+def _timit(argv):
+    from keystone_tpu.pipelines import timit
+
+    timit.main(argv)
+
+
+def _cifar(variant):
+    def runner(argv):
+        from keystone_tpu.pipelines import cifar
+
+        cifar.main(argv, variant=variant)
+
+    return runner
+
+
+def _voc(argv):
+    from keystone_tpu.pipelines import voc_sift_fisher
+
+    voc_sift_fisher.main(argv)
+
+
+def _imagenet(argv):
+    from keystone_tpu.pipelines import imagenet_sift_lcs_fv
+
+    imagenet_sift_lcs_fv.main(argv)
+
+
+def _amazon(argv):
+    from keystone_tpu.pipelines import amazon_reviews
+
+    amazon_reviews.main(argv)
+
+
+def _newsgroups(argv):
+    from keystone_tpu.pipelines import newsgroups
+
+    newsgroups.main(argv)
+
+
+def _stupid_backoff(argv):
+    from keystone_tpu.pipelines import stupid_backoff
+
+    stupid_backoff.main(argv)
+
+
+PIPELINES: Dict[str, Callable] = {
+    "MnistRandomFFT": _mnist,
+    "TimitPipeline": _timit,
+    "Timit": _timit,
+    "LinearPixels": _cifar("LinearPixels"),
+    "RandomCifar": _cifar("RandomCifar"),
+    "RandomPatchCifar": _cifar("RandomPatchCifar"),
+    "RandomPatchCifarKernel": _cifar("RandomPatchCifarKernel"),
+    "RandomPatchCifarAugmented": _cifar("RandomPatchCifarAugmented"),
+    "VOCSIFTFisher": _voc,
+    "ImageNetSiftLcsFV": _imagenet,
+    "AmazonReviewsPipeline": _amazon,
+    "NewsgroupsPipeline": _newsgroups,
+    "StupidBackoffPipeline": _stupid_backoff,
+}
+
+
+def resolve(name: str) -> Callable:
+    """Accept bare or fully-qualified (dotted) pipeline names."""
+    bare = name.rsplit(".", 1)[-1]
+    if bare not in PIPELINES:
+        known = ", ".join(sorted(PIPELINES))
+        raise SystemExit(f"Unknown pipeline {name!r}. Known pipelines: {known}")
+    return PIPELINES[bare]
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not argv or argv[0] in ("-h", "--help"):
+        print(__doc__)
+        print("Pipelines:", ", ".join(sorted(PIPELINES)))
+        return 0
+    runner = resolve(argv[0])
+    runner(argv[1:])
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

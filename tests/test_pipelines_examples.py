@@ -1,0 +1,148 @@
+"""End-to-end example-pipeline tests on synthetic data (model: the
+reference's pipeline suites, e.g. pipelines/nlp/StupidBackoffSuite.scala,
+run in Spark local mode — here an 8-device CPU mesh via conftest)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.pipelines.amazon_reviews import AmazonReviewsConfig
+from keystone_tpu.pipelines.amazon_reviews import run as run_amazon
+from keystone_tpu.pipelines.cifar import (
+    CifarConfig,
+    run_linear_pixels,
+    run_random_patch_cifar,
+    run_random_patch_cifar_augmented,
+    run_random_patch_cifar_kernel,
+)
+from keystone_tpu.pipelines.newsgroups import NewsgroupsConfig
+from keystone_tpu.pipelines.newsgroups import run as run_newsgroups
+from keystone_tpu.pipelines.stupid_backoff import StupidBackoffConfig
+from keystone_tpu.pipelines.stupid_backoff import run as run_stupid_backoff
+from keystone_tpu.pipelines.timit import TimitConfig
+from keystone_tpu.pipelines.timit import run as run_timit
+from keystone_tpu.run import PIPELINES, resolve
+
+
+class TestTimit:
+    def test_synthetic_parity(self):
+        cfg = TimitConfig(num_cosines=2, block_size=256, num_epochs=2,
+                          synthetic_n=1024)
+        _, train_eval, test_eval = run_timit(cfg)
+        # 147-class random features on gaussian blobs: must beat chance by a
+        # wide margin (chance error ≈ 99.3%).
+        assert train_eval.total_error < 0.05
+        assert test_eval.total_error < 0.8
+
+
+class TestCifarFamily:
+    CFG = CifarConfig(
+        synthetic_n=192,
+        num_filters=24,
+        whitener_size=300,
+        block_size=216,
+        pool_stride=9,
+        pool_size=10,
+    )
+
+    def test_linear_pixels_runs(self):
+        _, train_eval, test_eval = run_linear_pixels(self.CFG)
+        assert 0.0 <= test_eval.total_error <= 1.0
+
+    def test_random_patch_cifar_learns(self):
+        _, train_eval, test_eval = run_random_patch_cifar(self.CFG)
+        assert train_eval.total_error < 0.1
+        assert test_eval.total_error < 0.5  # chance = 0.9
+
+    def test_random_patch_cifar_kernel_learns(self):
+        _, train_eval, test_eval = run_random_patch_cifar_kernel(self.CFG)
+        assert test_eval.total_error < 0.5
+
+    def test_augmented_votes_over_crops(self):
+        _, test_eval = run_random_patch_cifar_augmented(self.CFG)
+        assert test_eval.total_error < 0.6
+
+
+class TestVocImageNet:
+    def test_voc_sift_fisher(self):
+        from keystone_tpu.pipelines.voc_sift_fisher import VOCConfig
+        from keystone_tpu.pipelines.voc_sift_fisher import run as run_voc
+
+        cfg = VOCConfig(synthetic_n=12, synthetic_image_size=40, vocab_size=8,
+                        descriptor_dim=32, block_size=1024)
+        _, aps, mean_ap = run_voc(cfg)
+        assert np.asarray(aps).shape == (20,)
+        assert 0.0 <= mean_ap <= 1.0
+
+    def test_imagenet_sift_lcs_fv(self):
+        from keystone_tpu.pipelines.imagenet_sift_lcs_fv import ImageNetConfig
+        from keystone_tpu.pipelines.imagenet_sift_lcs_fv import run as run_in
+
+        cfg = ImageNetConfig(synthetic_n=16, synthetic_classes=4,
+                             synthetic_image_size=40, vocab_size=8,
+                             sift_pca_dim=32, lcs_pca_dim=32, block_size=1024)
+        _, top1_eval, top5_err = run_in(cfg)
+        # top-5 with 4 synthetic classes degenerates to top-4; must be solid.
+        assert top5_err <= 0.5
+        assert top1_eval.total_error <= 0.75
+
+
+class TestTextPipelines:
+    def test_amazon_reviews(self):
+        cfg = AmazonReviewsConfig(synthetic_n=200, common_features=400,
+                                  num_iters=15)
+        _, train_eval, test_eval = run_amazon(cfg)
+        assert train_eval.accuracy > 0.95
+        assert test_eval.accuracy > 0.9
+
+    def test_newsgroups(self):
+        cfg = NewsgroupsConfig(synthetic_n=200, synthetic_classes=5)
+        _, train_eval, test_eval = run_newsgroups(cfg)
+        assert train_eval.total_error < 0.05
+        assert test_eval.total_error < 0.2
+
+
+class TestStupidBackoffPipeline:
+    def test_scores_follow_counts(self):
+        model, encoder = run_stupid_backoff(StupidBackoffConfig(synthetic_n=150))
+        assert len(model.scores) > 0
+        # Every score is a valid probability-like positive number.
+        vals = np.array(list(model.scores.values()))
+        assert np.all(vals > 0)
+        assert np.all(vals <= 1.0 + 1e-9)
+        # Backoff scoring of an unseen bigram falls back to unigram mass.
+        from keystone_tpu.ops.nlp import NGram
+
+        w_rare = max(model.unigram_counts)  # least frequent word id
+        unseen = NGram((w_rare, w_rare))
+        s = model.score(unseen)
+        assert 0 < s <= 1.0
+
+
+class TestCLI:
+    def test_registry_covers_reference_workloads(self):
+        # The reference's acceptance workloads (SURVEY.md §2.9) all resolve.
+        for name in [
+            "MnistRandomFFT",
+            "TimitPipeline",
+            "LinearPixels",
+            "RandomCifar",
+            "RandomPatchCifar",
+            "RandomPatchCifarKernel",
+            "RandomPatchCifarAugmented",
+            "VOCSIFTFisher",
+            "ImageNetSiftLcsFV",
+            "AmazonReviewsPipeline",
+            "NewsgroupsPipeline",
+            "StupidBackoffPipeline",
+        ]:
+            assert resolve(name) is not None
+
+    def test_fully_qualified_names_resolve(self):
+        assert (
+            resolve("keystoneml.pipelines.images.mnist.MnistRandomFFT")
+            is PIPELINES["MnistRandomFFT"]
+        )
+
+    def test_unknown_name_raises(self):
+        with pytest.raises(SystemExit):
+            resolve("NoSuchPipeline")
