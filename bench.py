@@ -9,6 +9,14 @@ under the driver) at a row count that fits in HBM, and compares against the
 baseline wall-clock scaled linearly by row count (the solver's cost is linear
 in n: per-block Gramian + correlation + residual GEMMs).
 
+TPU-native path: the whole train step — 4 random-feature blocks fused
+matmul+cos (Pallas, bfloat16 feature layout) + a full Gauss-Seidel BCD epoch
+(Pallas symmetric Gramian+correlation kernels, f32 accumulation/solves) — is
+ONE compiled XLA program: zero host round-trips between blocks, unlike the
+reference's per-block Spark job waves.
+
+Env knobs: BENCH_SCALE (row multiplier), BENCH_PRECISION=bf16|f32.
+
 Prints ONE JSON line:
   {"metric": ..., "value": <seconds>, "unit": "s", "vs_baseline": <speedup x>}
 vs_baseline > 1 means faster than the (n-scaled) 16-node Spark cluster.
@@ -34,41 +42,65 @@ NUM_EPOCHS = 1
 
 def main():
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    precision = os.environ.get("BENCH_PRECISION", "bf16")
+    if precision not in ("bf16", "f32"):
+        raise SystemExit(f"BENCH_PRECISION must be bf16 or f32, got {precision!r}")
+    bf16 = precision == "bf16"
     n = int(131072 * scale)
-    dtype = jnp.float32
 
     rng = np.random.default_rng(0)
     X_np = rng.normal(size=(n, TIMIT_INPUT_DIMS)).astype(np.float32)
     y_np = rng.integers(0, TIMIT_NUM_CLASSES, size=n)
 
+    from keystone_tpu.ops import pallas_ops as po
     from keystone_tpu.ops.stats import CosineRandomFeatures
     from keystone_tpu.parallel import linalg
 
-    X = jnp.asarray(X_np, dtype=dtype)
-    Y = 2.0 * jax.nn.one_hot(y_np, TIMIT_NUM_CLASSES, dtype=dtype) - 1.0
+    X = jnp.asarray(X_np)
+    Y = 2.0 * jax.nn.one_hot(y_np, TIMIT_NUM_CLASSES, dtype=jnp.float32) - 1.0
 
     # One CosineRandomFeatures branch per feature block, mirroring the
     # reference TimitPipeline's gather of numCosines branches
-    # (TimitPipeline.scala:37-109). Features are generated per block so the
-    # full (n, 16384) matrix is the only large resident buffer.
+    # (TimitPipeline.scala:37-109).
     num_blocks = NUM_FEATURES // BLOCK_SIZE
     rfs = [
         CosineRandomFeatures(TIMIT_INPUT_DIMS, BLOCK_SIZE, gamma=0.05, seed=i)
         for i in range(num_blocks)
     ]
+    Wrf = jnp.stack([rf.W for rf in rfs])
+    brf = jnp.stack([rf.b for rf in rfs])
+
+    use_pallas = po.pallas_enabled()
+    feat_dtype = jnp.bfloat16 if bf16 else jnp.float32
 
     @jax.jit
-    def featurize_block(X, W, b):
-        return jnp.cos(X @ W.T.astype(dtype) + b.astype(dtype))
+    def train_step(X, Wrf, brf, Y):
+        if use_pallas:
+            F = jnp.stack(
+                [
+                    po.cosine_features(
+                        X, Wrf[i], brf[i],
+                        compute_dtype=feat_dtype, out_dtype=feat_dtype,
+                    )
+                    for i in range(num_blocks)
+                ]
+            )
+        else:
+            F = jnp.stack(
+                [jnp.cos(X @ Wrf[i].T + brf[i]).astype(feat_dtype)
+                 for i in range(num_blocks)]
+            )
+        return linalg.bcd_least_squares_fused(
+            F, Y, lam=1e-4, num_iter=NUM_EPOCHS, use_pallas=use_pallas
+        )
 
     def run_once():
-        blocks = [featurize_block(X, rf.W, rf.b) for rf in rfs]
-        Ws = linalg.bcd_least_squares(blocks, Y, lam=1e-4, num_iter=NUM_EPOCHS)
+        W = train_step(X, Wrf, brf, Y)
         # Force execution end-to-end: on the tunneled TPU backend,
         # block_until_ready is not a reliable barrier — a host transfer is.
-        checksum = float(sum(jnp.sum(jnp.abs(W)) for W in Ws))
+        checksum = float(jnp.sum(jnp.abs(W)))
         assert np.isfinite(checksum) and checksum > 0, f"bad solve: {checksum}"
-        return Ws
+        return W
 
     run_once()  # warmup (compile)
     t0 = time.perf_counter()
@@ -91,6 +123,9 @@ def main():
                     "k": TIMIT_NUM_CLASSES,
                     "block_size": BLOCK_SIZE,
                     "epochs": NUM_EPOCHS,
+                    "precision": "bf16" if bf16 else "f32",
+                    "pallas": use_pallas,
+                    "single_dispatch": True,
                     "baseline": "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), n-scaled",
                     "baseline_scaled_s": round(baseline_scaled_s, 3),
                     "device": str(jax.devices()[0]),

@@ -35,7 +35,13 @@ logger = logging.getLogger("keystone_tpu.kernel")
 @functools.partial(jax.jit, static_argnames=("gamma",))
 def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float):
     """K[i, j] = exp(-γ ‖X_i − Xb_j‖²) via ‖x‖² + ‖y‖² − 2x·y
-    (reference: KernelGenerator.scala:121-205)."""
+    (reference: KernelGenerator.scala:121-205). On TPU the distance+exp
+    epilogue is fused into the matmul by the Pallas kernel so the squared-
+    distance intermediate never round-trips HBM."""
+    from keystone_tpu.ops import pallas_ops
+
+    if pallas_ops.pallas_enabled():
+        return pallas_ops.gaussian_kernel_block(X, Xb, x_norms, xb_norms, gamma)
     sq = x_norms[:, None] + xb_norms[None, :] - 2.0 * (X @ Xb.T)
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
 

@@ -1,0 +1,470 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference's performance-critical inner loops are per-partition BLAS-3
+calls (Convolver im2col GEMM, KernelGenerator's blocked ``‖x−y‖²`` + exp,
+CosineRandomFeatures' broadcast-W GEMM + cos, the BCD solvers' Gramian /
+correlation GEMMs — nodes/learning/*, nodes/stats/CosineRandomFeatures.scala).
+On TPU those are MXU matmuls; the wins left on the table by stock XLA are
+(a) fusing the elementwise epilogue (exp/cos) into the matmul's output tiles
+so the (m, n) intermediate never round-trips HBM, and (b) computing AᵀA and
+AᵀR in a single pass over A (one HBM read instead of two).
+
+Every kernel here is a tiled matmul with a K-innermost accumulation grid:
+
+    grid = (m_tiles, n_tiles, k_tiles)        # k varies fastest
+    acc  = VMEM scratch, zeroed at k == 0
+    epilogue applied and written out at k == k_tiles - 1
+
+All kernels take a ``compute_dtype``: with ``bfloat16`` the operand tiles are
+cast before hitting the MXU while the accumulator and epilogue stay float32
+(preferred_element_type) — the standard TPU mixed-precision recipe.
+
+Wrappers pad inputs to tile multiples (zero rows/cols are exact for the dot
+contractions) and slice the result; `interpret=True` is used automatically on
+non-TPU backends so the same code paths are unit-testable on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = [
+    "gaussian_kernel_block",
+    "cosine_features",
+    "gram_corr",
+    "gram_corr_sym",
+    "pallas_enabled",
+]
+
+_TILE_M = 256
+_TILE_N = 256
+_TILE_K = 512
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _dot_kwargs(compute_dtype):
+    """MXU precision recipe: float32 operands need precision=HIGHEST (the
+    default is a single bf16 pass, ~1e-1 absolute error on O(1) data);
+    bfloat16 operands hit the MXU natively and accumulate in float32 via
+    preferred_element_type."""
+    if compute_dtype == jnp.float32:
+        return dict(
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+    return dict(preferred_element_type=jnp.float32)
+
+
+def pallas_enabled() -> bool:
+    """True when the Pallas paths should be used for real.
+
+    Requires the TPU backend and (for now) a single-device process:
+    ``pl.pallas_call`` is not partitionable by GSPMD, so dispatching it on a
+    mesh-sharded array would force an all-gather. Multi-device meshes take
+    the XLA paths (which partition fine); shard_map-wrapped variants live in
+    ``keystone_tpu.parallel.ring``. ``KEYSTONE_PALLAS=1`` forces the kernels
+    on regardless; ``KEYSTONE_NO_PALLAS=1`` forces them off.
+    """
+    if os.environ.get("KEYSTONE_NO_PALLAS"):
+        return False
+    if jax.default_backend() != "tpu":
+        return False
+    if os.environ.get("KEYSTONE_PALLAS"):
+        return True
+    return len(jax.devices()) == 1
+
+
+def _pad_to(x, multiple: int, axis: int):
+    size = x.shape[axis]
+    rem = (-size) % multiple
+    if rem == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, rem)
+    return jnp.pad(x, widths)
+
+
+# ---------------------------------------------------------------------------
+# Fused Gaussian kernel block: exp(-gamma * (‖x‖² + ‖y‖² − 2 x·y))
+# ---------------------------------------------------------------------------
+
+
+def _gaussian_kernel_kernel(
+    x_ref, y_ref, xn_ref, yn_ref, out_ref, acc_ref, *, gamma, nk, compute_dtype
+):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(compute_dtype),
+        y_ref[:].astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        sq = xn_ref[:] + yn_ref[:] - 2.0 * acc_ref[:]
+        out_ref[:] = jnp.exp(-gamma * jnp.maximum(sq, 0.0)).astype(out_ref.dtype)
+
+
+def gaussian_kernel_block(
+    X,
+    Y,
+    x_norms,
+    y_norms,
+    gamma: float,
+    compute_dtype=jnp.float32,
+    interpret: Optional[bool] = None,
+):
+    """K[i, j] = exp(-gamma * ‖X_i − Y_j‖²) as one fused Pallas kernel.
+
+    X: (m, d), Y: (n, d), x_norms: (m,), y_norms: (n,). The distance matrix
+    is never materialized in HBM — the norm-broadcast + exp epilogue runs on
+    the accumulator tile in VMEM (reference computes the same algebra
+    unfused: KernelGenerator.scala:121-205).
+    """
+    X = jnp.asarray(X, dtype=jnp.float32)
+    Y = jnp.asarray(Y, dtype=jnp.float32)
+    m, d = X.shape
+    n = Y.shape[0]
+    xn = jnp.asarray(x_norms, dtype=jnp.float32).reshape(m, 1)
+    yn = jnp.asarray(y_norms, dtype=jnp.float32).reshape(1, n)
+
+    tm, tn, tk = min(_TILE_M, m), min(_TILE_N, n), min(_TILE_K, d)
+    Xp = _pad_to(_pad_to(X, tm, 0), tk, 1)
+    Yp = _pad_to(_pad_to(Y, tn, 0), tk, 1)
+    xnp = _pad_to(xn, tm, 0)
+    ynp = _pad_to(yn, tn, 1)
+    mp, dp = Xp.shape
+    np_ = Yp.shape[0]
+    nk = dp // tk
+
+    out = pl.pallas_call(
+        functools.partial(
+            _gaussian_kernel_kernel,
+            gamma=float(gamma),
+            nk=nk,
+            compute_dtype=compute_dtype,
+        ),
+        grid=(mp // tm, np_ // tn, nk),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((tn, tk), lambda i, j, k: (j, k)),
+            pl.BlockSpec((tm, 1), lambda i, j, k: (i, 0)),
+            pl.BlockSpec((1, tn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        interpret=_interpret() if interpret is None else interpret,
+    )(Xp, Yp, xnp, ynp)
+    return out[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# Fused cosine random features: cos(X Wᵀ + b)
+# ---------------------------------------------------------------------------
+
+
+def _cosine_kernel(x_ref, w_ref, b_ref, out_ref, acc_ref, *, nk, compute_dtype):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(compute_dtype),
+        w_ref[:].astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        out_ref[:] = jnp.cos(acc_ref[:] + b_ref[:]).astype(out_ref.dtype)
+
+
+def cosine_features(
+    X,
+    W,
+    b,
+    compute_dtype=jnp.float32,
+    out_dtype=None,
+    interpret: Optional[bool] = None,
+):
+    """cos(X @ Wᵀ + b) fused into the matmul epilogue.
+
+    X: (m, d), W: (num_out, d), b: (num_out,). The featurized (m, num_out)
+    matrix is written once; the pre-activation never exists in HBM
+    (reference: CosineRandomFeatures.scala:19-45). ``out_dtype=bfloat16``
+    writes the feature matrix at half the HBM footprint for downstream
+    bf16 solvers.
+    """
+    out_dtype = jnp.float32 if out_dtype is None else out_dtype
+    X = jnp.asarray(X, dtype=jnp.float32)
+    W = jnp.asarray(W, dtype=jnp.float32)
+    m, d = X.shape
+    n = W.shape[0]
+    bias = jnp.asarray(b, dtype=jnp.float32).reshape(1, n)
+
+    tm, tn, tk = min(_TILE_M, m), min(_TILE_N, n), min(_TILE_K, d)
+    Xp = _pad_to(_pad_to(X, tm, 0), tk, 1)
+    Wp = _pad_to(_pad_to(W, tn, 0), tk, 1)
+    bp = _pad_to(bias, tn, 1)
+    mp, dp = Xp.shape
+    np_ = Wp.shape[0]
+    nk = dp // tk
+
+    out = pl.pallas_call(
+        functools.partial(_cosine_kernel, nk=nk, compute_dtype=compute_dtype),
+        grid=(mp // tm, np_ // tn, nk),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda i, j, k: (i, k)),
+            pl.BlockSpec((tn, tk), lambda i, j, k: (j, k)),
+            pl.BlockSpec((1, tn), lambda i, j, k: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), out_dtype),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        interpret=_interpret() if interpret is None else interpret,
+    )(Xp, Wp, bp)
+    return out[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# One-pass Gramian + correlation: (AᵀA, AᵀR)
+# ---------------------------------------------------------------------------
+
+
+def _gram_corr_kernel(
+    ai_ref, aj_ref, r_ref, gram_ref, corr_ref, gacc_ref, cacc_ref, *, nk, compute_dtype
+):
+    """Grid (i, j, k): gram tile (i, j) accumulates AᵢᵀAⱼ over row-tiles k;
+    the corr tile (i, :) piggybacks on Aᵢ's residency when j == 0."""
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        gacc_ref[:] = jnp.zeros_like(gacc_ref)
+
+    ai = ai_ref[:].astype(compute_dtype)
+    gacc_ref[:] += jax.lax.dot_general(
+        ai,
+        aj_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        gram_ref[:] = gacc_ref[:].astype(gram_ref.dtype)
+
+    @pl.when((j == 0) & (k == 0))
+    def _():
+        cacc_ref[:] = jnp.zeros_like(cacc_ref)
+
+    @pl.when(j == 0)
+    def _():
+        cacc_ref[:] += jax.lax.dot_general(
+            ai,
+            r_ref[:].astype(compute_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            **_dot_kwargs(compute_dtype),
+        )
+
+    @pl.when((j == 0) & (k == nk - 1))
+    def _():
+        corr_ref[:] = cacc_ref[:].astype(corr_ref.dtype)
+
+
+def gram_corr(
+    A,
+    R,
+    compute_dtype=jnp.float32,
+    interpret: Optional[bool] = None,
+):
+    """(AᵀA, AᵀR) in a single pass over A's rows.
+
+    A: (n, d), R: (n, k). This is the hot contraction of every normal-
+    equations / BCD step (reference: mlmatrix NormalEquations; the in-tree
+    pattern at BlockWeightedLeastSquares.scala:212-221 computes exactly this
+    pair per block). Fusing them halves HBM traffic for A on the correlation
+    side and shares the row-tile DMA schedule.
+    """
+    A = jnp.asarray(A)
+    R = jnp.asarray(R, dtype=jnp.float32)
+    if A.dtype == jnp.bfloat16:
+        compute_dtype = jnp.bfloat16
+    n, d = A.shape
+    kdim = R.shape[1]
+
+    ti = min(_TILE_M, d)
+    tk = min(_TILE_K, n)
+    Ap = _pad_to(_pad_to(A, tk, 0), ti, 1)
+    # R's column count is small (num classes); pad to the 128-lane minimum.
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Rp = _pad_to(_pad_to(R, tk, 0), tr, 1)
+    npad, dp = Ap.shape
+    nk = npad // tk
+
+    gram, corr = pl.pallas_call(
+        functools.partial(_gram_corr_kernel, nk=nk, compute_dtype=compute_dtype),
+        grid=(dp // ti, dp // ti, nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda i, j, k: (k, i)),
+            pl.BlockSpec((tk, ti), lambda i, j, k: (k, j)),
+            pl.BlockSpec((tk, tr), lambda i, j, k: (k, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, ti), lambda i, j, k: (i, j)),
+            pl.BlockSpec((ti, tr), lambda i, j, k: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((dp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, tr), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ti, ti), jnp.float32),
+            pltpu.VMEM((ti, tr), jnp.float32),
+        ],
+        interpret=_interpret() if interpret is None else interpret,
+    )(Ap, Ap, Rp)
+    return gram[:d, :d], corr[:d, :kdim]
+
+
+# ---------------------------------------------------------------------------
+# Symmetric one-pass Gramian + correlation (upper-triangle blocks only)
+# ---------------------------------------------------------------------------
+
+
+def _gram_corr_sym_kernel(
+    ii_ref, jj_ref, ai_ref, aj_ref, r_ref, gram_ref, corr_ref, gacc_ref,
+    cacc_ref, *, nk, compute_dtype
+):
+    """Grid (p, k): p walks the upper-triangle block pairs (ii[p], jj[p]) in
+    row-major order; k sweeps row tiles. The correlation AᵀR rides along on
+    the diagonal pairs (one per block row) where Aᵢ is already resident."""
+    p = pl.program_id(0)
+    k = pl.program_id(1)
+    diag = ii_ref[p] == jj_ref[p]
+
+    @pl.when(k == 0)
+    def _():
+        gacc_ref[:] = jnp.zeros_like(gacc_ref)
+
+    ai = ai_ref[:].astype(compute_dtype)
+    gacc_ref[:] += jax.lax.dot_general(
+        ai,
+        aj_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when(k == nk - 1)
+    def _():
+        gram_ref[:] = gacc_ref[:].astype(gram_ref.dtype)
+
+    @pl.when(diag & (k == 0))
+    def _():
+        cacc_ref[:] = jnp.zeros_like(cacc_ref)
+
+    @pl.when(diag)
+    def _():
+        cacc_ref[:] += jax.lax.dot_general(
+            ai,
+            r_ref[:].astype(compute_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            **_dot_kwargs(compute_dtype),
+        )
+
+    @pl.when(diag & (k == nk - 1))
+    def _():
+        corr_ref[:] = cacc_ref[:].astype(corr_ref.dtype)
+
+
+def gram_corr_sym(
+    A,
+    R,
+    compute_dtype=jnp.float32,
+    interpret: Optional[bool] = None,
+):
+    """(AᵀA, AᵀR) computing only the upper triangle of AᵀA and mirroring.
+
+    Does ~half the MXU work and HBM traffic of the dense version for the
+    Gramian — the symmetric-rank-k update (BLAS ``syrk``) the reference gets
+    from netlib and XLA does not exploit. Block pairs are enumerated
+    row-major via scalar-prefetched index arrays.
+
+    A may be bfloat16 — tiles then hit the MXU natively with float32
+    accumulation, and HBM traffic is half that of an f32 layout.
+    """
+    A = jnp.asarray(A)
+    R = jnp.asarray(R, dtype=jnp.float32)
+    if A.dtype == jnp.bfloat16:
+        compute_dtype = jnp.bfloat16
+    n, d = A.shape
+    kdim = R.shape[1]
+
+    ti = min(512, ((d + 127) // 128) * 128)
+    tk = min(_TILE_K, n)
+    Ap = _pad_to(_pad_to(A, tk, 0), ti, 1)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Rp = _pad_to(_pad_to(R, tk, 0), tr, 1)
+    npad, dp = Ap.shape
+    nk = npad // tk
+    nt = dp // ti
+
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = jnp.asarray(np.array([p[0] for p in pairs], dtype=np.int32))
+    jj = jnp.asarray(np.array([p[1] for p in pairs], dtype=np.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, jj[p])),
+            pl.BlockSpec((tk, tr), lambda p, k, ii, jj: (k, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])),
+            pl.BlockSpec((ti, tr), lambda p, k, ii, jj: (ii[p], 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((ti, ti), jnp.float32),
+            pltpu.VMEM((ti, tr), jnp.float32),
+        ],
+    )
+    gram_u, corr = pl.pallas_call(
+        functools.partial(
+            _gram_corr_sym_kernel, nk=nk, compute_dtype=compute_dtype
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((dp, dp), jnp.float32),
+            jax.ShapeDtypeStruct((dp, tr), jnp.float32),
+        ],
+        interpret=_interpret() if interpret is None else interpret,
+    )(ii, jj, Ap, Ap, Rp)
+    # Mirror the (written) upper triangle; lower-triangle blocks are
+    # undefined memory, so build from triu explicitly.
+    upper = jnp.triu(gram_u)
+    gram = upper + jnp.triu(gram_u, 1).T
+    return gram[:d, :d], corr[:d, :kdim]

@@ -94,6 +94,13 @@ class CosineRandomFeaturesModel(Transformer):
         return jnp.cos(jnp.asarray(x) @ self.W.T + self.b)
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        from keystone_tpu.ops import pallas_ops
+
+        if pallas_ops.pallas_enabled():
+            # Fused Pallas matmul+cos: the pre-activation never hits HBM.
+            return data.map_batch(
+                lambda X: pallas_ops.cosine_features(X, self.W, self.b)
+            )._rezero_padding()
         return data.map_batch(lambda X: jnp.cos(X @ self.W.T + self.b))._rezero_padding()
 
 

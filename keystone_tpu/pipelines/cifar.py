@@ -64,7 +64,6 @@ class CifarConfig:
     num_filters: int = 100
     whitener_size: int = 1000  # patches sampled for the ZCA fit
     patch_size: int = 6
-    patch_steps: int = 1
     pool_size: int = 10
     pool_stride: int = 9
     alpha: float = 0.25
@@ -125,7 +124,6 @@ def _conv_featurizer(filters, whitener, config: CifarConfig) -> Pipeline:
         whitener=whitener,
         normalize_patches=True,
     )
-    conv.patch_size = config.patch_size
     return (
         conv.to_pipeline()
         .and_then(SymmetricRectifier(alpha=config.alpha))
@@ -293,7 +291,6 @@ def run_random_patch_cifar_augmented(config: CifarConfig):
         whitener=whitener,
         normalize_patches=True,
     )
-    conv.patch_size = conv_cfg.patch_size
     featurizer = (
         conv.to_pipeline()
         .and_then(SymmetricRectifier(alpha=config.alpha))
