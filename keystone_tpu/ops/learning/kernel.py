@@ -134,11 +134,41 @@ class KernelBlockLinearMapper(Transformer):
         self.block_size = block_size
         self.kernel_transformer = kernel_transformer
         self.n_train = n_train
+        self._ring_operands = None  # (mesh, Xtr_sharded, W_sharded) cache
 
     def apply(self, x):
         return self.batch_apply(Dataset.of(np.asarray(x)[None])).to_numpy()[0]
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        from keystone_tpu.parallel import mesh as mesh_lib
+        from keystone_tpu.parallel import ring
+
+        mesh = data.mesh
+        if mesh is not None and mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS) > 1:
+            # Multi-device: ring schedule — train rows + dual model circulate
+            # the mesh via ppermute; no block gather, no replicated W.
+            if self._ring_operands is None or self._ring_operands[0] is not mesh:
+                # The sharded (train rows, dual model) pair is invariant per
+                # model+mesh: build once, reuse across test batches.
+                p = mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS)
+                W_full = jnp.concatenate(self.w_locals, axis=0)[: self.n_train]
+                Xtr = self.kernel_transformer.train_X[: self.n_train]
+                # Ghost train rows have nonzero kernel values, but zero model
+                # rows, so padding contributes nothing to the product.
+                W_pad, _ = mesh_lib.pad_rows(np.asarray(W_full), p)
+                Xtr_pad, _ = mesh_lib.pad_rows(np.asarray(Xtr), p)
+                self._ring_operands = (
+                    mesh,
+                    mesh_lib.shard_rows(Xtr_pad, mesh),
+                    mesh_lib.shard_rows(W_pad, mesh),
+                )
+            _, Xtr_s, W_s = self._ring_operands
+            out = ring.ring_kernel_apply(
+                data.array, Xtr_s, W_s,
+                self.kernel_transformer.gamma, mesh=mesh,
+            )
+            return Dataset(out, n=data.n, mesh=mesh)._rezero_padding()
+
         X = jnp.asarray(data.array)
         out = None
         for bi, w in enumerate(self.w_locals):

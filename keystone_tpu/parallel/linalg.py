@@ -228,7 +228,11 @@ def bcd_least_squares_fused(
     )
     if W_init is not None:
         B = B - sum(
-            A_stack[i].astype(jnp.float32) @ W0[i] for i in range(nb)
+            jnp.dot(
+                A_stack[i].astype(jnp.float32), W0[i],
+                precision=jax.lax.Precision.HIGHEST,
+            )
+            for i in range(nb)
         )
     W, R = _bcd_fused_kernel(
         A_stack, B, W0, float(lam), max(int(num_iter), 1),

@@ -70,9 +70,9 @@ class TestGramCorr:
         np.testing.assert_allclose(np.asarray(corr), A.T @ R, atol=1e-4)
 
     def test_sym_multi_tile_symmetry(self):
-        # Wide enough for several column tiles: exercises the triangular
-        # pair enumeration + mirror.
-        A = rng.normal(size=(64, 300)).astype(np.float32)
+        # d > 512 forces nt > 1 column tiles: exercises the scalar-prefetched
+        # triangular pair enumeration, off-diagonal writeback, and mirror.
+        A = rng.normal(size=(64, 700)).astype(np.float32)
         R = rng.normal(size=(64, 5)).astype(np.float32)
         gram, corr = po.gram_corr_sym(A, R, interpret=True)
         np.testing.assert_allclose(np.asarray(gram), A.T @ A, atol=1e-4)
@@ -158,3 +158,24 @@ class TestFusedBCD:
             )
         finally:
             po_mod._interpret = orig
+
+
+class TestBf16SolveQuality:
+    def test_bf16_features_preserve_solve_quality(self):
+        """The bench's bf16 feature layout must not degrade the solve beyond
+        feature-level noise: solutions from bf16 and f32 layouts of the same
+        problem agree to ~1%."""
+        n, db, nb, k = 128, 16, 2, 3
+        A = rng.normal(size=(nb, n, db)).astype(np.float32)
+        W_true = rng.normal(size=(nb, db, k)).astype(np.float32)
+        B = sum(A[i] @ W_true[i] for i in range(nb))
+        W32 = linalg.bcd_least_squares_fused(
+            A, B, lam=1e-3, num_iter=4, use_pallas=False
+        )
+        W16 = linalg.bcd_least_squares_fused(
+            jnp.asarray(A, dtype=jnp.bfloat16), B, lam=1e-3, num_iter=4,
+            use_pallas=False,
+        )
+        denom = np.abs(np.asarray(W32)).max()
+        rel = np.abs(np.asarray(W16) - np.asarray(W32)).max() / denom
+        assert rel < 2e-2, rel
