@@ -18,28 +18,70 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu import native
+
 from .dataset import Dataset, LabeledData
 
 
-def _read_csv_matrix(path: str) -> np.ndarray:
-    """CSV -> (rows, cols) float matrix via the native parser when available
-    (keystone_tpu/native — the host-side data-plane tier), else numpy."""
-    from keystone_tpu import native
-
-    with open(path, "rb") as f:
-        text = f.read()
-    vals, ncols, nrows = native.parse_csv_floats(text)
+def _check_rect(vals, ncols: int, nrows: int, where: str) -> np.ndarray:
     if ncols <= 0 or vals.size != ncols * nrows:
         raise ValueError(
-            f"{path}: ragged CSV — {vals.size} values over {nrows} rows "
+            f"{where}: ragged CSV — {vals.size} values over {nrows} rows "
             f"do not form a rectangular {nrows}x{ncols} matrix"
         )
     return vals.reshape(nrows, ncols)
 
 
+def _read_csv_matrix(path: str) -> np.ndarray:
+    """CSV -> (rows, cols) float matrix via the native parser when available
+    (keystone_tpu/native — the host-side data-plane tier), else numpy."""
+    with open(path, "rb") as f:
+        text = f.read()
+    vals, ncols, nrows = native.parse_csv_floats(text)
+    return _check_rect(vals, ncols, nrows, path)
+
+
+def _read_csv_matrices(paths: List[str]) -> List[np.ndarray]:
+    """Parse many CSV files through the native thread pool (one task per
+    file), falling back to sequential parsing without the native library.
+    Empty files contribute no rows (sc.textFile semantics — e.g. Spark
+    _SUCCESS markers)."""
+    texts = []
+    for p in paths:
+        with open(p, "rb") as f:
+            texts.append(f.read())
+    many = native.parse_csv_floats_many(texts)
+    if many is None:
+        many = [native.parse_csv_floats(t) for t in texts]
+    return [
+        _check_rect(vals, ncols, nrows, path)
+        for path, (vals, ncols, nrows) in zip(paths, many)
+        if nrows > 0
+    ]
+
+
 def csv_data_loader(path: str) -> Dataset:
     """CSV of comma-separated numbers -> Dataset of rows
-    (reference: loaders/CsvDataLoader.scala:10-31)."""
+    (reference: loaders/CsvDataLoader.scala:10-31).
+
+    Like the reference's ``sc.textFile``, ``path`` may be a directory: every
+    regular file inside is parsed (concurrently, in the native thread pool)
+    and the row blocks are concatenated in sorted-filename order."""
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if os.path.isfile(os.path.join(path, f)) and not f.startswith(".")
+        )
+        if not files:
+            raise ValueError(f"{path}: directory contains no files")
+        mats = _read_csv_matrices(files)
+        if not mats:
+            raise ValueError(f"{path}: no data rows in any file")
+        widths = {m.shape[1] for m in mats}
+        if len(widths) != 1:
+            raise ValueError(f"{path}: files disagree on column count {widths}")
+        return Dataset.of(np.concatenate(mats, axis=0))
     return Dataset.of(_read_csv_matrix(path))
 
 
@@ -62,18 +104,28 @@ CIFAR_RECORD_BYTES = CIFAR_LABEL_SIZE + CIFAR_IMAGE_BYTES
 def load_cifar_binary(path: str) -> LabeledData:
     """CIFAR-10 binary format: 3073-byte records of [label, 3072 pixel bytes]
     (reference: loaders/CifarLoader.scala:14-53). Images come out as
-    (n, 32, 32, 3) float64 in [0, 255]."""
-    raw = np.fromfile(path, dtype=np.uint8)
-    if raw.size % CIFAR_RECORD_BYTES != 0:
+    (n, 32, 32, 3) float32 in [0, 255] (pixel bytes are exact in float32).
+
+    The record deinterleave + planar->HWC conversion runs in the threaded
+    native data plane when available."""
+    with open(path, "rb") as f:
+        raw_bytes = f.read()
+    if len(raw_bytes) % CIFAR_RECORD_BYTES != 0:
         raise ValueError(f"{path}: not a multiple of {CIFAR_RECORD_BYTES} bytes")
-    records = raw.reshape(-1, CIFAR_RECORD_BYTES)
+    split = native.split_records(raw_bytes, CIFAR_LABEL_SIZE, 3, 32, 32)
+    if split is not None:
+        labels, images = split
+        return LabeledData(images, labels)
+    records = np.frombuffer(raw_bytes, dtype=np.uint8).reshape(
+        -1, CIFAR_RECORD_BYTES
+    )
     labels = records[:, 0].astype(np.int64)
     # CIFAR stores channel-planar (RGB planes); convert to HWC.
     images = (
         records[:, 1:]
         .reshape(-1, 3, 32, 32)
         .transpose(0, 2, 3, 1)
-        .astype(np.float64)
+        .astype(np.float32)
     )
     return LabeledData(images, labels)
 
@@ -86,7 +138,7 @@ class TimitFeaturesDataLoader:
     num_features = 440
 
     def __init__(self, feature_path: str, label_path: str):
-        feats = np.loadtxt(feature_path, delimiter=",", dtype=np.float64, ndmin=2)
+        feats = _read_csv_matrix(feature_path)
         labels = self._parse_sparse_labels(label_path, feats.shape[0])
         self.labeled = LabeledData(feats, labels)
 

@@ -18,14 +18,17 @@ import numpy as np
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libkeystone_native.so")
-_SOURCES = [os.path.join(_DIR, "csv_loader.cpp")]
+_SOURCES = [
+    os.path.join(_DIR, "csv_loader.cpp"),
+    os.path.join(_DIR, "data_plane.cpp"),
+]
 
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
 def _build() -> bool:
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-o", _LIB_PATH] + _SOURCES
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-o", _LIB_PATH] + _SOURCES
     try:
         res = subprocess.run(cmd, capture_output=True, timeout=120)
         return res.returncode == 0
@@ -54,6 +57,28 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_long),
         ]
+        lib.ks_split_records.restype = None
+        lib.ks_split_records.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.ks_parse_csv_many.restype = None
+        lib.ks_parse_csv_many.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_long),
+        ]
         lib.ks_decode_pnm.restype = ctypes.c_int
         lib.ks_decode_pnm.argtypes = [
             ctypes.c_char_p,
@@ -70,6 +95,20 @@ def get_lib() -> Optional[ctypes.CDLL]:
     return _lib
 
 
+def _csv_max_vals(text: bytes) -> int:
+    """Upper bound on the value count of a CSV buffer: every value is
+    preceded by a separator (incl. CR, which the parser skips) or starts the
+    buffer."""
+    return (
+        text.count(b",")
+        + text.count(b"\n")
+        + text.count(b" ")
+        + text.count(b"\t")
+        + text.count(b"\r")
+        + 2
+    )
+
+
 def parse_csv_floats(text: bytes) -> Tuple[np.ndarray, int, int]:
     """Parse a CSV byte buffer into (flat float64 values, num_columns,
     num_rows). Uses the native parser when available, else a NumPy fallback.
@@ -77,15 +116,7 @@ def parse_csv_floats(text: bytes) -> Tuple[np.ndarray, int, int]:
     ragged input."""
     lib = get_lib()
     if lib is not None:
-        # Upper bound on value count: every value is preceded by a separator
-        # or starts the buffer.
-        max_vals = (
-            text.count(b",")
-            + text.count(b"\n")
-            + text.count(b" ")
-            + text.count(b"\t")
-            + 2
-        )
+        max_vals = _csv_max_vals(text)
         out = np.empty(max_vals, dtype=np.float64)
         ncols = ctypes.c_long(0)
         nrows = ctypes.c_long(0)
@@ -134,3 +165,65 @@ def decode_pnm(data: bytes) -> Optional[np.ndarray]:
         return None
     n = x.value * y.value * c.value
     return out[:n].copy().reshape(x.value, y.value, c.value)
+
+
+def split_records(
+    buf: bytes,
+    label_bytes: int,
+    channels: int,
+    height: int,
+    width: int,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Deinterleave CIFAR-style fixed records [label_bytes | planar pixels]
+    into (int64 labels, float32 HWC images) with a threaded native loop;
+    None when the native library is unavailable. The last label byte is used
+    (CIFAR-10's only byte; CIFAR-100's fine label)."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    img_bytes = channels * height * width
+    rec = label_bytes + img_bytes
+    if len(buf) % rec != 0:
+        raise ValueError(f"buffer not a multiple of record size {rec}")
+    n = len(buf) // rec
+    labels = np.empty(n, dtype=np.int64)
+    images = np.empty((n, height, width, channels), dtype=np.float32)
+    lib.ks_split_records(
+        buf,
+        n,
+        label_bytes,
+        channels,
+        height,
+        width,
+        labels.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        images.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+    )
+    return labels, images
+
+
+def parse_csv_floats_many(texts) -> Optional[list]:
+    """Parse many CSV byte buffers concurrently via the native thread pool.
+    Returns a list of (flat values, num_columns, num_rows) or None when the
+    native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n = len(texts)
+    if n == 0:
+        return []
+    bufs = (ctypes.c_char_p * n)(*texts)
+    lens = (ctypes.c_long * n)(*[len(t) for t in texts])
+    max_vals_list = [_csv_max_vals(t) for t in texts]
+    outs_np = [np.empty(m, dtype=np.float64) for m in max_vals_list]
+    outs = (ctypes.POINTER(ctypes.c_double) * n)(
+        *[o.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) for o in outs_np]
+    )
+    max_vals = (ctypes.c_long * n)(*max_vals_list)
+    counts = (ctypes.c_long * n)()
+    ncols = (ctypes.c_long * n)()
+    nrows = (ctypes.c_long * n)()
+    lib.ks_parse_csv_many(bufs, lens, n, outs, max_vals, counts, ncols, nrows)
+    return [
+        (outs_np[i][: counts[i]].copy(), int(ncols[i]), int(nrows[i]))
+        for i in range(n)
+    ]

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.utils import profiling
 from keystone_tpu.workflow import Estimator, LabelEstimator, Transformer
 
 logger = logging.getLogger("keystone_tpu.kernel")
@@ -221,6 +222,10 @@ class KernelRidgeRegression(LabelEstimator):
 
         rng = np.random.default_rng(self.block_permuter) if self.block_permuter is not None else None
 
+        # Per-phase breakdown, the analog of the reference's kernelGen/
+        # residual/localSolve/modelUpdate ns logs (KernelRidgeRegression.scala:213-221).
+        timer = profiling.PhaseTimer("krr_fit")
+
         for epoch in range(self.num_epochs):
             order = list(range(num_blocks))
             if rng is not None:
@@ -232,23 +237,29 @@ class KernelRidgeRegression(LabelEstimator):
                 valid_col = (
                     (jnp.arange(start, start + bs) < n_train).astype(Y.dtype)
                 )
-                K_block = transformer.column_block(start, bs)
-                K_bb = transformer.diag_block(start, bs)
+                with timer.phase("kernel_gen"):
+                    K_block = transformer.column_block(start, bs)
+                    K_bb = transformer.diag_block(start, bs)
+                    # Barrier so the async kernel GEMMs are attributed here,
+                    # not to the solve phase that first touches the values.
+                    jax.block_until_ready((K_block, K_bb))
                 y_bb = jax.lax.dynamic_slice_in_dim(Y, start, bs, axis=0)
                 y_bb = y_bb * valid_col[:, None]
 
                 # The in-step scatter is the analog of updateModel's
                 # prefix-length index intersection (KernelRidgeRegression.scala:237-274).
-                w_new, W = _krr_block_step(
-                    K_block, W, K_bb, y_bb, w_locals[block],
-                    valid_col, valid_row, start, float(self.lam),
-                )
-                w_locals[block] = w_new
-                W.block_until_ready()
+                with timer.phase("block_solve"):
+                    w_new, W = _krr_block_step(
+                        K_block, W, K_bb, y_bb, w_locals[block],
+                        valid_col, valid_row, start, float(self.lam),
+                    )
+                    w_locals[block] = w_new
+                    W.block_until_ready()
                 logger.info(
                     "EPOCH_%d_BLOCK_%d took %.3f seconds",
                     epoch, block, time.perf_counter() - t0,
                 )
+        timer.log_summary()
         return KernelBlockLinearMapper(w_locals, bs, transformer, n_train)
 
     @property

@@ -1,0 +1,102 @@
+"""Tracing / profiling utilities (SURVEY.md §5).
+
+The reference has two profiling mechanisms: the AutoCacheRule sampling
+profiler (wall-clock + memory per node, AutoCacheRule.scala:153-465) and
+ad-hoc per-phase nanosecond logs inside solvers (KernelRidgeRegression.scala:
+213-221). The TPU equivalents here:
+
+  - ``PhaseTimer`` — named phase accumulation with a log summary, used by the
+    iterative solvers for per-phase breakdowns.
+  - ``trace`` — context manager around ``jax.profiler`` emitting a TensorBoard
+    trace directory (XLA device timelines), the deep-dive tool.
+  - ``compiled_cost`` — static cost extraction from a jitted function's
+    compiled XLA executable (FLOPs / bytes accessed), the analog of the
+    reference's analytic ``CostModel`` inputs but read from the compiler
+    instead of hand-derived.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+import jax
+
+logger = logging.getLogger("keystone_tpu.profiling")
+
+
+class PhaseTimer:
+    """Accumulate wall-clock per named phase.
+
+    >>> t = PhaseTimer("krr")
+    >>> with t.phase("kernel_gen"):
+    ...     do_work()
+    >>> t.log_summary()
+    """
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.totals: "OrderedDict[str, float]" = OrderedDict()
+        self.counts: Dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def phase(self, phase_name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self.totals[phase_name] = self.totals.get(phase_name, 0.0) + dt
+            self.counts[phase_name] = self.counts.get(phase_name, 0) + 1
+
+    def total(self, phase_name: str) -> float:
+        return self.totals.get(phase_name, 0.0)
+
+    def summary(self) -> str:
+        parts = [
+            f"{k}={v:.3f}s/{self.counts[k]}x" for k, v in self.totals.items()
+        ]
+        prefix = f"{self.name}: " if self.name else ""
+        return prefix + ", ".join(parts) if parts else prefix + "(no phases)"
+
+    def log_summary(self, level: int = logging.INFO) -> None:
+        logger.log(level, "%s", self.summary())
+
+
+@contextlib.contextmanager
+def trace(log_dir: str):
+    """Emit a jax.profiler trace (TensorBoard 'profile' plugin format) for
+    everything run inside the context. No-op if the profiler cannot start
+    (e.g. a second concurrent trace)."""
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - depends on runtime state
+        logger.warning("profiler trace unavailable: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+def compiled_cost(fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """FLOPs / memory-traffic estimates for ``jax.jit(fn)(*args)`` from XLA's
+    cost analysis of the compiled executable.
+
+    Returns {"flops": float, "bytes accessed": float, ...} (keys as XLA
+    reports them) or None when the backend doesn't support cost analysis.
+    """
+    try:
+        lowered = jax.jit(fn).lower(*args, **kwargs)
+        analysis = lowered.compile().cost_analysis()
+    except Exception as e:  # pragma: no cover - backend-specific
+        logger.warning("cost analysis unavailable: %s", e)
+        return None
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    return dict(analysis) if analysis else None

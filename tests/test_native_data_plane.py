@@ -1,0 +1,148 @@
+"""Native data-plane tests: threaded record deinterleave + parallel CSV
+parse, and their loader integrations (reference native tier:
+src/main/cpp — SURVEY.md §2.5; CifarLoader.scala:14-53)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu import native
+from keystone_tpu.data.loaders import (
+    CIFAR_RECORD_BYTES,
+    csv_data_loader,
+    load_cifar_binary,
+)
+
+
+rng = np.random.default_rng(3)
+
+
+class TestSplitRecords:
+    def test_matches_numpy_deinterleave(self):
+        n = 40
+        recs = rng.integers(0, 256, size=(n, CIFAR_RECORD_BYTES), dtype=np.uint8)
+        out = native.split_records(recs.tobytes(), 1, 3, 32, 32)
+        if out is None:
+            pytest.skip("native library unavailable")
+        labels, images = out
+        np.testing.assert_array_equal(labels, recs[:, 0])
+        ref = (
+            recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+        ).astype(np.float32)
+        np.testing.assert_array_equal(images, ref)
+
+    def test_cifar100_style_two_label_bytes(self):
+        # [coarse, fine | pixels]: the fine (last) byte is the label.
+        n = 8
+        rec_len = 2 + 3 * 8 * 8
+        recs = rng.integers(0, 256, size=(n, rec_len), dtype=np.uint8)
+        out = native.split_records(recs.tobytes(), 2, 3, 8, 8)
+        if out is None:
+            pytest.skip("native library unavailable")
+        labels, images = out
+        np.testing.assert_array_equal(labels, recs[:, 1])
+
+    def test_bad_record_size_raises(self):
+        if native.get_lib() is None:
+            pytest.skip("native library unavailable")
+        with pytest.raises(ValueError):
+            native.split_records(b"\x00" * 100, 1, 3, 32, 32)
+
+
+class TestLoadCifarBinary:
+    def test_roundtrip(self, tmp_path):
+        n = 12
+        recs = rng.integers(0, 256, size=(n, CIFAR_RECORD_BYTES), dtype=np.uint8)
+        p = tmp_path / "batch.bin"
+        p.write_bytes(recs.tobytes())
+        out = load_cifar_binary(str(p))
+        images = np.asarray(out.data.array)
+        assert images.shape == (n, 32, 32, 3)
+        np.testing.assert_array_equal(out.labels.to_numpy(), recs[:, 0])
+        ref = recs[:, 1:].reshape(n, 3, 32, 32).transpose(0, 2, 3, 1)
+        np.testing.assert_array_equal(images, ref)
+
+    def test_truncated_file_raises(self, tmp_path):
+        p = tmp_path / "bad.bin"
+        p.write_bytes(b"\x00" * (CIFAR_RECORD_BYTES + 7))
+        with pytest.raises(ValueError):
+            load_cifar_binary(str(p))
+
+
+class TestParallelCsv:
+    def test_many_matches_single(self):
+        texts = [
+            b"1,2,3\n4,5,6\n",
+            b"7.25,8.5\n9,10\n11,12\n",
+            b"13\n",
+        ]
+        many = native.parse_csv_floats_many(texts)
+        if many is None:
+            pytest.skip("native library unavailable")
+        for text, (vals, ncols, nrows) in zip(texts, many):
+            v1, c1, r1 = native.parse_csv_floats(text)
+            np.testing.assert_array_equal(vals, v1)
+            assert (ncols, nrows) == (c1, r1)
+
+    def test_empty_list(self):
+        if native.get_lib() is None:
+            pytest.skip("native library unavailable")
+        assert native.parse_csv_floats_many([]) == []
+
+    def test_many_files_stress(self):
+        texts = [
+            ("\n".join(",".join(str(i * 100 + j) for j in range(5))
+                       for i in range(20))).encode()
+            for _ in range(64)
+        ]
+        many = native.parse_csv_floats_many(texts)
+        if many is None:
+            pytest.skip("native library unavailable")
+        for vals, ncols, nrows in many:
+            assert (ncols, nrows) == (5, 20)
+            assert vals.size == 100
+
+
+class TestCsvDirectoryLoader:
+    def test_directory_concatenates_sorted(self, tmp_path):
+        d = tmp_path / "csvdir"
+        d.mkdir()
+        (d / "b.csv").write_text("3,4\n")
+        (d / "a.csv").write_text("1,2\n")
+        (d / "c.csv").write_text("5,6\n7,8\n")
+        out = np.asarray(csv_data_loader(str(d)).array)
+        np.testing.assert_array_equal(out, [[1, 2], [3, 4], [5, 6], [7, 8]])
+
+    def test_mismatched_columns_raise(self, tmp_path):
+        d = tmp_path / "csvdir"
+        d.mkdir()
+        (d / "a.csv").write_text("1,2\n")
+        (d / "b.csv").write_text("1,2,3\n")
+        with pytest.raises(ValueError):
+            csv_data_loader(str(d))
+
+    def test_empty_directory_raises(self, tmp_path):
+        d = tmp_path / "empty"
+        d.mkdir()
+        with pytest.raises(ValueError):
+            csv_data_loader(str(d))
+
+
+class TestCsvEdgeCases:
+    def test_cr_separated_values_not_truncated(self):
+        vals, ncols, nrows = native.parse_csv_floats(b"1\r2\r3")
+        assert vals.size == 3, (vals, ncols, nrows)
+
+    def test_directory_skips_empty_files(self, tmp_path):
+        d = tmp_path / "csvdir"
+        d.mkdir()
+        (d / "_SUCCESS").write_bytes(b"")
+        (d / "part-0.csv").write_text("1,2\n")
+        out = np.asarray(csv_data_loader(str(d)).array)
+        np.testing.assert_array_equal(out, [[1, 2]])
+
+    def test_directory_all_empty_raises(self, tmp_path):
+        d = tmp_path / "csvdir"
+        d.mkdir()
+        (d / "_SUCCESS").write_bytes(b"")
+        with pytest.raises(ValueError):
+            csv_data_loader(str(d))
