@@ -178,6 +178,8 @@ def split_records(
     into (int64 labels, float32 HWC images) with a threaded native loop;
     None when the native library is unavailable. The last label byte is used
     (CIFAR-10's only byte; CIFAR-100's fine label)."""
+    if label_bytes < 1:
+        raise ValueError("label_bytes must be >= 1")
     lib = get_lib()
     if lib is None:
         return None

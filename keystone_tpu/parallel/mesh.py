@@ -16,6 +16,7 @@ NCCL/MPI.
 from __future__ import annotations
 
 import contextlib
+import os
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -71,6 +72,58 @@ def use_mesh(mesh: Mesh):
 
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis] if axis in mesh.shape else 1
+
+
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join the multi-host JAX runtime (one process per host over DCN).
+
+    The analog of the Spark driver/executor bring-up in bin/run-pipeline.sh:
+    after this, ``jax.devices()`` spans every host's chips and meshes built
+    from it produce programs whose collectives ride ICI within a slice and
+    DCN across slices. No-op when already initialized or single-process with
+    no coordinator configured.
+    """
+    # NOTE: must not touch jax.devices()/process_count() here — querying the
+    # backend initializes it, after which jax.distributed.initialize refuses
+    # to run. Check the distributed client state directly instead.
+    from jax._src import distributed as _distributed
+
+    if getattr(_distributed.global_state, "client", None) is not None:
+        return  # already initialized
+    if coordinator_address is None and "JAX_COORDINATOR_ADDRESS" not in os.environ:
+        return  # single-process run
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(
+    ici_shape: Tuple[int, ...],
+    dcn_shape: Tuple[int, ...],
+    axis_names: Sequence[str],
+) -> Mesh:
+    """Mesh over a multi-slice topology: ``ici_shape`` axes map within a
+    slice (fast ICI), ``dcn_shape`` axes across slices (DCN). Put the
+    data-parallel axis on DCN and model/feature axes on ICI — the layout that
+    keeps Gramian all-reduces and block broadcasts on the fast interconnect.
+
+    Degenerates to a plain mesh when there is a single slice.
+    """
+    if int(np.prod(dcn_shape)) == 1:
+        full = tuple(d * i for d, i in zip(dcn_shape, ici_shape))
+        return make_mesh(full, axis_names)
+    from jax.experimental import mesh_utils
+
+    devices = mesh_utils.create_hybrid_device_mesh(
+        ici_shape, dcn_shape, devices=jax.devices()
+    )
+    return Mesh(devices, tuple(axis_names))
 
 
 def pad_rows(x: np.ndarray, multiple: int):

@@ -1,0 +1,21 @@
+"""Numeric comparison helpers (reference: utils/Stats.scala:25-66).
+
+``about_eq`` is the tolerance comparison the reference uses throughout its
+solver tests; it accepts scalars, arrays, and nested sequences.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+DEFAULT_THRESHOLD = 1e-8
+
+
+def about_eq(a, b, threshold: float = DEFAULT_THRESHOLD) -> bool:
+    """True when every element of ``a`` is within ``threshold`` of ``b``
+    (absolute difference — the reference's Stats.aboutEq semantics)."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    if a.shape != b.shape:
+        return False
+    return bool(np.all(np.abs(a - b) <= threshold))

@@ -45,5 +45,6 @@ from .pipeline import (
     PipelineDatum,
     PipelineResult,
     Transformer,
+    TransformerGraph,
     transformer,
 )

@@ -195,12 +195,7 @@ class Pipeline(Chainable[A, B]):
             graph = graph.set_operator(node, transformer).set_dependencies(node, deps[1:])
 
         graph, _ = UnusedBranchRemovalRule().apply(graph, {})
-
-        for node, op in graph.operators.items():
-            if not isinstance(op, TransformerOperator):
-                raise TypeError(f"Non-transformer operator {op.label} survived fit()")
-
-        return FittedPipeline(graph, self.source, self.sink)
+        return FittedPipeline(TransformerGraph.from_graph(graph), self.source, self.sink)
 
     @staticmethod
     def gather(branches: Sequence["Pipeline[A, B]"]) -> "Pipeline[A, List[B]]":
@@ -227,8 +222,28 @@ class Pipeline(Chainable[A, B]):
 
 
 # ---------------------------------------------------------------------------
-# FittedPipeline
+# TransformerGraph + FittedPipeline
 # ---------------------------------------------------------------------------
+
+
+class TransformerGraph(Graph):
+    """A Graph whose every operator is a TransformerOperator — the
+    serializable transformer-only restriction backing FittedPipeline
+    (reference: TransformerGraph.scala:12-29)."""
+
+    @staticmethod
+    def from_graph(graph: Graph) -> "TransformerGraph":
+        for _, op in graph.operators.items():
+            if not isinstance(op, TransformerOperator):
+                raise TypeError(
+                    f"Non-transformer operator {op.label} in TransformerGraph"
+                )
+        return TransformerGraph(
+            sources=graph.sources,
+            operators=graph.operators,
+            dependencies=graph.dependencies,
+            sink_dependencies=graph.sink_dependencies,
+        )
 
 
 class FittedPipeline(Generic[A, B]):

@@ -89,3 +89,56 @@ class TestTSQR:
         A = rng.normal(size=(256, 10))
         R = np.asarray(linalg.tsqr_r(mesh_lib.shard_rows(A, mesh8), mesh8))
         np.testing.assert_allclose(R.T @ R, A.T @ A, atol=1e-9)
+
+
+class TestMeshHelpers:
+    def test_hybrid_mesh_single_slice_degenerates(self):
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        m = mesh_lib.make_hybrid_mesh((4, 2), (1, 1), ("data", "model"))
+        assert dict(m.shape) == {"data": 4, "model": 2}
+
+    def test_init_distributed_noop_single_process(self):
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        # No coordinator configured: must not raise, must not initialize.
+        import jax
+
+        mesh_lib.init_distributed()
+        assert jax.process_count() == 1
+
+
+class TestAboutEq:
+    def test_scalars_and_arrays(self):
+        from keystone_tpu.utils.stats import about_eq
+
+        assert about_eq(1.0, 1.0 + 1e-9)
+        assert not about_eq(1.0, 1.1)
+        assert about_eq([1.0, 2.0], [1.0, 2.0 + 1e-9])
+        assert not about_eq([[1.0]], [1.0])  # shape mismatch
+
+
+class TestTransformerGraph:
+    def test_fit_produces_transformer_graph(self):
+        import numpy as np
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.workflow import TransformerGraph, transformer
+        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+
+        X = np.random.default_rng(0).normal(size=(16, 3)).astype(np.float32)
+        Y = X @ np.ones((3, 2), dtype=np.float32)
+        pipe = transformer(lambda x: x).and_then(
+            LinearMapEstimator(lam=0.0), Dataset.of(X), Dataset.of(Y)
+        )
+        fitted = pipe.fit()
+        assert isinstance(fitted.transformer_graph, TransformerGraph)
+
+    def test_rejects_non_transformer_operator(self):
+        import pytest
+        from keystone_tpu.workflow import TransformerGraph
+        from keystone_tpu.workflow.graph import Graph
+        from keystone_tpu.workflow.operators import DatumOperator
+
+        g, _ = Graph().add_node(DatumOperator(1), [])
+        with pytest.raises(TypeError):
+            TransformerGraph.from_graph(g)
