@@ -46,7 +46,9 @@ def main():
     if precision not in ("bf16", "f32"):
         raise SystemExit(f"BENCH_PRECISION must be bf16 or f32, got {precision!r}")
     bf16 = precision == "bf16"
-    n = int(131072 * scale)
+    # 262144 rows ≈ 12 GB peak HBM at bf16 features (fits a 16 GB v5e with
+    # headroom); f32 features double the feature buffer, so halve the rows.
+    n = int(262144 * scale) if bf16 else int(131072 * scale)
 
     rng = np.random.default_rng(0)
     X_np = rng.normal(size=(n, TIMIT_INPUT_DIMS)).astype(np.float32)
@@ -73,29 +75,28 @@ def main():
     use_pallas = po.pallas_enabled()
     feat_dtype = jnp.bfloat16 if bf16 else jnp.float32
 
+    # Flat (n, 16384) feature layout: one fused featurize producing a single
+    # buffer — a stacked per-block layout would need 2x the features' HBM
+    # during the stack and OOMs at BENCH_SCALE >= 2.
+    Wrf_flat = Wrf.reshape(NUM_FEATURES, TIMIT_INPUT_DIMS)
+    brf_flat = brf.reshape(NUM_FEATURES)
+
     @jax.jit
-    def train_step(X, Wrf, brf, Y):
+    def train_step(X, Wrf_flat, brf_flat, Y):
         if use_pallas:
-            F = jnp.stack(
-                [
-                    po.cosine_features(
-                        X, Wrf[i], brf[i],
-                        compute_dtype=feat_dtype, out_dtype=feat_dtype,
-                    )
-                    for i in range(num_blocks)
-                ]
+            F = po.cosine_features(
+                X, Wrf_flat, brf_flat,
+                compute_dtype=feat_dtype, out_dtype=feat_dtype,
             )
         else:
-            F = jnp.stack(
-                [jnp.cos(X @ Wrf[i].T + brf[i]).astype(feat_dtype)
-                 for i in range(num_blocks)]
-            )
-        return linalg.bcd_least_squares_fused(
-            F, Y, lam=1e-4, num_iter=NUM_EPOCHS, use_pallas=use_pallas
+            F = jnp.cos(X @ Wrf_flat.T + brf_flat).astype(feat_dtype)
+        return linalg.bcd_least_squares_fused_flat(
+            F, Y, BLOCK_SIZE, lam=1e-4, num_iter=NUM_EPOCHS,
+            use_pallas=use_pallas,
         )
 
     def run_once():
-        W = train_step(X, Wrf, brf, Y)
+        W = train_step(X, Wrf_flat, brf_flat, Y)
         # Force execution end-to-end: on the tunneled TPU backend,
         # block_until_ready is not a reliable barrier — a host transfer is.
         checksum = float(jnp.sum(jnp.abs(W)))

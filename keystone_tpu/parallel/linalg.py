@@ -129,56 +129,27 @@ def bcd_least_squares(
 )
 def _bcd_fused_kernel(A_stack, B, W0, lam: float, num_iter: int,
                       use_pallas: bool, sym: bool):
-    from keystone_tpu.ops import pallas_ops
-
-    feat_dtype = A_stack.dtype
-    hi = (
-        dict(precision=jax.lax.Precision.HIGHEST)
-        if feat_dtype == jnp.float32
-        else {}
-    )
-
-    def _corr(Ab, R):
-        return jax.lax.dot_general(
-            Ab, R.astype(feat_dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32, **hi,
-        )
-
-    def _update(R, Ab, Wb, Wb_new):
-        # The residual delta is accumulated in f32 regardless of the feature
-        # layout dtype (preferred_element_type) so bf16 GEMM inputs never
-        # quantize the running residual.
-        delta = jax.lax.dot_general(
-            Ab, (Wb_new - Wb).astype(feat_dtype), (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32, **hi,
-        )
-        return R - delta
-
     def first_epoch_step(R, xs):
-        """First sweep: compute + stash each block's Gramian."""
+        """First sweep: compute (and, for multi-epoch runs, stash) each
+        block's Gramian. Single-epoch runs skip the stash — at bench shapes
+        it costs nb*db^2 f32 (~268 MB) of HBM for nothing."""
         Ab, Wb = xs
-        if use_pallas:
-            fn = pallas_ops.gram_corr_sym if sym else pallas_ops.gram_corr
-            gram, corr = fn(Ab, R)
-        else:
-            gram = jax.lax.dot_general(
-                Ab, Ab, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, **hi,
-            )
-            corr = _corr(Ab, R)
-        rhs = corr + gram @ Wb
-        Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
-        return _update(R, Ab, Wb, Wb_new), (Wb_new, gram)
+        R, Wb_new, gram = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
+        stash = (Wb_new, gram) if num_iter > 1 else (Wb_new, jnp.zeros((0,)))
+        return R, stash
 
     def later_epoch_step(R, xs):
         """Later sweeps reuse the loop-invariant Gramians — only the
         correlation AᵀR depends on the evolving residual."""
         Ab, Wb, gram = xs
-        rhs = _corr(Ab, R) + gram @ Wb
-        Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
-        return _update(R, Ab, Wb, Wb_new), Wb_new
+        R, Wb_new, _ = _bcd_block_update(
+            Ab, R, Wb, lam, use_pallas, sym, gram=gram
+        )
+        return R, Wb_new
 
     R, (W, grams) = jax.lax.scan(first_epoch_step, B, (A_stack, W0))
+    if num_iter == 1:
+        return W, R
 
     def epoch(carry, _):
         R, W = carry
@@ -187,6 +158,110 @@ def _bcd_fused_kernel(A_stack, B, W0, lam: float, num_iter: int,
 
     (R, W), _ = jax.lax.scan(epoch, (R, W), None, length=num_iter - 1)
     return W, R
+
+
+def _hi_kwargs(feat_dtype):
+    """f32 operands force HIGHEST precision (the TPU default is a single
+    lossy bf16 pass); bf16 operands hit the MXU natively."""
+    if feat_dtype == jnp.float32:
+        return dict(precision=jax.lax.Precision.HIGHEST)
+    return {}
+
+
+def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
+                      gram=None):
+    """One Gauss-Seidel block update shared by the fused solvers.
+
+    Solves (AbᵀAb + λI) Wb' = AbᵀR + (AbᵀAb) Wb and returns
+    (R - Ab (Wb' - Wb), Wb', AbᵀAb). The residual delta is accumulated in f32
+    regardless of the feature layout dtype (preferred_element_type) so bf16
+    GEMM inputs never quantize the running residual. Pass ``gram`` to reuse a
+    precomputed Gramian (only the correlation then recomputes).
+    """
+    from keystone_tpu.ops import pallas_ops
+
+    feat_dtype = Ab.dtype
+    hi = _hi_kwargs(feat_dtype)
+    if gram is None and use_pallas:
+        fn = pallas_ops.gram_corr_sym if sym else pallas_ops.gram_corr
+        gram, corr = fn(Ab, R)
+    else:
+        if gram is None:
+            gram = jax.lax.dot_general(
+                Ab, Ab, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32, **hi,
+            )
+        corr = jax.lax.dot_general(
+            Ab, R.astype(feat_dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, **hi,
+        )
+    rhs = corr + gram @ Wb
+    Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
+    delta = jax.lax.dot_general(
+        Ab, (Wb_new - Wb).astype(feat_dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, **hi,
+    )
+    return R - delta, Wb_new, gram
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block", "lam", "num_iter", "use_pallas", "sym")
+)
+def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
+                           use_pallas: bool, sym: bool):
+    nb = F.shape[1] // block
+
+    def do_block(bi, R, W):
+        Ab = jax.lax.dynamic_slice_in_dim(F, bi * block, block, axis=1)
+        Wb = jax.lax.dynamic_index_in_dim(W, bi, axis=0, keepdims=False)
+        R, Wb_new, _ = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
+        return R, jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0)
+
+    def epoch(_, carry):
+        def body(bi, c):
+            return do_block(bi, *c)
+
+        return jax.lax.fori_loop(0, nb, body, carry)
+
+    R, W = jax.lax.fori_loop(0, num_iter, epoch, (B, W0))
+    return W, R
+
+
+def bcd_least_squares_fused_flat(
+    F,
+    B,
+    block_size: int,
+    lam: float = 0.0,
+    num_iter: int = 1,
+    use_pallas: Optional[bool] = None,
+    return_residual: bool = False,
+):
+    """Single-dispatch BCD over a *flat* (n, d) feature matrix.
+
+    Functionally identical to ``bcd_least_squares_fused`` on the column
+    blocks ``F[:, i*block : (i+1)*block]``, but the features live in one
+    contiguous buffer — at large n the stacked layout cannot be produced
+    without a second full-size copy (stack of independently-computed block
+    buffers), which is the difference between fitting in HBM and not.
+    Unlike the stacked path, Gramians are recomputed each epoch (trading
+    FLOPs for the nb*d_b² stash — rematerialization economics).
+    """
+    from keystone_tpu.ops import pallas_ops
+
+    F = jnp.asarray(F)
+    B = jnp.asarray(B, dtype=jnp.float32)
+    n, d = F.shape
+    if d % block_size != 0:
+        raise ValueError(f"feature dim {d} not divisible by block {block_size}")
+    nb = d // block_size
+    if use_pallas is None:
+        use_pallas = pallas_ops.pallas_enabled()
+    W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=jnp.float32)
+    W, R = _bcd_fused_flat_kernel(
+        F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
+        bool(use_pallas), True,
+    )
+    return (W, R) if return_residual else W
 
 
 def bcd_least_squares_fused(

@@ -158,6 +158,10 @@ class TestFusedBCD:
             )
         finally:
             po_mod._interpret = orig
+            # Drop jit executables compiled against the patched interpreter
+            # so later same-shape calls re-lower for the real backend.
+            import jax
+            jax.clear_caches()
 
 
 class TestBf16SolveQuality:
@@ -179,3 +183,50 @@ class TestBf16SolveQuality:
         denom = np.abs(np.asarray(W32)).max()
         rel = np.abs(np.asarray(W16) - np.asarray(W32)).max() / denom
         assert rel < 2e-2, rel
+
+
+class TestFusedFlatBCD:
+    def test_flat_matches_stacked(self):
+        n, db, nb, k = 96, 8, 3, 4
+        F = rng.normal(size=(n, nb * db)).astype(np.float32)
+        B = rng.normal(size=(n, k)).astype(np.float32)
+        stacked = np.stack([F[:, i * db : (i + 1) * db] for i in range(nb)])
+        W_stacked = linalg.bcd_least_squares_fused(
+            stacked, B, lam=0.3, num_iter=3, use_pallas=False
+        )
+        W_flat = linalg.bcd_least_squares_fused_flat(
+            F, B, db, lam=0.3, num_iter=3, use_pallas=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_flat), np.asarray(W_stacked), atol=1e-4
+        )
+
+    def test_indivisible_block_raises(self):
+        F = rng.normal(size=(16, 10)).astype(np.float32)
+        B = rng.normal(size=(16, 2)).astype(np.float32)
+        with pytest.raises(ValueError):
+            linalg.bcd_least_squares_fused_flat(F, B, 4, use_pallas=False)
+
+    def test_flat_with_pallas_interpret(self):
+        import keystone_tpu.ops.pallas_ops as po_mod
+
+        orig = po_mod._interpret
+        po_mod._interpret = lambda: True
+        try:
+            F = rng.normal(size=(32, 16)).astype(np.float32)
+            B = rng.normal(size=(32, 3)).astype(np.float32)
+            W_pl = linalg.bcd_least_squares_fused_flat(
+                F, B, 8, lam=0.1, num_iter=2, use_pallas=True
+            )
+            W_ref = linalg.bcd_least_squares_fused_flat(
+                F, B, 8, lam=0.1, num_iter=2, use_pallas=False
+            )
+            np.testing.assert_allclose(
+                np.asarray(W_pl), np.asarray(W_ref), atol=1e-3
+            )
+        finally:
+            po_mod._interpret = orig
+            # Drop jit executables compiled against the patched interpreter
+            # so later same-shape calls re-lower for the real backend.
+            import jax
+            jax.clear_caches()
