@@ -46,15 +46,20 @@ def main():
     if precision not in ("bf16", "f32"):
         raise SystemExit(f"BENCH_PRECISION must be bf16 or f32, got {precision!r}")
     bf16 = precision == "bf16"
-    # 262144 rows ≈ 12 GB peak HBM at bf16 features (fits a 16 GB v5e with
-    # headroom); f32 features double the feature buffer, so halve the rows.
-    n = int(262144 * scale) if bf16 else int(131072 * scale)
+
+    from keystone_tpu.ops import pallas_ops as po
+
+    use_pallas = po.pallas_enabled()
+    # 262144 rows ≈ 12 GB peak HBM with fused bf16 features (fits a 16 GB
+    # v5e with headroom). The XLA fallback materializes a full-width f32
+    # pre-activation (~17 GB at that n) and f32 features double the buffer,
+    # so both fall back to half the rows.
+    n = int(262144 * scale) if (bf16 and use_pallas) else int(131072 * scale)
 
     rng = np.random.default_rng(0)
     X_np = rng.normal(size=(n, TIMIT_INPUT_DIMS)).astype(np.float32)
     y_np = rng.integers(0, TIMIT_NUM_CLASSES, size=n)
 
-    from keystone_tpu.ops import pallas_ops as po
     from keystone_tpu.ops.stats import CosineRandomFeatures
     from keystone_tpu.parallel import linalg
 
@@ -72,7 +77,6 @@ def main():
     Wrf = jnp.stack([rf.W for rf in rfs])
     brf = jnp.stack([rf.b for rf in rfs])
 
-    use_pallas = po.pallas_enabled()
     feat_dtype = jnp.bfloat16 if bf16 else jnp.float32
 
     # Flat (n, 16384) feature layout: one fused featurize producing a single

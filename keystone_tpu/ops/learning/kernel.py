@@ -235,7 +235,11 @@ class KernelRidgeRegression(LabelEstimator):
         multi_device = data.mesh is not None and any(
             s > 1 for s in dict(data.mesh.shape).values()
         )
-        sync_blocks = timing_on or multi_device
+        # The per-block EPOCH_x_BLOCK_y log is only meaningful with a sync,
+        # so this module's INFO level also forces one.
+        sync_blocks = (
+            timing_on or multi_device or logger.isEnabledFor(logging.INFO)
+        )
 
         for epoch in range(self.num_epochs):
             order = list(range(num_blocks))

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.ops.util import FunctionNode
 from keystone_tpu.workflow import Estimator, Transformer
 
 
@@ -257,3 +258,15 @@ def sample_dataset(data: Dataset, num_items: int, seed: int = 0) -> Dataset:
         return Dataset.of([items[i] for i in idx])
     idx = jax.random.choice(jax.random.key(seed), data.n, (k,), replace=False)
     return Dataset(jnp.asarray(data.array)[: data.n][idx], n=k)
+
+
+class Sampler(FunctionNode):
+    """Dataset-level row sampler (FunctionNode, operates outside graph
+    tracking like the reference's — reference: nodes/stats/Sampling.scala:27-32)."""
+
+    def __init__(self, size: int, seed: int = 0):
+        self.size = size
+        self.seed = seed
+
+    def apply(self, data: Dataset) -> Dataset:
+        return sample_dataset(data, self.size, self.seed)

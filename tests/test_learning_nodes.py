@@ -234,3 +234,27 @@ class TestLeastSquaresEstimatorSelection:
         dense_cost = est.options[0][0].cost(1e7, 1e5, 2, 1.0, 16, 3.8e-4, 2.9e-1, 1.32)
         sparse_cost = est.options[1][0].cost(1e7, 1e5, 2, 0.001, 16, 3.8e-4, 2.9e-1, 1.32)
         assert sparse_cost < dense_cost
+
+
+class TestSampler:
+    def test_samples_rows_without_replacement(self):
+        import numpy as np
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.stats import Sampler
+
+        X = np.arange(40, dtype=np.float32).reshape(20, 2)
+        out = Sampler(8, seed=1)(Dataset.of(X)).to_numpy()
+        assert out.shape == (8, 2)
+        # Rows come from X, all distinct.
+        rows = {tuple(r) for r in out}
+        assert len(rows) == 8
+        all_rows = {tuple(r) for r in X}
+        assert rows <= all_rows
+
+    def test_caps_at_dataset_size(self):
+        import numpy as np
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.stats import Sampler
+
+        X = np.ones((5, 3), dtype=np.float32)
+        assert Sampler(100)(Dataset.of(X)).to_numpy().shape == (5, 3)

@@ -1,0 +1,133 @@
+"""Operator-level semantics incl. invalid-input failure cases, mirroring the
+reference's OperatorSuite (reference:
+src/test/scala/keystoneml/workflow/OperatorSuite.scala:11-247)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow.operators import (
+    DatasetExpression,
+    DatasetOperator,
+    DatumExpression,
+    DatumOperator,
+    DelegatingOperator,
+    ExpressionOperator,
+    TransformerExpression,
+)
+from keystone_tpu.workflow.pipeline import transformer
+
+
+class TestDatasetOperator:
+    def test_executes_to_memoized_dataset(self):
+        ds = Dataset.of(np.ones((4, 2), dtype=np.float32))
+        expr = DatasetOperator(ds).execute([])
+        assert isinstance(expr, DatasetExpression)
+        assert expr.get() is ds
+
+    def test_rejects_inputs(self):
+        ds = Dataset.of(np.ones((2, 2), dtype=np.float32))
+        with pytest.raises(ValueError):
+            DatasetOperator(ds).execute([DatumExpression(lambda: 1)])
+
+    def test_identity_semantics_for_equality(self):
+        # Two operators over equal-valued but distinct datasets must NOT be
+        # merged by CSE (RDD-reference semantics in the reference).
+        a = DatasetOperator(Dataset.of(np.ones((2, 2), dtype=np.float32)))
+        b = DatasetOperator(Dataset.of(np.ones((2, 2), dtype=np.float32)))
+        assert a != b
+        assert a == a
+
+
+class TestDatumOperator:
+    def test_executes_to_datum(self):
+        expr = DatumOperator(7).execute([])
+        assert isinstance(expr, DatumExpression)
+        assert expr.get() == 7
+
+    def test_rejects_inputs(self):
+        with pytest.raises(ValueError):
+            DatumOperator(7).execute([DatumExpression(lambda: 1)])
+
+
+class TestTransformerOperator:
+    def test_empty_dependencies_raise(self):
+        t = transformer(lambda x: x + 1)
+        with pytest.raises(ValueError):
+            t.execute([])
+
+    def test_single_vs_batch_dispatch(self):
+        t = transformer(lambda x: x * 2)
+        datum_out = t.execute([DatumExpression(lambda: 3)])
+        assert datum_out.get() == 6
+        ds = Dataset.of(np.asarray([[1.0], [2.0]], dtype=np.float32))
+        batch_out = t.execute([DatasetExpression(lambda: ds)])
+        np.testing.assert_allclose(
+            np.asarray(batch_out.get().to_numpy()).ravel(), [2.0, 4.0]
+        )
+
+    def test_mixed_dataset_datum_deps_raise(self):
+        t = transformer(lambda x, y: x)
+        ds = Dataset.of(np.ones((2, 1), dtype=np.float32))
+        with pytest.raises(ValueError):
+            t.execute([DatasetExpression(lambda: ds), DatumExpression(lambda: 1)])
+
+
+class TestDelegatingOperator:
+    def test_applies_fitted_transformer(self):
+        t = transformer(lambda x: x + 10)
+        expr = DelegatingOperator().execute(
+            [TransformerExpression(lambda: t), DatumExpression(lambda: 5)]
+        )
+        assert expr.get() == 15
+
+    def test_empty_deps_raise(self):
+        with pytest.raises(ValueError):
+            DelegatingOperator().execute([])
+
+    def test_first_dep_must_be_transformer(self):
+        with pytest.raises(ValueError):
+            DelegatingOperator().execute(
+                [DatumExpression(lambda: 1), DatumExpression(lambda: 2)]
+            )
+
+    def test_lazy_fit_not_forced_until_get(self):
+        calls = []
+
+        def make_transformer():
+            calls.append(1)
+            return transformer(lambda x: x)
+
+        expr = DelegatingOperator().execute(
+            [TransformerExpression(make_transformer), DatumExpression(lambda: 1)]
+        )
+        assert calls == []  # estimator fit not forced by graph wiring
+        assert expr.get() == 1
+        assert calls == [1]
+
+
+class TestExpressionOperator:
+    def test_returns_constant_expression(self):
+        e = DatumExpression(lambda: 42)
+        out = ExpressionOperator(e).execute([])
+        assert out.get() == 42
+
+    def test_rejects_inputs(self):
+        e = DatumExpression(lambda: 42)
+        with pytest.raises(ValueError):
+            ExpressionOperator(e).execute([e])
+
+
+class TestExpressionMemoization:
+    def test_call_by_name_evaluated_once(self):
+        calls = []
+
+        def compute():
+            calls.append(1)
+            return 9
+
+        e = DatumExpression(compute)
+        assert calls == []
+        assert e.get() == 9
+        assert e.get() == 9
+        assert calls == [1]

@@ -153,3 +153,74 @@ class TestComputeRuns:
         # Greedy may or may not cache depending on measured profile times, but
         # the rule must at least run cleanly and keep the graph executable.
         assert new_graph2.sinks == g.sinks
+
+
+class TestGreedyBudgetSweep:
+    """Exact cache-placement decisions at increasing memory budgets with
+    stubbed profiles (the AutocCacheRuleSuite.scala:74-181 pattern)."""
+
+    def _graph(self):
+        ds = Dataset.of(np.arange(4.0))
+        g = Graph()
+        g, d = g.add_node(DatasetOperator(ds), [])
+        g, a = g.add_node(PlusOne(), [d])
+        g, b = g.add_node(TimesTen(), [a])
+
+        class Heavy5(Transformer):
+            weight = 5
+
+            def apply(self, x):
+                return x
+
+        class Heavy3(Transformer):
+            weight = 3
+
+            def apply(self, x):
+                return x
+
+        g, h = g.add_node(Heavy5(), [b])
+        g, h2 = g.add_node(Heavy3(), [a])
+        g, s1 = g.add_sink(h)
+        g, s2 = g.add_sink(h2)
+        return g, d, a, b
+
+    def _greedy_with_stub_profiles(self, budget):
+        from keystone_tpu.workflow import autocache
+        from keystone_tpu.workflow.autocache import Profile
+
+        g, d, a, b = self._graph()
+        stub = {
+            d: Profile(ns=1.0, mem_bytes=1000),
+            a: Profile(ns=1000.0, mem_bytes=100),
+            b: Profile(ns=10.0, mem_bytes=100),
+        }
+        orig = autocache.profile_nodes
+        autocache.profile_nodes = lambda graph, nodes, spp: {
+            n: stub[n] for n in nodes
+        }
+        try:
+            rule = AutoCacheRule(GreedyCache(max_mem_bytes=budget))
+            cached = rule._greedy(g, {d, a, b}, rule.strategy)
+        finally:
+            autocache.profile_nodes = orig
+        return cached, (d, a, b)
+
+    def test_zero_budget_caches_nothing(self):
+        cached, _ = self._greedy_with_stub_profiles(0)
+        assert cached == set()
+
+    def test_small_budget_picks_single_best(self):
+        # Only one 100-byte node fits; a (ns=1000, 8 weighted runs) dominates.
+        cached, (d, a, b) = self._greedy_with_stub_profiles(150)
+        assert cached == {a}
+
+    def test_medium_budget_adds_second_win(self):
+        # Both 100-byte nodes fit; caching b still saves 4 runs x 10ns.
+        cached, (d, a, b) = self._greedy_with_stub_profiles(250)
+        assert cached == {a, b}
+
+    def test_huge_budget_skips_zero_gain_nodes(self):
+        # d would fit, but once a is cached d only runs once — no gain, so
+        # greedy must not waste budget on it.
+        cached, (d, a, b) = self._greedy_with_stub_profiles(1 << 30)
+        assert cached == {a, b}

@@ -16,6 +16,25 @@ from keystone_tpu.parallel import linalg
 rng = np.random.default_rng(42)
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def force_interpret():
+    """Route pallas dispatch through the interpreter, then restore and drop
+    jit executables compiled against the patched interpreter so later
+    same-shape calls re-lower for the real backend."""
+    import jax
+
+    orig = po._interpret
+    po._interpret = lambda: True
+    try:
+        yield
+    finally:
+        po._interpret = orig
+        jax.clear_caches()
+
+
 class TestGaussianKernelBlock:
     def test_matches_reference_algebra(self):
         X = rng.normal(size=(70, 50)).astype(np.float32)
@@ -138,12 +157,7 @@ class TestFusedBCD:
         np.testing.assert_allclose(np.asarray(W2), np.asarray(W4), atol=1e-4)
 
     def test_fused_with_pallas_interpret(self):
-        # Force the pallas gram path through the interpreter.
-        import keystone_tpu.ops.pallas_ops as po_mod
-
-        orig = po_mod._interpret
-        po_mod._interpret = lambda: True
-        try:
+        with force_interpret():
             n, db, nb, k = 32, 8, 2, 3
             A = rng.normal(size=(nb, n, db)).astype(np.float32)
             B = rng.normal(size=(n, k)).astype(np.float32)
@@ -156,12 +170,6 @@ class TestFusedBCD:
             np.testing.assert_allclose(
                 np.asarray(W_pl), np.asarray(W_ref), atol=1e-3
             )
-        finally:
-            po_mod._interpret = orig
-            # Drop jit executables compiled against the patched interpreter
-            # so later same-shape calls re-lower for the real backend.
-            import jax
-            jax.clear_caches()
 
 
 class TestBf16SolveQuality:
@@ -208,11 +216,7 @@ class TestFusedFlatBCD:
             linalg.bcd_least_squares_fused_flat(F, B, 4, use_pallas=False)
 
     def test_flat_with_pallas_interpret(self):
-        import keystone_tpu.ops.pallas_ops as po_mod
-
-        orig = po_mod._interpret
-        po_mod._interpret = lambda: True
-        try:
+        with force_interpret():
             F = rng.normal(size=(32, 16)).astype(np.float32)
             B = rng.normal(size=(32, 3)).astype(np.float32)
             W_pl = linalg.bcd_least_squares_fused_flat(
@@ -224,9 +228,3 @@ class TestFusedFlatBCD:
             np.testing.assert_allclose(
                 np.asarray(W_pl), np.asarray(W_ref), atol=1e-3
             )
-        finally:
-            po_mod._interpret = orig
-            # Drop jit executables compiled against the patched interpreter
-            # so later same-shape calls re-lower for the real backend.
-            import jax
-            jax.clear_caches()
