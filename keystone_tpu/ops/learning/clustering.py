@@ -92,8 +92,9 @@ class KMeansPlusPlusEstimator(Estimator):
         means = jnp.asarray(X[centers])
         Xd = jnp.asarray(X)
 
-        # -- Lloyd's iterations (device GEMMs), host-checked convergence.
-        @jax.jit
+        # -- Lloyd's iterations: the whole (step + convergence check) loop is
+        # ONE compiled program (lax.while_loop) — no per-iteration host
+        # round trips, unlike the reference's driver-checked loop.
         def lloyd_step(means):
             sq_dist = (
                 0.5 * jnp.sum(Xd * Xd, axis=1, keepdims=True)
@@ -110,16 +111,37 @@ class KMeansPlusPlusEstimator(Estimator):
             new_means = jnp.where((mass > 0)[:, None], new_means, means)
             return new_means, cost
 
-        prev_cost = None
-        for it in range(self.max_iterations):
-            means, cost = lloyd_step(means)
-            cost = float(cost)
-            logger.info("Iteration: %d current cost %f", it, cost)
-            if prev_cost is not None and (prev_cost - cost) < self.stop_tolerance * abs(
-                prev_cost
-            ):
-                break
-            prev_cost = cost
+        @jax.jit
+        def lloyd_loop(means):
+            def cond(carry):
+                it, _, prev_cost, cost = carry
+                not_converged = (prev_cost - cost) >= (
+                    self.stop_tolerance * jnp.abs(prev_cost)
+                )
+                return (it < self.max_iterations) & (
+                    (it < 2) | not_converged
+                )
+
+            def body(carry):
+                it, means, _, cost = carry
+                new_means, new_cost = lloyd_step(means)
+                return it + 1, new_means, cost, new_cost
+
+            inf = jnp.asarray(jnp.inf, dtype=Xd.dtype)
+            it, means_out, _, cost = jax.lax.while_loop(
+                cond, body, (0, means, inf, inf)
+            )
+            return it, means_out, cost
+
+        it, means, cost = lloyd_loop(means)
+        it = int(it)
+        logger.info(
+            "KMeans stopped after %d iterations (max %d, %s), cost %f",
+            it,
+            self.max_iterations,
+            "converged" if it < self.max_iterations else "iteration cap",
+            float(cost),
+        )
         return KMeansModel(means)
 
 
@@ -225,22 +247,23 @@ class GaussianMixtureModelEstimator(Estimator):
             mu = np.array(km.means)
         else:
             mu = X[rng.choice(n, self.k, replace=False)]
-        var = np.tile(X.var(axis=0), (self.k, 1)) + 1e-6
+        base_var = X.var(axis=0) + 1e-6
+        var = np.tile(base_var, (self.k, 1))
         w = np.full(self.k, 1.0 / self.k)
 
         Xd = jnp.asarray(X)
+        x_var = jnp.asarray(base_var)
+        small_threshold = min(self.min_cluster_size, n / (2 * self.k))
 
-        @jax.jit
         def em_step(mu, var, w):
-            muj, varj = jnp.asarray(mu), jnp.asarray(var)
             sq_mahl = (
-                (Xd * Xd) @ (0.5 / varj).T
-                - Xd @ (muj / varj).T
-                + 0.5 * jnp.sum(muj * muj / varj, axis=1)[None, :]
+                (Xd * Xd) @ (0.5 / var).T
+                - Xd @ (mu / var).T
+                + 0.5 * jnp.sum(mu * mu / var, axis=1)[None, :]
             )
             llh = (
                 -0.5 * d * jnp.log(2 * jnp.pi)
-                - 0.5 * jnp.sum(jnp.log(varj), axis=1)[None, :]
+                - 0.5 * jnp.sum(jnp.log(var), axis=1)[None, :]
                 + jnp.log(w)[None, :]
                 - sq_mahl
             )
@@ -254,30 +277,61 @@ class GaussianMixtureModelEstimator(Estimator):
             new_w = nk / n
             return new_mu, new_var, new_w, jnp.mean(log_norm), nk
 
-        prev_ll = -np.inf
-        for it in range(self.max_iterations):
-            mu_j, var_j, w_j, ll, nk = em_step(mu, var, w)
-            mu, var, w = np.array(mu_j), np.array(var_j), np.array(w_j)
-            nk = np.asarray(nk)
-            # Variance floors (GaussianMixtureModelEstimator variance bounds).
-            floor = np.maximum(
-                self.absolute_variance_floor,
-                self.relative_variance_floor * var.mean(axis=0, keepdims=True),
-            )
-            var = np.maximum(var, floor)
-            # Restart clusters that collapsed below the minimum size.
-            small = nk < min(self.min_cluster_size, n / (2 * self.k))
-            if small.any():
-                num_restarts = int(small.sum())
-                idx = rng.choice(n, num_restarts, replace=num_restarts > n)
-                mu[small] = X[idx]
-                var[small] = X.var(axis=0) + 1e-6
-                w[small] = 1.0 / self.k
-                w = w / w.sum()
-            ll = float(ll)
-            if abs(ll - prev_ll) < self.tol * max(abs(prev_ll), 1.0):
-                break
-            prev_ll = ll
+        @jax.jit
+        def em_loop(mu, var, w, key):
+            """Whole EM loop as one program: step + variance floors +
+            collapsed-cluster restarts + convergence, no host round trips."""
+
+            def cond(carry):
+                it, _, _, _, prev_ll, ll, _ = carry
+                not_converged = jnp.abs(ll - prev_ll) >= (
+                    self.tol * jnp.maximum(jnp.abs(prev_ll), 1.0)
+                )
+                return (it < self.max_iterations) & ((it < 2) | not_converged)
+
+            def body(carry):
+                it, mu, var, w, _, ll, key = carry
+                new_mu, new_var, new_w, new_ll, nk = em_step(mu, var, w)
+                # Variance floors (GaussianMixtureModelEstimator bounds).
+                floor = jnp.maximum(
+                    self.absolute_variance_floor,
+                    self.relative_variance_floor
+                    * new_var.mean(axis=0, keepdims=True),
+                )
+                new_var = jnp.maximum(new_var, floor)
+                # Restart clusters that collapsed below the minimum size with
+                # random data points (device RNG replaces the host draws).
+                key, sub = jax.random.split(key)
+                small = nk < small_threshold
+                # Distinct indices (choice without replacement): clusters
+                # restarted in the same iteration must not collapse onto the
+                # same reseed point.
+                idx = jax.random.choice(sub, n, (min(self.k, n),), replace=False)
+                idx = jnp.resize(idx, (self.k,))
+                new_mu = jnp.where(small[:, None], Xd[idx], new_mu)
+                new_var = jnp.where(small[:, None], x_var[None, :], new_var)
+                new_w = jnp.where(small, 1.0 / self.k, new_w)
+                new_w = new_w / jnp.sum(new_w)
+                return it + 1, new_mu, new_var, new_w, ll, new_ll, key
+
+            neg_inf = jnp.asarray(-jnp.inf, dtype=Xd.dtype)
+            init = (0, mu, var, w, neg_inf, neg_inf, key)
+            it, mu, var, w, _, ll, _ = jax.lax.while_loop(cond, body, init)
+            return it, mu, var, w, ll
+
+        key = jax.random.key(int(rng.integers(0, 2**31 - 1)))
+        it, mu_j, var_j, w_j, ll = em_loop(
+            jnp.asarray(mu), jnp.asarray(var), jnp.asarray(w), key
+        )
+        it = int(it)
+        logger.info(
+            "GMM EM stopped after %d iterations (max %d, %s), mean llh %f",
+            it,
+            self.max_iterations,
+            "converged" if it < self.max_iterations else "iteration cap",
+            float(ll),
+        )
+        mu, var, w = np.array(mu_j), np.array(var_j), np.array(w_j)
 
         # Reference layout: (d, k).
         return GaussianMixtureModel(mu.T, var.T, w)

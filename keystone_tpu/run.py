@@ -8,8 +8,33 @@ Pipeline names accept the reference's fully-qualified class names
 
 from __future__ import annotations
 
+import os
 import sys
 from typing import Callable, Dict
+
+
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache for pipeline runs.
+
+    Example pipelines compile dozens of programs (per image scale, per
+    solver block); caching them across runs matters most on backends where
+    compilation is remote/slow. Default dir ~/.cache/keystone_tpu_xla;
+    disable with KEYSTONE_COMPILE_CACHE=0 or point it elsewhere.
+    """
+    setting = os.environ.get("KEYSTONE_COMPILE_CACHE", "")
+    if setting == "0":
+        return
+    cache_dir = setting or os.path.join(
+        os.path.expanduser("~"), ".cache", "keystone_tpu_xla"
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache is an optimization; never block the run on it
 
 
 def _mnist(argv):
@@ -95,6 +120,7 @@ def main(argv=None):
         print(__doc__)
         print("Pipelines:", ", ".join(sorted(PIPELINES)))
         return 0
+    _enable_compile_cache()
     runner = resolve(argv[0])
     runner(argv[1:])
     return 0
