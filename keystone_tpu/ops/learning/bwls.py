@@ -30,8 +30,7 @@ from keystone_tpu.workflow import LabelEstimator
 logger = logging.getLogger("keystone_tpu.bwls")
 
 
-@functools.partial(jax.jit, static_argnames=("lam", "mw"))
-def _class_solve(
+def _class_solve_core(
     A_c,  # (M, b) class rows (zero-padded beyond n_c)
     r_c,  # (M,) class residual column c
     mask,  # (M,) 1 for real class rows, 0 for slice padding
@@ -42,10 +41,11 @@ def _class_solve(
     residual_mean_c,  # scalar
     joint_mean_c,  # (b,)
     model_old_col,  # (b,)
-    lam: float,
-    mw: float,
+    lam,
+    mw,
 ):
     """One per-class column solve (BlockWeightedLeastSquares.scala:241-276)."""
+    n_c = jnp.maximum(n_c, 1.0)  # padded chunk entries have n_c == 0
     class_mean = jnp.sum(A_c, axis=0) / n_c
     centered = (A_c - class_mean) * mask[:, None]
     class_cov = centered.T @ centered / n_c
@@ -66,6 +66,58 @@ def _class_solve(
     lhs = joint_xtx + jnp.eye(b, dtype=A_c.dtype) * lam
     rhs = joint_xtr - model_old_col * lam
     return jnp.linalg.solve(lhs, rhs)
+
+
+@functools.partial(jax.jit, static_argnames=("M", "lam", "mw"))
+def _class_chunk_solve(
+    A,  # (n + M, b) block rows, class-sorted, padded
+    R,  # (n + M, k) residual
+    starts,  # (C,) class row offsets
+    counts,  # (C,) class sizes (0 for chunk padding)
+    cols,  # (C,) class/column indices
+    pop_cov,
+    pop_mean,
+    pop_xtr,  # (b, k)
+    residual_mean,  # (k,)
+    joint_means,  # (k, b)
+    model_old,  # (b, k)
+    M: int,
+    lam: float,
+    mw: float,
+):
+    """A chunk of per-class solves as ONE vmapped program — replaces a
+    dispatch per class (the reference solves classes inside partition tasks;
+    here the class axis is a batch axis on the MXU)."""
+
+    def gather(s, c):
+        A_c = jax.lax.dynamic_slice_in_dim(A, s, M, axis=0)
+        # Slice both axes at once: a row-slice followed by a column pick
+        # would materialize the full (M, k) stripe per class.
+        r_c = jax.lax.dynamic_slice(R, (s, c), (M, 1))[:, 0]
+        return A_c, r_c
+
+    A_cs, r_cs = jax.vmap(gather)(starts, cols)
+    masks = (jnp.arange(M)[None, :] < counts[:, None]).astype(A.dtype)
+    A_cs = A_cs * masks[:, :, None]
+    r_cs = r_cs * masks
+    sol = jax.vmap(
+        _class_solve_core,
+        in_axes=(0, 0, 0, 0, None, None, 0, 0, 0, 0, None, None),
+    )(
+        A_cs,
+        r_cs,
+        masks,
+        counts.astype(A.dtype),
+        pop_cov,
+        pop_mean,
+        pop_xtr[:, cols].T,
+        residual_mean[cols],
+        joint_means[cols],
+        model_old[:, cols].T,
+        lam,
+        mw,
+    )
+    return sol  # (C, b)
 
 
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
@@ -114,7 +166,6 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         num_blocks = len(blocks)
 
         # Pad rows by M so per-class dynamic slices never clamp.
-        pad = np.zeros((M, 1))
         blocks_d = [
             jnp.asarray(np.vstack([b, np.zeros((M, b.shape[1]))])) for b in blocks
         ]
@@ -169,33 +220,42 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 joint_means_j = jnp.asarray(block_stats[bi][2])
 
                 model_old = models[bi]
+                # Solve classes in fixed-size vmapped chunks (one dispatch
+                # per chunk, one executable across chunks; the final chunk is
+                # padded with count-0 entries whose outputs are discarded).
+                chunk = min(32, len(present))
                 new_cols = []
-                for c in present:
-                    s = int(class_starts[c])
-                    n_c = float(class_counts[c])
-                    A_c = jax.lax.dynamic_slice_in_dim(A, s, M, axis=0)
-                    r_c = jax.lax.dynamic_slice_in_dim(R, s, M, axis=0)[:, c]
-                    # Zero rows beyond this class's count inside the slice.
-                    row_mask = (jnp.arange(M) < class_counts[c]).astype(A.dtype)
-                    w_col = _class_solve(
-                        A_c * row_mask[:, None],
-                        r_c * row_mask,
-                        row_mask,
-                        n_c,
+                for lo in range(0, len(present), chunk):
+                    sel = present[lo : lo + chunk]
+                    pad_len = chunk - len(sel)
+                    sel_p = np.concatenate([sel, np.repeat(sel[-1:], pad_len)])
+                    sol = _class_chunk_solve(
+                        A,
+                        R,
+                        jnp.asarray(class_starts[sel_p]),
+                        jnp.asarray(
+                            np.where(
+                                np.arange(chunk) < len(sel),
+                                class_counts[sel_p],
+                                0,
+                            )
+                        ),
+                        jnp.asarray(sel_p),
                         pop_cov,
                         pop_mean,
-                        pop_xtr[:, c],
-                        residual_mean[c],
-                        joint_means_j[c],
-                        model_old[:, c],
-                        float(self.lam),
-                        float(mw),
+                        pop_xtr,
+                        residual_mean,
+                        joint_means_j,
+                        model_old,
+                        M=M,
+                        lam=float(self.lam),
+                        mw=float(mw),
                     )
-                    new_cols.append(w_col)
+                    new_cols.append(sol[: len(sel)])
 
                 delta = jnp.zeros((d_b, k))
                 delta = delta.at[:, jnp.asarray(present)].set(
-                    jnp.stack(new_cols, axis=1)
+                    jnp.concatenate(new_cols, axis=0).T
                 )
                 models[bi] = model_old + delta
                 R = residual_update(A, delta, R)
