@@ -47,6 +47,17 @@ def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float):
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
 
 
+def _column_and_diag_blocks(train_X, train_norms, start, size: int, gamma: float):
+    """K(train, block) and K(block, block) for one column block — the single
+    source of truth for kernel-block generation, shared by the transformer
+    methods and the fused training scan."""
+    Xb = jax.lax.dynamic_slice_in_dim(train_X, start, size, axis=0)
+    nb = jax.lax.dynamic_slice_in_dim(train_norms, start, size, axis=0)
+    K_block = _gaussian_block(train_X, Xb, train_norms, nb, gamma)
+    K_bb = _gaussian_block(Xb, Xb, nb, nb, gamma)
+    return K_block, K_bb
+
+
 class GaussianKernelTransformer:
     """Holds the train rows; produces kernel column blocks on demand."""
 
@@ -58,9 +69,9 @@ class GaussianKernelTransformer:
 
     def column_block(self, start: int, size: int):
         """K(train, train[start:start+size]) — (n_padded, size)."""
-        Xb = jax.lax.dynamic_slice_in_dim(self.train_X, start, size, axis=0)
-        nb = jax.lax.dynamic_slice_in_dim(self._train_norms, start, size, axis=0)
-        return _gaussian_block(self.train_X, Xb, self._train_norms, nb, self.gamma)
+        return _column_and_diag_blocks(
+            self.train_X, self._train_norms, start, size, self.gamma
+        )[0]
 
     def test_block(self, test_X, start: int, size: int):
         """K(test, train[start:start+size])."""
@@ -72,9 +83,9 @@ class GaussianKernelTransformer:
 
     def diag_block(self, start: int, size: int):
         """K(train[start:start+size], train[start:start+size])."""
-        Xb = jax.lax.dynamic_slice_in_dim(self.train_X, start, size, axis=0)
-        nb = jax.lax.dynamic_slice_in_dim(self._train_norms, start, size, axis=0)
-        return _gaussian_block(Xb, Xb, nb, nb, self.gamma)
+        return _column_and_diag_blocks(
+            self.train_X, self._train_norms, start, size, self.gamma
+        )[1]
 
 
 class GaussianKernelGenerator:
@@ -93,6 +104,60 @@ class GaussianKernelGenerator:
 # ---------------------------------------------------------------------------
 
 
+def _krr_block_step_math(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, start, lam):
+    """Shared math of one Gauss-Seidel dual block update (un-jitted body)."""
+    K_block = K_block * valid_row[:, None] * valid_col[None, :]
+    residual = K_block.T @ W
+    K_bb = K_bb * valid_col[:, None] * valid_col[None, :]
+    rhs = y_bb - (residual - K_bb.T @ w_old)
+    b = K_bb.shape[0]
+    lhs = K_bb + jnp.eye(b, dtype=K_bb.dtype) * lam
+    lhs = jnp.where(
+        (valid_col[:, None] * valid_col[None, :]) > 0,
+        lhs,
+        jnp.eye(b, dtype=K_bb.dtype),
+    )
+    w_new = jnp.linalg.solve(lhs, rhs * valid_col[:, None])
+    W_updated = jax.lax.dynamic_update_slice_in_dim(W, w_new, start, axis=0)
+    return w_new, W_updated
+
+
+@functools.partial(
+    jax.jit, static_argnames=("gamma", "lam", "bs", "n_train", "num_blocks")
+)
+def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
+                   n_train: int, num_blocks: int):
+    """The whole KRR training sweep as ONE program: lax.scan over the
+    (epochs × blocks) order, kernel blocks generated in-loop (fused Pallas
+    on TPU) via the shared _column_and_diag_blocks recipe, dual model
+    updated in place. No host round trips — the single-dispatch replacement
+    for the reference's per-block driver loop
+    (KernelRidgeRegression.scala:136-231)."""
+    n_pad, k = Y.shape
+    x_norms = jnp.sum(X * X, axis=1)
+    valid_row = (jnp.arange(n_pad) < n_train).astype(Y.dtype)
+
+    def step(carry, block):
+        W, w_stack = carry
+        start = block * bs
+        K_block, K_bb = _column_and_diag_blocks(X, x_norms, start, bs, gamma)
+        valid_col = ((jnp.arange(bs) + start) < n_train).astype(Y.dtype)
+        y_bb = jax.lax.dynamic_slice_in_dim(Y, start, bs, axis=0)
+        y_bb = y_bb * valid_col[:, None]
+        w_old = jax.lax.dynamic_index_in_dim(w_stack, block, 0, keepdims=False)
+        w_new, W = _krr_block_step_math(
+            K_block, W, K_bb, y_bb, w_old, valid_col, valid_row,
+            start, jnp.asarray(lam, dtype=Y.dtype),
+        )
+        w_stack = jax.lax.dynamic_update_index_in_dim(w_stack, w_new, block, 0)
+        return (W, w_stack), None
+
+    W0 = jnp.zeros((n_pad, k), dtype=Y.dtype)
+    stack0 = jnp.zeros((num_blocks, bs, k), dtype=Y.dtype)
+    (W, w_stack), _ = jax.lax.scan(step, (W0, stack0), order)
+    return W, w_stack
+
+
 @functools.partial(jax.jit, static_argnames=("lam",), donate_argnums=(1,))
 def _krr_block_step(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, start, lam: float):
     """One Gauss-Seidel block update of the dual model; returns (w_new, W').
@@ -102,22 +167,10 @@ def _krr_block_step(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, start, 
     valid_col: (b,) mask for ghost columns in a ragged final block;
     valid_row: (n_pad,) mask for padding rows; start: block row offset.
     """
-    K_block = K_block * valid_row[:, None] * valid_col[None, :]
-    # residual_b = K_Bᵀ W over all training rows (KernelRidgeRegression.scala:161-166)
-    residual = K_block.T @ W
-    K_bb = K_bb * valid_col[:, None] * valid_col[None, :]
-    rhs = y_bb - (residual - K_bb.T @ w_old)
-    b = K_bb.shape[0]
-    lhs = K_bb + jnp.eye(b, dtype=K_bb.dtype) * lam
-    # Ghost columns get identity rows -> their solution stays what rhs gives (0).
-    lhs = jnp.where(
-        (valid_col[:, None] * valid_col[None, :]) > 0,
-        lhs,
-        jnp.eye(b, dtype=K_bb.dtype),
+    return _krr_block_step_math(
+        K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, start,
+        jnp.asarray(lam, dtype=W.dtype),
     )
-    w_new = jnp.linalg.solve(lhs, rhs * valid_col[:, None])
-    W_updated = jax.lax.dynamic_update_slice_in_dim(W, w_new, start, axis=0)
-    return w_new, W_updated
 
 
 class KernelBlockLinearMapper(Transformer):
@@ -216,17 +269,8 @@ class KernelRidgeRegression(LabelEstimator):
         transformer = self.kernel_generator.fit(Dataset(X, n=n_train, mesh=data.mesh))
         k = Y.shape[1]
 
-        valid_row = (jnp.arange(n_pad) < n_train).astype(Y.dtype)
-        W = jnp.zeros((n_pad, k), dtype=Y.dtype)
-        w_locals = [jnp.zeros((bs, k), dtype=Y.dtype) for _ in range(num_blocks)]
-
         rng = np.random.default_rng(self.block_permuter) if self.block_permuter is not None else None
 
-        # Per-phase breakdown, the analog of the reference's kernelGen/
-        # residual/localSolve/modelUpdate ns logs (KernelRidgeRegression.scala:213-221).
-        # The phase barrier costs a host-device sync per block, so only pay
-        # it when the profiling summary will actually be emitted.
-        timer = profiling.PhaseTimer("krr_fit")
         timing_on = profiling.logger.isEnabledFor(logging.INFO)
         # Per-block syncs: needed for timing attribution, and on multi-device
         # meshes (queueing many collective programs asynchronously deadlocks
@@ -240,6 +284,33 @@ class KernelRidgeRegression(LabelEstimator):
         sync_blocks = (
             timing_on or multi_device or logger.isEnabledFor(logging.INFO)
         )
+
+        if not sync_blocks:
+            # Fast path: the whole (epochs × blocks) sweep is one compiled
+            # scan — kernel blocks generated in-loop, zero host round trips.
+            orders = []
+            for _ in range(self.num_epochs):
+                order = list(range(num_blocks))
+                if rng is not None:
+                    rng.shuffle(order)
+                orders.extend(order)
+            _, w_stack = _krr_fit_fused(
+                X, Y, jnp.asarray(np.array(orders, dtype=np.int32)),
+                float(self.kernel_generator.gamma), float(self.lam),
+                bs, int(n_train), num_blocks,
+            )
+            w_locals = [w_stack[i] for i in range(num_blocks)]
+            return KernelBlockLinearMapper(w_locals, bs, transformer, n_train)
+
+        valid_row = (jnp.arange(n_pad) < n_train).astype(Y.dtype)
+        W = jnp.zeros((n_pad, k), dtype=Y.dtype)
+        w_locals = [jnp.zeros((bs, k), dtype=Y.dtype) for _ in range(num_blocks)]
+
+        # Per-phase breakdown, the analog of the reference's kernelGen/
+        # residual/localSolve/modelUpdate ns logs (KernelRidgeRegression.scala:213-221).
+        # The phase barrier costs a host-device sync per block, so only pay
+        # it when the profiling summary will actually be emitted.
+        timer = profiling.PhaseTimer("krr_fit")
 
         for epoch in range(self.num_epochs):
             order = list(range(num_blocks))
