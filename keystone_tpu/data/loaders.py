@@ -272,12 +272,15 @@ def load_imagenet(data_path: str, labels_path: str) -> Dataset:
             if len(parts) >= 2:
                 labels_map[parts[0]] = int(parts[1])
 
+    from keystone_tpu.utils.images import crop_to_multiple
+
     out: List[LabeledImage] = []
     for tar_path in _tar_paths(data_path):
         for name, img in iter_tar_images(tar_path):
             cls = name.split("/")[0]
             if cls in labels_map:
-                out.append(LabeledImage(img, labels_map[cls], name))
+                # Shape-bucket photos so similar sizes share XLA executables.
+                out.append(LabeledImage(crop_to_multiple(img), labels_map[cls], name))
     return Dataset(out)
 
 
@@ -288,6 +291,8 @@ def load_voc(data_path: str, labels_path: str, name_prefix: str = "") -> Dataset
     """VOC2007 tar + CSV multi-labels -> Dataset of MultiLabeledImage
     (reference: VOCLoader.scala:16-53). The CSV has a header; column 4 is the
     quoted filename, column 1 the 1-based class id."""
+    from keystone_tpu.utils.images import crop_to_multiple
+
     labels_map: Dict[str, List[int]] = {}
     with open(labels_path) as f:
         next(f)  # header
@@ -304,8 +309,13 @@ def load_voc(data_path: str, labels_path: str, name_prefix: str = "") -> Dataset
             if name_prefix and not base.startswith(name_prefix):
                 continue
             if base in labels_map:
+                # Shape-bucket photos so similar sizes share XLA executables.
                 out.append(
-                    MultiLabeledImage(img, np.asarray(sorted(labels_map[base])), base)
+                    MultiLabeledImage(
+                        crop_to_multiple(img),
+                        np.asarray(sorted(labels_map[base])),
+                        base,
+                    )
                 )
     return Dataset(out)
 

@@ -47,15 +47,32 @@ def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float):
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
 
 
-def _column_and_diag_blocks(train_X, train_norms, start, size: int, gamma: float):
-    """K(train, block) and K(block, block) for one column block — the single
-    source of truth for kernel-block generation, shared by the transformer
-    methods and the fused training scan."""
+def _slice_block(train_X, train_norms, start, size: int):
     Xb = jax.lax.dynamic_slice_in_dim(train_X, start, size, axis=0)
     nb = jax.lax.dynamic_slice_in_dim(train_norms, start, size, axis=0)
-    K_block = _gaussian_block(train_X, Xb, train_norms, nb, gamma)
-    K_bb = _gaussian_block(Xb, Xb, nb, nb, gamma)
-    return K_block, K_bb
+    return Xb, nb
+
+
+def _column_block(train_X, train_norms, start, size: int, gamma: float):
+    """K(train, train[start:start+size]) — (n_padded, size)."""
+    Xb, nb = _slice_block(train_X, train_norms, start, size)
+    return _gaussian_block(train_X, Xb, train_norms, nb, gamma)
+
+
+def _diag_block(train_X, train_norms, start, size: int, gamma: float):
+    """K(block, block) — (size, size)."""
+    Xb, nb = _slice_block(train_X, train_norms, start, size)
+    return _gaussian_block(Xb, Xb, nb, nb, gamma)
+
+
+def _column_and_diag_blocks(train_X, train_norms, start, size: int, gamma: float):
+    """Both blocks for the fused training scan (inside jit, where the shared
+    slice is CSE'd). Eager callers should use the single-block helpers —
+    these two dispatches would both execute outside a trace."""
+    return (
+        _column_block(train_X, train_norms, start, size, gamma),
+        _diag_block(train_X, train_norms, start, size, gamma),
+    )
 
 
 class GaussianKernelTransformer:
@@ -69,9 +86,9 @@ class GaussianKernelTransformer:
 
     def column_block(self, start: int, size: int):
         """K(train, train[start:start+size]) — (n_padded, size)."""
-        return _column_and_diag_blocks(
+        return _column_block(
             self.train_X, self._train_norms, start, size, self.gamma
-        )[0]
+        )
 
     def test_block(self, test_X, start: int, size: int):
         """K(test, train[start:start+size])."""
@@ -83,9 +100,9 @@ class GaussianKernelTransformer:
 
     def diag_block(self, start: int, size: int):
         """K(train[start:start+size], train[start:start+size])."""
-        return _column_and_diag_blocks(
+        return _diag_block(
             self.train_X, self._train_norms, start, size, self.gamma
-        )[1]
+        )
 
 
 class GaussianKernelGenerator:

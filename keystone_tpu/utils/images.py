@@ -149,3 +149,27 @@ def gaussian_blur(img, sigma: float):
     img = conv2d_valid(padded, k[:, None].astype(jnp.float32))
     padded = jnp.pad(img, ((0, 0), (r, r), (0, 0)), mode="edge")
     return conv2d_valid(padded, k[None, :].astype(jnp.float32))
+
+
+def crop_to_multiple(img, multiple: int = 8):
+    """Center-crop spatial dims down to multiples of ``multiple``.
+
+    Shape-bucketing policy for real-image archives (SURVEY.md §7 hard part
+    (d)): XLA programs are specialized per shape, so arbitrary-size photos
+    would compile one executable each. Cropping at the loader boundary to a
+    coarse grid makes images of similar size share executables while losing
+    at most ``multiple - 1`` border pixels per axis (the extractors' dense
+    grids exclude borders anyway). Images smaller than one multiple are
+    returned unchanged.
+    """
+    img = np.asarray(img)
+    h, w = img.shape[0], img.shape[1]
+    # Bucket each axis independently: a sub-multiple axis stays as-is but
+    # must not exempt the other axis from cropping.
+    nh = (h // multiple) * multiple or h
+    nw = (w // multiple) * multiple or w
+    if nh == h and nw == w:
+        return img
+    top = (h - nh) // 2
+    left = (w - nw) // 2
+    return img[top : top + nh, left : left + nw]

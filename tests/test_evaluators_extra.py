@@ -73,3 +73,22 @@ class TestAugmentedExamplesEvaluator:
                 Dataset.of(np.array([[1.0, 0.0], [1.0, 0.0]])),
                 Dataset.of(np.array([0, 1])),
             )
+
+
+class TestMulticlassSummary:
+    def test_pretty_print_and_micro_macro(self):
+        import numpy as np
+        from keystone_tpu.evaluation.metrics import MulticlassClassifierEvaluator
+        from keystone_tpu.data import Dataset
+
+        preds = Dataset.of(np.asarray([0, 0, 1, 1, 2, 2, 0, 1]))
+        labels = Dataset.of(np.asarray([0, 0, 1, 1, 2, 2, 1, 2]))
+        m = MulticlassClassifierEvaluator(3).evaluate(preds, labels)
+        # Confusion: diag = [2, 2, 2]; off: label1->pred0 (1), label2->pred1 (1)
+        np.testing.assert_array_equal(np.diag(np.asarray(m.confusion)), [2, 2, 2])
+        assert m.total_error == pytest.approx(2 / 8)
+        # Micro-averaged accuracy == 1 - total error for single-label.
+        s = m.summary(class_names=["a", "b", "c"])
+        assert "a" in s and "b" in s and "c" in s
+        # Macro F1 must be between per-class min and max.
+        assert 0.0 < m.macro_f1 <= 1.0

@@ -152,3 +152,54 @@ class TestCsvRobustness:
         if decoded is not None:
             assert decoded.shape[:2] == (1, 2)
             assert decoded.max() > 255  # 16-bit range preserved by PIL
+
+
+class TestShapeBucketing:
+    def test_crop_to_multiple_center(self):
+        from keystone_tpu.utils.images import crop_to_multiple
+
+        img = np.arange(13 * 18 * 3, dtype=np.float32).reshape(13, 18, 3)
+        out = crop_to_multiple(img, 8)
+        assert out.shape == (8, 16, 3)
+        # Center crop: rows [2, 10), cols [1, 17).
+        np.testing.assert_array_equal(out, img[2:10, 1:17])
+
+    def test_exact_multiple_unchanged(self):
+        from keystone_tpu.utils.images import crop_to_multiple
+
+        img = np.zeros((16, 24, 3), dtype=np.float32)
+        assert crop_to_multiple(img, 8) is img
+
+    def test_tiny_image_unchanged(self):
+        from keystone_tpu.utils.images import crop_to_multiple
+
+        img = np.zeros((5, 6, 3), dtype=np.float32)
+        assert crop_to_multiple(img, 8).shape == (5, 6, 3)
+
+    def test_tar_loaders_bucket_shapes(self, tmp_path):
+        import io, tarfile
+        from keystone_tpu.data.loaders import load_imagenet
+
+        def ppm_bytes(h, w):
+            hdr = f"P6\n{w} {h}\n255\n".encode()
+            return hdr + bytes(h * w * 3)
+
+        tar = tmp_path / "n01.tar"
+        with tarfile.open(tar, "w") as tf:
+            for i, (h, w) in enumerate([(13, 18), (40, 40)]):
+                data = ppm_bytes(h, w)
+                info = tarfile.TarInfo(f"n01/img{i}.ppm")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        labels = tmp_path / "labels.txt"
+        labels.write_text("n01 3\n")
+        out = load_imagenet(str(tmp_path), str(labels)).to_list()
+        shapes = sorted(x.image.shape for x in out)
+        # 13x18 -> 8x16; 40x40 stays (exact multiple).
+        assert shapes == [(8, 16, 3), (40, 40, 3)]
+
+    def test_one_axis_below_multiple_still_crops_other(self):
+        from keystone_tpu.utils.images import crop_to_multiple
+
+        img = np.zeros((7, 1999, 3), dtype=np.float32)
+        assert crop_to_multiple(img, 8).shape == (7, 1992, 3)

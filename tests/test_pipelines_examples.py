@@ -146,3 +146,30 @@ class TestCLI:
     def test_unknown_name_raises(self):
         with pytest.raises(SystemExit):
             resolve("NoSuchPipeline")
+
+
+class TestFittedPipelineSerialization:
+    def test_cifar_fitted_pipeline_roundtrip(self, tmp_path):
+        """fit() the full conv featurizer + solver pipeline, save, load in a
+        fresh object, and check prediction parity (the reference's
+        Serializable FittedPipeline contract, FittedPipeline.scala:12-48)."""
+        import numpy as np
+        from keystone_tpu.pipelines.cifar import CifarConfig, run_random_patch_cifar
+        from keystone_tpu.data.loaders import synthetic_cifar
+        from keystone_tpu.workflow import FittedPipeline
+
+        cfg = CifarConfig(
+            synthetic_n=96, num_filters=8, whitener_size=100,
+            block_size=72, pool_stride=9, pool_size=10,
+        )
+        pipeline, _, _ = run_random_patch_cifar(cfg)
+        fitted = pipeline.fit()
+
+        test = synthetic_cifar(32, seed=1)
+        before = np.asarray(fitted.apply(test.data).to_numpy())
+
+        path = str(tmp_path / "cifar.pkl")
+        fitted.save(path)
+        loaded = FittedPipeline.load(path)
+        after = np.asarray(loaded.apply(test.data).to_numpy())
+        np.testing.assert_array_equal(before, after)
