@@ -94,7 +94,10 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     ):
         from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
         from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
-        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+        from keystone_tpu.ops.learning.linear import (
+            LinearMapEstimator,
+            SketchedLeastSquaresEstimator,
+        )
 
         self.lam = lam
         self.num_machines = num_machines
@@ -106,12 +109,17 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
         block = BlockLeastSquaresEstimator(1000, 3, lam=lam)
         exact = LinearMapEstimator(lam)
+        # Beyond the reference's candidate set: randomized sketch-and-solve
+        # with Hessian-sketch refinement (see SketchedLeastSquaresEstimator),
+        # the cheapest option in the tall-and-wide dense regime.
+        sketched = SketchedLeastSquaresEstimator(lam=lam)
 
         self.options: Sequence[Tuple[object, LabelEstimator]] = [
             (dense_lbfgs, dense_lbfgs),
             (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
             (block, TransformerLabelEstimatorChain(Densify(), block)),
             (exact, TransformerLabelEstimatorChain(Densify(), exact)),
+            (sketched, TransformerLabelEstimatorChain(Densify(), sketched)),
         ]
         self._default = dense_lbfgs
 

@@ -106,3 +106,78 @@ class LocalLeastSquaresEstimator(LabelEstimator):
         return LinearMapper(
             x, b_opt=b_mean, feature_scaler=StandardScalerModel(a_mean)
         )
+
+
+class SketchedLeastSquaresEstimator(LabelEstimator):
+    """Randomized (sketch-and-solve) least squares with optional iterative
+    Hessian-sketch refinement.
+
+    Beyond-parity solver motivated by the randomized NLA literature
+    (Drineas et al., "Faster Least Squares Approximation", arXiv:0710.1435;
+    Pilanci & Wainwright iterative Hessian sketch, cf. arXiv:1910.14166):
+    a CountSketch S with m = sketch_factor*d rows compresses (A, B) in ONE
+    bandwidth-bound pass — a segment-sum scatter of sign-flipped rows, O(nd)
+    versus the normal equations' O(nd²) MXU work — then the m×d sketched
+    system solves locally. ``refine_iters`` Hessian-sketch steps close the
+    gap to the exact solution using the sketched Gramian as a preconditioner
+    with exact full-data gradients (each an O(ndk) pass).
+
+    TPU-native: the scatter is ``jax.ops.segment_sum`` over the sharded row
+    axis; signs/buckets are derived from a counter-based PRNG so the sketch
+    is reproducible and never materialized.
+    """
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        sketch_factor: int = 8,
+        refine_iters: int = 2,
+        seed: int = 0,
+    ):
+        self.lam = lam
+        self.sketch_factor = sketch_factor
+        self.refine_iters = refine_iters
+        self.seed = seed
+
+    def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
+        import jax
+
+        feature_scaler = StandardScaler(normalize_std_dev=False).fit(data)
+        label_scaler = StandardScaler(normalize_std_dev=False).fit(labels)
+        A = jnp.asarray(feature_scaler.batch_apply(data).array)
+        B = jnp.asarray(label_scaler.batch_apply(labels).array)
+        n_pad, d = A.shape
+        n = data.n
+        m = min(max(self.sketch_factor * d, d + 1), max(n, d + 1))
+
+        key = jax.random.key(self.seed)
+        kb, ks = jax.random.split(key)
+        buckets = jax.random.randint(kb, (n_pad,), 0, m)
+        signs = jax.random.rademacher(ks, (n_pad,), dtype=A.dtype)
+        # Padding rows are zero, so their scattered contribution is zero.
+        SA = jax.ops.segment_sum(A * signs[:, None], buckets, num_segments=m)
+        SB = jax.ops.segment_sum(B * signs[:, None], buckets, num_segments=m)
+
+        # One factorization serves both the initial sketched solve and the
+        # refinement preconditioner.
+        gram_s = SA.T @ SA + (self.lam + 1e-8) * jnp.eye(d, dtype=A.dtype)
+        chol = jax.scipy.linalg.cholesky(gram_s, lower=True)
+        x = jax.scipy.linalg.cho_solve((chol, True), SA.T @ SB)
+
+        # Iterative Hessian sketch refinement: exact gradient, sketched
+        # Hessian. x ← x − H_s⁻¹ (Aᵀ(Ax − B) + λx)
+        for _ in range(max(self.refine_iters, 0)):
+            grad = A.T @ (A @ x - B) + self.lam * x
+            x = x - jax.scipy.linalg.cho_solve((chol, True), grad)
+
+        return LinearMapper(x, b_opt=label_scaler.mean, feature_scaler=feature_scaler)
+
+    def cost(
+        self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight
+    ) -> float:
+        """Sketch pass O(nd) + local solve O(d³) + refinement passes O(ndk)."""
+        m = self.sketch_factor * d
+        flops = (n * d + m * d * d + self.refine_iters * n * d * k) / num_machines
+        bytes_scanned = (1 + self.refine_iters) * n * d / num_machines
+        network = d * (d + k)
+        return max(cpu_weight * flops, mem_weight * bytes_scanned) + network_weight * network

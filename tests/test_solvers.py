@@ -136,3 +136,60 @@ class TestEndToEndClassification:
         metrics = MulticlassClassifierEvaluator(4).evaluate(preds, test.labels)
         assert metrics.accuracy > 0.9
         assert "Accuracy" in metrics.summary()
+
+
+class TestSketchedLeastSquares:
+    def test_recovers_solution_with_refinement(self):
+        from keystone_tpu.ops.learning.linear import (
+            LinearMapEstimator,
+            SketchedLeastSquaresEstimator,
+        )
+
+        rng = np.random.default_rng(0)
+        n, d, k = 2048, 32, 3
+        X = rng.normal(size=(n, d)).astype(np.float64)
+        W = rng.normal(size=(d, k))
+        Y = X @ W + 0.01 * rng.normal(size=(n, k))
+
+        exact = LinearMapEstimator(lam=1e-3).fit(Dataset.of(X), Dataset.of(Y))
+        sk = SketchedLeastSquaresEstimator(
+            lam=1e-3, sketch_factor=8, refine_iters=3
+        ).fit(Dataset.of(X), Dataset.of(Y))
+
+        pe = np.asarray(exact.batch_apply(Dataset.of(X)).to_numpy())
+        ps = np.asarray(sk.batch_apply(Dataset.of(X)).to_numpy())
+        # Hessian-sketch refinement closes the gap to the exact solve.
+        rel = np.abs(ps - pe).max() / np.abs(pe).max()
+        assert rel < 1e-2, rel
+
+    def test_sketch_only_residual_bound(self):
+        from keystone_tpu.ops.learning.linear import SketchedLeastSquaresEstimator
+
+        rng = np.random.default_rng(1)
+        n, d, k = 4096, 16, 2
+        X = rng.normal(size=(n, d)).astype(np.float64)
+        Y = X @ rng.normal(size=(d, k)) + 0.5 * rng.normal(size=(n, k))
+
+        sk = SketchedLeastSquaresEstimator(
+            lam=0.0, sketch_factor=8, refine_iters=0
+        ).fit(Dataset.of(X), Dataset.of(Y))
+        preds = np.asarray(sk.batch_apply(Dataset.of(X)).to_numpy())
+        res_sk = np.linalg.norm(preds - Y)
+        # Optimal residual from lstsq on centered data.
+        Xc, Yc = X - X.mean(0), Y - Y.mean(0)
+        W_opt, *_ = np.linalg.lstsq(Xc, Yc, rcond=None)
+        res_opt = np.linalg.norm(Xc @ W_opt - Yc)
+        assert res_sk <= 1.5 * res_opt, (res_sk, res_opt)
+
+    def test_sharded_matches_unsharded(self, mesh8):
+        from keystone_tpu.ops.learning.linear import SketchedLeastSquaresEstimator
+
+        rng = np.random.default_rng(2)
+        X = rng.normal(size=(128, 8)).astype(np.float64)
+        Y = rng.normal(size=(128, 2)).astype(np.float64)
+        est = lambda: SketchedLeastSquaresEstimator(lam=1e-2, refine_iters=2)
+        m1 = est().fit(Dataset.of(X), Dataset.of(Y))
+        m2 = est().fit(Dataset.of(X).shard(mesh8), Dataset.of(Y).shard(mesh8))
+        p1 = np.asarray(m1.batch_apply(Dataset.of(X)).to_numpy())
+        p2 = np.asarray(m2.batch_apply(Dataset.of(X).shard(mesh8)).to_numpy())
+        np.testing.assert_allclose(p1, p2, atol=1e-5)
