@@ -80,8 +80,11 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     """Auto-selecting least-squares solver (LeastSquaresEstimator.scala:26-87).
 
     Candidates: DenseLBFGS, Sparsify->SparseLBFGS, Densify->BlockLS(1000, 3),
-    Densify->Exact normal equations. ``optimize`` measures (n, d, k, sparsity,
-    num devices) from the sample and picks the cost-model argmin.
+    Densify->Exact normal equations, and (only when ``allow_approximate``)
+    Densify->SketchedLeastSquares — a randomized solver whose answer is an
+    approximation of the exact ridge solution. ``optimize`` measures
+    (n, d, k, sparsity, num devices) from the sample and picks the
+    cost-model argmin.
     """
 
     def __init__(
@@ -91,6 +94,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         cpu_weight: float = DEFAULT_CPU_WEIGHT,
         mem_weight: float = DEFAULT_MEM_WEIGHT,
         network_weight: float = DEFAULT_NETWORK_WEIGHT,
+        allow_approximate: bool = False,
     ):
         from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
         from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
@@ -109,18 +113,22 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
         block = BlockLeastSquaresEstimator(1000, 3, lam=lam)
         exact = LinearMapEstimator(lam)
-        # Beyond the reference's candidate set: randomized sketch-and-solve
-        # with Hessian-sketch refinement (see SketchedLeastSquaresEstimator),
-        # the cheapest option in the tall-and-wide dense regime.
-        sketched = SketchedLeastSquaresEstimator(lam=lam)
 
         self.options: Sequence[Tuple[object, LabelEstimator]] = [
             (dense_lbfgs, dense_lbfgs),
             (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
             (block, TransformerLabelEstimatorChain(Densify(), block)),
             (exact, TransformerLabelEstimatorChain(Densify(), exact)),
-            (sketched, TransformerLabelEstimatorChain(Densify(), sketched)),
         ]
+        if allow_approximate:
+            # Beyond the reference's candidate set: randomized sketch-and-
+            # solve with Hessian-sketch refinement — cheapest in the tall-
+            # and-wide dense regime, but its answer is approximate, so users
+            # must opt in.
+            sketched = SketchedLeastSquaresEstimator(lam=lam)
+            self.options = list(self.options) + [
+                (sketched, TransformerLabelEstimatorChain(Densify(), sketched)),
+            ]
         self._default = dense_lbfgs
 
     @property

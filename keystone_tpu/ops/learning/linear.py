@@ -123,8 +123,11 @@ class SketchedLeastSquaresEstimator(LabelEstimator):
     with exact full-data gradients (each an O(ndk) pass).
 
     TPU-native: the scatter is ``jax.ops.segment_sum`` over the sharded row
-    axis; signs/buckets are derived from a counter-based PRNG so the sketch
-    is reproducible and never materialized.
+    axis, with per-row signs/buckets drawn once from the JAX PRNG (two
+    n-length vectors — the m×n sketch matrix itself is never formed).
+    Refinement is guarded: iterates whose gradient norm stops shrinking are
+    rejected, so a poor sketch degrades gracefully to the plain
+    sketch-and-solve answer instead of diverging.
     """
 
     def __init__(
@@ -165,9 +168,16 @@ class SketchedLeastSquaresEstimator(LabelEstimator):
         x = jax.scipy.linalg.cho_solve((chol, True), SA.T @ SB)
 
         # Iterative Hessian sketch refinement: exact gradient, sketched
-        # Hessian. x ← x − H_s⁻¹ (Aᵀ(Ax − B) + λx)
+        # Hessian. x ← x − H_s⁻¹ (Aᵀ(Ax − B) + λx). Guarded: a step is only
+        # accepted while the gradient norm shrinks (an undamped fixed point
+        # can diverge when the sketch approximates the Gramian poorly).
+        prev_gnorm = None
         for _ in range(max(self.refine_iters, 0)):
             grad = A.T @ (A @ x - B) + self.lam * x
+            gnorm = float(jnp.linalg.norm(grad))
+            if prev_gnorm is not None and gnorm >= prev_gnorm:
+                break
+            prev_gnorm = gnorm
             x = x - jax.scipy.linalg.cho_solve((chol, True), grad)
 
         return LinearMapper(x, b_opt=label_scaler.mean, feature_scaler=feature_scaler)
@@ -175,9 +185,11 @@ class SketchedLeastSquaresEstimator(LabelEstimator):
     def cost(
         self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight
     ) -> float:
-        """Sketch pass O(nd) + local solve O(d³) + refinement passes O(ndk)."""
-        m = self.sketch_factor * d
+        """Sketch pass O(nd) + local solve O(m d²) + refinement passes O(ndk),
+        with the same m clamp fit() applies and a per-iteration d*k gradient
+        all-reduce in the network term."""
+        m = min(max(self.sketch_factor * d, d + 1), max(n, d + 1))
         flops = (n * d + m * d * d + self.refine_iters * n * d * k) / num_machines
         bytes_scanned = (1 + self.refine_iters) * n * d / num_machines
-        network = d * (d + k)
+        network = d * (d + k) + self.refine_iters * d * k
         return max(cpu_weight * flops, mem_weight * bytes_scanned) + network_weight * network

@@ -193,3 +193,16 @@ class TestSketchedLeastSquares:
         p1 = np.asarray(m1.batch_apply(Dataset.of(X)).to_numpy())
         p2 = np.asarray(m2.batch_apply(Dataset.of(X).shard(mesh8)).to_numpy())
         np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+    def test_approximate_candidate_is_opt_in(self):
+        from keystone_tpu.ops.learning.cost import LeastSquaresEstimator
+        from keystone_tpu.ops.learning.linear import SketchedLeastSquaresEstimator
+
+        def has_sketched(est):
+            return any(
+                isinstance(opt, SketchedLeastSquaresEstimator)
+                for opt, _ in est.options
+            )
+
+        assert not has_sketched(LeastSquaresEstimator(lam=0.1))
+        assert has_sketched(LeastSquaresEstimator(lam=0.1, allow_approximate=True))
