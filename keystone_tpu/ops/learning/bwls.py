@@ -120,6 +120,24 @@ def _class_chunk_solve(
     return sol  # (C, b)
 
 
+@jax.jit
+def _block_pop_stats(A, R, n):
+    pop_mean = jnp.sum(A, axis=0) / n
+    pop_cov = A.T @ A / n - jnp.outer(pop_mean, pop_mean)
+    pop_xtr = A.T @ R / n
+    return pop_mean, pop_cov, pop_xtr
+
+
+@jax.jit
+def _block_xtr(A, R, n):
+    return A.T @ R / n
+
+
+@functools.partial(jax.jit, donate_argnums=(2,))
+def _residual_update(A, delta, R):
+    return R - A @ delta
+
+
 class BlockWeightedLeastSquaresEstimator(LabelEstimator):
     """Weighted BCD least squares with per-class covariance mixing."""
 
@@ -177,27 +195,14 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         residual_mean = jnp.sum(R, axis=0) / n
         block_stats = [None] * num_blocks
 
-        @jax.jit
-        def block_pop_stats(A, R):
-            pop_mean = jnp.sum(A, axis=0) / n
-            pop_cov = A.T @ A / n - jnp.outer(pop_mean, pop_mean)
-            pop_xtr = A.T @ R / n
-            return pop_mean, pop_cov, pop_xtr
-
-        @jax.jit
-        def block_xtr(A, R):
-            return A.T @ R / n
-
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def residual_update(A, delta, R):
-            return R - A @ delta
+        n_t = jnp.asarray(float(n))
 
         for it in range(self.num_iter):
             for bi in range(num_blocks):
                 A = blocks_d[bi]
                 d_b = A.shape[1]
                 if block_stats[bi] is None:
-                    pop_mean, pop_cov, pop_xtr = block_pop_stats(A, R)
+                    pop_mean, pop_cov, pop_xtr = _block_pop_stats(A, R, n_t)
                     # jointMeans per class: classMean·mw + popMean·(1−mw).
                     joint_means = np.zeros((k, d_b))
                     class_means = np.zeros((k, d_b))
@@ -216,7 +221,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                 else:
                     pop_cov, pop_mean, joint_means = block_stats[bi]
                     pop_cov, pop_mean = jnp.asarray(pop_cov), jnp.asarray(pop_mean)
-                    pop_xtr = block_xtr(A, R)
+                    pop_xtr = _block_xtr(A, R, n_t)
                 joint_means_j = jnp.asarray(block_stats[bi][2])
 
                 model_old = models[bi]
@@ -258,7 +263,7 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     jnp.concatenate(new_cols, axis=0).T
                 )
                 models[bi] = model_old + delta
-                R = residual_update(A, delta, R)
+                R = _residual_update(A, delta, R)
                 residual_mean = jnp.sum(R, axis=0) / n
                 residual_mean.block_until_ready()
                 logger.info("BWLS pass %d block %d done", it, bi)

@@ -85,6 +85,41 @@ class LogisticRegressionModel(Transformer):
         return data.map_batch(lambda X: jnp.argmax(X @ self.weights, axis=-1))
 
 
+@jax.jit
+def _logistic_lbfgs(X, onehot, mask, W0, n, lam, num_iters, tol):
+    """Multinomial logistic LBFGS core (module-level jit: one executable per
+    shape, reused across fits)."""
+
+    def loss_fn(W):
+        logits = X @ W
+        # log-sum-exp over classes; padding rows masked out of the sum.
+        lse = jax.nn.logsumexp(logits, axis=1)
+        ll = jnp.sum(logits * onehot, axis=1) - lse * mask
+        nll = -jnp.sum(ll) / n
+        return nll + 0.5 * lam * jnp.sum(W * W)
+
+    solver = optax.lbfgs()
+    value_and_grad = optax.value_and_grad_from_state(loss_fn)
+
+    def step(carry):
+        W, state, _ = carry
+        value, grad = value_and_grad(W, state=state)
+        updates, state = solver.update(
+            grad, state, W, value=value, grad=grad, value_fn=loss_fn
+        )
+        return optax.apply_updates(W, updates), state, grad
+
+    def cond(carry):
+        _, state, grad = carry
+        count = optax.tree_utils.tree_get(state, "count")
+        return (count < num_iters) & (optax.tree_utils.tree_norm(grad) > tol)
+
+    state = solver.init(W0)
+    g0 = jax.grad(loss_fn)(W0)
+    W, _, _ = jax.lax.while_loop(cond, step, (W0, state, g0))
+    return W, loss_fn(W)
+
+
 class LogisticRegressionEstimator(LabelEstimator):
     """Softmax regression by L-BFGS over the full sharded batch — the in-tree
     replacement for MLlib's LogisticRegressionWithLBFGS
@@ -118,43 +153,15 @@ class LogisticRegressionEstimator(LabelEstimator):
         onehot = jax.nn.one_hot(y, self.num_classes, dtype=X.dtype) * mask[:, None]
         lam = self.reg_param
 
-        def loss_fn(W):
-            logits = X @ W
-            # log-sum-exp over classes; padding rows masked out of the sum.
-            lse = jax.nn.logsumexp(logits, axis=1)
-            ll = jnp.sum(logits * onehot, axis=1) - lse * mask
-            nll = -jnp.sum(ll) / n
-            return nll + 0.5 * lam * jnp.sum(W * W)
-
-        solver = optax.lbfgs()
         W0 = jnp.zeros((X.shape[1], self.num_classes), dtype=X.dtype)
-
-        @jax.jit
-        def optimize(W0):
-            value_and_grad = optax.value_and_grad_from_state(loss_fn)
-
-            def step(carry):
-                W, state, _ = carry
-                value, grad = value_and_grad(W, state=state)
-                updates, state = solver.update(
-                    grad, state, W, value=value, grad=grad, value_fn=loss_fn
-                )
-                return optax.apply_updates(W, updates), state, grad
-
-            def cond(carry):
-                _, state, grad = carry
-                count = optax.tree_utils.tree_get(state, "count")
-                return (count < self.num_iters) & (
-                    optax.tree_utils.tree_norm(grad) > self.convergence_tol
-                )
-
-            state = solver.init(W0)
-            g0 = jax.grad(loss_fn)(W0)
-            W, _, _ = jax.lax.while_loop(cond, step, (W0, state, g0))
-            return W
-
-        W = optimize(W0)
-        logger.info("logistic final loss: %s", float(loss_fn(W)))
+        W, final_loss = _logistic_lbfgs(
+            X, onehot, mask, W0,
+            jnp.asarray(float(n), dtype=X.dtype),
+            jnp.asarray(lam, dtype=X.dtype),
+            jnp.asarray(self.num_iters),
+            jnp.asarray(self.convergence_tol, dtype=X.dtype),
+        )
+        logger.info("logistic final loss: %s", float(final_loss))
         return LogisticRegressionModel(W)
 
 

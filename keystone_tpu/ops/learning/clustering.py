@@ -47,6 +47,45 @@ class KMeansModel(Transformer):
         return data.map_batch(self.assignments)
 
 
+@jax.jit
+def _lloyd_loop(Xd, means, stop_tolerance, max_iterations):
+    """Lloyd's iterations: the whole (step + convergence check) loop is ONE
+    compiled program (lax.while_loop) — no per-iteration host round trips,
+    unlike the reference's driver-checked loop. Module-level jit: one
+    executable per shape, reused across fits."""
+    num_means = means.shape[0]
+
+    def lloyd_step(means):
+        sq_dist = (
+            0.5 * jnp.sum(Xd * Xd, axis=1, keepdims=True)
+            - Xd @ means.T
+            + 0.5 * jnp.sum(means * means, axis=1)[None, :]
+        )
+        cost = jnp.mean(jnp.min(sq_dist, axis=1))
+        assign = jax.nn.one_hot(
+            jnp.argmin(sq_dist, axis=1), num_means, dtype=Xd.dtype
+        )
+        mass = jnp.sum(assign, axis=0)
+        new_means = (assign.T @ Xd) / jnp.maximum(mass, 1e-12)[:, None]
+        # Keep empty clusters where they were rather than collapsing to 0.
+        new_means = jnp.where((mass > 0)[:, None], new_means, means)
+        return new_means, cost
+
+    def cond(carry):
+        it, _, prev_cost, cost = carry
+        not_converged = (prev_cost - cost) >= (stop_tolerance * jnp.abs(prev_cost))
+        return (it < max_iterations) & ((it < 2) | not_converged)
+
+    def body(carry):
+        it, means, _, cost = carry
+        new_means, new_cost = lloyd_step(means)
+        return it + 1, new_means, cost, new_cost
+
+    inf = jnp.asarray(jnp.inf, dtype=Xd.dtype)
+    it, means_out, _, cost = jax.lax.while_loop(cond, body, (0, means, inf, inf))
+    return it, means_out, cost
+
+
 class KMeansPlusPlusEstimator(Estimator):
     """k-means++ seeding + Lloyd's iterations with cost-improvement stopping
     (reference: KMeansPlusPlus.scala:83-180)."""
@@ -92,48 +131,11 @@ class KMeansPlusPlusEstimator(Estimator):
         means = jnp.asarray(X[centers])
         Xd = jnp.asarray(X)
 
-        # -- Lloyd's iterations: the whole (step + convergence check) loop is
-        # ONE compiled program (lax.while_loop) — no per-iteration host
-        # round trips, unlike the reference's driver-checked loop.
-        def lloyd_step(means):
-            sq_dist = (
-                0.5 * jnp.sum(Xd * Xd, axis=1, keepdims=True)
-                - Xd @ means.T
-                + 0.5 * jnp.sum(means * means, axis=1)[None, :]
-            )
-            cost = jnp.mean(jnp.min(sq_dist, axis=1))
-            assign = jax.nn.one_hot(
-                jnp.argmin(sq_dist, axis=1), self.num_means, dtype=Xd.dtype
-            )
-            mass = jnp.sum(assign, axis=0)
-            new_means = (assign.T @ Xd) / jnp.maximum(mass, 1e-12)[:, None]
-            # Keep empty clusters where they were rather than collapsing to 0.
-            new_means = jnp.where((mass > 0)[:, None], new_means, means)
-            return new_means, cost
-
-        @jax.jit
-        def lloyd_loop(means):
-            def cond(carry):
-                it, _, prev_cost, cost = carry
-                not_converged = (prev_cost - cost) >= (
-                    self.stop_tolerance * jnp.abs(prev_cost)
-                )
-                return (it < self.max_iterations) & (
-                    (it < 2) | not_converged
-                )
-
-            def body(carry):
-                it, means, _, cost = carry
-                new_means, new_cost = lloyd_step(means)
-                return it + 1, new_means, cost, new_cost
-
-            inf = jnp.asarray(jnp.inf, dtype=Xd.dtype)
-            it, means_out, _, cost = jax.lax.while_loop(
-                cond, body, (0, means, inf, inf)
-            )
-            return it, means_out, cost
-
-        it, means, cost = lloyd_loop(means)
+        it, means, cost = _lloyd_loop(
+            Xd, means,
+            jnp.asarray(self.stop_tolerance, dtype=Xd.dtype),
+            jnp.asarray(self.max_iterations),
+        )
         it = int(it)
         logger.info(
             "KMeans stopped after %d iterations (max %d, %s), cost %f",
@@ -207,6 +209,72 @@ class GaussianMixtureModel(Transformer):
         return GaussianMixtureModel(means, variances, weights)
 
 
+@jax.jit
+def _em_loop(Xd, mu, var, w, key, x_var, small_threshold, tol,
+             max_iterations, abs_var_floor, rel_var_floor):
+    """Whole EM loop as one program: step + variance floors + collapsed-
+    cluster restarts + convergence, no host round trips. Module-level jit:
+    one executable per shape, reused across fits."""
+    n, d = Xd.shape
+    k = mu.shape[0]
+
+    def em_step(mu, var, w):
+        sq_mahl = (
+            (Xd * Xd) @ (0.5 / var).T
+            - Xd @ (mu / var).T
+            + 0.5 * jnp.sum(mu * mu / var, axis=1)[None, :]
+        )
+        llh = (
+            -0.5 * d * jnp.log(2 * jnp.pi)
+            - 0.5 * jnp.sum(jnp.log(var), axis=1)[None, :]
+            + jnp.log(w)[None, :]
+            - sq_mahl
+        )
+        m = jnp.max(llh, axis=1, keepdims=True)
+        log_norm = m + jnp.log(jnp.sum(jnp.exp(llh - m), axis=1, keepdims=True))
+        post = jnp.exp(llh - log_norm)
+        nk = jnp.sum(post, axis=0)
+        new_mu = (post.T @ Xd) / nk[:, None]
+        ex2 = (post.T @ (Xd * Xd)) / nk[:, None]
+        new_var = ex2 - new_mu * new_mu
+        new_w = nk / n
+        return new_mu, new_var, new_w, jnp.mean(log_norm), nk
+
+    def cond(carry):
+        it, _, _, _, prev_ll, ll, _ = carry
+        not_converged = jnp.abs(ll - prev_ll) >= (
+            tol * jnp.maximum(jnp.abs(prev_ll), 1.0)
+        )
+        return (it < max_iterations) & ((it < 2) | not_converged)
+
+    def body(carry):
+        it, mu, var, w, _, ll, key = carry
+        new_mu, new_var, new_w, new_ll, nk = em_step(mu, var, w)
+        # Variance floors (GaussianMixtureModelEstimator variance bounds).
+        floor = jnp.maximum(
+            abs_var_floor, rel_var_floor * new_var.mean(axis=0, keepdims=True)
+        )
+        new_var = jnp.maximum(new_var, floor)
+        # Restart clusters that collapsed below the minimum size with random
+        # data points (device RNG replaces the host draws). Distinct indices
+        # (choice without replacement): clusters restarted in the same
+        # iteration must not collapse onto the same reseed point.
+        key, sub = jax.random.split(key)
+        small = nk < small_threshold
+        idx = jax.random.choice(sub, n, (min(k, n),), replace=False)
+        idx = jnp.resize(idx, (k,))
+        new_mu = jnp.where(small[:, None], Xd[idx], new_mu)
+        new_var = jnp.where(small[:, None], x_var[None, :], new_var)
+        new_w = jnp.where(small, 1.0 / k, new_w)
+        new_w = new_w / jnp.sum(new_w)
+        return it + 1, new_mu, new_var, new_w, ll, new_ll, key
+
+    neg_inf = jnp.asarray(-jnp.inf, dtype=Xd.dtype)
+    init = (0, mu, var, w, neg_inf, neg_inf, key)
+    it, mu, var, w, _, ll, _ = jax.lax.while_loop(cond, body, init)
+    return it, mu, var, w, ll
+
+
 class GaussianMixtureModelEstimator(Estimator):
     """Diagonal-covariance GMM via local EM over the collected sample, k-means++
     (or random) init, variance lower bounds, min-cluster-size restarts
@@ -255,73 +323,14 @@ class GaussianMixtureModelEstimator(Estimator):
         x_var = jnp.asarray(base_var)
         small_threshold = min(self.min_cluster_size, n / (2 * self.k))
 
-        def em_step(mu, var, w):
-            sq_mahl = (
-                (Xd * Xd) @ (0.5 / var).T
-                - Xd @ (mu / var).T
-                + 0.5 * jnp.sum(mu * mu / var, axis=1)[None, :]
-            )
-            llh = (
-                -0.5 * d * jnp.log(2 * jnp.pi)
-                - 0.5 * jnp.sum(jnp.log(var), axis=1)[None, :]
-                + jnp.log(w)[None, :]
-                - sq_mahl
-            )
-            m = jnp.max(llh, axis=1, keepdims=True)
-            log_norm = m + jnp.log(jnp.sum(jnp.exp(llh - m), axis=1, keepdims=True))
-            post = jnp.exp(llh - log_norm)
-            nk = jnp.sum(post, axis=0)
-            new_mu = (post.T @ Xd) / nk[:, None]
-            ex2 = (post.T @ (Xd * Xd)) / nk[:, None]
-            new_var = ex2 - new_mu * new_mu
-            new_w = nk / n
-            return new_mu, new_var, new_w, jnp.mean(log_norm), nk
-
-        @jax.jit
-        def em_loop(mu, var, w, key):
-            """Whole EM loop as one program: step + variance floors +
-            collapsed-cluster restarts + convergence, no host round trips."""
-
-            def cond(carry):
-                it, _, _, _, prev_ll, ll, _ = carry
-                not_converged = jnp.abs(ll - prev_ll) >= (
-                    self.tol * jnp.maximum(jnp.abs(prev_ll), 1.0)
-                )
-                return (it < self.max_iterations) & ((it < 2) | not_converged)
-
-            def body(carry):
-                it, mu, var, w, _, ll, key = carry
-                new_mu, new_var, new_w, new_ll, nk = em_step(mu, var, w)
-                # Variance floors (GaussianMixtureModelEstimator bounds).
-                floor = jnp.maximum(
-                    self.absolute_variance_floor,
-                    self.relative_variance_floor
-                    * new_var.mean(axis=0, keepdims=True),
-                )
-                new_var = jnp.maximum(new_var, floor)
-                # Restart clusters that collapsed below the minimum size with
-                # random data points (device RNG replaces the host draws).
-                key, sub = jax.random.split(key)
-                small = nk < small_threshold
-                # Distinct indices (choice without replacement): clusters
-                # restarted in the same iteration must not collapse onto the
-                # same reseed point.
-                idx = jax.random.choice(sub, n, (min(self.k, n),), replace=False)
-                idx = jnp.resize(idx, (self.k,))
-                new_mu = jnp.where(small[:, None], Xd[idx], new_mu)
-                new_var = jnp.where(small[:, None], x_var[None, :], new_var)
-                new_w = jnp.where(small, 1.0 / self.k, new_w)
-                new_w = new_w / jnp.sum(new_w)
-                return it + 1, new_mu, new_var, new_w, ll, new_ll, key
-
-            neg_inf = jnp.asarray(-jnp.inf, dtype=Xd.dtype)
-            init = (0, mu, var, w, neg_inf, neg_inf, key)
-            it, mu, var, w, _, ll, _ = jax.lax.while_loop(cond, body, init)
-            return it, mu, var, w, ll
-
         key = jax.random.key(int(rng.integers(0, 2**31 - 1)))
-        it, mu_j, var_j, w_j, ll = em_loop(
-            jnp.asarray(mu), jnp.asarray(var), jnp.asarray(w), key
+        it, mu_j, var_j, w_j, ll = _em_loop(
+            Xd, jnp.asarray(mu), jnp.asarray(var), jnp.asarray(w), key, x_var,
+            jnp.asarray(small_threshold, dtype=Xd.dtype),
+            jnp.asarray(self.tol, dtype=Xd.dtype),
+            jnp.asarray(self.max_iterations),
+            jnp.asarray(self.absolute_variance_floor, dtype=Xd.dtype),
+            jnp.asarray(self.relative_variance_floor, dtype=Xd.dtype),
         )
         it = int(it)
         logger.info(

@@ -11,6 +11,7 @@ sparsity comes from the compact host→device transfer and bounded max_nnz.
 
 from __future__ import annotations
 
+import functools
 import heapq
 from collections import Counter
 from dataclasses import dataclass
@@ -71,17 +72,19 @@ def densify_dataset(data: Dataset, num_features: Optional[int] = None) -> Datase
     indices = jnp.asarray(data.data["indices"])
     values = jnp.asarray(data.data["values"])
     d = num_features if num_features is not None else int(indices.max()) + 1
+    return Dataset(_scatter_dense(indices, values, d), n=data.n, mesh=data.mesh)
 
-    @jax.jit
-    def scatter(indices, values):
-        n, width = indices.shape
-        dense = jnp.zeros((n, d), dtype=values.dtype)
-        safe_idx = jnp.where(indices >= 0, indices, 0)
-        mask = (indices >= 0).astype(values.dtype)
-        rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, width))
-        return dense.at[rows, safe_idx].add(values * mask)
 
-    return Dataset(scatter(indices, values), n=data.n, mesh=data.mesh)
+@functools.partial(jax.jit, static_argnames=("d",))
+def _scatter_dense(indices, values, d: int):
+    """Padded-COO -> dense scatter-add (module-level jit: one executable per
+    (shape, d), reused across batches)."""
+    n, width = indices.shape
+    dense = jnp.zeros((n, d), dtype=values.dtype)
+    safe_idx = jnp.where(indices >= 0, indices, 0)
+    mask = (indices >= 0).astype(values.dtype)
+    rows = jnp.broadcast_to(jnp.arange(n)[:, None], (n, width))
+    return dense.at[rows, safe_idx].add(values * mask)
 
 
 @dataclass(frozen=True)
