@@ -14,7 +14,9 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.stats import StandardScaler, StandardScalerModel
@@ -92,6 +94,23 @@ class BlockLinearMapper(Transformer):
             evaluator(Dataset(preds, n=data.n, mesh=data.mesh)._rezero_padding())
 
 
+def _stack_fits_memory(A_blocks) -> bool:
+    """True when a stacked second copy of the blocks fits comfortably in
+    device memory (the fused path's transient peak is ~2x the blocks)."""
+    try:
+        total = sum(
+            int(a.nbytes) if hasattr(a, "nbytes") else int(np.asarray(a).nbytes)
+            for a in A_blocks
+        )
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if not limit:
+            return True  # backends without memory stats (CPU): no constraint
+        return 2 * total < 0.6 * int(limit)
+    except Exception:
+        return True
+
+
 class BlockLeastSquaresEstimator(LabelEstimator):
     """Block coordinate descent ridge regression
     (reference: BlockLinearMapper.scala:199-283).
@@ -134,9 +153,31 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             for block, scaler in zip(blocks, feature_scalers)
         ]
 
-        Ws = linalg.bcd_least_squares(
-            A_blocks, B, lam=self.lam, num_iter=self.num_iter
+        multi_device = any(
+            b.mesh is not None
+            and any(s > 1 for s in dict(b.mesh.shape).values())
+            for b in blocks
         )
+        if (
+            len({a.shape for a in A_blocks}) == 1
+            and not multi_device
+            and _stack_fits_memory(A_blocks)
+        ):
+            # Equal-size blocks on one device (the common case): the whole
+            # (epochs x blocks) sweep is one compiled program. Multi-device
+            # data keeps the stepwise path (per-block programs partition
+            # cleanly and match the unsharded reduction order); so do fits
+            # whose stacked copy would not fit beside the blocks in HBM.
+            stacked = jnp.stack(A_blocks)
+            del A_blocks  # the stack is a full second copy; drop the list
+            W_stack = linalg.bcd_least_squares_fused(
+                stacked, B, lam=self.lam, num_iter=self.num_iter
+            )
+            Ws = [W_stack[i] for i in range(W_stack.shape[0])]
+        else:
+            Ws = linalg.bcd_least_squares(
+                A_blocks, B, lam=self.lam, num_iter=self.num_iter
+            )
         return BlockLinearMapper(
             Ws, self.block_size, b_opt=label_scaler.mean, feature_scalers=feature_scalers
         )

@@ -160,6 +160,14 @@ def _bcd_fused_kernel(A_stack, B, W0, lam: float, num_iter: int,
     return W, R
 
 
+def _residual_dtype(feat_dtype, label_dtype):
+    """Residual/solve dtype: at least f32 (bf16 features still accumulate in
+    f32), promoted to f64 when either operand is double so fused results
+    match the stepwise solver bit for bit."""
+    acc = jnp.promote_types(feat_dtype, jnp.float32)
+    return jnp.promote_types(acc, jnp.promote_types(label_dtype, jnp.float32))
+
+
 def _hi_kwargs(feat_dtype):
     """f32 operands force HIGHEST precision (the TPU default is a single
     lossy bf16 pass); bf16 operands hit the MXU natively."""
@@ -181,6 +189,9 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
     from keystone_tpu.ops import pallas_ops
 
     feat_dtype = Ab.dtype
+    # Accumulate in at least f32; f64 inputs keep f64 (a preferred type of
+    # plain f32 would silently downcast double-precision accumulations).
+    acc_dtype = jnp.promote_types(feat_dtype, jnp.float32)
     hi = _hi_kwargs(feat_dtype)
     if gram is None and use_pallas:
         fn = pallas_ops.gram_corr_sym if sym else pallas_ops.gram_corr
@@ -189,17 +200,17 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
         if gram is None:
             gram = jax.lax.dot_general(
                 Ab, Ab, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32, **hi,
+                preferred_element_type=acc_dtype, **hi,
             )
         corr = jax.lax.dot_general(
             Ab, R.astype(feat_dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32, **hi,
+            preferred_element_type=acc_dtype, **hi,
         )
     rhs = corr + gram @ Wb
     Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
     delta = jax.lax.dot_general(
         Ab, (Wb_new - Wb).astype(feat_dtype), (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32, **hi,
+        preferred_element_type=acc_dtype, **hi,
     )
     return R - delta, Wb_new, gram
 
@@ -249,14 +260,17 @@ def bcd_least_squares_fused_flat(
     from keystone_tpu.ops import pallas_ops
 
     F = jnp.asarray(F)
-    B = jnp.asarray(B, dtype=jnp.float32)
+    B = jnp.asarray(B)
+    B = B.astype(_residual_dtype(F.dtype, B.dtype))
+    if F.dtype != jnp.bfloat16:
+        F = F.astype(B.dtype)
     n, d = F.shape
     if d % block_size != 0:
         raise ValueError(f"feature dim {d} not divisible by block {block_size}")
     nb = d // block_size
     if use_pallas is None:
         use_pallas = pallas_ops.pallas_enabled()
-    W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=jnp.float32)
+    W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=B.dtype)
     W, R = _bcd_fused_flat_kernel(
         F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
         bool(use_pallas), True,
@@ -291,15 +305,21 @@ def bcd_least_squares_fused(
     from keystone_tpu.ops import pallas_ops
 
     A_stack = jnp.asarray(A_stack)
-    B = jnp.asarray(B, dtype=jnp.float32)
+    B = jnp.asarray(B)
+    B = B.astype(_residual_dtype(A_stack.dtype, B.dtype))
+    if A_stack.dtype != jnp.bfloat16:
+        # Unify operand dtypes up front (except the intentional bf16 feature
+        # layout) so the block updates run entirely in the residual dtype —
+        # e.g. f32 features with f64 labels solve in f64.
+        A_stack = A_stack.astype(B.dtype)
     nb, n, db = A_stack.shape
     k = B.shape[1]
     if use_pallas is None:
         use_pallas = pallas_ops.pallas_enabled()
     W0 = (
-        jnp.asarray(W_init, dtype=jnp.float32)
+        jnp.asarray(W_init, dtype=B.dtype)
         if W_init is not None
-        else jnp.zeros((nb, db, k), dtype=jnp.float32)
+        else jnp.zeros((nb, db, k), dtype=B.dtype)
     )
     if W_init is not None:
         B = B - sum(
