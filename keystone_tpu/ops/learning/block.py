@@ -94,19 +94,27 @@ class BlockLinearMapper(Transformer):
             evaluator(Dataset(preds, n=data.n, mesh=data.mesh)._rezero_padding())
 
 
-def _stack_fits_memory(A_blocks) -> bool:
-    """True when a stacked second copy of the blocks fits comfortably in
-    device memory (the fused path's transient peak is ~2x the blocks)."""
+def _stack_fits_memory(A_blocks, num_iter: int) -> bool:
+    """True when the fused path's transient peak fits comfortably in device
+    memory. At stack time up to THREE full-size copies of the feature blocks
+    are live (the unscaled splits, the scaled list, and the stack), plus the
+    multi-epoch Gramian stash (nb * d_b^2)."""
     try:
-        total = sum(
+        sizes = [
             int(a.nbytes) if hasattr(a, "nbytes") else int(np.asarray(a).nbytes)
             for a in A_blocks
-        )
+        ]
+        total = sum(sizes)
+        stash = 0
+        if num_iter > 1 and A_blocks:
+            d_b = int(np.asarray(A_blocks[0]).shape[1])
+            itemsize = getattr(A_blocks[0], "dtype", np.dtype(np.float32)).itemsize
+            stash = len(A_blocks) * d_b * d_b * max(int(itemsize), 4)
         stats = jax.local_devices()[0].memory_stats() or {}
         limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
         if not limit:
             return True  # backends without memory stats (CPU): no constraint
-        return 2 * total < 0.6 * int(limit)
+        return 3 * total + stash < 0.6 * int(limit)
     except Exception:
         return True
 
@@ -153,15 +161,16 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             for block, scaler in zip(blocks, feature_scalers)
         ]
 
-        multi_device = any(
-            b.mesh is not None
-            and any(s > 1 for s in dict(b.mesh.shape).values())
-            for b in blocks
-        )
+        def _is_multi(ds):
+            return ds.mesh is not None and any(
+                s > 1 for s in dict(ds.mesh.shape).values()
+            )
+
+        multi_device = _is_multi(labels) or any(_is_multi(b) for b in blocks)
         if (
             len({a.shape for a in A_blocks}) == 1
             and not multi_device
-            and _stack_fits_memory(A_blocks)
+            and _stack_fits_memory(A_blocks, self.num_iter)
         ):
             # Equal-size blocks on one device (the common case): the whole
             # (epochs x blocks) sweep is one compiled program. Multi-device

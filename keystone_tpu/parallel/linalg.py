@@ -193,7 +193,9 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
     # plain f32 would silently downcast double-precision accumulations).
     acc_dtype = jnp.promote_types(feat_dtype, jnp.float32)
     hi = _hi_kwargs(feat_dtype)
-    if gram is None and use_pallas:
+    if gram is None and use_pallas and acc_dtype == jnp.float32:
+        # The Pallas kernels accumulate in f32; f64 inputs keep the XLA path
+        # so the double-precision promotion below is honored.
         fn = pallas_ops.gram_corr_sym if sym else pallas_ops.gram_corr
         gram, corr = fn(Ab, R)
     else:
@@ -322,9 +324,11 @@ def bcd_least_squares_fused(
         else jnp.zeros((nb, db, k), dtype=B.dtype)
     )
     if W_init is not None:
+        # A_stack is already unified with B's dtype (bf16 features upcast
+        # here so the warm-start residual keeps full precision).
         B = B - sum(
             jnp.dot(
-                A_stack[i].astype(jnp.float32), W0[i],
+                A_stack[i].astype(B.dtype), W0[i],
                 precision=jax.lax.Precision.HIGHEST,
             )
             for i in range(nb)

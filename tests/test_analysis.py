@@ -1,0 +1,89 @@
+"""Graph traversal/query semantics, mirroring the reference's
+AnalysisUtilsSuite (reference:
+src/test/scala/keystoneml/workflow/AnalysisUtilsSuite.scala:39-287)."""
+
+import pytest
+
+from keystone_tpu.workflow import analysis
+from keystone_tpu.workflow.graph import Graph, NodeId, SinkId, SourceId
+from keystone_tpu.workflow.operators import DatumOperator
+
+
+def op(tag):
+    return DatumOperator(tag)
+
+
+@pytest.fixture
+def diamond():
+    """source -> a -> {b, c} -> d -> sink, plus a second sink on b."""
+    g = Graph(sources=frozenset({SourceId(0)}))
+    g, a = g.add_node(op("a"), [SourceId(0)])
+    g, b = g.add_node(op("b"), [a])
+    g, c = g.add_node(op("c"), [a])
+    g, d = g.add_node(op("d"), [b, c])
+    g, s1 = g.add_sink(d)
+    g, s2 = g.add_sink(b)
+    return g, a, b, c, d, s1, s2
+
+
+class TestParentsChildren:
+    def test_children_of_source(self, diamond):
+        g, a, *_ = diamond
+        assert analysis.get_children(g, SourceId(0)) == {a}
+
+    def test_children_include_sinks(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        assert analysis.get_children(g, d) == {s1}
+        assert analysis.get_children(g, b) == {d, s2}
+
+    def test_parents_of_sink(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        assert analysis.get_parents(g, s1) == {d}
+
+    def test_parents_of_join_node(self, diamond):
+        g, a, b, c, d, *_ = diamond
+        assert analysis.get_parents(g, d) == {b, c}
+
+    def test_parents_of_source_empty(self, diamond):
+        assert analysis.get_parents(diamond[0], SourceId(0)) == set()
+
+
+class TestAncestorsDescendants:
+    def test_ancestors_of_sink_cover_whole_chain(self, diamond):
+        g, a, b, c, d, s1, _ = diamond
+        anc = analysis.get_ancestors(g, s1)
+        assert anc == {SourceId(0), a, b, c, d}
+
+    def test_descendants_of_source(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        desc = analysis.get_descendants(g, SourceId(0))
+        assert {a, b, c, d} <= desc
+
+    def test_ancestors_of_mid_node(self, diamond):
+        g, a, b, *_ = diamond
+        assert analysis.get_ancestors(g, b) == {SourceId(0), a}
+
+    def test_diamond_ancestors_visited_once(self, diamond):
+        # a appears via both b and c paths but is reported once (a set).
+        g, a, b, c, d, *_ = diamond
+        anc = analysis.get_ancestors(g, d)
+        assert list(anc).count(a) == 1
+
+
+class TestLinearize:
+    def test_topological_order(self, diamond):
+        g, a, b, c, d, s1, _ = diamond
+        order = analysis.linearize(g, s1)
+        pos = {gid: i for i, gid in enumerate(order)}
+        assert pos[a] < pos[b] and pos[a] < pos[c]
+        assert pos[b] < pos[d] and pos[c] < pos[d]
+        assert pos[d] < pos[s1]
+
+    def test_deterministic(self, diamond):
+        g, *_, s1, _ = diamond
+        assert analysis.linearize(g, s1) == analysis.linearize(g, s1)
+
+    def test_restricted_to_requested_subgraph(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        order = analysis.linearize(g, s2)
+        assert c not in order and d not in order

@@ -228,3 +228,43 @@ class TestFusedFlatBCD:
             np.testing.assert_allclose(
                 np.asarray(W_pl), np.asarray(W_ref), atol=1e-3
             )
+
+
+class TestF64Preservation:
+    def test_fused_f64_warm_start_matches_stepwise(self):
+        """The W_init path must keep f64 precision too (regression: features
+        were downcast to f32 in the warm-start residual)."""
+        n, db, nb, k = 48, 6, 2, 2
+        A = rng.normal(size=(n, nb * db))  # float64
+        B = rng.normal(size=(n, k))
+        blocks = [A[:, i * db : (i + 1) * db] for i in range(nb)]
+        stack = np.stack(blocks)
+        W1 = linalg.bcd_least_squares_fused(
+            stack, B, lam=0.5, num_iter=2, use_pallas=False
+        )
+        W_ref = linalg.bcd_least_squares(
+            blocks, B, lam=0.5, num_iter=4, W_init=None
+        )
+        W2 = linalg.bcd_least_squares_fused(
+            stack, B, lam=0.5, num_iter=2, W_init=W1, use_pallas=False
+        )
+        for i in range(nb):
+            np.testing.assert_allclose(
+                np.asarray(W2[i]), np.asarray(W_ref[i]), rtol=0, atol=1e-12
+            )
+
+    def test_fused_f64_pallas_flag_falls_back_to_xla(self):
+        """f64 inputs must not route through the f32-accumulating pallas
+        kernels even when use_pallas=True."""
+        with force_interpret():
+            A = rng.normal(size=(2, 32, 8))  # float64
+            B = rng.normal(size=(32, 3))
+            W_pl = linalg.bcd_least_squares_fused(
+                A, B, lam=0.2, num_iter=1, use_pallas=True
+            )
+            W_ref = linalg.bcd_least_squares_fused(
+                A, B, lam=0.2, num_iter=1, use_pallas=False
+            )
+            np.testing.assert_allclose(
+                np.asarray(W_pl), np.asarray(W_ref), atol=1e-12
+            )
