@@ -107,7 +107,7 @@ def _stack_fits_memory(A_blocks, num_iter: int) -> bool:
         total = sum(sizes)
         stash = 0
         if num_iter > 1 and A_blocks:
-            d_b = int(np.asarray(A_blocks[0]).shape[1])
+            d_b = int(A_blocks[0].shape[1])
             itemsize = getattr(A_blocks[0], "dtype", np.dtype(np.float32)).itemsize
             stash = len(A_blocks) * d_b * d_b * max(int(itemsize), 4)
         stats = jax.local_devices()[0].memory_stats() or {}

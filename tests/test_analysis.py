@@ -63,11 +63,6 @@ class TestAncestorsDescendants:
         g, a, b, *_ = diamond
         assert analysis.get_ancestors(g, b) == {SourceId(0), a}
 
-    def test_diamond_ancestors_visited_once(self, diamond):
-        # a appears via both b and c paths but is reported once (a set).
-        g, a, b, c, d, *_ = diamond
-        anc = analysis.get_ancestors(g, d)
-        assert list(anc).count(a) == 1
 
 
 class TestLinearize:

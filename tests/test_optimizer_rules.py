@@ -224,3 +224,53 @@ class TestGreedyBudgetSweep:
         # greedy must not waste budget on it.
         cached, (d, a, b) = self._greedy_with_stub_profiles(1 << 30)
         assert cached == {a, b}
+
+
+class TestAutoCachingOptimizerEndToEnd:
+    def test_pipeline_results_unchanged_with_auto_caching(self):
+        """Install the AutoCachingOptimizer globally and run a real pipeline
+        end to end (the AutocCacheRuleSuite end-to-end pattern)."""
+        from keystone_tpu.workflow.optimizer import AutoCachingOptimizer
+        from keystone_tpu.workflow.autocache import AggressiveCache
+        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+        from keystone_tpu.workflow import transformer
+
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 4)).astype(np.float32)
+        Y = rng.normal(size=(32, 2)).astype(np.float32)
+
+        def build():
+            return transformer(lambda x: x * 2.0).and_then(
+                LinearMapEstimator(lam=1e-3), Dataset.of(X), Dataset.of(Y)
+            )
+
+        env = PipelineEnv.get_or_create()
+        env.reset()
+        baseline = np.asarray(build().apply(Dataset.of(X)).get().to_numpy())
+
+        env.reset()
+        env.set_optimizer(AutoCachingOptimizer(AggressiveCache()))
+        try:
+            cached = np.asarray(build().apply(Dataset.of(X)).get().to_numpy())
+        finally:
+            env.reset()
+        np.testing.assert_allclose(cached, baseline, atol=1e-6)
+
+    def test_greedy_strategy_end_to_end(self):
+        from keystone_tpu.workflow.optimizer import AutoCachingOptimizer
+        from keystone_tpu.workflow.autocache import GreedyCache
+        from keystone_tpu.workflow import transformer
+
+        env = PipelineEnv.get_or_create()
+        env.reset()
+        env.set_optimizer(AutoCachingOptimizer(GreedyCache(max_mem_bytes=1 << 20)))
+        try:
+            pipe = transformer(lambda x: x + 1.0).and_then(
+                transformer(lambda x: x * 3.0).to_pipeline()
+            )
+            out = np.asarray(
+                pipe.apply(Dataset.of(np.ones((8, 2), dtype=np.float32))).get().to_numpy()
+            )
+        finally:
+            env.reset()
+        np.testing.assert_allclose(out, np.full((8, 2), 6.0))
