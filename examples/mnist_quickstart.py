@@ -1,0 +1,74 @@
+"""Quickstart: compose, fit, save, and reload a pipeline end to end.
+
+The analog of the reference's examples/ walkthrough (README.md:14-24 runs
+MnistRandomFFT): build the MNIST random-FFT featurizer + block least squares
+classifier against synthetic data, evaluate, then round-trip the fitted
+pipeline through disk.
+
+Run:  python examples/mnist_quickstart.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.loaders import synthetic_mnist
+from keystone_tpu.evaluation.metrics import MulticlassClassifierEvaluator
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.stats import LinearRectifier, PaddedFFT, RandomSignNode
+from keystone_tpu.ops.util import (
+    ClassLabelIndicatorsFromIntLabels,
+    MaxClassifier,
+    VectorCombiner,
+)
+from keystone_tpu.workflow import FittedPipeline, Pipeline
+
+
+def main():
+    num_classes, num_ffts = 10, 3
+    train = synthetic_mnist(n=2048, seed=0)
+    test = synthetic_mnist(n=512, seed=1)
+    labels = ClassLabelIndicatorsFromIntLabels(num_classes)(train.labels)
+
+    # numFFTs random-sign FFT branches, gathered and concatenated —
+    # the MnistRandomFFT composition (reference: MnistRandomFFT.scala:21-70).
+    d = np.asarray(train.data.array).shape[1]
+    branches = [
+        RandomSignNode.create(d, seed=i).and_then(PaddedFFT()).and_then(LinearRectifier())
+        for i in range(num_ffts)
+    ]
+    pipeline = (
+        Pipeline.gather(branches)
+        .and_then(VectorCombiner())
+        .and_then(
+            BlockLeastSquaresEstimator(block_size=512, num_iter=1, lam=1e-3),
+            train.data,
+            labels,
+        )
+        .and_then(MaxClassifier())
+    )
+
+    evaluator = MulticlassClassifierEvaluator(num_classes)
+    test_preds = pipeline.apply(test.data)  # lazy handle, memoized on .get()
+    train_eval = evaluator.evaluate(pipeline.apply(train.data), train.labels)
+    test_eval = evaluator.evaluate(test_preds, test.labels)
+    print(f"train error {100 * train_eval.total_error:.2f}%  "
+          f"test error {100 * test_eval.total_error:.2f}%")
+
+    # Fit -> serializable transformer-only pipeline -> disk round trip.
+    fitted = pipeline.fit()
+    path = os.path.join(tempfile.mkdtemp(), "mnist.pipeline")
+    fitted.save(path)
+    reloaded = FittedPipeline.load(path)
+    preds = reloaded.apply(test.data).to_numpy()
+    agree = (preds == test_preds.get().to_numpy()).mean()
+    print(f"reloaded pipeline agreement: {100 * agree:.1f}%  (saved to {path})")
+
+
+if __name__ == "__main__":
+    main()

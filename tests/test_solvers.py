@@ -206,3 +206,59 @@ class TestSketchedLeastSquares:
 
         assert not has_sketched(LeastSquaresEstimator(lam=0.1))
         assert has_sketched(LeastSquaresEstimator(lam=0.1, allow_approximate=True))
+
+
+class TestNystromKernelRidge:
+    def _problem(self):
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(256, 6)).astype(np.float32)
+        y = (np.sin(X[:, 0]) + 0.5 * X[:, 1] ** 2 > 0.5).astype(np.int64)
+        Y = (2.0 * np.eye(2)[y] - 1.0).astype(np.float32)
+        return X, Y, y
+
+    def test_close_to_exact_krr(self):
+        from keystone_tpu.ops.learning.kernel import (
+            GaussianKernelGenerator,
+            KernelRidgeRegression,
+            NystromKernelRidge,
+        )
+
+        X, Y, y = self._problem()
+        gen = GaussianKernelGenerator(gamma=0.5)
+        exact = KernelRidgeRegression(gen, 1e-3, 64, 4).fit(
+            Dataset.of(X), Dataset.of(Y)
+        )
+        nystrom = NystromKernelRidge(gen, 1e-3, num_landmarks=64).fit(
+            Dataset.of(X), Dataset.of(Y)
+        )
+        pe = np.asarray(exact.batch_apply(Dataset.of(X)).to_numpy()).argmax(1)
+        pn = np.asarray(nystrom.batch_apply(Dataset.of(X)).to_numpy()).argmax(1)
+        # Both should classify the training set nearly identically.
+        assert (pe == y).mean() > 0.95
+        assert (pn == y).mean() > 0.92
+
+    def test_uniform_landmarks(self):
+        from keystone_tpu.ops.learning.kernel import (
+            GaussianKernelGenerator,
+            NystromKernelRidge,
+        )
+
+        X, Y, y = self._problem()
+        m = NystromKernelRidge(
+            GaussianKernelGenerator(0.5), 1e-3, 48, kmeans_landmarks=False
+        ).fit(Dataset.of(X), Dataset.of(Y))
+        pn = np.asarray(m.batch_apply(Dataset.of(X)).to_numpy()).argmax(1)
+        assert (pn == y).mean() > 0.9
+
+    def test_landmarks_capped_at_n(self):
+        from keystone_tpu.ops.learning.kernel import (
+            GaussianKernelGenerator,
+            NystromKernelRidge,
+        )
+
+        X, Y, _ = self._problem()
+        m = NystromKernelRidge(
+            GaussianKernelGenerator(0.5), 1e-3, num_landmarks=10_000,
+            kmeans_landmarks=False,
+        ).fit(Dataset.of(X[:32]), Dataset.of(Y[:32]))
+        assert m.landmarks.shape[0] == 32
