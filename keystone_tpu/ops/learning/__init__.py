@@ -28,9 +28,16 @@ from .kernel import (
     GaussianKernelTransformer,
     KernelBlockLinearMapper,
     KernelRidgeRegression,
+    NystromKernelMapper,
+    NystromKernelRidge,
 )
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, run_lbfgs
-from .linear import LinearMapEstimator, LinearMapper, LocalLeastSquaresEstimator
+from .linear import (
+    LinearMapEstimator,
+    LinearMapper,
+    LocalLeastSquaresEstimator,
+    SketchedLeastSquaresEstimator,
+)
 from .pca import (
     ApproximatePCAEstimator,
     BatchPCATransformer,

@@ -463,6 +463,13 @@ class NystromKernelRidge(LabelEstimator):
 
         X = jnp.asarray(data.array)
         Y = jnp.asarray(labels.array)
+        # Align physical row counts: data and labels may carry different
+        # padding (mesh multiples vs unpadded host arrays).
+        n_pad = max(X.shape[0], Y.shape[0])
+        if X.shape[0] < n_pad:
+            X = jnp.pad(X, ((0, n_pad - X.shape[0]), (0, 0)))
+        if Y.shape[0] < n_pad:
+            Y = jnp.pad(Y, ((0, n_pad - Y.shape[0]), (0, 0)))
         alpha = _nystrom_fit_kernel(
             X, Y, L, float(self.kernel_generator.gamma),
             jnp.asarray(self.lam, dtype=Y.dtype), data.n,

@@ -1,0 +1,96 @@
+"""Dataset substrate unit tests: padding invariants, sharding, host/device
+forms, gather — the RDD-replacement contract every solver relies on
+(SURVEY.md §7 step 2)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset, LabeledData
+from keystone_tpu.parallel import mesh as mesh_lib
+
+
+class TestConstruction:
+    def test_of_array(self):
+        ds = Dataset.of(np.ones((5, 2), dtype=np.float32))
+        assert ds.n == 5 and not ds.is_host
+        assert np.asarray(ds.array).shape == (5, 2)
+
+    def test_of_list_of_arrays_stacks(self):
+        ds = Dataset.of([np.zeros(3), np.ones(3)])
+        assert ds.n == 2
+        np.testing.assert_array_equal(ds.to_numpy(), [[0, 0, 0], [1, 1, 1]])
+
+    def test_of_ragged_items_stays_host(self):
+        ds = Dataset.of(["a", "bb"])
+        assert ds.is_host
+        assert ds.to_list() == ["a", "bb"]
+
+    def test_len(self):
+        assert len(Dataset.of(np.ones((7, 1)))) == 7
+
+
+class TestShardingAndPadding:
+    def test_shard_pads_to_mesh_multiple(self, mesh8=None):
+        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
+        ds = Dataset.of(np.arange(10, dtype=np.float32).reshape(5, 2)).shard(mesh)
+        assert ds.n == 5
+        assert np.asarray(ds.array).shape[0] == 8  # padded to 8 shards
+        # Padding rows are zero (the solver invariant).
+        np.testing.assert_array_equal(np.asarray(ds.array)[5:], 0.0)
+
+    def test_to_numpy_strips_padding(self):
+        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
+        X = np.arange(10, dtype=np.float32).reshape(5, 2)
+        ds = Dataset.of(X).shard(mesh)
+        np.testing.assert_array_equal(ds.to_numpy(), X)
+
+    def test_valid_mask(self):
+        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
+        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh)
+        mask = np.asarray(ds.valid_mask())
+        np.testing.assert_array_equal(mask[:5], True)
+        np.testing.assert_array_equal(mask[5:], False)
+
+    def test_map_batch_rezeroes_padding(self):
+        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
+        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh)
+        out = ds.map_batch(lambda X: X + 7.0)  # padding would become 7
+        arr = np.asarray(out.array)
+        np.testing.assert_array_equal(arr[:5], 8.0)
+        np.testing.assert_array_equal(arr[5:], 0.0)
+
+
+class TestGather:
+    def test_gather_zips_device_branches_as_pytree(self):
+        a = Dataset.of(np.ones((3, 2), dtype=np.float32))
+        b = Dataset.of(np.full((3, 1), 2.0, dtype=np.float32))
+        out = Dataset.gather([a, b])
+        assert out.n == 3
+        # Device branches stay a tuple pytree (VectorCombiner concatenates).
+        assert isinstance(out.data, tuple) and len(out.data) == 2
+        np.testing.assert_array_equal(np.asarray(out.data[1]), 2.0)
+
+    def test_gather_host_branches_zip_items(self):
+        a = Dataset.of(["x", "y"])
+        b = Dataset.of(["1", "2"])
+        out = Dataset.gather([a, b])
+        assert out.to_list() == [("x", "1"), ("y", "2")]
+
+    def test_gather_rejects_mismatched_sizes(self):
+        a = Dataset.of(np.ones((3, 1), dtype=np.float32))
+        b = Dataset.of(np.ones((4, 1), dtype=np.float32))
+        with pytest.raises(ValueError):
+            Dataset.gather([a, b])
+
+
+class TestHostForm:
+    def test_map_on_host_items(self):
+        ds = Dataset.of(["x", "yy", "zzz"])
+        out = ds.map(len)
+        assert out.to_list() == [1, 2, 3]
+
+    def test_labeled_data_wraps(self):
+        ld = LabeledData(np.ones((4, 2)), np.arange(4))
+        assert ld.data.n == 4
+        np.testing.assert_array_equal(ld.labels.to_numpy(), np.arange(4))

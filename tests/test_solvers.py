@@ -262,3 +262,20 @@ class TestNystromKernelRidge:
             kmeans_landmarks=False,
         ).fit(Dataset.of(X[:32]), Dataset.of(Y[:32]))
         assert m.landmarks.shape[0] == 32
+
+    def test_sharded_data_unpadded_labels(self, mesh8):
+        """Nystrom fit aligns differing physical paddings (mesh-padded data
+        vs unpadded labels)."""
+        from keystone_tpu.ops.learning.kernel import (
+            GaussianKernelGenerator,
+            NystromKernelRidge,
+        )
+
+        rng = np.random.default_rng(9)
+        X = rng.normal(size=(30, 4)).astype(np.float32)  # pads to 32 on mesh8
+        Y = rng.normal(size=(30, 2)).astype(np.float32)
+        m = NystromKernelRidge(
+            GaussianKernelGenerator(0.3), 1e-3, 16, kmeans_landmarks=False
+        ).fit(Dataset.of(X).shard(mesh8), Dataset.of(Y))
+        out = m.batch_apply(Dataset.of(X)).to_numpy()
+        assert out.shape == (30, 2) and np.isfinite(out).all()
