@@ -239,7 +239,30 @@ def decode_image_bytes(data: bytes) -> Optional[np.ndarray]:
 
 def iter_tar_images(tar_path: str):
     """Yield (member_name, decoded image) from a tar of image files
-    (reference: ImageLoaderUtils.loadTarFiles)."""
+    (reference: ImageLoaderUtils.loadTarFiles).
+
+    PNM members are batch-decoded through the native thread pool (the
+    data-plane analog of the reference's per-worker JNI decodes); other
+    formats fall back to per-member PIL decode.
+    """
+    CHUNK = 64  # bound peak memory: raws + decode buffers per chunk only
+
+    def flush(names, raws):
+        pnm_idx = [i for i, d in enumerate(raws) if d[:2] in (b"P5", b"P6")]
+        decoded: Dict[int, Optional[np.ndarray]] = {}
+        if pnm_idx:
+            many = native.decode_pnm_many([raws[i] for i in pnm_idx])
+            if many is not None:
+                decoded = dict(zip(pnm_idx, many))
+        for i, (name, data) in enumerate(zip(names, raws)):
+            img = decoded.get(i)
+            if img is None:
+                img = decode_image_bytes(data)
+            if img is not None:
+                yield name, img
+
+    names: List[str] = []
+    raws: List[bytes] = []
     with tarfile.open(tar_path) as tf:
         for member in tf.getmembers():
             if not member.isfile():
@@ -247,9 +270,12 @@ def iter_tar_images(tar_path: str):
             f = tf.extractfile(member)
             if f is None:
                 continue
-            img = decode_image_bytes(f.read())
-            if img is not None:
-                yield member.name, img
+            names.append(member.name)
+            raws.append(f.read())
+            if len(raws) >= CHUNK:
+                yield from flush(names, raws)
+                names, raws = [], []
+    yield from flush(names, raws)
 
 
 def _tar_paths(data_path: str) -> List[str]:

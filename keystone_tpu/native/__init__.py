@@ -79,6 +79,21 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_long),
         ]
+        # Bindings for symbols that may be absent from a stale .so are
+        # guarded so get_lib keeps its degrade-gracefully contract.
+        if hasattr(lib, "ks_decode_pnm_many"):
+            lib.ks_decode_pnm_many.restype = None
+            lib.ks_decode_pnm_many.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.c_long,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+                ctypes.POINTER(ctypes.c_long),
+            ]
         lib.ks_decode_pnm.restype = ctypes.c_int
         lib.ks_decode_pnm.argtypes = [
             ctypes.c_char_p,
@@ -229,3 +244,38 @@ def parse_csv_floats_many(texts) -> Optional[list]:
         (outs_np[i][: counts[i]].copy(), int(ncols[i]), int(nrows[i]))
         for i in range(n)
     ]
+
+
+def decode_pnm_many(datas) -> Optional[list]:
+    """Decode many binary PNM buffers concurrently via the native thread
+    pool. Returns a list of float32 (h, w, c) arrays (None per item that
+    failed to decode), or None when the native library is unavailable."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    if not hasattr(lib, "ks_decode_pnm_many"):
+        return None
+    n = len(datas)
+    if n == 0:
+        return []
+    bufs = (ctypes.c_char_p * n)(*datas)
+    lens = (ctypes.c_long * n)(*[len(d) for d in datas])
+    max_vals_list = [len(d) * 3 for d in datas]
+    outs_np = [np.empty(m, dtype=np.float32) for m in max_vals_list]
+    outs = (ctypes.POINTER(ctypes.c_float) * n)(
+        *[o.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for o in outs_np]
+    )
+    max_vals = (ctypes.c_long * n)(*max_vals_list)
+    xs = (ctypes.c_long * n)()
+    ys = (ctypes.c_long * n)()
+    cs = (ctypes.c_long * n)()
+    rcs = (ctypes.c_long * n)()
+    lib.ks_decode_pnm_many(bufs, lens, n, outs, max_vals, xs, ys, cs, rcs)
+    results = []
+    for i in range(n):
+        if rcs[i] != 0:
+            results.append(None)
+            continue
+        count = xs[i] * ys[i] * cs[i]
+        results.append(outs_np[i][:count].copy().reshape(xs[i], ys[i], cs[i]))
+    return results

@@ -81,3 +81,37 @@ void ks_parse_csv_many(const char** bufs, const long* lens, long n_bufs,
 }
 
 }  // extern "C"
+
+extern "C" {
+
+// From csv_loader.cpp.
+int ks_decode_pnm(const unsigned char* data, long len, float* out,
+                  long max_vals, long* x, long* y, long* c);
+
+// Decode n_bufs PNM buffers concurrently (thread pool over a shared counter).
+// Per-buffer outputs mirror ks_decode_pnm; rcs[i] is the per-buffer return
+// code (0 = ok).
+void ks_decode_pnm_many(const char** bufs, const long* lens, long n_bufs,
+                        float** outs, const long* max_vals, long* xs,
+                        long* ys, long* cs, long* rcs) {
+  long n_threads = (long)std::thread::hardware_concurrency();
+  if (n_threads < 1) n_threads = 1;
+  if (n_threads > n_bufs) n_threads = n_bufs;
+  std::atomic<long> next(0);
+  std::vector<std::thread> workers;
+  workers.reserve(n_threads);
+  for (long t = 0; t < n_threads; ++t) {
+    workers.emplace_back([&]() {
+      for (;;) {
+        const long i = next.fetch_add(1);
+        if (i >= n_bufs) return;
+        rcs[i] = ks_decode_pnm(
+            reinterpret_cast<const unsigned char*>(bufs[i]), lens[i],
+            outs[i], max_vals[i], &xs[i], &ys[i], &cs[i]);
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+}  // extern "C"

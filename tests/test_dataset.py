@@ -31,30 +31,26 @@ class TestConstruction:
 
 
 class TestShardingAndPadding:
-    def test_shard_pads_to_mesh_multiple(self, mesh8=None):
-        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
-        ds = Dataset.of(np.arange(10, dtype=np.float32).reshape(5, 2)).shard(mesh)
+    def test_shard_pads_to_mesh_multiple(self, mesh8):
+        ds = Dataset.of(np.arange(10, dtype=np.float32).reshape(5, 2)).shard(mesh8)
         assert ds.n == 5
         assert np.asarray(ds.array).shape[0] == 8  # padded to 8 shards
         # Padding rows are zero (the solver invariant).
         np.testing.assert_array_equal(np.asarray(ds.array)[5:], 0.0)
 
-    def test_to_numpy_strips_padding(self):
-        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
+    def test_to_numpy_strips_padding(self, mesh8):
         X = np.arange(10, dtype=np.float32).reshape(5, 2)
-        ds = Dataset.of(X).shard(mesh)
+        ds = Dataset.of(X).shard(mesh8)
         np.testing.assert_array_equal(ds.to_numpy(), X)
 
-    def test_valid_mask(self):
-        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
-        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh)
+    def test_valid_mask(self, mesh8):
+        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh8)
         mask = np.asarray(ds.valid_mask())
         np.testing.assert_array_equal(mask[:5], True)
         np.testing.assert_array_equal(mask[5:], False)
 
-    def test_map_batch_rezeroes_padding(self):
-        mesh = mesh_lib.make_mesh((8,), (mesh_lib.DATA_AXIS,))
-        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh)
+    def test_map_batch_rezeroes_padding(self, mesh8):
+        ds = Dataset.of(np.ones((5, 2), dtype=np.float32)).shard(mesh8)
         out = ds.map_batch(lambda X: X + 7.0)  # padding would become 7
         arr = np.asarray(out.array)
         np.testing.assert_array_equal(arr[:5], 8.0)

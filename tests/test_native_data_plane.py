@@ -146,3 +146,57 @@ class TestCsvEdgeCases:
         (d / "_SUCCESS").write_bytes(b"")
         with pytest.raises(ValueError):
             csv_data_loader(str(d))
+
+
+class TestBatchPnmDecode:
+    def _ppm(self, h, w, v):
+        return f"P6\n{w} {h}\n255\n".encode() + bytes([v]) * (h * w * 3)
+
+    def test_many_matches_single(self):
+        datas = [self._ppm(4, 6, 10), self._ppm(8, 3, 200)]
+        many = native.decode_pnm_many(datas)
+        if many is None:
+            pytest.skip("native library unavailable")
+        for d, out in zip(datas, many):
+            single = native.decode_pnm(d)
+            np.testing.assert_array_equal(out, single)
+
+    def test_bad_buffer_yields_none(self):
+        many = native.decode_pnm_many([b"notapnm", self._ppm(2, 2, 5)])
+        if many is None:
+            pytest.skip("native library unavailable")
+        assert many[0] is None and many[1].shape == (2, 2, 3)
+
+    def test_tar_loader_uses_batch_path(self, tmp_path):
+        import io, tarfile
+        from keystone_tpu.data.loaders import iter_tar_images
+
+        tar = tmp_path / "imgs.tar"
+        with tarfile.open(tar, "w") as tf:
+            for i in range(5):
+                data = self._ppm(8, 8, i * 10)
+                info = tarfile.TarInfo(f"img{i}.ppm")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        out = list(iter_tar_images(str(tar)))
+        assert len(out) == 5
+        for i, (name, img) in enumerate(sorted(out)):
+            assert img.shape == (8, 8, 3)
+            np.testing.assert_array_equal(img, i * 10)
+
+    def test_tar_loader_chunking_boundary(self, tmp_path):
+        """More members than one chunk: all still decoded, order preserved."""
+        import io, tarfile
+        from keystone_tpu.data.loaders import iter_tar_images
+
+        tar = tmp_path / "many.tar"
+        n = 70  # > CHUNK=64
+        with tarfile.open(tar, "w") as tf:
+            for i in range(n):
+                data = self._ppm(4, 4, i % 256)
+                info = tarfile.TarInfo(f"img{i:03d}.ppm")
+                info.size = len(data)
+                tf.addfile(info, io.BytesIO(data))
+        out = list(iter_tar_images(str(tar)))
+        assert len(out) == n
+        assert [name for name, _ in out] == [f"img{i:03d}.ppm" for i in range(n)]
