@@ -30,16 +30,19 @@ DEFAULT_CPU_WEIGHT = 3.8e-4
 DEFAULT_MEM_WEIGHT = 2.9e-1
 DEFAULT_NETWORK_WEIGHT = 1.32
 
-# TPU-fitted weights from scripts/fit_cost_weights.py on a single v5e chip
+# TPU-measured weights from scripts/fit_cost_weights.py on a single v5e chip
 # (2026-07; grid up to n=131072, d=2048; median rel err ~0.6 — the measured
-# times at these scales are dominated by host transfer, so treat these as
-# order-of-magnitude rates and refit at your workload's scale before relying
-# on them). Pass to LeastSquaresEstimator(cpu_weight=..., ...) to use; the
-# reference's cluster-fitted defaults above remain active because solver
-# *ranking* (what the selector needs) is insensitive to the common scale.
+# times at these scales are dominated by host transfer, so treat the cpu/mem
+# rates as order-of-magnitude only, and NOTE that the network weight is
+# unidentifiable from a single-chip fit (the value below is the fit's clamp
+# floor, not a measurement — it must be refit on a real multi-chip mesh).
+# The reference's cluster-fitted defaults above remain the active selector
+# weights: the two sets are NOT a common rescaling of each other (their
+# cpu:mem:net ratios differ), so switching would change solver selection and
+# should only be done after a trustworthy refit at the target scale.
 TPU_CPU_WEIGHT = 3.631e-10
 TPU_MEM_WEIGHT = 1.896e-08
-TPU_NETWORK_WEIGHT = 1.000e-09
+TPU_NETWORK_WEIGHT = 1.000e-09  # clamp floor; single-chip fit can't observe it
 
 
 class CostModel:
