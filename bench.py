@@ -7,7 +7,9 @@ TIMIT d=16384 block least squares on a 16-node r3.4xlarge Spark cluster:
 This bench runs the same computation shape on the available TPU (single chip
 under the driver) at a row count that fits in HBM, and compares against the
 baseline wall-clock scaled linearly by row count (the solver's cost is linear
-in n: per-block Gramian + correlation + residual GEMMs).
+in n: per-block Gramian + correlation + residual GEMMs) and by epochs
+(baseline assumed to be 3 BCD sweeps per its own cost-model fit,
+scripts/constantEstimator.R:12 — see the scaling-site comment).
 
 TPU-native path: the whole train step — 4 random-feature blocks fused
 matmul+cos (Pallas, bfloat16 feature layout) + a full Gauss-Seidel BCD epoch
@@ -36,6 +38,8 @@ TIMIT_INPUT_DIMS = 440
 TIMIT_NUM_CLASSES = 147
 BASELINE_N = 2_200_000
 BASELINE_MS = 580_555.0  # scripts/solver-comparisons-final.csv:26 (d=16384, Block)
+# Epochs assumed for the baseline CSV row (see comment at the scaling site).
+BASELINE_ASSUMED_EPOCHS = 3
 NUM_FEATURES = 16384
 BLOCK_SIZE = 4096  # reference TimitPipeline blockSize (TimitPipeline.scala:37-109)
 NUM_EPOCHS = int(os.environ.get("BENCH_EPOCHS", "1"))
@@ -116,9 +120,20 @@ def main():
     run_once()  # timed: featurization + solve (the pipeline's compute body)
     elapsed = time.perf_counter() - t0
 
-    # The baseline CSV row is one full solver run; model its cost as linear
-    # in rows AND epochs so BENCH_EPOCHS compares like against like.
-    baseline_scaled_s = (BASELINE_MS / 1000.0) * (n / BASELINE_N) * NUM_EPOCHS
+    # The baseline CSV row is one full solver run whose epoch count is not
+    # recorded. The reference's own cost-model fit multiplies the Block
+    # solver's FLOPs/mem/network by 3 (scripts/constantEstimator.R:12,20,27)
+    # — in-repo evidence the CSV Block rows ran 3 BCD sweeps — so model the
+    # baseline as 3 epochs and scale per-epoch, linear in rows. This is
+    # conservative only relative to round 1's single-sweep assumption (3x
+    # lower); under the TimitPipeline *default* of numEpochs=5
+    # (TimitPipeline.scala:34) the speedup would read another 3/5 lower —
+    # reported alongside as vs_baseline_if_5_epochs.
+    baseline_scaled_s = (
+        (BASELINE_MS / 1000.0)
+        * (n / BASELINE_N)
+        * (NUM_EPOCHS / BASELINE_ASSUMED_EPOCHS)
+    )
     speedup = baseline_scaled_s / elapsed
 
     print(
@@ -137,8 +152,14 @@ def main():
                     "precision": "bf16" if bf16 else "f32",
                     "pallas": use_pallas,
                     "single_dispatch": True,
-                    "baseline": "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), n-scaled",
+                    "baseline": (
+                        "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), "
+                        "n-scaled, assumed 3 epochs (constantEstimator.R:12)"
+                    ),
                     "baseline_scaled_s": round(baseline_scaled_s, 3),
+                    "baseline_assumed_epochs": BASELINE_ASSUMED_EPOCHS,
+                    "vs_baseline_if_5_epochs": round(speedup * 3.0 / 5.0, 2),
+                    "vs_baseline_if_1_epoch": round(speedup * 3.0, 2),
                     "device": str(jax.devices()[0]),
                 },
             }

@@ -45,7 +45,8 @@ def _class_solve_core(
     mw,
 ):
     """One per-class column solve (BlockWeightedLeastSquares.scala:241-276)."""
-    n_c = jnp.maximum(n_c, 1.0)  # padded chunk entries have n_c == 0
+    is_pad = n_c < 0.5  # padded chunk entries have n_c == 0
+    n_c = jnp.maximum(n_c, 1.0)
     class_mean = jnp.sum(A_c, axis=0) / n_c
     centered = (A_c - class_mean) * mask[:, None]
     class_cov = centered.T @ centered / n_c
@@ -65,6 +66,10 @@ def _class_solve_core(
     b = joint_xtx.shape[0]
     lhs = joint_xtx + jnp.eye(b, dtype=A_c.dtype) * lam
     rhs = joint_xtr - model_old_col * lam
+    # Padded lanes solve the identity system (zero output) instead of a
+    # near-singular one whose NaNs the caller would otherwise discard.
+    lhs = jnp.where(is_pad, jnp.eye(b, dtype=A_c.dtype), lhs)
+    rhs = jnp.where(is_pad, 0.0, rhs)
     return jnp.linalg.solve(lhs, rhs)
 
 
@@ -174,6 +179,8 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         class_counts = np.bincount(class_of_row, minlength=k)
         class_starts = np.concatenate([[0], np.cumsum(class_counts)[:-1]])
         present = np.nonzero(class_counts > 0)[0]
+        if len(present) == 0:
+            raise ValueError("BWLS fit requires at least one labeled row")
         M = int(class_counts.max())  # per-class padded slice size
 
         # jointLabelMean (intercept base): 2mw + 2(1-mw)·n_c/n − 1.

@@ -112,10 +112,12 @@ def bcd_least_squares(
     for _ in range(max(num_iter, 1)):
         for b, Ab in enumerate(A_blocks):
             Ws[b], R = _bcd_block_step(jnp.asarray(Ab), Ws[b], R, float(lam))
-            # Synchronize per block step: queueing many collective programs
-            # asynchronously deadlocks the forced-host multi-device CPU
-            # backend, and each step is one large fused GEMM program anyway.
-            R.block_until_ready()
+            if jax.default_backend() == "cpu":
+                # Synchronize per block step on the CPU test backend only:
+                # queueing many collective programs asynchronously deadlocks
+                # the forced-host multi-device CPU backend. TPU meshes keep
+                # async dispatch so block b+1's GEMMs overlap b's solve.
+                R.block_until_ready()
     return Ws
 
 

@@ -12,10 +12,14 @@ DEFAULT_THRESHOLD = 1e-8
 
 
 def about_eq(a, b, threshold: float = DEFAULT_THRESHOLD) -> bool:
-    """True when every element of ``a`` is within ``threshold`` of ``b``
-    (absolute difference — the reference's Stats.aboutEq semantics)."""
+    """True when every element of ``a`` is strictly within ``threshold`` of
+    ``b`` (absolute difference — the reference's Stats.aboutEq semantics:
+    ``abs(diff) < threshold``, and a shape mismatch is a programming error
+    that *throws*, matching the reference's ``require``; Stats.scala:25-66)."""
     a = np.asarray(a, dtype=np.float64)
     b = np.asarray(b, dtype=np.float64)
     if a.shape != b.shape:
-        return False
-    return bool(np.all(np.abs(a - b) <= threshold))
+        raise ValueError(
+            f"about_eq operands must have the same shape: {a.shape} vs {b.shape}"
+        )
+    return bool(np.all(np.abs(a - b) < threshold))

@@ -112,10 +112,16 @@ class TestAboutEq:
     def test_scalars_and_arrays(self):
         from keystone_tpu.utils.stats import about_eq
 
+        import pytest
+
         assert about_eq(1.0, 1.0 + 1e-9)
         assert not about_eq(1.0, 1.1)
         assert about_eq([1.0, 2.0], [1.0, 2.0 + 1e-9])
-        assert not about_eq([[1.0]], [1.0])  # shape mismatch
+        # Boundary is exclusive (reference Stats.aboutEq uses strict <).
+        assert not about_eq(0.0, 1e-8, threshold=1e-8)
+        # Shape mismatch throws, matching the reference's `require`.
+        with pytest.raises(ValueError):
+            about_eq([[1.0]], [1.0])
 
 
 class TestTransformerGraph:
