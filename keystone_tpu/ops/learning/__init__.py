@@ -1,9 +1,10 @@
 """Solver and model nodes (reference: nodes/learning/)."""
 
 from .block import BlockLeastSquaresEstimator, BlockLinearMapper
-from .bwls import (
-    BlockWeightedLeastSquaresEstimator,
+from .bwls import BlockWeightedLeastSquaresEstimator
+from .rwls import (
     PerClassWeightedLeastSquaresEstimator,
+    ReWeightedLeastSquaresSolver,
 )
 from .classifiers import (
     LinearDiscriminantAnalysis,
@@ -37,6 +38,7 @@ from .linear import (
     LinearMapper,
     LocalLeastSquaresEstimator,
     SketchedLeastSquaresEstimator,
+    SparseLinearMapper,
 )
 from .pca import (
     ApproximatePCAEstimator,

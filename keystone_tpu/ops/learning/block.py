@@ -184,8 +184,12 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             )
             Ws = [W_stack[i] for i in range(W_stack.shape[0])]
         else:
+            mesh = next(
+                (d.mesh for d in [labels, *blocks] if d.mesh is not None), None
+            )
             Ws = linalg.bcd_least_squares(
-                A_blocks, B, lam=self.lam, num_iter=self.num_iter
+                A_blocks, B, lam=self.lam, num_iter=self.num_iter,
+                mesh=mesh if multi_device else None,
             )
         return BlockLinearMapper(
             Ws, self.block_size, b_opt=label_scaler.mean, feature_scalers=feature_scalers

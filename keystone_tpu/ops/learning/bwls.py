@@ -24,7 +24,11 @@ import numpy as np
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.block import BlockLinearMapper
-from keystone_tpu.ops.util import VectorSplitter
+from keystone_tpu.ops.learning.classstats import (
+    column_blocks,
+    mixed_class_means,
+)
+from keystone_tpu.parallel import mesh as mesh_lib
 from keystone_tpu.workflow import LabelEstimator
 
 logger = logging.getLogger("keystone_tpu.bwls")
@@ -165,18 +169,26 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         return 3 * self.num_iter + 1
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
-        X = np.asarray(data.to_numpy(), dtype=np.float64)
-        Y = np.asarray(labels.to_numpy(), dtype=np.float64)
-        n, k = Y.shape
+        n, k = labels.n, labels.array.shape[1]
+        # Stay on device end to end: rows (possibly mesh-sharded) are sorted
+        # by class with a device argsort/gather — replacing the reference's
+        # HashPartitioner(nClasses) reshuffle — and all per-class statistics
+        # are device segment sums. Only the (k,) class counts come to host,
+        # to plan the static chunk shapes. Solve dtype: at least f32 (the
+        # reference solves in f64; CPU tests run x64 so f64 inputs keep f64).
+        dtype = jnp.promote_types(jnp.asarray(data.array).dtype, jnp.float32)
+        X = jnp.asarray(data.array)[:n].astype(dtype)
+        Y = jnp.asarray(labels.array)[:n].astype(dtype)
         mw = self.mixture_weight
 
-        # Group rows by class (argmax of the ±1 indicators) — the analog of
-        # the reference's hash-partitioned reshuffle.
-        class_of_row = Y.argmax(axis=1)
-        order = np.argsort(class_of_row, kind="stable")
-        X, Y = X[order], Y[order]
-        class_of_row = class_of_row[order]
-        class_counts = np.bincount(class_of_row, minlength=k)
+        class_of_row = jnp.argmax(Y, axis=1)
+        order = jnp.argsort(class_of_row, stable=True)
+        X = jnp.take(X, order, axis=0)
+        Y = jnp.take(Y, order, axis=0)
+        class_of_row = jnp.take(class_of_row, order)
+        class_counts = np.asarray(
+            jnp.bincount(class_of_row, length=k), dtype=np.int64
+        )
         class_starts = np.concatenate([[0], np.cumsum(class_counts)[:-1]])
         present = np.nonzero(class_counts > 0)[0]
         if len(present) == 0:
@@ -184,52 +196,38 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
         M = int(class_counts.max())  # per-class padded slice size
 
         # jointLabelMean (intercept base): 2mw + 2(1-mw)·n_c/n − 1.
-        joint_label_mean = 2 * mw + 2 * (1 - mw) * class_counts / n - 1.0
-
-        splitter = VectorSplitter(self.block_size, self.num_features)
-        blocks = [np.asarray(b.array) for b in splitter.apply(Dataset.of(X))]
-        num_blocks = len(blocks)
-
-        # Pad rows by M so per-class dynamic slices never clamp.
-        blocks_d = [
-            jnp.asarray(np.vstack([b, np.zeros((M, b.shape[1]))])) for b in blocks
-        ]
-        R = jnp.asarray(
-            np.vstack([Y - joint_label_mean, np.zeros((M, k))])
+        joint_label_mean = jnp.asarray(
+            2 * mw + 2 * (1 - mw) * class_counts / n - 1.0, dtype=dtype
         )
 
-        models = [jnp.zeros((b.shape[1], k)) for b in blocks]
+        d_eff = self.num_features or X.shape[1]
+        blocks_d = column_blocks(X, self.block_size, d_eff, M)
+        num_blocks = len(blocks_d)
+        R = jnp.pad(Y - joint_label_mean, ((0, M), (0, 0)))
+
+        counts_d = jnp.asarray(class_counts, dtype=dtype)
+        models = [jnp.zeros((b.shape[1], k), dtype=dtype) for b in blocks_d]
         residual_mean = jnp.sum(R, axis=0) / n
         block_stats = [None] * num_blocks
 
-        n_t = jnp.asarray(float(n))
+        n_t = jnp.asarray(float(n), dtype=dtype)
 
         for it in range(self.num_iter):
             for bi in range(num_blocks):
                 A = blocks_d[bi]
-                d_b = A.shape[1]
                 if block_stats[bi] is None:
                     pop_mean, pop_cov, pop_xtr = _block_pop_stats(A, R, n_t)
-                    # jointMeans per class: classMean·mw + popMean·(1−mw).
-                    joint_means = np.zeros((k, d_b))
-                    class_means = np.zeros((k, d_b))
-                    A_np = np.asarray(A)
-                    for c in present:
-                        s, nc = class_starts[c], class_counts[c]
-                        class_means[c] = A_np[s : s + nc].mean(axis=0)
-                    joint_means = class_means * mw + np.asarray(pop_mean)[None, :] * (
-                        1 - mw
+                    # jointMeans per class: classMean·mw + popMean·(1−mw),
+                    # class means as one device segment sum over the block.
+                    joint_means = mixed_class_means(
+                        A[: A.shape[0] - M] if M else A,
+                        class_of_row, counts_d, pop_mean, k, float(mw),
                     )
-                    block_stats[bi] = (
-                        np.asarray(pop_cov),
-                        np.asarray(pop_mean),
-                        jnp.asarray(joint_means),
-                    )
+                    block_stats[bi] = (pop_cov, pop_mean, joint_means)
                 else:
                     pop_cov, pop_mean, joint_means = block_stats[bi]
-                    pop_cov, pop_mean = jnp.asarray(pop_cov), jnp.asarray(pop_mean)
                     pop_xtr = _block_xtr(A, R, n_t)
-                joint_means_j = jnp.asarray(block_stats[bi][2])
+                joint_means_j = block_stats[bi][2]
 
                 model_old = models[bi]
                 # Solve classes in fixed-size vmapped chunks (one dispatch
@@ -265,29 +263,25 @@ class BlockWeightedLeastSquaresEstimator(LabelEstimator):
                     )
                     new_cols.append(sol[: len(sel)])
 
-                delta = jnp.zeros((d_b, k))
+                delta = jnp.zeros((A.shape[1], k), dtype=dtype)
                 delta = delta.at[:, jnp.asarray(present)].set(
                     jnp.concatenate(new_cols, axis=0).T
                 )
                 models[bi] = model_old + delta
                 R = _residual_update(A, delta, R)
                 residual_mean = jnp.sum(R, axis=0) / n
-                residual_mean.block_until_ready()
+                mesh_lib.sync_if_cpu(residual_mean)
                 logger.info("BWLS pass %d block %d done", it, bi)
 
         # Intercept: jointLabelMean − Σ_d jointMeans[c, d]·W[d, c]
         # (BlockWeightedLeastSquares.scala:315-320).
         full_model = jnp.concatenate(models, axis=0)
         joint_means_all = jnp.concatenate(
-            [jnp.asarray(bs[2]) for bs in block_stats], axis=1
+            [stats[2] for stats in block_stats], axis=1
         )  # (k, D)
-        final_b = jnp.asarray(joint_label_mean) - jnp.sum(
+        final_b = joint_label_mean - jnp.sum(
             joint_means_all * full_model.T, axis=1
         )
         return BlockLinearMapper(models, self.block_size, b_opt=final_b)
 
 
-class PerClassWeightedLeastSquaresEstimator(BlockWeightedLeastSquaresEstimator):
-    """Per-class weighted least squares — the mixture solve with class-local
-    statistics dominating (reference: PerClassWeightedLeastSquares.scala:31-223,
-    a variant of the BWLS solve with the same per-class weighting structure)."""

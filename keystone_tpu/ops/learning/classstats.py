@@ -1,0 +1,42 @@
+"""Shared per-class statistics and feature-block slicing for the weighted
+solvers (BWLS and PerClassWeightedLS both mix class and population moments —
+BlockWeightedLeastSquares.scala:120-150, PerClassWeightedLeastSquares.scala:129-167)."""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("k", "mw", "absent_to_pop"))
+def mixed_class_means(
+    X, class_of_row, counts, pop_mean, k: int, mw: float,
+    absent_to_pop: bool = False,
+):
+    """Per-class mixed means ``classMean·mw + popMean·(1−mw)`` as one device
+    segment sum over the rows — replacing the reference's per-partition folds.
+
+    ``absent_to_pop=True`` maps classes with no rows to the population mean
+    outright (a zero classMean scaled by mw would bias the intercept);
+    ``False`` keeps the raw mix (BWLS never reads absent rows).
+    """
+    sums = jax.ops.segment_sum(X, class_of_row, num_segments=k)
+    class_means = sums / jnp.maximum(counts, 1.0)[:, None]
+    mixed = class_means * mw + pop_mean[None, :] * (1.0 - mw)
+    if absent_to_pop:
+        absent = (counts < 0.5).astype(X.dtype)[:, None]
+        mixed = mixed * (1.0 - absent) + pop_mean[None, :] * absent
+    return mixed
+
+
+def column_blocks(X, block_size: int, d_eff: int, pad_rows: int) -> List:
+    """Slice X into feature-column blocks (the VectorSplitter convention:
+    ceil(d/bs) blocks, last one ragged), each zero-padded by ``pad_rows``
+    extra rows so per-class dynamic slices never clamp."""
+    return [
+        jnp.pad(X[:, s : min(s + block_size, d_eff)], ((0, pad_rows), (0, 0)))
+        for s in range(0, d_eff, block_size)
+    ]

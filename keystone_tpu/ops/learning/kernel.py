@@ -33,15 +33,18 @@ logger = logging.getLogger("keystone_tpu.kernel")
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("gamma",))
-def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float):
+@functools.partial(jax.jit, static_argnames=("gamma", "use_pallas"))
+def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float, use_pallas: bool):
     """K[i, j] = exp(-γ ‖X_i − Xb_j‖²) via ‖x‖² + ‖y‖² − 2x·y
     (reference: KernelGenerator.scala:121-205). On TPU the distance+exp
     epilogue is fused into the matmul by the Pallas kernel so the squared-
-    distance intermediate never round-trips HBM."""
+    distance intermediate never round-trips HBM. ``use_pallas`` is resolved
+    by the *eager* caller (pallas_direct_ok) — a bare pallas_call on a
+    mesh-sharded operand would force a gather, so sharded callers pass
+    False here and reach the kernels through shard_map (parallel.ring)."""
     from keystone_tpu.ops import pallas_ops
 
-    if pallas_ops.pallas_enabled():
+    if use_pallas:
         return pallas_ops.gaussian_kernel_block(X, Xb, x_norms, xb_norms, gamma)
     sq = x_norms[:, None] + xb_norms[None, :] - 2.0 * (X @ Xb.T)
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
@@ -53,25 +56,28 @@ def _slice_block(train_X, train_norms, start, size: int):
     return Xb, nb
 
 
-def _column_block(train_X, train_norms, start, size: int, gamma: float):
+def _column_block(train_X, train_norms, start, size: int, gamma: float,
+                  use_pallas: bool):
     """K(train, train[start:start+size]) — (n_padded, size)."""
     Xb, nb = _slice_block(train_X, train_norms, start, size)
-    return _gaussian_block(train_X, Xb, train_norms, nb, gamma)
+    return _gaussian_block(train_X, Xb, train_norms, nb, gamma, use_pallas)
 
 
-def _diag_block(train_X, train_norms, start, size: int, gamma: float):
+def _diag_block(train_X, train_norms, start, size: int, gamma: float,
+                use_pallas: bool):
     """K(block, block) — (size, size)."""
     Xb, nb = _slice_block(train_X, train_norms, start, size)
-    return _gaussian_block(Xb, Xb, nb, nb, gamma)
+    return _gaussian_block(Xb, Xb, nb, nb, gamma, use_pallas)
 
 
-def _column_and_diag_blocks(train_X, train_norms, start, size: int, gamma: float):
+def _column_and_diag_blocks(train_X, train_norms, start, size: int,
+                            gamma: float, use_pallas: bool):
     """Both blocks for the fused training scan (inside jit, where the shared
     slice is CSE'd). Eager callers should use the single-block helpers —
     these two dispatches would both execute outside a trace."""
     return (
-        _column_block(train_X, train_norms, start, size, gamma),
-        _diag_block(train_X, train_norms, start, size, gamma),
+        _column_block(train_X, train_norms, start, size, gamma, use_pallas),
+        _diag_block(train_X, train_norms, start, size, gamma, use_pallas),
     )
 
 
@@ -79,29 +85,39 @@ class GaussianKernelTransformer:
     """Holds the train rows; produces kernel column blocks on demand."""
 
     def __init__(self, gamma: float, train_X, n_train: int):
+        from keystone_tpu.ops import pallas_ops
+
         self.gamma = float(gamma)
         self.train_X = jnp.asarray(train_X)
         self.n_train = n_train
         self._train_norms = jnp.sum(self.train_X * self.train_X, axis=1)
+        # Resolved once per transformer: direct Pallas dispatch is only safe
+        # when the captured train rows are not mesh-sharded.
+        self._use_pallas = pallas_ops.pallas_direct_ok(self.train_X)
 
     def column_block(self, start: int, size: int):
         """K(train, train[start:start+size]) — (n_padded, size)."""
         return _column_block(
-            self.train_X, self._train_norms, start, size, self.gamma
+            self.train_X, self._train_norms, start, size, self.gamma,
+            self._use_pallas,
         )
 
     def test_block(self, test_X, start: int, size: int):
         """K(test, train[start:start+size])."""
+        from keystone_tpu.ops import pallas_ops
+
         test_X = jnp.asarray(test_X)
         t_norms = jnp.sum(test_X * test_X, axis=1)
         Xb = jax.lax.dynamic_slice_in_dim(self.train_X, start, size, axis=0)
         nb = jax.lax.dynamic_slice_in_dim(self._train_norms, start, size, axis=0)
-        return _gaussian_block(test_X, Xb, t_norms, nb, self.gamma)
+        use_pallas = self._use_pallas and pallas_ops.pallas_direct_ok(test_X)
+        return _gaussian_block(test_X, Xb, t_norms, nb, self.gamma, use_pallas)
 
     def diag_block(self, start: int, size: int):
         """K(train[start:start+size], train[start:start+size])."""
         return _diag_block(
-            self.train_X, self._train_norms, start, size, self.gamma
+            self.train_X, self._train_norms, start, size, self.gamma,
+            self._use_pallas,
         )
 
 
@@ -140,10 +156,11 @@ def _krr_block_step_math(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, st
 
 
 @functools.partial(
-    jax.jit, static_argnames=("gamma", "lam", "bs", "n_train", "num_blocks")
+    jax.jit,
+    static_argnames=("gamma", "lam", "bs", "n_train", "num_blocks", "use_pallas"),
 )
 def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
-                   n_train: int, num_blocks: int):
+                   n_train: int, num_blocks: int, use_pallas: bool):
     """The whole KRR training sweep as ONE program: lax.scan over the
     (epochs × blocks) order, kernel blocks generated in-loop (fused Pallas
     on TPU) via the shared _column_and_diag_blocks recipe, dual model
@@ -157,7 +174,9 @@ def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
     def step(carry, block):
         W, w_stack = carry
         start = block * bs
-        K_block, K_bb = _column_and_diag_blocks(X, x_norms, start, bs, gamma)
+        K_block, K_bb = _column_and_diag_blocks(
+            X, x_norms, start, bs, gamma, use_pallas
+        )
         valid_col = ((jnp.arange(bs) + start) < n_train).astype(Y.dtype)
         y_bb = jax.lax.dynamic_slice_in_dim(Y, start, bs, axis=0)
         y_bb = y_bb * valid_col[:, None]
@@ -316,10 +335,13 @@ class KernelRidgeRegression(LabelEstimator):
                 if rng is not None:
                     rng.shuffle(order)
                 orders.extend(order)
+            from keystone_tpu.ops import pallas_ops
+
             _, w_stack = _krr_fit_fused(
                 X, Y, jnp.asarray(np.array(orders, dtype=np.int32)),
                 float(self.kernel_generator.gamma), float(self.lam),
                 bs, int(n_train), num_blocks,
+                pallas_ops.pallas_direct_ok(X),
             )
             w_locals = [w_stack[i] for i in range(num_blocks)]
             return KernelBlockLinearMapper(w_locals, bs, transformer, n_train)
@@ -399,15 +421,21 @@ class NystromKernelMapper(Transformer):
         return self.batch_apply(Dataset.of(np.asarray(x)[None])).to_numpy()[0]
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        from keystone_tpu.ops import pallas_ops
+
         X = jnp.asarray(data.array)
         x_norms = jnp.sum(X * X, axis=1)
-        K = _gaussian_block(X, self.landmarks, x_norms, self._lm_norms, self.gamma)
+        K = _gaussian_block(
+            X, self.landmarks, x_norms, self._lm_norms, self.gamma,
+            pallas_ops.pallas_direct_ok(X, self.landmarks),
+        )
         out = K @ self.alpha
         return Dataset(out, n=data.n, mesh=data.mesh)._rezero_padding()
 
 
-@functools.partial(jax.jit, static_argnames=("gamma",))
-def _nystrom_fit_kernel(X, Y, L, gamma: float, lam, n_valid):
+@functools.partial(jax.jit, static_argnames=("gamma", "use_pallas"))
+def _nystrom_fit_kernel(X, Y, L, gamma: float, lam, n_valid,
+                        use_pallas: bool = False):
     """Nyström KRR normal equations: (K_nmᵀ K_nm + λ K_mm) α = K_nmᵀ Y.
 
     One compiled program: landmark kernel blocks via the fused gaussian
@@ -418,8 +446,8 @@ def _nystrom_fit_kernel(X, Y, L, gamma: float, lam, n_valid):
     x_norms = jnp.sum(X * X, axis=1)
     l_norms = jnp.sum(L * L, axis=1)
     mask = (jnp.arange(X.shape[0]) < n_valid).astype(Y.dtype)
-    K_nm = _gaussian_block(X, L, x_norms, l_norms, gamma) * mask[:, None]
-    K_mm = _gaussian_block(L, L, l_norms, l_norms, gamma)
+    K_nm = _gaussian_block(X, L, x_norms, l_norms, gamma, use_pallas) * mask[:, None]
+    K_mm = _gaussian_block(L, L, l_norms, l_norms, gamma, use_pallas)
     m = L.shape[0]
     lhs = K_nm.T @ K_nm + lam * K_mm
     # Scale-relative jitter: duplicate landmarks make lhs exactly singular,
@@ -478,9 +506,12 @@ class NystromKernelRidge(LabelEstimator):
             X = jnp.pad(X, ((0, n_pad - X.shape[0]), (0, 0)))
         if Y.shape[0] < n_pad:
             Y = jnp.pad(Y, ((0, n_pad - Y.shape[0]), (0, 0)))
+        from keystone_tpu.ops import pallas_ops
+
         alpha = _nystrom_fit_kernel(
             X, Y, L, float(self.kernel_generator.gamma),
             jnp.asarray(self.lam, dtype=Y.dtype), data.n,
+            pallas_ops.pallas_direct_ok(X, L),
         )
         return NystromKernelMapper(L, alpha, self.kernel_generator.gamma)
 

@@ -39,6 +39,45 @@ class LinearMapper(Transformer):
         return data.map_batch(self.apply)
 
 
+class SparseLinearMapper(Transformer):
+    """Sparse-input dense-model apply: ``out = X W + b`` over padded-COO
+    batches via a model-row gather + nnz reduction — the design matrix is
+    never densified (reference: SparseLinearMapper.scala:13-50, the apply
+    used by SparseLBFGS's fitted models). Dense inputs fall through to a
+    plain GEMM so the mapper slots anywhere a LinearMapper does.
+    """
+
+    def __init__(self, x, b_opt=None):
+        self.x = jnp.asarray(x)
+        self.b_opt = None if b_opt is None else jnp.asarray(b_opt)
+
+    def apply(self, v):
+        if isinstance(v, dict) and set(v.keys()) == {"indices", "values"}:
+            idx = np.asarray(v["indices"])
+            val = np.asarray(v["values"])
+            m = idx >= 0
+            out = jnp.asarray(val[m]) @ self.x[jnp.asarray(idx[m])]
+        else:
+            out = jnp.asarray(v) @ self.x
+        if self.b_opt is not None:
+            out = out + self.b_opt
+        return out
+
+    def batch_apply(self, data: Dataset) -> Dataset:
+        from keystone_tpu.ops.sparse import is_sparse_dataset, sparse_matmul
+
+        if is_sparse_dataset(data):
+            out = sparse_matmul(
+                jnp.asarray(data.data["indices"]),
+                jnp.asarray(data.data["values"]),
+                self.x,
+            )
+            if self.b_opt is not None:
+                out = out + self.b_opt
+            return Dataset(out, n=data.n, mesh=data.mesh)._rezero_padding()
+        return data.map_batch(self.apply)
+
+
 class LinearMapEstimator(LabelEstimator):
     """Exact OLS/ridge via distributed normal equations
     (reference: LinearMapper.scala:64-98): mean-center features and labels,

@@ -42,6 +42,7 @@ __all__ = [
     "gram_corr",
     "gram_corr_sym",
     "pallas_enabled",
+    "pallas_direct_ok",
 ]
 
 _TILE_M = 256
@@ -67,22 +68,44 @@ def _dot_kwargs(compute_dtype):
 
 
 def pallas_enabled() -> bool:
-    """True when the Pallas paths should be used for real.
+    """True when the Pallas kernels should be used.
 
-    Requires the TPU backend and (for now) a single-device process:
-    ``pl.pallas_call`` is not partitionable by GSPMD, so dispatching it on a
-    mesh-sharded array would force an all-gather. Multi-device meshes take
-    the XLA paths (which partition fine); shard_map-wrapped variants live in
-    ``keystone_tpu.parallel.ring``. ``KEYSTONE_PALLAS=1`` forces the kernels
-    on regardless; ``KEYSTONE_NO_PALLAS=1`` forces them off.
+    Requires the TPU backend. Multi-device callers reach the kernels through
+    ``shard_map`` wrappers (each shard's tile is unsharded inside the body,
+    so ``pallas_call`` composes; the collectives around it are explicit
+    psums/ppermutes) — see ``parallel.linalg`` (sharded BCD gram+corr) and
+    ``parallel.ring`` (ring kernel blocks). Callers that dispatch a kernel
+    *directly* on eager arrays must additionally check
+    :func:`pallas_direct_ok`, since GSPMD cannot partition a bare
+    ``pallas_call`` over a sharded operand. ``KEYSTONE_PALLAS=1`` forces the
+    kernels on off-TPU (interpret mode); ``KEYSTONE_NO_PALLAS=1`` forces
+    them off.
     """
     if os.environ.get("KEYSTONE_NO_PALLAS"):
         return False
-    if jax.default_backend() != "tpu":
-        return False
     if os.environ.get("KEYSTONE_PALLAS"):
         return True
-    return len(jax.devices()) == 1
+    return jax.default_backend() == "tpu"
+
+
+def pallas_direct_ok(*arrays) -> bool:
+    """True when a *direct* (non-shard_map) kernel dispatch is safe for these
+    eager operands: Pallas enabled and no operand sharded across devices.
+    A bare ``pallas_call`` on a multi-device-sharded array would force XLA
+    to gather it to one device — such callers should take a shard_map
+    wrapper or the XLA path instead."""
+    if not pallas_enabled():
+        return False
+    for a in arrays:
+        sharding = getattr(a, "sharding", None)
+        if sharding is None:
+            continue
+        try:
+            if len(sharding.device_set) > 1 and not sharding.is_fully_replicated:
+                return False
+        except Exception:
+            return False
+    return True
 
 
 def _pad_to(x, multiple: int, axis: int):

@@ -90,14 +90,41 @@ class CosineRandomFeaturesModel(Transformer):
         self.b = jnp.asarray(b)
         if self.b.shape[0] != self.W.shape[0]:
             raise ValueError("# of rows in W and size of b should match")
+        # (mesh, wrapped fn) — a fresh shard_map-of-lambda per call would
+        # defeat jit's trace cache and recompile every batch.
+        self._sharded_fused = None
 
     def apply(self, x):
         return jnp.cos(jnp.asarray(x) @ self.W.T + self.b)
 
     def batch_apply(self, data: Dataset) -> Dataset:
-        from keystone_tpu.ops import pallas_ops
+        import jax.tree_util as jtu
+        from jax.sharding import PartitionSpec as P
 
-        if pallas_ops.pallas_enabled():
+        from keystone_tpu.ops import pallas_ops
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        mesh = data.mesh
+        multi = mesh is not None and mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS) > 1
+        if pallas_ops.pallas_enabled() and multi:
+            # Row-sharded input: run the fused kernel per shard under
+            # shard_map (W/b replicate into the body; no collective needed —
+            # the featurization is embarrassingly row-parallel). The wrapper
+            # is cached per mesh so repeat batches reuse the compiled program.
+            if self._sharded_fused is None or self._sharded_fused[0] is not mesh:
+                W, b = self.W, self.b
+                self._sharded_fused = (
+                    mesh,
+                    jax.shard_map(
+                        lambda X: pallas_ops.cosine_features(X, W, b),
+                        mesh=mesh,
+                        in_specs=P(mesh_lib.DATA_AXIS),
+                        out_specs=P(mesh_lib.DATA_AXIS),
+                        check_vma=False,  # pallas outputs carry no vma info
+                    ),
+                )
+            return data.map_batch(self._sharded_fused[1])._rezero_padding()
+        if pallas_ops.pallas_direct_ok(*jtu.tree_leaves(data.data)):
             # Fused Pallas matmul+cos: the pre-activation never hits HBM.
             return data.map_batch(
                 lambda X: pallas_ops.cosine_features(X, self.W, self.b)

@@ -77,12 +77,68 @@ def _bcd_block_step(Ab, Wb, R, lam: float):
     return Wb_new, R_new
 
 
+@functools.lru_cache(maxsize=None)
+def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
+    """Compiled per-block BCD step for a row-sharded design matrix.
+
+    The Gramian + correlation are computed per shard — through the fused
+    Pallas ``gram_corr_sym`` kernel when enabled (each shard's tile is
+    unsharded inside shard_map, so ``pallas_call`` composes with the mesh)
+    — then psum'd over the ``data`` axis: the explicit-collective form of
+    the reference's per-partition Gramians + treeReduce (mlmatrix
+    NormalEquations). Solve and weight update are replicated; the residual
+    update partitions as a plain sharded GEMM.
+    """
+    axis = mesh_lib.DATA_AXIS
+
+    def gram_corr_body(a, r):
+        if use_pallas:
+            from keystone_tpu.ops import pallas_ops
+
+            gram, corr = pallas_ops.gram_corr_sym(a, r)
+        else:
+            acc = jnp.promote_types(a.dtype, jnp.float32)
+            gram = jax.lax.dot_general(
+                a, a, (((0,), (0,)), ((), ())), preferred_element_type=acc,
+                **_hi_kwargs(a.dtype),
+            )
+            corr = jax.lax.dot_general(
+                a, r.astype(a.dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=acc, **_hi_kwargs(a.dtype),
+            )
+        return jax.lax.psum(gram, axis), jax.lax.psum(corr, axis)
+
+    # check_vma=False: pallas_call outputs carry no varying-mesh-axes info,
+    # so the static replication checker cannot see through them; the psums
+    # above establish the replicated out_specs regardless.
+    sharded_gram_corr = jax.shard_map(
+        gram_corr_body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis)),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step(Ab, Wb, R):
+        gram, corr = sharded_gram_corr(Ab, R)
+        Wb = Wb.astype(gram.dtype)
+        rhs = corr + gram @ Wb
+        Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
+        delta = (Ab @ (Wb_new - Wb).astype(Ab.dtype)).astype(R.dtype)
+        return Wb_new, R - delta
+
+    return step
+
+
 def bcd_least_squares(
     A_blocks: Sequence,
     B,
     lam: float = 0.0,
     num_iter: int = 1,
     W_init: Optional[List] = None,
+    mesh=None,
+    use_pallas: Optional[bool] = None,
 ) -> List:
     """Block coordinate descent ridge regression over feature blocks.
 
@@ -93,8 +149,12 @@ def bcd_least_squares(
 
     Host Python drives the (epoch × block) loop — the analog of the Spark
     driver — while each block step is one compiled sharded computation. All
-    equally-shaped blocks share a single compiled executable.
+    equally-shaped blocks share a single compiled executable. Pass ``mesh``
+    (multi-device) to run each step's Gramian+correlation as an explicit
+    shard_map program — with the fused Pallas kernels inside when enabled.
     """
+    from keystone_tpu.ops import pallas_ops
+
     B = jnp.asarray(B)
     k = B.shape[1]
     Ws = (
@@ -109,15 +169,21 @@ def bcd_least_squares(
         # would delete it out from under them.
         R = jnp.array(B, copy=True)
 
+    multi = mesh is not None and mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS) > 1
+    if multi:
+        if use_pallas is None:
+            use_pallas = pallas_ops.pallas_enabled()
+        step = _mesh_bcd_step(mesh, float(lam), bool(use_pallas))
+    else:
+        step = None
+
     for _ in range(max(num_iter, 1)):
         for b, Ab in enumerate(A_blocks):
-            Ws[b], R = _bcd_block_step(jnp.asarray(Ab), Ws[b], R, float(lam))
-            if jax.default_backend() == "cpu":
-                # Synchronize per block step on the CPU test backend only:
-                # queueing many collective programs asynchronously deadlocks
-                # the forced-host multi-device CPU backend. TPU meshes keep
-                # async dispatch so block b+1's GEMMs overlap b's solve.
-                R.block_until_ready()
+            if step is not None:
+                Ws[b], R = step(jnp.asarray(Ab), Ws[b], R)
+            else:
+                Ws[b], R = _bcd_block_step(jnp.asarray(Ab), Ws[b], R, float(lam))
+            mesh_lib.sync_if_cpu(R)
     return Ws
 
 
@@ -273,7 +339,7 @@ def bcd_least_squares_fused_flat(
         raise ValueError(f"feature dim {d} not divisible by block {block_size}")
     nb = d // block_size
     if use_pallas is None:
-        use_pallas = pallas_ops.pallas_enabled()
+        use_pallas = pallas_ops.pallas_direct_ok(F)
     W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=B.dtype)
     W, R = _bcd_fused_flat_kernel(
         F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
@@ -319,7 +385,7 @@ def bcd_least_squares_fused(
     nb, n, db = A_stack.shape
     k = B.shape[1]
     if use_pallas is None:
-        use_pallas = pallas_ops.pallas_enabled()
+        use_pallas = pallas_ops.pallas_direct_ok(A_stack)
     W0 = (
         jnp.asarray(W_init, dtype=B.dtype)
         if W_init is not None

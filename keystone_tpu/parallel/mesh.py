@@ -152,3 +152,15 @@ def replicate(x, mesh: Optional[Mesh] = None):
     """Fully replicate an array over the mesh (the `broadcast` analog)."""
     mesh = mesh or default_mesh()
     return jax.device_put(x, NamedSharding(mesh, P()))
+
+
+def sync_if_cpu(x) -> None:
+    """Barrier after a dispatched step — on the CPU backend only.
+
+    The forced-host multi-device CPU backend deadlocks when many collective
+    programs are queued asynchronously, so host-driven solver loops call
+    this after each dispatched step. On TPU it is a no-op: the loop keeps
+    async dispatch and step b+1's GEMMs overlap step b's solve.
+    """
+    if jax.default_backend() == "cpu":
+        jax.block_until_ready(x)

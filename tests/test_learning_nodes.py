@@ -199,6 +199,20 @@ class TestBWLS:
         est = BlockWeightedLeastSquaresEstimator(4, 3, 0.1, 0.5)
         assert est.weight == 10
 
+    def test_sharded_matches_unsharded(self, mesh8):
+        """Rows stay on the mesh: a sharded fit must equal the local fit
+        (round 2 removed the host-f64 round trip; stats are device segment
+        sums over the class-sorted sharded rows)."""
+        train = synthetic_classification(160, 8, 3, seed=11)
+        labels = ClassLabelIndicatorsFromIntLabels(3)(train.labels)
+        est = BlockWeightedLeastSquaresEstimator(
+            block_size=4, num_iter=2, lam=0.1, mixture_weight=0.4)
+        m_local = est.fit(train.data, labels)
+        m_sharded = est.fit(train.data.shard(mesh8), labels.shard(mesh8))
+        p_local = m_local.batch_apply(train.data).to_numpy()
+        p_sharded = m_sharded.batch_apply(train.data).to_numpy()
+        np.testing.assert_allclose(p_sharded, p_local, atol=1e-8)
+
     def test_mw_zero_close_to_unweighted(self):
         """mixture_weight→0 should approach the population (unweighted) solve."""
         train = synthetic_classification(300, 8, 3, seed=7)
