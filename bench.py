@@ -90,15 +90,17 @@ def main():
     Wrf_flat = Wrf.reshape(NUM_FEATURES, TIMIT_INPUT_DIMS)
     brf_flat = brf.reshape(NUM_FEATURES)
 
-    @jax.jit
-    def train_step(X, Wrf_flat, brf_flat, Y):
+    def featurize(X):
         if use_pallas:
-            F = po.cosine_features(
+            return po.cosine_features(
                 X, Wrf_flat, brf_flat,
                 compute_dtype=feat_dtype, out_dtype=feat_dtype,
             )
-        else:
-            F = jnp.cos(X @ Wrf_flat.T + brf_flat).astype(feat_dtype)
+        return jnp.cos(X @ Wrf_flat.T + brf_flat).astype(feat_dtype)
+
+    @jax.jit
+    def train_step(X, Wrf_flat, brf_flat, Y):
+        F = featurize(X)
         W = linalg.bcd_least_squares_fused_flat(
             F, Y, BLOCK_SIZE, lam=1e-4, num_iter=NUM_EPOCHS,
             use_pallas=use_pallas,
@@ -106,6 +108,26 @@ def main():
         # Checksum computed in-program: the barrier below is then a bare
         # scalar transfer, not a second dispatch round trip.
         return W, jnp.sum(jnp.abs(W))
+
+    @jax.jit
+    def quality_step(X, Wrf_flat, brf_flat, Y, W):
+        # Untimed pass: ridge loss ||Y − F W||²/n and train error of the
+        # fitted model (the CSV rows report err+loss, so the bench does
+        # too). Kept out of train_step so the timed program is exactly the
+        # solve — returning the residual there perturbs buffer lifetimes.
+        F = featurize(X)
+        nb = NUM_FEATURES // BLOCK_SIZE
+        preds = sum(
+            jax.lax.dynamic_slice_in_dim(F, i * BLOCK_SIZE, BLOCK_SIZE, 1)
+            .astype(jnp.float32) @ W[i]
+            for i in range(nb)
+        )
+        R = Y - preds
+        loss = jnp.sum(R * R) / R.shape[0]
+        train_err = jnp.mean(
+            jnp.argmax(preds, axis=1) == jnp.argmax(Y, axis=1)
+        )
+        return loss, 1.0 - train_err
 
     def run_once():
         W, checksum = train_step(X, Wrf_flat, brf_flat, Y)
@@ -117,8 +139,12 @@ def main():
 
     run_once()  # warmup (compile)
     t0 = time.perf_counter()
-    run_once()  # timed: featurization + solve (the pipeline's compute body)
+    W = run_once()  # timed: featurization + solve (the pipeline's compute body)
     elapsed = time.perf_counter() - t0
+
+    loss, train_err = (
+        float(x) for x in quality_step(X, Wrf_flat, brf_flat, Y, W)
+    )
 
     # The baseline CSV row is one full solver run whose epoch count is not
     # recorded. The reference's own cost-model fit multiplies the Block
@@ -150,6 +176,13 @@ def main():
                     "block_size": BLOCK_SIZE,
                     "epochs": NUM_EPOCHS,
                     "precision": "bf16" if bf16 else "f32",
+                    "train_loss": round(loss, 4),
+                    "train_err": round(train_err, 4),
+                    "quality_note": (
+                        "synthetic labels; error/loss parity vs an exact "
+                        "solver on real data lives in parity.py / "
+                        "PARITY_RESULTS.json"
+                    ),
                     "pallas": use_pallas,
                     "single_dispatch": True,
                     "baseline": (

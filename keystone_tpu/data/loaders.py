@@ -379,6 +379,27 @@ def synthetic_mnist(n: int = 4096, seed: int = 0) -> LabeledData:
     return synthetic_classification(n, 784, 10, seed=seed, class_sep=0.5)
 
 
+def load_digits_real(train_fraction: float = 0.8, seed: int = 0):
+    """Real handwritten-digit data (UCI optical digits, 1797 8×8 images,
+    bundled with scikit-learn — the real-data stand-in for MNIST in this
+    offline environment). Returns (train: LabeledData, test: LabeledData)
+    with pixel values scaled to [0, 1], deterministic shuffled split.
+    """
+    from sklearn.datasets import load_digits
+
+    bunch = load_digits()
+    X = bunch.data.astype(np.float64) / 16.0
+    y = bunch.target.astype(np.int64)
+    rng = np.random.default_rng(seed)
+    order = rng.permutation(len(y))
+    X, y = X[order], y[order]
+    n_train = int(len(y) * train_fraction)
+    return (
+        LabeledData(X[:n_train], y[:n_train]),
+        LabeledData(X[n_train:], y[n_train:]),
+    )
+
+
 def synthetic_timit(n: int = 8192, seed: int = 0) -> LabeledData:
     """TIMIT-shaped synthetic data: 440-dim frames, 147 classes."""
     return synthetic_classification(

@@ -91,9 +91,11 @@ def sparse_matmul(indices, values, W):
     rows at the active indices plus a reduction over the nnz axis (the
     active-index inner loops of LeastSquaresSparseGradient,
     Gradient.scala:58-123, become one vectorized gather+sum). Cost is
-    O(n · max_nnz · k) independent of d.
+    O(n · max_nnz · k) independent of d. Indices outside [0, d) are dropped
+    (the same semantics as the densify scatter and sparse_matmul_t — the
+    X and Xᵀ operators must agree or gradients silently corrupt).
     """
-    mask = indices >= 0
+    mask = (indices >= 0) & (indices < W.shape[0])
     safe = jnp.where(mask, indices, 0)
     gathered = jnp.take(W, safe, axis=0)  # (n, w, k)
     vals = jnp.where(mask, values, 0.0).astype(W.dtype)
@@ -105,13 +107,14 @@ def sparse_matmul_t(indices, values, V, d: int):
     """Xᵀ @ V for a padded-COO X via a segment-sum scatter.
 
     Every active (i, j) contributes ``values[i, j] · V[i, :]`` to output row
-    ``indices[i, j]``; padding lanes scatter into a ghost bucket that is
-    sliced off. This is the transpose pass of the sparse gradient — together
+    ``indices[i, j]``; padding and out-of-range lanes scatter into a ghost
+    bucket that is sliced off (dropped — matching sparse_matmul). This is
+    the transpose pass of the sparse gradient — together
     with :func:`sparse_matmul` it gives the full Xᵀ(XW − Y) gradient without
     ever materializing a dense design matrix.
     """
     n, w = indices.shape
-    mask = indices >= 0
+    mask = (indices >= 0) & (indices < d)
     safe = jnp.where(mask, indices, d)  # ghost bucket d for padding
     vals = jnp.where(mask, values, 0.0).astype(V.dtype)
     contrib = (vals[:, :, None] * V[:, None, :]).reshape(n * w, V.shape[1])

@@ -40,11 +40,14 @@ class MnistRandomFFTConfig:
     lam: Optional[float] = None
     seed: int = 0
     synthetic_n: int = 4096  # used when no train_location given
+    image_size: int = MNIST_IMAGE_SIZE  # input dims (64 for the real
+    # sklearn digits data used by parity.py; 784 for MNIST CSVs)
+    use_digits: bool = False  # real UCI digits instead of synthetic
 
 
 def build_featurizer(config: MnistRandomFFTConfig) -> Pipeline:
     branches = [
-        RandomSignNode.create(MNIST_IMAGE_SIZE, seed=config.seed + i)
+        RandomSignNode.create(config.image_size, seed=config.seed + i)
         .and_then(PaddedFFT())
         .and_then(LinearRectifier(0.0))
         for i in range(config.num_ffts)
@@ -59,6 +62,17 @@ def run(config: MnistRandomFFTConfig):
         # File labels are 1-indexed (MnistRandomFFT.scala:34-37).
         train = load_labeled_csv(config.train_location, label_offset=-1)
         test = load_labeled_csv(config.test_location, label_offset=-1)
+    elif config.use_digits:
+        from dataclasses import replace
+
+        from keystone_tpu.data.loaders import load_digits_real
+
+        train, test = load_digits_real(seed=config.seed)
+        dim = int(train.data.array.shape[1])
+        if config.image_size != dim:
+            # Derive the featurizer width from the loaded data (64 for the
+            # UCI digits) rather than crashing on the 784 MNIST default.
+            config = replace(config, image_size=dim)
     else:
         train = synthetic_mnist(config.synthetic_n, seed=config.seed)
         test = synthetic_mnist(max(config.synthetic_n // 4, 256), seed=config.seed + 1)

@@ -1,0 +1,43 @@
+"""Real-data accuracy parity (the in-suite slice of parity.py).
+
+The MnistRandomFFT composition on the real UCI handwritten-digits dataset
+must reach the same train/test error as an independent float64 numpy exact
+ridge solve on identical features — solver parity on real data at equal
+hyperparameters (the acceptance convention of
+scripts/solver-comparisons-final.csv).
+"""
+
+import numpy as np
+import pytest
+
+
+class TestDigitsRealDataParity:
+    def test_block_ls_matches_exact_on_real_digits(self):
+        from keystone_tpu.pipelines import mnist_random_fft as mp
+        from keystone_tpu.data.loaders import load_digits_real
+        from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+        from parity import _exact_ridge_errors
+
+        lam = 1e-6
+        config = mp.MnistRandomFFTConfig(
+            num_ffts=4, block_size=128, lam=lam, image_size=64,
+            use_digits=True,
+        )
+        _, train_eval, test_eval = mp.run(config)
+
+        train, test = load_digits_real(seed=config.seed)
+        featurizer = mp.build_featurizer(config)
+        F_train = np.asarray(featurizer.apply(train.data).get().array)
+        F_test = np.asarray(featurizer.apply(test.data).get().array)
+        Y = np.asarray(
+            ClassLabelIndicatorsFromIntLabels(10)(train.labels).array
+        )
+        p_tr, p_te = _exact_ridge_errors(F_train, Y, F_test, lam)
+        exact_train = (p_tr.argmax(1) != np.asarray(train.labels.array)).mean()
+        exact_test = (p_te.argmax(1) != np.asarray(test.labels.array)).mean()
+
+        # Real-data sanity: way better than chance (90% error).
+        assert test_eval.total_error < 0.10
+        # Solver parity at equal hyperparameters.
+        assert abs(train_eval.total_error - exact_train) < 0.01
+        assert abs(test_eval.total_error - exact_test) < 0.015
