@@ -249,22 +249,19 @@ class WordFrequencyEncoder(Estimator):
 # ---------------------------------------------------------------------------
 
 
-_SUFFIXES = ("ing", "edly", "ed", "es", "s", "ly")
-
-
 def _default_lemmatizer(word: str) -> str:
-    w = word.lower()
-    for suf in _SUFFIXES:
-        if w.endswith(suf) and len(w) > len(suf) + 2:
-            return w[: -len(suf)]
-    return w
+    from keystone_tpu.ops.lemmatizer import lemmatize
+
+    return lemmatize(word)
 
 
 class CoreNLPFeatureExtractor(Transformer):
     """Sentence -> lemmatized n-grams. The reference shells out to Stanford
-    CoreNLP (CoreNLPFeatureExtractor.scala:18); here the lemmatizer is a
-    pluggable callable with a light rule-based default, keeping the node's
-    contract (lemma n-grams of orders 1..n) without the external dependency."""
+    CoreNLP (CoreNLPFeatureExtractor.scala:18); here the default lemmatizer
+    is the in-tree Morpha-style inflectional analyzer
+    (:mod:`keystone_tpu.ops.lemmatizer` — irregular-form table + detachment
+    rule cascade, the same analysis class as CoreNLP's Morphology), and the
+    lemmatizer stays a pluggable callable."""
 
     def __init__(self, orders: Sequence[int], lemmatizer: Optional[Callable[[str], str]] = None):
         self.featurizer = NGramsFeaturizer(orders)

@@ -120,8 +120,14 @@ def make_hybrid_mesh(
         return make_mesh(full, axis_names)
     from jax.experimental import mesh_utils
 
+    # TPU slices carry a slice_index; hosts without one (multi-process CPU,
+    # single-slice-per-host topologies) group by process instead, so the DCN
+    # axes land across processes.
+    slice_ids = {getattr(d, "slice_index", None) for d in jax.devices()}
+    process_is_granule = len(slice_ids) <= 1
     devices = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, devices=jax.devices()
+        ici_shape, dcn_shape, devices=jax.devices(),
+        process_is_granule=process_is_granule,
     )
     return Mesh(devices, tuple(axis_names))
 

@@ -7,11 +7,14 @@ computed Dataset resident in device HBM (and publishing it into the prefix
 state table) versus recomputing it on each downstream pass.
 
 Two strategies, as in the reference:
-  - AggressiveCache: cache every dataset-producing node whose weighted direct
-    successor count exceeds 1 (AutoCacheRule.scala:503-518).
-  - GreedyCache(max_mem_bytes, scales, trials): profile sampled execution and
-    greedily add the cache that most reduces estimated runtime while the
-    cached set fits the memory budget (AutoCacheRule.scala:559-602).
+  - AggressiveCache: cache every node whose weighted direct successor count
+    exceeds 1 (AutoCacheRule.scala:503-518).
+  - GreedyCache(max_mem_bytes, partition_scales, num_trials): profile
+    sampled execution at MULTIPLE sample scales, fit linear time/mem models
+    vs data scale (``generalizeProfiles``, AutoCacheRule.scala:104-135),
+    extrapolate to the full data size, then greedily add the cache that
+    most reduces estimated runtime while the cached set fits the memory
+    budget (AutoCacheRule.scala:559-602).
 
 Node weights come from the ``weight`` attribute of operators (the
 WeightedOperator contract, reference: workflow/WeightedOperator.scala): the
@@ -21,8 +24,8 @@ number of passes the operator makes over its inputs.
 from __future__ import annotations
 
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 import jax
 import numpy as np
@@ -34,8 +37,10 @@ from .operators import (
     DatasetExpression,
     DatasetOperator,
     DatumExpression,
+    DatumOperator,
     EstimatorOperator,
     Expression,
+    ExpressionOperator,
     TransformerExpression,
     TransformerOperator,
 )
@@ -58,6 +63,36 @@ class Profile:
         return Profile(self.ns + other.ns, self.mem_bytes + other.mem_bytes)
 
 
+@dataclass
+class SampleProfile:
+    """One measurement at one sample scale (AutoCacheRule.scala:16)."""
+
+    scale: int
+    profile: Profile
+
+
+def generalize_profiles(
+    new_scale: int, sample_profiles: Sequence[SampleProfile]
+) -> Profile:
+    """Fit linear models time/mem vs sample scale and evaluate at the full
+    data scale (``generalizeProfiles``, AutoCacheRule.scala:104-135: solve
+    ``[scale, 1] \\ y`` with coefficients clipped at zero)."""
+    X = np.array(
+        [[float(sp.scale), 1.0] for sp in sample_profiles], dtype=np.float64
+    )
+
+    def model(ys: List[float]) -> float:
+        y = np.asarray(ys, dtype=np.float64)
+        coef, *_ = np.linalg.lstsq(X, y, rcond=None)
+        coef = np.maximum(coef, 0.0)  # max(X \ y, 0.0)
+        return float(coef[0] * new_scale + coef[1])
+
+    return Profile(
+        ns=model([sp.profile.ns for sp in sample_profiles]),
+        mem_bytes=int(model([sp.profile.mem_bytes for sp in sample_profiles])),
+    )
+
+
 @dataclass(frozen=True)
 class AggressiveCache:
     pass
@@ -66,25 +101,44 @@ class AggressiveCache:
 @dataclass(frozen=True)
 class GreedyCache:
     max_mem_bytes: Optional[int] = None  # default: 75% of device memory
-    samples_per_shard: int = 3
+    # Sample scales (items per shard), profiled smallest-to-largest
+    # (reference default partitionScales = Seq(2, 4)).
+    partition_scales: Tuple[int, ...] = (2, 4)
+    num_trials: int = 1
 
 
-def _dataset_nodes(graph: Graph) -> Set[NodeId]:
-    """Nodes that produce datasets: transformer-ish nodes not downstream of sources."""
-    out = set()
+# ---------------------------------------------------------------------------
+# Graph queries (ported from AutoCacheRule.scala:18-95)
+# ---------------------------------------------------------------------------
+
+
+def init_cache_set(graph: Graph) -> Set[NodeId]:
+    """Nodes whose results are effectively cached before the rule runs
+    (initCacheSet, AutoCacheRule.scala:80-95): datum constants, Cachers,
+    estimator fits, and spliced expressions."""
+    from keystone_tpu.ops.util import Cacher
+
+    cached = set()
     for node, op in graph.operators.items():
-        if isinstance(op, EstimatorOperator):
-            continue
-        ancestors = analysis.get_ancestors(graph, node)
-        if any(isinstance(a, SourceId) for a in ancestors):
-            continue
-        out.add(node)
+        if isinstance(
+            op, (DatumOperator, EstimatorOperator, ExpressionOperator, Cacher)
+        ):
+            cached.add(node)
+    return cached
+
+
+def descendants_of_sources(graph: Graph) -> Set[NodeId]:
+    out: Set[NodeId] = set()
+    for source in graph.sources:
+        for gid in analysis.get_descendants(graph, source):
+            if isinstance(gid, NodeId):
+                out.add(gid)
     return out
 
 
 def compute_runs(graph: Graph, cached: Set[NodeId]) -> Dict[NodeId, int]:
     """Times each node's result gets *computed*, given a cached set
-    (the analog of AutoCacheRule.getRuns, AutoCacheRule.scala:57-81).
+    (getRuns, AutoCacheRule.scala:57-77).
 
     A node's result is accessed once per (child run × child weight); caching a
     node bounds its compute count at 1.
@@ -114,12 +168,96 @@ def compute_runs(graph: Graph, cached: Set[NodeId]) -> Dict[NodeId, int]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Greedy selection (ported from AutoCacheRule.scala:460-602)
+# ---------------------------------------------------------------------------
+
+
+def estimate_cached_runtime(
+    graph: Graph, cached: Set[NodeId], profiles: Dict[NodeId, Profile]
+) -> float:
+    """Total estimated runtime given a cached set (estimateCachedRunTime,
+    AutoCacheRule.scala:468-487): Σ executions × profiled ns over all nodes
+    (unprofiled nodes contribute 0)."""
+    runs = compute_runs(graph, cached)
+    return sum(
+        runs[n] * profiles.get(n, Profile()).ns for n in graph.nodes
+    )
+
+
+def cached_mem(cached: Set[NodeId], profiles: Dict[NodeId, Profile]) -> int:
+    return sum(profiles.get(n, Profile()).mem_bytes for n in cached)
+
+
+def _still_room(
+    cached: Set[NodeId],
+    runs: Dict[NodeId, int],
+    profiles: Dict[NodeId, Profile],
+    space_left: int,
+) -> bool:
+    """True iff an uncached node used >1 time would fit if cached
+    (stillRoom, AutoCacheRule.scala:529-541)."""
+    return any(
+        runs[n] > 1
+        and n not in cached
+        and profiles.get(n, Profile()).mem_bytes < space_left
+        for n in runs
+    )
+
+
+def _select_next(
+    graph: Graph,
+    profiles: Dict[NodeId, Profile],
+    cached: Set[NodeId],
+    runs: Dict[NodeId, int],
+    space_left: int,
+) -> NodeId:
+    """The fitting uncached node that minimizes estimated runtime when
+    cached (selectNext, AutoCacheRule.scala:543-557). Ties break on NodeId
+    order for determinism."""
+    eligible = [
+        n
+        for n in sorted(graph.nodes, key=lambda n: n.id)
+        if n not in cached
+        and profiles.get(n, Profile()).mem_bytes < space_left
+        and runs[n] > 1
+    ]
+    return min(
+        eligible,
+        key=lambda n: estimate_cached_runtime(graph, cached | {n}, profiles),
+    )
+
+
+def greedy_cache_set(
+    graph: Graph,
+    profiles: Dict[NodeId, Profile],
+    max_mem: int,
+) -> Set[NodeId]:
+    """The greedy selection loop (greedyCache, AutoCacheRule.scala:559-602),
+    returning the set of nodes to cache (source descendants excluded)."""
+    cached = init_cache_set(graph)
+    runs = compute_runs(graph, cached)
+    to_cache: Set[NodeId] = set()
+    used = cached_mem(cached, profiles)
+    while used < max_mem and _still_room(
+        cached | to_cache, runs, profiles, max_mem - used
+    ):
+        to_cache.add(
+            _select_next(
+                graph, profiles, cached | to_cache, runs, max_mem - used
+            )
+        )
+        runs = compute_runs(graph, cached | to_cache)
+        used = cached_mem(cached | to_cache, profiles)
+    return to_cache - descendants_of_sources(graph)
+
+
 def _insert_cachers(plan: Graph, nodes: Set[NodeId]) -> Graph:
     """Splice a Cacher node after each selected node (AutoCacheRule.scala:492-501)."""
     from keystone_tpu.ops.util import Cacher
 
     graph = plan
-    for node in nodes:
+    for node in sorted(nodes, key=lambda n: n.id):
         op = graph.get_operator(node)
         if isinstance(op, Cacher):
             continue
@@ -136,25 +274,31 @@ def _insert_cachers(plan: Graph, nodes: Set[NodeId]) -> Graph:
     return graph
 
 
-def profile_nodes(
-    graph: Graph, nodes: Set[NodeId], samples_per_shard: int = 3
-) -> Dict[NodeId, Profile]:
-    """Execute sampled ancestor chains, measuring per-node wall time and output size
-    (the analog of AutoCacheRule.profileNodes, AutoCacheRule.scala:153-465)."""
+# ---------------------------------------------------------------------------
+# Multi-scale profiling (ported from profileNodes + generalizeProfiles)
+# ---------------------------------------------------------------------------
+
+
+def _sample_once(
+    graph: Graph, nodes: Set[NodeId], sample_size: int
+) -> Tuple[Dict[NodeId, Profile], Dict[NodeId, int], Dict[NodeId, int]]:
+    """Execute the ancestor closure of ``nodes`` on inputs subsampled to
+    ``sample_size`` items, timing each profiled node. Returns
+    (raw profiles at this scale, per-node sampled item counts, per-node
+    full data sizes)."""
     from keystone_tpu.data import Dataset
 
     memo: Dict[NodeId, object] = {}
     profiles: Dict[NodeId, Profile] = {}
+    full_counts: Dict[NodeId, int] = {}
+    actual: Dict[NodeId, int] = {}
 
-    def sample_dataset(ds: Dataset) -> Tuple[Dataset, float]:
-        k = min(ds.n, max(samples_per_shard, 1))
-        scale = ds.n / max(k, 1)
+    def sample_dataset(ds: Dataset) -> Dataset:
+        k = min(ds.n, max(sample_size, 1))
         if ds.is_host:
-            return Dataset.of(ds.to_list()[:k]), scale
+            return Dataset.of(ds.to_list()[:k])
         data = jax.tree_util.tree_map(lambda x: x[:k], ds.data)
-        return Dataset(data, n=k), scale
-
-    scales: Dict[NodeId, float] = {}
+        return Dataset(data, n=k)
 
     def evaluate(gid):
         if gid in memo:
@@ -163,21 +307,22 @@ def profile_nodes(
         dep_values = [evaluate(d) for d in graph.get_dependencies(gid)]
         t0 = time.perf_counter()
         if isinstance(op, DatasetOperator):
-            value, scale = sample_dataset(Dataset.of(op.dataset))
-            scales[gid] = scale
+            full = Dataset.of(op.dataset)
+            full_counts[gid] = full.n
+            value = sample_dataset(full)
+            actual[gid] = value.n
         else:
             exprs = [_wrap(v) for v in dep_values]
             value = op.execute(exprs).get()
             if isinstance(value, Dataset):
                 value.cache()
-            dep_scales = [
-                scales.get(d, 1.0) for d in graph.get_dependencies(gid)
-            ]
-            scales[gid] = max(dep_scales, default=1.0)
+            deps = graph.get_dependencies(gid)
+            full_counts[gid] = max(
+                (full_counts.get(d, 1) for d in deps), default=1
+            )
+            actual[gid] = max((actual.get(d, 1) for d in deps), default=1)
         elapsed_ns = (time.perf_counter() - t0) * 1e9
-        mem = _estimate_bytes(value)
-        scale = scales.get(gid, 1.0)
-        profiles[gid] = Profile(ns=elapsed_ns * scale, mem_bytes=int(mem * scale))
+        profiles[gid] = Profile(ns=elapsed_ns, mem_bytes=_estimate_bytes(value))
         memo[gid] = value
         return value
 
@@ -193,7 +338,45 @@ def profile_nodes(
             evaluate(node)
         except Exception:
             profiles.setdefault(node, Profile())
-    return {n: profiles.get(n, Profile()) for n in nodes}
+            full_counts.setdefault(node, 1)
+            actual.setdefault(node, 1)
+    return profiles, actual, full_counts
+
+
+def profile_nodes(
+    graph: Graph,
+    nodes: Set[NodeId],
+    partition_scales: Sequence[int] = (2, 4),
+    num_trials: int = 1,
+) -> Dict[NodeId, Profile]:
+    """Profile nodes at multiple sample scales and generalize to the full
+    data size with the fitted linear models (profileNodes +
+    generalizeProfiles, AutoCacheRule.scala:104-135, 153-465)."""
+    samples: Dict[NodeId, List[SampleProfile]] = {n: [] for n in nodes}
+    full: Dict[NodeId, int] = {}
+    for scale in sorted(partition_scales):
+        for _ in range(max(int(num_trials), 1)):
+            profiles, actual, full_counts = _sample_once(graph, nodes, scale)
+            for n in nodes:
+                samples[n].append(
+                    SampleProfile(actual.get(n, 1), profiles.get(n, Profile()))
+                )
+                full[n] = max(full.get(n, 1), full_counts.get(n, 1))
+    out = {}
+    for n in nodes:
+        if len({sp.scale for sp in samples[n]}) >= 2:
+            out[n] = generalize_profiles(full[n], samples[n])
+        elif samples[n]:
+            # Single usable scale: fall back to proportional extrapolation.
+            sp = samples[n][-1]
+            factor = full[n] / max(sp.scale, 1)
+            out[n] = Profile(
+                ns=sp.profile.ns * factor,
+                mem_bytes=int(sp.profile.mem_bytes * factor),
+            )
+        else:
+            out[n] = Profile()
+    return out
 
 
 def _estimate_bytes(value) -> int:
@@ -215,21 +398,22 @@ class AutoCacheRule(Rule):
         self.strategy = strategy or GreedyCache()
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
-        candidates = _dataset_nodes(plan)
-        if not candidates:
-            return plan, prefixes
-
         if isinstance(self.strategy, AggressiveCache):
-            to_cache = self._aggressive(plan, candidates)
+            to_cache = self._aggressive(plan)
         else:
-            to_cache = self._greedy(plan, candidates, self.strategy)
-
+            to_cache = self._greedy(plan, self.strategy)
         return _insert_cachers(plan, to_cache), prefixes
 
-    def _aggressive(self, plan: Graph, candidates: Set[NodeId]) -> Set[NodeId]:
-        """Cache every dataset node with >1 weighted direct successor access."""
+    def _aggressive(self, plan: Graph) -> Set[NodeId]:
+        """Cache every node with >1 weighted direct successor access that is
+        not already cached and not source-dependent
+        (aggressiveCache, AutoCacheRule.scala:503-518)."""
+        cached = init_cache_set(plan)
+        source_desc = descendants_of_sources(plan)
         out = set()
-        for node in candidates:
+        for node in plan.nodes:
+            if node in cached or node in source_desc:
+                continue
             accesses = 0
             for child in analysis.get_children(plan, node):
                 if isinstance(child, NodeId):
@@ -240,38 +424,26 @@ class AutoCacheRule(Rule):
                 out.add(node)
         return out
 
-    def _greedy(
-        self, plan: Graph, candidates: Set[NodeId], strategy: GreedyCache
-    ) -> Set[NodeId]:
-        profiles = profile_nodes(plan, candidates, strategy.samples_per_shard)
+    def _greedy(self, plan: Graph, strategy: GreedyCache) -> Set[NodeId]:
+        cached = init_cache_set(plan)
+        runs = compute_runs(plan, cached)
+        source_desc = descendants_of_sources(plan)
+        # Profile every uncached node accessed more than once that doesn't
+        # depend on the sources (AutoCacheRule.scala:612-618).
+        to_profile = {
+            n
+            for n in plan.nodes
+            if n not in cached and runs[n] > 1 and n not in source_desc
+        }
+        if not to_profile:
+            return set()
+        profiles = profile_nodes(
+            plan, to_profile, strategy.partition_scales, strategy.num_trials
+        )
         max_mem = strategy.max_mem_bytes
         if max_mem is None:
             max_mem = _default_mem_budget()
-
-        def total_cost(cached: Set[NodeId]) -> float:
-            runs = compute_runs(plan, cached)
-            return sum(runs[n] * profiles[n].ns for n in candidates)
-
-        def mem_used(cached: Set[NodeId]) -> int:
-            return sum(profiles[n].mem_bytes for n in cached)
-
-        cached: Set[NodeId] = set()
-        cur_cost = total_cost(cached)
-        improved = True
-        while improved:
-            improved = False
-            best_node, best_cost = None, cur_cost
-            for node in candidates - cached:
-                if mem_used(cached | {node}) > max_mem:
-                    continue
-                cost = total_cost(cached | {node})
-                if cost < best_cost:
-                    best_cost, best_node = cost, node
-            if best_node is not None:
-                cached.add(best_node)
-                cur_cost = best_cost
-                improved = True
-        return cached
+        return greedy_cache_set(plan, profiles, max_mem)
 
 
 def _default_mem_budget() -> int:

@@ -50,8 +50,6 @@ def _exact_ridge_errors(F_train, Y_train, F_test, lam):
 
 
 def digits_parity(lam=1e-6):
-    from keystone_tpu.data import Dataset
-    from keystone_tpu.evaluation import MulticlassClassifierEvaluator
     from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
     from keystone_tpu.pipelines import mnist_random_fft as mp
 

@@ -1,0 +1,163 @@
+"""The reference AutoCacheRule suite's exact plan + budget sweep
+(AutocCacheRuleSuite.scala:1-193), ported node for node.
+
+Plan: train data → +1 → +2 → (+3, +4) → +5 → estimator(weight 4) →
+delegating; source → +8 → +9 → (+10, +11) → +12 → delegating's data input.
+With the suite's stubbed profiles, greedy cache selection must produce the
+exact cached sets at budgets 10/75/125/175/350/10000, aggressive must pick
+{+2, +5}, and both end-to-end optimizer runs must still compute
+``apply(5) == 168``.
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.util import Cacher
+from keystone_tpu.workflow import Estimator, Pipeline, PipelineEnv, Transformer
+from keystone_tpu.workflow.autocache import (
+    AggressiveCache,
+    AutoCacheRule,
+    GreedyCache,
+    Profile,
+    SampleProfile,
+    generalize_profiles,
+    greedy_cache_set,
+)
+from keystone_tpu.workflow.executor import GraphExecutor
+from keystone_tpu.workflow.graph import Graph
+from keystone_tpu.workflow.operators import DatasetOperator, DelegatingOperator
+from keystone_tpu.workflow.optimizer import Batch, Once, Optimizer
+
+
+class TransformerPlus(Transformer):
+    def __init__(self, plus: int):
+        self.plus = plus
+
+    def apply(self, x):
+        return x + self.plus
+
+    def __eq__(self, other):
+        return isinstance(other, TransformerPlus) and other.plus == self.plus
+
+    def __hash__(self):
+        return hash(("TransformerPlus", self.plus))
+
+
+class SumEstimator(Estimator):
+    weight = 4
+
+    def fit(self, data: Dataset) -> Transformer:
+        return TransformerPlus(sum(data.to_list()))
+
+
+def _plan():
+    """The suite's 13-node graph; returns (graph, ids dict, source, sink)."""
+    train = Dataset.of([1, 2, 3, 4, 5, 6, 7, 8])
+    g = Graph()
+    g, n0 = g.add_node(DatasetOperator(train), [])
+    g, n1 = g.add_node(TransformerPlus(1), [n0])
+    g, n2 = g.add_node(TransformerPlus(2), [n1])
+    g, n3 = g.add_node(TransformerPlus(3), [n2])
+    g, n4 = g.add_node(TransformerPlus(4), [n2])
+    g, n5 = g.add_node(TransformerPlus(5), [n3, n4])
+    g, n6 = g.add_node(SumEstimator(), [n5])
+    g, src = g.add_source()
+    g, n8 = g.add_node(TransformerPlus(8), [src])
+    g, n9 = g.add_node(TransformerPlus(9), [n8])
+    g, n10 = g.add_node(TransformerPlus(10), [n9])
+    g, n11 = g.add_node(TransformerPlus(11), [n9])
+    g, n12 = g.add_node(TransformerPlus(12), [n10, n11])
+    g, n7 = g.add_node(DelegatingOperator(), [n6, n12])
+    g, sink = g.add_sink(n7)
+    ids = dict(n0=n0, n1=n1, n2=n2, n3=n3, n4=n4, n5=n5, n6=n6, n7=n7)
+    return g, ids, src, sink
+
+
+def _profiles(ids):
+    """The suite's stubbed profiles (AutocCacheRuleSuite.scala:65-72);
+    ns/mem pairs, driverMem omitted (always 0 there)."""
+    big = 1 << 62  # Long.MaxValue stand-in: never fits any budget
+    return {
+        ids["n0"]: Profile(10, big),
+        ids["n1"]: Profile(10, 50),
+        ids["n2"]: Profile(30, 200),
+        ids["n3"]: Profile(20, 1000),
+        ids["n4"]: Profile(20, 1000),
+        ids["n5"]: Profile(20, 100),
+    }
+
+
+class TestGreedyBudgetSweepExact:
+    @pytest.mark.parametrize(
+        "budget,expected",
+        [
+            (10, set()),
+            (75, {"n1"}),
+            (125, {"n5"}),
+            (175, {"n1", "n5"}),
+            (350, {"n2", "n5"}),
+            (10000, {"n2", "n5"}),
+        ],
+    )
+    def test_cached_set_at_budget(self, budget, expected):
+        g, ids, _, _ = _plan()
+        cached = greedy_cache_set(g, _profiles(ids), budget)
+        assert cached == {ids[name] for name in expected}
+
+
+class TestAggressiveExact:
+    def test_aggressive_picks_multiply_consumed_nodes(self):
+        g, ids, _, _ = _plan()
+        rule = AutoCacheRule(AggressiveCache())
+        # +2 feeds two branches; +5 feeds the weight-4 estimator.
+        assert rule._aggressive(g) == {ids["n2"], ids["n5"]}
+
+
+class TestEndToEnd:
+    """pipe.apply(5) == 168 under both caching optimizers
+    (AutocCacheRuleSuite.scala:74-95): train chain fits TransformerPlus(124)
+    (Σ of 1..8 each +11), source chain maps 5 → 44, 44 + 124 = 168."""
+
+    def _run_with(self, strategy):
+        g, _, src, sink = _plan()
+
+        class CacheOnlyOptimizer(Optimizer):
+            batches = [Batch("Auto Cache", Once(), [AutoCacheRule(strategy)])]
+
+        env = PipelineEnv.get_or_create()
+        env.reset()
+        env.set_optimizer(CacheOnlyOptimizer())
+        try:
+            pipe = Pipeline(GraphExecutor(g), src, sink)
+            return pipe.apply(5).get()
+        finally:
+            env.reset()
+
+    def test_greedy_end_to_end(self):
+        assert self._run_with(GreedyCache()) == 168
+
+    def test_aggressive_end_to_end(self):
+        assert self._run_with(AggressiveCache()) == 168
+
+
+class TestGeneralizeProfiles:
+    def test_linear_model_recovers_slope_and_intercept(self):
+        samples = [
+            SampleProfile(2, Profile(ns=3 * 2 + 5, mem_bytes=10 * 2)),
+            SampleProfile(4, Profile(ns=3 * 4 + 5, mem_bytes=10 * 4)),
+        ]
+        p = generalize_profiles(100, samples)
+        assert abs(p.ns - 305.0) < 1e-6
+        assert p.mem_bytes == 1000
+
+    def test_negative_slope_clipped_to_zero(self):
+        # Decreasing measurements must not extrapolate negative costs
+        # (the reference clips the solved coefficients at zero).
+        samples = [
+            SampleProfile(2, Profile(ns=100.0, mem_bytes=100)),
+            SampleProfile(4, Profile(ns=50.0, mem_bytes=50)),
+        ]
+        p = generalize_profiles(1000, samples)
+        assert p.ns >= 0.0
+        assert p.mem_bytes >= 0

@@ -185,8 +185,7 @@ class TestGreedyBudgetSweep:
         return g, d, a, b
 
     def _greedy_with_stub_profiles(self, budget):
-        from keystone_tpu.workflow import autocache
-        from keystone_tpu.workflow.autocache import Profile
+        from keystone_tpu.workflow.autocache import Profile, greedy_cache_set
 
         g, d, a, b = self._graph()
         stub = {
@@ -194,15 +193,7 @@ class TestGreedyBudgetSweep:
             a: Profile(ns=1000.0, mem_bytes=100),
             b: Profile(ns=10.0, mem_bytes=100),
         }
-        orig = autocache.profile_nodes
-        autocache.profile_nodes = lambda graph, nodes, spp: {
-            n: stub[n] for n in nodes
-        }
-        try:
-            rule = AutoCacheRule(GreedyCache(max_mem_bytes=budget))
-            cached = rule._greedy(g, {d, a, b}, rule.strategy)
-        finally:
-            autocache.profile_nodes = orig
+        cached = greedy_cache_set(g, stub, budget)
         return cached, (d, a, b)
 
     def test_zero_budget_caches_nothing(self):

@@ -1,0 +1,255 @@
+"""Quantitative validation of the native-tier featurizers (SIFT, FisherVector).
+
+The reference validates its JNI SIFT against MATLAB ``vl_phow`` output on the
+real ``000012.jpg`` test image (VLFeatSuite.scala:12-40, tolerance: <0.5% of
+entries may differ by more than 1 on the 0..255 short scale) and its
+FisherVector against the committed real VOC codebook (EncEvalSuite.scala).
+The MATLAB golden CSV (feats128.csv) is not in the reference checkout (it was
+fetched at build time) and vlfeat itself is not installable offline, so the
+external yardstick here is an INDEPENDENT literal implementation:
+
+  - SIFT: a plain-numpy dense-SIFT written directly from the vl_dsift
+    specification (gradient orientation histograms, flat-window box pooling,
+    4x4x8 layout, 0.2-clip renormalization, 512-scale), evaluated on the
+    real reference image and compared entry-by-entry at the reference
+    suite's own tolerance.
+  - FisherVector: a plain-numpy posterior + FV-moment implementation
+    (Sanchez et al. formulas, the reference's thresholded-posterior
+    semantics) evaluated against the REAL committed VOC codebook
+    (voc_codebook/{means,variances,priors}).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+_RES = "/root/reference/src/test/resources"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference fixture checkout not available"
+)
+
+
+# ---------------------------------------------------------------------------
+# Independent numpy dense SIFT (vl_dsift spec, flat window)
+# ---------------------------------------------------------------------------
+
+
+def _np_gaussian_blur(img, sigma):
+    """Edge-replicated separable Gaussian, radius ceil(3σ) (the smoothing
+    spec of the extractor; implemented here with numpy correlate loops)."""
+    radius = max(1, int(np.ceil(3.0 * sigma)))
+    xs = np.arange(-radius, radius + 1, dtype=np.float64)
+    k = np.exp(-0.5 * (xs / sigma) ** 2)
+    k /= k.sum()
+
+    def along_axis0(a):
+        padded = np.pad(a, ((radius, radius), (0, 0)), mode="edge")
+        out = np.zeros_like(a)
+        for i, w in enumerate(k):
+            out += w * padded[i : i + a.shape[0], :]
+        return out
+
+    return along_axis0(along_axis0(img).T).T
+
+
+def _np_box_sum(a, size):
+    """Zero-padded box sum matching 'same' conv alignment: output i sums
+    input [i-(size-1)//2, i + size - 1 - (size-1)//2]."""
+    lo = (size - 1) // 2
+    hi = size - 1 - lo
+
+    def axis0(x):
+        padded = np.pad(x, ((lo, hi), (0, 0)))
+        c = np.cumsum(padded, axis=0)
+        c = np.vstack([np.zeros((1, x.shape[1])), c])
+        return c[size:, :] - c[:-size, :]
+
+    return axis0(axis0(a).T).T
+
+
+def numpy_dsift(image, bin_size, step):
+    """Literal dense SIFT for one scale; image (X, Y) grayscale in [0, 1]."""
+    X, Y = image.shape
+    smoothed = _np_gaussian_blur(image.astype(np.float64), bin_size / 6.0)
+
+    dx = np.zeros_like(smoothed)
+    dx[1:-1, :] = (smoothed[2:, :] - smoothed[:-2, :]) * 0.5
+    dy = np.zeros_like(smoothed)
+    dy[:, 1:-1] = (smoothed[:, 2:] - smoothed[:, :-2]) * 0.5
+    mag = np.sqrt(dx * dx + dy * dy)
+    angle = np.arctan2(dy, dx)
+
+    t = np.mod(angle / (2 * np.pi) * 8.0, 8.0)
+    lo = np.floor(t)
+    frac = t - lo
+    lo_i = lo.astype(np.int64) % 8
+    hi_i = (lo_i + 1) % 8
+    planes = np.zeros((8, X, Y))
+    xi, yi = np.meshgrid(np.arange(X), np.arange(Y), indexing="ij")
+    np.add.at(planes, (lo_i, xi, yi), mag * (1.0 - frac))
+    np.add.at(planes, (hi_i, xi, yi), mag * frac)
+
+    pooled = np.stack([_np_box_sum(p, bin_size) for p in planes])
+
+    extent = 3 * bin_size + bin_size // 2
+    anchors_x = np.arange(0, X - extent, step)
+    anchors_y = np.arange(0, Y - extent, step)
+    centers = np.arange(4) * bin_size + bin_size // 2
+
+    descs = []
+    for ax in anchors_x:
+        for ay in anchors_y:
+            d = np.zeros((4, 4, 8))
+            for bx in range(4):
+                for by in range(4):
+                    d[bx, by, :] = pooled[:, ax + centers[bx], ay + centers[by]]
+            descs.append(d.reshape(128))
+    desc = np.asarray(descs)
+
+    norm = np.sqrt(np.sum(desc * desc, axis=1, keepdims=True))
+    d1 = desc / np.maximum(norm, 1e-12)
+    d1 = np.minimum(d1, 0.2)
+    norm2 = np.sqrt(np.sum(d1 * d1, axis=1, keepdims=True))
+    d2 = d1 / np.maximum(norm2, 1e-12)
+    d2 = np.where(norm > 0.005, d2, 0.0)
+    return np.minimum(np.floor(512.0 * d2), 255.0).T  # (128, n)
+
+
+def _load_real_image(max_side=180):
+    from PIL import Image
+
+    img = Image.open(os.path.join(_RES, "images/000012.jpg")).convert("L")
+    scale = max_side / max(img.size)
+    img = img.resize(
+        (int(img.size[0] * scale), int(img.size[1] * scale)), Image.BILINEAR
+    )
+    # (X, Y) layout: transpose PIL's (W, H)-indexed array.
+    return np.asarray(img, dtype=np.float64).T / 255.0
+
+
+class TestSIFTAgainstIndependentImplementation:
+    @pytest.mark.parametrize("bin_size,step", [(4, 3), (6, 4)])
+    def test_single_scale_matches_literal_numpy(self, bin_size, step):
+        from keystone_tpu.ops.images.sift import _scale_descriptors
+
+        image = _load_real_image()
+        ours = np.asarray(
+            _scale_descriptors(
+                np.asarray(image, np.float32), bin_size=bin_size, step=step
+            )
+        )
+        ref = numpy_dsift(image, bin_size, step)
+        assert ours.shape == ref.shape and ours.shape[1] > 100
+
+        # The reference suite's own gate (VLFeatSuite.scala:47-52): fewer
+        # than 0.5% of entries may differ by more than 1.
+        frac_off = float(np.mean(np.abs(ours - ref) > 1.0))
+        assert frac_off < 0.005, f"{frac_off:.4%} of entries off by > 1"
+
+    def test_multi_scale_extractor_on_real_image(self):
+        from keystone_tpu.ops.images.sift import SIFTExtractor
+
+        image = _load_real_image()
+        ext = SIFTExtractor(step_size=3, bin_size=4, scales=2, scale_step=1)
+        descs = np.asarray(ext.apply(np.asarray(image, np.float32)))
+        assert descs.shape[0] == 128
+        # Real-image content: descriptors span the short range and are not
+        # degenerate.
+        assert descs.max() > 100
+        assert (descs.sum(axis=0) > 0).mean() > 0.9
+
+
+# ---------------------------------------------------------------------------
+# FisherVector against the real VOC codebook
+# ---------------------------------------------------------------------------
+
+
+def _np_posteriors(X, means, variances, weights, thr=1e-4):
+    """Literal numpy port of the reference posterior math
+    (GaussianMixtureModel.scala:47-83): Mahalanobis via the three-term
+    expansion, shift-exp-normalize, aggressive thresholding, renormalize."""
+    mu = means.T  # (k, d)
+    var = variances.T
+    sq = (
+        (X * X) @ (0.5 / var).T
+        - X @ (mu / var).T
+        + 0.5 * np.sum(mu * mu / var, axis=1)[None, :]
+    )
+    llh = (
+        -0.5 * X.shape[1] * np.log(2 * np.pi)
+        - 0.5 * np.sum(np.log(var), axis=1)[None, :]
+        + np.log(weights)[None, :]
+        - sq
+    )
+    llh -= llh.max(axis=1, keepdims=True)
+    p = np.exp(llh)
+    p /= p.sum(axis=1, keepdims=True)
+    p = np.where(p > thr, p, 0.0)
+    return p / p.sum(axis=1, keepdims=True)
+
+
+def _np_fisher(x, means, variances, weights):
+    """Sanchez et al. FV from moments (FisherVector.scala:38-50)."""
+    n = x.shape[1]
+    q = _np_posteriors(x.T, means, variances, weights)
+    s0 = q.mean(axis=0)
+    s1 = (x @ q) / n
+    s2 = ((x * x) @ q) / n
+    fv1 = (s1 - means * s0[None, :]) / (
+        np.sqrt(variances) * np.sqrt(weights)[None, :]
+    )
+    fv2 = (s2 - 2.0 * means * s1 + (means * means - variances) * s0[None, :]) / (
+        variances * np.sqrt(2.0 * weights)[None, :]
+    )
+    return np.concatenate([fv1, fv2], axis=1)
+
+
+class TestFisherVectorAgainstRealCodebook:
+    def _codebook(self):
+        from keystone_tpu.ops.learning.clustering import GaussianMixtureModel
+
+        base = os.path.join(_RES, "images/voc_codebook")
+        return GaussianMixtureModel.load(
+            os.path.join(base, "means.csv"),
+            os.path.join(base, "variances.csv"),
+            os.path.join(base, "priors"),
+        )
+
+    def test_codebook_loads_with_reference_geometry(self):
+        gmm = self._codebook()
+        assert np.asarray(gmm.means).shape == (80, 256)
+        assert np.asarray(gmm.variances).shape == (80, 256)
+        w = np.asarray(gmm.weights)
+        assert w.shape == (256,) and abs(w.sum() - 1.0) < 1e-3
+
+    def test_fv_matches_independent_numpy_on_real_codebook(self):
+        from keystone_tpu.ops.images.fisher import FisherVector
+
+        gmm = self._codebook()
+        rng = np.random.default_rng(0)
+        # Descriptor-like inputs drawn around real codebook centers so the
+        # posteriors exercise the thresholding path non-trivially.
+        means = np.asarray(gmm.means, dtype=np.float64)  # (80, 256)
+        pick = rng.integers(0, 256, size=300)
+        x = (
+            means[:, pick]
+            + rng.normal(size=(80, 300))
+            * np.sqrt(np.asarray(gmm.variances))[:, pick]
+        )
+
+        ours = np.asarray(FisherVector(gmm).apply(x.astype(np.float32)))
+        ref = _np_fisher(
+            x,
+            means,
+            np.asarray(gmm.variances, dtype=np.float64),
+            np.asarray(gmm.weights, dtype=np.float64),
+        )
+        assert ours.shape == ref.shape == (80, 512)
+        # f32 pipeline vs f64 literal: relative agreement on the FV scale.
+        denom = np.maximum(np.abs(ref).max(), 1e-9)
+        assert np.abs(ours - ref).max() / denom < 5e-3
+        # The EncEval suite asserts on the FV sum (EncEvalSuite.scala:38-41);
+        # check ours against the independent implementation the same way.
+        assert abs(ours.sum() - ref.sum()) < 1e-2 * max(1.0, abs(ref.sum()))
