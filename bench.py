@@ -42,7 +42,11 @@ BASELINE_MS = 580_555.0  # scripts/solver-comparisons-final.csv:26 (d=16384, Blo
 BASELINE_ASSUMED_EPOCHS = 3
 NUM_FEATURES = 16384
 BLOCK_SIZE = 4096  # reference TimitPipeline blockSize (TimitPipeline.scala:37-109)
-NUM_EPOCHS = int(os.environ.get("BENCH_EPOCHS", "1"))
+# Default 3 BCD sweeps — the baseline CSV row's inferred count (see the
+# scaling-site comment), so the default comparison needs no epoch-ratio
+# adjustment at all. Epochs 2+ reuse the stashed per-block Gramians and
+# cost ~4% of the first sweep.
+NUM_EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 
 
 def main():

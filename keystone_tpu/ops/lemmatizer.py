@@ -140,7 +140,7 @@ def _restore_e(stem: str) -> str:
         )
         if single_vowel and _vowel_groups(stem) == 1:
             return stem + "e"
-    if stem.endswith(("at", "iz", "ys", "creat")) and _vowel_groups(stem) <= 2:
+    if stem.endswith(("at", "iz", "ys")) and _vowel_groups(stem) <= 2:
         return stem + "e"
     if len(stem) >= 1 and stem[-1] in "uv":  # argu-, lov-, believ-, continu-
         return stem + "e"
@@ -198,10 +198,12 @@ def _strip_ing(w: str) -> str:
 def lemmatize(word: str) -> str:
     """Best-effort inflectional lemma of a lowercased token."""
     w = word.lower()
-    if len(w) <= 2:
-        return w
+    # Irregulars first: "is"/"am" are two-letter words that must still map
+    # to "be", so the table outranks the short-word guard.
     if w in _IRREGULAR:
         return _IRREGULAR[w]
+    if len(w) <= 2:
+        return w
     if w.endswith("ing"):
         return _strip_ing(w)
     if w.endswith("ed"):

@@ -286,25 +286,56 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
 
 
 @functools.partial(
-    jax.jit, static_argnames=("block", "lam", "num_iter", "use_pallas", "sym")
+    jax.jit,
+    static_argnames=("block", "lam", "num_iter", "use_pallas", "sym",
+                     "cache_grams"),
 )
 def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
-                           use_pallas: bool, sym: bool):
+                           use_pallas: bool, sym: bool,
+                           cache_grams: bool = False):
     nb = F.shape[1] // block
+    acc_dtype = jnp.promote_types(F.dtype, jnp.float32)
 
-    def do_block(bi, R, W):
+    def slice_block(F, W, bi):
         Ab = jax.lax.dynamic_slice_in_dim(F, bi * block, block, axis=1)
         Wb = jax.lax.dynamic_index_in_dim(W, bi, axis=0, keepdims=False)
-        R, Wb_new, _ = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
-        return R, jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0)
+        return Ab, Wb
 
-    def epoch(_, carry):
-        def body(bi, c):
-            return do_block(bi, *c)
+    def first_block(bi, carry):
+        """First sweep: compute (and, when caching, stash) each block's
+        Gramian — it is loop-invariant across epochs, and recomputing it is
+        the dominant per-epoch cost (n·d_b² vs the correlation's n·d_b·k)."""
+        R, W, G = carry
+        Ab, Wb = slice_block(F, W, bi)
+        R, Wb_new, gram = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
+        W = jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0)
+        if cache_grams:
+            G = jax.lax.dynamic_update_index_in_dim(
+                G, gram.astype(acc_dtype), bi, 0
+            )
+        return R, W, G
 
-        return jax.lax.fori_loop(0, nb, body, carry)
+    def later_block(bi, carry):
+        R, W, G = carry
+        Ab, Wb = slice_block(F, W, bi)
+        gram = jax.lax.dynamic_index_in_dim(G, bi, axis=0, keepdims=False)
+        R, Wb_new, _ = _bcd_block_update(
+            Ab, R, Wb, lam, use_pallas, sym, gram=gram
+        )
+        return R, jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0), G
 
-    R, W = jax.lax.fori_loop(0, num_iter, epoch, (B, W0))
+    G0 = jnp.zeros(
+        (nb, block, block) if cache_grams else (0, 0, 0), dtype=acc_dtype
+    )
+    R, W, G = jax.lax.fori_loop(0, nb, first_block, (B, W0, G0))
+
+    if num_iter > 1:
+        body = later_block if cache_grams else first_block
+
+        def epoch(_, carry):
+            return jax.lax.fori_loop(0, nb, body, carry)
+
+        R, W, G = jax.lax.fori_loop(0, num_iter - 1, epoch, (R, W, G))
     return W, R
 
 
@@ -324,8 +355,10 @@ def bcd_least_squares_fused_flat(
     contiguous buffer — at large n the stacked layout cannot be produced
     without a second full-size copy (stack of independently-computed block
     buffers), which is the difference between fitting in HBM and not.
-    Unlike the stacked path, Gramians are recomputed each epoch (trading
-    FLOPs for the nb*d_b² stash — rematerialization economics).
+    Multi-epoch runs stash the loop-invariant per-block Gramians when the
+    (nb, d_b, d_b) buffer is small next to HBM (≤1 GB), making epochs 2+
+    pay only the correlation + solve + residual update; larger models fall
+    back to recomputation (rematerialization economics).
     """
     from keystone_tpu.ops import pallas_ops
 
@@ -341,9 +374,14 @@ def bcd_least_squares_fused_flat(
     if use_pallas is None:
         use_pallas = pallas_ops.pallas_direct_ok(F)
     W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=B.dtype)
+    acc_itemsize = jnp.promote_types(F.dtype, jnp.float32).itemsize
+    cache_grams = (
+        int(num_iter) > 1
+        and nb * block_size * block_size * acc_itemsize <= (1 << 30)
+    )
     W, R = _bcd_fused_flat_kernel(
         F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
-        bool(use_pallas), True,
+        bool(use_pallas), True, cache_grams,
     )
     return (W, R) if return_residual else W
 

@@ -190,16 +190,16 @@ def cached_mem(cached: Set[NodeId], profiles: Dict[NodeId, Profile]) -> int:
 
 
 def _still_room(
-    cached: Set[NodeId],
+    excluded: Set[NodeId],
     runs: Dict[NodeId, int],
     profiles: Dict[NodeId, Profile],
     space_left: int,
 ) -> bool:
-    """True iff an uncached node used >1 time would fit if cached
+    """True iff an eligible node used >1 time would fit if cached
     (stillRoom, AutoCacheRule.scala:529-541)."""
     return any(
         runs[n] > 1
-        and n not in cached
+        and n not in excluded
         and profiles.get(n, Profile()).mem_bytes < space_left
         for n in runs
     )
@@ -209,16 +209,18 @@ def _select_next(
     graph: Graph,
     profiles: Dict[NodeId, Profile],
     cached: Set[NodeId],
+    excluded: Set[NodeId],
     runs: Dict[NodeId, int],
     space_left: int,
 ) -> NodeId:
-    """The fitting uncached node that minimizes estimated runtime when
-    cached (selectNext, AutoCacheRule.scala:543-557). Ties break on NodeId
-    order for determinism."""
+    """The fitting eligible node that minimizes estimated runtime when
+    cached (selectNext, AutoCacheRule.scala:543-557). ``excluded`` bars
+    nodes from being picked; the runtime estimate itself uses only the
+    truly ``cached`` set. Ties break on NodeId order for determinism."""
     eligible = [
         n
         for n in sorted(graph.nodes, key=lambda n: n.id)
-        if n not in cached
+        if n not in excluded
         and profiles.get(n, Profile()).mem_bytes < space_left
         and runs[n] > 1
     ]
@@ -233,23 +235,37 @@ def greedy_cache_set(
     profiles: Dict[NodeId, Profile],
     max_mem: int,
 ) -> Set[NodeId]:
-    """The greedy selection loop (greedyCache, AutoCacheRule.scala:559-602),
-    returning the set of nodes to cache (source descendants excluded)."""
+    """The greedy selection loop (greedyCache, AutoCacheRule.scala:559-602).
+
+    Divergence from the reference: source descendants are excluded from
+    *selection*, not just subtracted from the result afterwards. The
+    reference lets an unprofiled (mem-0) source descendant win selectNext
+    when caching it would absorb its profiled ancestors' recompute savings,
+    then strips it at the end — leaving the expensive ancestors uncached
+    (a latent mis-selection its own suite never hits, since there the
+    profiled candidates always dominate strictly).
+    """
     cached = init_cache_set(graph)
+    source_desc = descendants_of_sources(graph)
     runs = compute_runs(graph, cached)
     to_cache: Set[NodeId] = set()
     used = cached_mem(cached, profiles)
     while used < max_mem and _still_room(
-        cached | to_cache, runs, profiles, max_mem - used
+        cached | to_cache | source_desc, runs, profiles, max_mem - used
     ):
         to_cache.add(
             _select_next(
-                graph, profiles, cached | to_cache, runs, max_mem - used
+                graph,
+                profiles,
+                cached | to_cache,
+                cached | to_cache | source_desc,
+                runs,
+                max_mem - used,
             )
         )
         runs = compute_runs(graph, cached | to_cache)
         used = cached_mem(cached | to_cache, profiles)
-    return to_cache - descendants_of_sources(graph)
+    return to_cache
 
 
 def _insert_cachers(plan: Graph, nodes: Set[NodeId]) -> Graph:

@@ -141,6 +141,34 @@ class TestEndToEnd:
         assert self._run_with(AggressiveCache()) == 168
 
 
+class TestSourceDescendantSelection:
+    def test_source_descendants_cannot_absorb_ancestor_savings(self):
+        """A mixed-ancestry fan-out node downstream of a source must not win
+        greedy selection (its unprofiled mem-0 entry would absorb the
+        profiled ancestors' savings and then be stripped, leaving the
+        expensive nodes uncached — the latent reference mis-selection)."""
+        train = Dataset.of([1, 2, 3, 4])
+        g = Graph()
+        g, d = g.add_node(DatasetOperator(train), [])
+        g, a = g.add_node(TransformerPlus(1), [d])
+        g, b = g.add_node(TransformerPlus(2), [a])
+        g, src = g.add_source()
+        # Mixed ancestry: depends on the expensive train side AND the source.
+        g, est = g.add_node(SumEstimator(), [b])
+        g, mix = g.add_node(DelegatingOperator(), [est, src])
+        g, fan1 = g.add_node(TransformerPlus(3), [mix])
+        g, fan2 = g.add_node(TransformerPlus(4), [mix])
+        g, s1 = g.add_sink(fan1)
+        g, s2 = g.add_sink(fan2)
+
+        profiles = {
+            a: Profile(1000, 10),
+            b: Profile(1000, 10),
+        }
+        cached = greedy_cache_set(g, profiles, 10_000)
+        assert cached == {b}  # caching b (fed to weight-4 estimator) wins
+
+
 class TestGeneralizeProfiles:
     def test_linear_model_recovers_slope_and_intercept(self):
         samples = [

@@ -65,10 +65,14 @@ class TestLemmatizer:
         assert acc >= 0.95, f"accuracy {acc:.2%}; misses: {wrong}"
 
     def test_idempotent_on_lemmas(self):
+        # Known approximations and genuinely ambiguous surface forms
+        # (e.g. "little"/"far" re-enter the irregular table via their own
+        # comparatives only, not as keys) are skipped explicitly.
+        skip = {"buse"}
         for _, lemma in CASES:
-            if lemma in ("buse",):  # known approximation
+            if lemma in skip:
                 continue
-            assert lemmatize(lemma) in (lemma, lemmatize(lemma))
+            assert lemmatize(lemma) == lemma, (lemma, lemmatize(lemma))
 
     def test_corenlp_extractor_uses_it(self):
         from keystone_tpu.ops.nlp import CoreNLPFeatureExtractor
