@@ -222,8 +222,11 @@ _TWO_PI = 6.283185307179586
 def _fast_cos(x):
     """Range-reduce to [-π, π] and evaluate the even minimax polynomial.
 
-    Accurate to ~4e-7 for |x| up to a few hundred (range-reduction rounding
-    grows with |x|·eps; the cosine-feature pre-activations are O(10))."""
+    Accuracy is |x|-proportional through the single-constant f32 range
+    reduction: ~4e-7 for |x| ≲ 10 (the cosine-feature regime — O(1)
+    pre-activations plus a [0, 2π) phase), ~6e-6 at |x| ≈ 100, ~2e-5 at
+    |x| ≈ 300 — the same order as f32's own argument-rounding error for
+    the library cos at those magnitudes."""
     q = jnp.floor(x * (1.0 / _TWO_PI) + 0.5)
     r = x - q * _TWO_PI
     r2 = r * r

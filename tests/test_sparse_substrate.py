@@ -65,6 +65,32 @@ class TestSpmmKernels:
         )
         np.testing.assert_allclose(out, dense.T @ V, atol=1e-12)
 
+    @pytest.mark.parametrize("k", [40, 147])
+    def test_wide_k_chunked_paths_match_dense(self, k):
+        """k > 32 takes the row-chunked formulations (the small-k per-column
+        path would cost k passes; the naive (n·w, k) layout lane-pads tiny
+        minor dims 64x on TPU)."""
+        rng = np.random.default_rng(7)
+        n, d, nnz = 100, 25, 6  # n not divisible by the chunk -> pad lanes
+        indices, values = _random_sparse(rng, n, d, nnz)
+        W = rng.normal(size=(d, k))
+        V = rng.normal(size=(n, k))
+        dense = np.asarray(
+            densify_dataset(
+                Dataset({"indices": indices, "values": values}, n=n), d
+            ).array
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse_matmul(indices, values, jnp.asarray(W))),
+            dense @ W,
+            atol=1e-12,
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse_matmul_t(indices, values, jnp.asarray(V), d)),
+            dense.T @ V,
+            atol=1e-12,
+        )
+
     def test_duplicate_indices_accumulate(self):
         # COO semantics: repeated indices sum (matches scatter-add densify).
         indices = np.array([[2, 2, -1]], dtype=np.int32)
