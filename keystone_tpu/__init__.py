@@ -8,6 +8,27 @@ distributed solvers, and example end-to-end workloads — with sharded
 `jax.Array`s over a TPU device mesh in place of RDDs over a Spark cluster.
 """
 
+import os as _os
+
+import jax as _jax
+
+# f32 means f32: TPU's out-of-the-box matmul default runs float32 operands
+# through a single lossy bfloat16 pass, which silently corrupts the solver
+# paths that CPU tests validate exactly (observed: finite-but-garbage
+# Cholesky factors and diverging BCD sweeps on rank-deficient blocks; the
+# triangular solves inside cho_solve/LU cannot take a per-op precision
+# flag). bfloat16 compute stays an explicit choice via bf16 operands
+# (feature layouts, Pallas compute_dtype) — those are unaffected by this
+# default. A precision the host application configured before importing
+# this package is respected; KEYSTONE_MATMUL_PRECISION overrides both.
+if "KEYSTONE_MATMUL_PRECISION" in _os.environ:
+    _jax.config.update(
+        "jax_default_matmul_precision",
+        _os.environ["KEYSTONE_MATMUL_PRECISION"],
+    )
+elif _jax.config.jax_default_matmul_precision is None:
+    _jax.config.update("jax_default_matmul_precision", "float32")
+
 from keystone_tpu.data import Dataset, LabeledData
 from keystone_tpu.workflow import (
     Chainable,

@@ -56,15 +56,20 @@ def _interpret() -> bool:
 
 def _dot_kwargs(compute_dtype):
     """MXU precision recipe: float32 operands need precision=HIGHEST (the
-    default is a single bf16 pass, ~1e-1 absolute error on O(1) data);
-    bfloat16 operands hit the MXU natively and accumulate in float32 via
-    preferred_element_type."""
+    TPU hardware default is a single bf16 pass, ~1e-1 absolute error on O(1)
+    data); bfloat16 operands hit the MXU natively and accumulate in float32
+    via preferred_element_type — with precision pinned to DEFAULT so the
+    package-wide f32 matmul default cannot leak a contract_precision<fp32>
+    attribute onto bf16 vectors (which crashes Mosaic)."""
     if compute_dtype == jnp.float32:
         return dict(
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
         )
-    return dict(preferred_element_type=jnp.float32)
+    return dict(
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.DEFAULT,
+    )
 
 
 def pallas_enabled() -> bool:

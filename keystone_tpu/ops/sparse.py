@@ -101,12 +101,13 @@ _COLWISE_MAX_K = 32
 _CHUNK_ELEMS = 1 << 20  # row-chunk size divisor for the wide-k paths
 
 
-def _row_chunks(safe, vals, pad_index=0):
+def _row_chunks(safe, vals, k, pad_index=0):
     """Split (n, w) index/value arrays into (nchunks, chunk, w) row chunks
-    for the wide-k paths, bounding each chunk's padded transient. The chunk
-    is capped at n so small batches are not inflated to the chunk quantum."""
+    for the wide-k paths, bounding each chunk's (chunk, w, k) transient at
+    ~_CHUNK_ELEMS elements. The chunk is capped at n so small batches are
+    not inflated to the chunk quantum."""
     n, w = safe.shape
-    chunk = min(max(n, 1), max(1, _CHUNK_ELEMS // max(w, 1)))
+    chunk = min(max(n, 1), max(1, _CHUNK_ELEMS // max(w * k, 1)))
     nchunks = -(-n // chunk)
     pad = nchunks * chunk - n
     safe_p = jnp.pad(safe, ((0, pad), (0, 0)), constant_values=pad_index)
@@ -146,7 +147,7 @@ def sparse_matmul(indices, values, W):
         ]
         return jnp.stack(cols, axis=1)
 
-    safe_p, vals_p, nchunks, chunk, _ = _row_chunks(safe, vals)
+    safe_p, vals_p, nchunks, chunk, _ = _row_chunks(safe, vals, k)
 
     def body(xs):
         s, va = xs
@@ -188,7 +189,9 @@ def sparse_matmul_t(indices, values, V, d: int):
         ]
         return jnp.stack(cols, axis=1)[:d]
 
-    safe_p, vals_p, nchunks, chunk, pad = _row_chunks(safe, vals, pad_index=d)
+    safe_p, vals_p, nchunks, chunk, pad = _row_chunks(
+        safe, vals, k, pad_index=d
+    )
     V_p = jnp.pad(V, ((0, pad), (0, 0))).reshape(nchunks, chunk, k)
 
     def body(acc, xs):

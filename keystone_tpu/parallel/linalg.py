@@ -31,11 +31,34 @@ from . import mesh as mesh_lib
 
 
 def _solve_psd(gram, rhs, lam):
-    """Solve (gram + lam I) x = rhs via Cholesky (gram PSD)."""
+    """Solve (gram + lam I) x = rhs via Cholesky (gram PSD).
+
+    Rank-deficient Gramians (fewer rows than block columns — demo-scale fits
+    of wide blocks) with zero/tiny lam defeat the f32 Cholesky (negative
+    pivots from rounding -> NaN factor). Those solves rescue through an LU
+    solve with a scale-relative jitter; healthy Gramians keep the exact
+    Cholesky path bit for bit. (The reference inherits this robustness from
+    Breeze's `\\` operator, which LU-solves; mlmatrix NormalEquations.)
+    """
     d = gram.shape[0]
-    regularized = gram + lam * jnp.eye(d, dtype=gram.dtype)
-    chol = jax.scipy.linalg.cholesky(regularized, lower=True)
-    return jax.scipy.linalg.cho_solve((chol, True), rhs)
+    eye = jnp.eye(d, dtype=gram.dtype)
+    chol = jax.scipy.linalg.cholesky(gram + lam * eye, lower=True)
+    sol = jax.scipy.linalg.cho_solve((chol, True), rhs)
+
+    def rescue(_):
+        jitter = (jnp.trace(gram) / d) * jnp.asarray(1e-4, gram.dtype) + lam
+        return jnp.linalg.solve(gram + jitter * eye, rhs)
+
+    # Acceptance is by the linear system's relative residual, not factor
+    # finiteness: a failed f32 Cholesky can also produce finite-but-garbage
+    # factors (observed on TPU) whose solutions blow up the BCD sweep. The
+    # check costs one (d,d)@(d,k) GEMM — noise next to the Gramian build.
+    lin_res = gram @ sol + lam * sol - rhs
+    ok = jnp.all(jnp.isfinite(sol)) & (
+        jnp.linalg.norm(lin_res)
+        <= jnp.asarray(1e-2, gram.dtype) * (jnp.linalg.norm(rhs) + 1e-30)
+    )
+    return jax.lax.cond(ok, lambda _: sol, rescue, None)
 
 
 @functools.partial(jax.jit, static_argnames=("lam",))

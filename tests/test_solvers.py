@@ -208,6 +208,24 @@ class TestSketchedLeastSquares:
         assert has_sketched(LeastSquaresEstimator(lam=0.1, allow_approximate=True))
 
 
+class TestRankDeficientBlocks:
+    def test_wide_block_f32_lam_zero_stays_finite(self):
+        """block_size > n with λ=0 in f32: the rank-deficient Gramian defeats
+        Cholesky; the scale-relative LU rescue must keep the solve finite and
+        near the minimum-norm fit (the TimitPipeline demo shape that returned
+        99% NaN-error before round 2)."""
+        rng = np.random.default_rng(0)
+        n, d, k = 48, 128, 3
+        F = rng.normal(size=(n, d)).astype(np.float32)
+        Y = rng.normal(size=(n, k)).astype(np.float32)
+        est = BlockLeastSquaresEstimator(block_size=d, num_iter=2, lam=0.0)
+        model = est.fit(Dataset.of(F), Dataset.of(Y))
+        preds = np.asarray(model.batch_apply(Dataset.of(F)).array)
+        assert np.isfinite(preds).all()
+        # d > n: the (jittered) interpolating fit should be near-exact.
+        assert np.abs(preds - Y).max() < 0.05
+
+
 class TestNystromKernelRidge:
     def _problem(self):
         rng = np.random.default_rng(3)

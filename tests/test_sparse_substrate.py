@@ -66,12 +66,16 @@ class TestSpmmKernels:
         np.testing.assert_allclose(out, dense.T @ V, atol=1e-12)
 
     @pytest.mark.parametrize("k", [40, 147])
-    def test_wide_k_chunked_paths_match_dense(self, k):
+    def test_wide_k_chunked_paths_match_dense(self, k, monkeypatch):
         """k > 32 takes the row-chunked formulations (the small-k per-column
         path would cost k passes; the naive (n·w, k) layout lane-pads tiny
-        minor dims 64x on TPU)."""
+        minor dims 64x on TPU). _CHUNK_ELEMS is shrunk so the chunk loop
+        and its ghost-index pad lanes actually execute."""
+        from keystone_tpu.ops import sparse as sparse_mod
+
+        monkeypatch.setattr(sparse_mod, "_CHUNK_ELEMS", 30 * 6 * 40)
         rng = np.random.default_rng(7)
-        n, d, nnz = 100, 25, 6  # n not divisible by the chunk -> pad lanes
+        n, d, nnz = 100, 25, 6  # 100 rows over ~30-row chunks -> pad lanes
         indices, values = _random_sparse(rng, n, d, nnz)
         W = rng.normal(size=(d, k))
         V = rng.normal(size=(n, k))
