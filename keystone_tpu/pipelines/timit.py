@@ -76,6 +76,22 @@ def run(config: TimitConfig):
     else:
         train = synthetic_timit(config.synthetic_n, seed=config.seed)
         test = synthetic_timit(max(config.synthetic_n // 4, 256), seed=config.seed + 1)
+        # The reference default (numCosines=50 -> 204,800 features) is a
+        # 2.2M-row cluster shape (TimitPipeline.scala:30); at the synthetic
+        # demo's row count it is absurdly overparametrized and overflows a
+        # single chip's HBM. Cap the demo's feature width at 8n; explicit
+        # real-data runs keep whatever was asked for.
+        max_branches = max(
+            1, (8 * config.synthetic_n) // max(config.block_size, 1)
+        )
+        if config.num_cosines > max_branches:
+            from dataclasses import replace
+
+            logger.info(
+                "synthetic demo: capping numCosines %d -> %d (d <= 8n)",
+                config.num_cosines, max_branches,
+            )
+            config = replace(config, num_cosines=max_branches)
 
     labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
 
