@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import functools
 import logging
-from typing import List, Optional
+from typing import Optional
 
 import jax
 import jax.numpy as jnp

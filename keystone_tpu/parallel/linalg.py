@@ -20,14 +20,23 @@ Conventions (matching the reference solvers):
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from . import mesh as mesh_lib
+
+
+def _corr(a, r):
+    """AᵀR with at-least-f32 accumulation and the f32-operand precision
+    pin — the correlation contraction shared by every BCD path."""
+    acc = jnp.promote_types(a.dtype, jnp.float32)
+    return jax.lax.dot_general(
+        a, r.astype(a.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=acc, **_hi_kwargs(a.dtype),
+    )
 
 
 def _solve_psd(gram, rhs, lam):
@@ -87,17 +96,20 @@ def normal_equations_solve(A, B, lam: float = 0.0):
 
 
 @functools.partial(jax.jit, static_argnames=("lam",), donate_argnums=(2,))
-def _bcd_block_step(Ab, Wb, R, lam: float):
+def _bcd_block_step(Ab, Wb, R, lam: float, gram=None):
     """One Gauss-Seidel block update.
 
-    Solves (AbᵀAb + λI) Wb' = Abᵀ(R + Ab Wb), returns (Wb', R') with
-    R' = R - Ab (Wb' - Wb). R is donated (updated in place on device).
+    Solves (AbᵀAb + λI) Wb' = Abᵀ(R + Ab Wb), returns (Wb', R', AbᵀAb) with
+    R' = R - Ab (Wb' - Wb). R is donated (updated in place on device). Pass
+    ``gram`` to reuse a previous epoch's loop-invariant Gramian — only the
+    correlation then touches the data.
     """
-    gram = Ab.T @ Ab
+    if gram is None:
+        gram = Ab.T @ Ab
     rhs = Ab.T @ R + gram @ Wb
     Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=Ab.dtype))
     R_new = R - Ab @ (Wb_new - Wb)
-    return Wb_new, R_new
+    return Wb_new, R_new, gram
 
 
 @functools.lru_cache(maxsize=None)
@@ -125,10 +137,7 @@ def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
                 a, a, (((0,), (0,)), ((), ())), preferred_element_type=acc,
                 **_hi_kwargs(a.dtype),
             )
-            corr = jax.lax.dot_general(
-                a, r.astype(a.dtype), (((0,), (0,)), ((), ())),
-                preferred_element_type=acc, **_hi_kwargs(a.dtype),
-            )
+            corr = _corr(a, r)
         return jax.lax.psum(gram, axis), jax.lax.psum(corr, axis)
 
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes info,
@@ -142,16 +151,34 @@ def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
         check_vma=False,
     )
 
-    @functools.partial(jax.jit, donate_argnums=(2,))
-    def step(Ab, Wb, R):
-        gram, corr = sharded_gram_corr(Ab, R)
+    sharded_corr = jax.shard_map(
+        lambda a, r: jax.lax.psum(_corr(a, r), axis),
+        mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(),
+        check_vma=False,
+    )
+
+    def finish(Ab, Wb, R, gram, corr):
         Wb = Wb.astype(gram.dtype)
         rhs = corr + gram @ Wb
         Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
         delta = (Ab @ (Wb_new - Wb).astype(Ab.dtype)).astype(R.dtype)
         return Wb_new, R - delta
 
-    return step
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step(Ab, Wb, R):
+        gram, corr = sharded_gram_corr(Ab, R)
+        Wb_new, R_new = finish(Ab, Wb, R, gram, corr)
+        return Wb_new, R_new, gram
+
+    @functools.partial(jax.jit, donate_argnums=(2,))
+    def step_cached(Ab, Wb, R, gram):
+        """Later epochs: the Gramian is loop-invariant — only the
+        correlation re-reads the sharded rows."""
+        corr = sharded_corr(Ab, R)
+        Wb_new, R_new = finish(Ab, Wb, R, gram, corr)
+        return Wb_new, R_new
+
+    return step, step_cached
 
 
 def bcd_least_squares(
@@ -196,16 +223,36 @@ def bcd_least_squares(
     if multi:
         if use_pallas is None:
             use_pallas = pallas_ops.pallas_enabled()
-        step = _mesh_bcd_step(mesh, float(lam), bool(use_pallas))
+        step, step_cached = _mesh_bcd_step(mesh, float(lam), bool(use_pallas))
     else:
-        step = None
+        step = step_cached = None
+
+    # Stash loop-invariant per-block Gramians across epochs when the stash
+    # is small beside HBM (same policy as the fused flat path).
+    gram_bytes = sum(
+        int(a.shape[1]) ** 2
+        * jnp.promote_types(jnp.asarray(a).dtype, jnp.float32).itemsize
+        for a in A_blocks
+    )
+    cache_grams = max(num_iter, 1) > 1 and gram_bytes <= (1 << 30)
+    grams: List = [None] * len(A_blocks)
 
     for _ in range(max(num_iter, 1)):
         for b, Ab in enumerate(A_blocks):
+            Ab = jnp.asarray(Ab)
             if step is not None:
-                Ws[b], R = step(jnp.asarray(Ab), Ws[b], R)
+                if grams[b] is not None:
+                    Ws[b], R = step_cached(Ab, Ws[b], R, grams[b])
+                else:
+                    Ws[b], R, gram = step(Ab, Ws[b], R)
+                    if cache_grams:
+                        grams[b] = gram
             else:
-                Ws[b], R = _bcd_block_step(jnp.asarray(Ab), Ws[b], R, float(lam))
+                Ws[b], R, gram = _bcd_block_step(
+                    Ab, Ws[b], R, float(lam), grams[b]
+                )
+                if cache_grams and grams[b] is None:
+                    grams[b] = gram
             mesh_lib.sync_if_cpu(R)
     return Ws
 
@@ -295,10 +342,7 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
                 Ab, Ab, (((0,), (0,)), ((), ())),
                 preferred_element_type=acc_dtype, **hi,
             )
-        corr = jax.lax.dot_general(
-            Ab, R.astype(feat_dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=acc_dtype, **hi,
-        )
+        corr = _corr(Ab, R)
     rhs = corr + gram @ Wb
     Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
     delta = jax.lax.dot_general(

@@ -26,12 +26,10 @@ collectives, compiled once over the whole mesh.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib

@@ -9,11 +9,9 @@ exact cached sets at budgets 10/75/125/175/350/10000, aggressive must pick
 ``apply(5) == 168``.
 """
 
-import numpy as np
 import pytest
 
 from keystone_tpu.data import Dataset
-from keystone_tpu.ops.util import Cacher
 from keystone_tpu.workflow import Estimator, Pipeline, PipelineEnv, Transformer
 from keystone_tpu.workflow.autocache import (
     AggressiveCache,

@@ -5,8 +5,6 @@ external contract the in-tree analyzer is graded against
 (CoreNLPFeatureExtractor.scala:18).
 """
 
-import pytest
-
 from keystone_tpu.ops.lemmatizer import lemmatize
 
 

@@ -14,9 +14,6 @@ import subprocess
 import sys
 import textwrap
 
-import numpy as np
-import pytest
-
 _WORKER = textwrap.dedent(
     """
     import sys

@@ -13,7 +13,6 @@ NormalEquations / BlockCoordinateDescent), blocked Gaussian kernel generation
 import numpy as np
 import pytest
 
-import jax
 import jax.numpy as jnp
 
 from keystone_tpu.data import Dataset

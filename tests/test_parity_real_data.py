@@ -8,7 +8,6 @@ scripts/solver-comparisons-final.csv).
 """
 
 import numpy as np
-import pytest
 
 
 class TestDigitsRealDataParity:

@@ -10,8 +10,6 @@ ReWeightedLeastSquares run per class — for the multi-block iteration.
 import numpy as np
 import pytest
 
-import jax.numpy as jnp
-
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.rwls import (
     PerClassWeightedLeastSquaresEstimator,
