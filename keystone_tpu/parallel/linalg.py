@@ -44,10 +44,11 @@ def _solve_psd(gram, rhs, lam):
 
     Rank-deficient Gramians (fewer rows than block columns — demo-scale fits
     of wide blocks) with zero/tiny lam defeat the f32 Cholesky (negative
-    pivots from rounding -> NaN factor). Those solves rescue through an LU
-    solve with a scale-relative jitter; healthy Gramians keep the exact
-    Cholesky path bit for bit. (The reference inherits this robustness from
-    Breeze's `\\` operator, which LU-solves; mlmatrix NormalEquations.)
+    pivots from rounding -> NaN factor). Those solves rescue through a
+    second Cholesky with a strong scale-relative jitter (TPU's LU kernel
+    cannot compile at d=16384 — scoped-VMEM overflow — so the rescue stays
+    Cholesky-shaped); healthy Gramians keep the exact path bit for bit.
+    (The reference inherits robustness from Breeze's `\\`, which LU-solves.)
     """
     d = gram.shape[0]
     eye = jnp.eye(d, dtype=gram.dtype)
@@ -55,8 +56,17 @@ def _solve_psd(gram, rhs, lam):
     sol = jax.scipy.linalg.cho_solve((chol, True), rhs)
 
     def rescue(_):
-        jitter = (jnp.trace(gram) / d) * jnp.asarray(1e-4, gram.dtype) + lam
-        return jnp.linalg.solve(gram + jitter * eye, rhs)
+        # 1e-3·(tr/d) keeps the condition number within f32 Cholesky's
+        # reliable range (~1e6) while shrinking the fit by ~0.1%. Should a
+        # concentrated spectrum defeat even the jittered factorization, the
+        # last resort is a diagonal-preconditioned step — always finite, and
+        # still a descent direction for the BCD sweep.
+        mean_diag = jnp.trace(gram) / d
+        jitter = mean_diag * jnp.asarray(1e-3, gram.dtype) + lam
+        chol_j = jax.scipy.linalg.cholesky(gram + jitter * eye, lower=True)
+        sol_j = jax.scipy.linalg.cho_solve((chol_j, True), rhs)
+        fallback = rhs / (mean_diag + lam + jnp.asarray(1e-30, gram.dtype))
+        return jnp.where(jnp.all(jnp.isfinite(sol_j)), sol_j, fallback)
 
     # Acceptance is by the linear system's relative residual, not factor
     # finiteness: a failed f32 Cholesky can also produce finite-but-garbage
@@ -96,20 +106,33 @@ def normal_equations_solve(A, B, lam: float = 0.0):
 
 
 @functools.partial(jax.jit, static_argnames=("lam",), donate_argnums=(2,))
-def _bcd_block_step(Ab, Wb, R, lam: float, gram=None):
+def _bcd_block_step(Ab, Wb, R, lam: float):
     """One Gauss-Seidel block update.
 
     Solves (AbᵀAb + λI) Wb' = Abᵀ(R + Ab Wb), returns (Wb', R', AbᵀAb) with
-    R' = R - Ab (Wb' - Wb). R is donated (updated in place on device). Pass
-    ``gram`` to reuse a previous epoch's loop-invariant Gramian — only the
-    correlation then touches the data.
+    R' = R - Ab (Wb' - Wb). R is donated (updated in place on device).
     """
-    if gram is None:
-        gram = Ab.T @ Ab
+    gram = Ab.T @ Ab
     rhs = Ab.T @ R + gram @ Wb
     Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=Ab.dtype))
     R_new = R - Ab @ (Wb_new - Wb)
     return Wb_new, R_new, gram
+
+
+@functools.partial(jax.jit, static_argnames=("lam",), donate_argnums=(2,))
+def _bcd_block_step_cached(Ab, Wb, R, lam: float, gram):
+    """Later-epoch block update reusing a stashed Gramian: only the
+    correlation re-reads the data, and the pass-through gram is not a jit
+    output (which would copy it every step)."""
+    rhs = Ab.T @ R + gram @ Wb
+    Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=Ab.dtype))
+    return Wb_new, R - Ab @ (Wb_new - Wb)
+
+
+def _gram_cache_ok(num_iter: int, gram_bytes: int) -> bool:
+    """Stash per-block Gramians across epochs only when the stash is small
+    beside HBM (shared policy of the stepwise and fused flat paths)."""
+    return num_iter > 1 and gram_bytes <= (1 << 30)
 
 
 @functools.lru_cache(maxsize=None)
@@ -228,30 +251,34 @@ def bcd_least_squares(
         step = step_cached = None
 
     # Stash loop-invariant per-block Gramians across epochs when the stash
-    # is small beside HBM (same policy as the fused flat path).
+    # is small beside HBM (shared policy with the fused flat path).
+    # jnp.result_type reads the dtype without transferring host blocks.
     gram_bytes = sum(
         int(a.shape[1]) ** 2
-        * jnp.promote_types(jnp.asarray(a).dtype, jnp.float32).itemsize
+        * jnp.promote_types(jnp.result_type(a), jnp.float32).itemsize
         for a in A_blocks
     )
-    cache_grams = max(num_iter, 1) > 1 and gram_bytes <= (1 << 30)
+    cache_grams = _gram_cache_ok(max(num_iter, 1), gram_bytes)
     grams: List = [None] * len(A_blocks)
 
     for _ in range(max(num_iter, 1)):
         for b, Ab in enumerate(A_blocks):
             Ab = jnp.asarray(Ab)
-            if step is not None:
-                if grams[b] is not None:
+            if grams[b] is not None:
+                if step_cached is not None:
                     Ws[b], R = step_cached(Ab, Ws[b], R, grams[b])
                 else:
-                    Ws[b], R, gram = step(Ab, Ws[b], R)
-                    if cache_grams:
-                        grams[b] = gram
+                    Ws[b], R = _bcd_block_step_cached(
+                        Ab, Ws[b], R, float(lam), grams[b]
+                    )
             else:
-                Ws[b], R, gram = _bcd_block_step(
-                    Ab, Ws[b], R, float(lam), grams[b]
-                )
-                if cache_grams and grams[b] is None:
+                if step is not None:
+                    Ws[b], R, gram = step(Ab, Ws[b], R)
+                else:
+                    Ws[b], R, gram = _bcd_block_step(
+                        Ab, Ws[b], R, float(lam)
+                    )
+                if cache_grams:
                     grams[b] = gram
             mesh_lib.sync_if_cpu(R)
     return Ws
@@ -442,9 +469,8 @@ def bcd_least_squares_fused_flat(
         use_pallas = pallas_ops.pallas_direct_ok(F)
     W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=B.dtype)
     acc_itemsize = jnp.promote_types(F.dtype, jnp.float32).itemsize
-    cache_grams = (
-        int(num_iter) > 1
-        and nb * block_size * block_size * acc_itemsize <= (1 << 30)
+    cache_grams = _gram_cache_ok(
+        int(num_iter), nb * block_size * block_size * acc_itemsize
     )
     W, R = _bcd_fused_flat_kernel(
         F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
