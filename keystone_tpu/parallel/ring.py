@@ -158,6 +158,106 @@ def ring_kernel_apply(
     )(X_test, X_train, W)
 
 
+def ring_attention(
+    Q,
+    K,
+    V,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    n_valid: Optional[int] = None,
+):
+    """Exact softmax attention over a sequence sharded across the mesh.
+
+    The general form of this module's kernel-matrix rings (and the direct
+    TPU analog of Ring Attention, Liu et al. 2023): queries stay resident,
+    the (K, V) shard pair circulates neighbor-to-neighbor via ``ppermute``,
+    and each step folds one block of scores into an **online softmax**
+    running state (row max ``m``, normalizer ``l``, weighted accumulator) —
+    so neither the n×n score matrix nor the full K/V ever exist on one
+    device, peak memory is O(n/P · d), and the P hops ride ICI.
+
+    Q, K, V: (n, d) row-sharded over the ``data`` axis (same sharding).
+    ``causal=True`` masks with GLOBAL sequence positions (query i attends
+    to keys j ≤ i across shard boundaries). Rows padded on by
+    ``mesh.pad_rows`` must be masked via ``n_valid`` — zero key rows are
+    NOT no-ops under softmax (score 0 still gets weight), unlike the
+    Gramian/moment reductions the zero-padding invariant covers. The
+    softmax state (m, l, acc) runs in f32 regardless of the input layout
+    dtype — bf16 operands, f32 accumulation — with one cast at the end.
+    Returns (n, d) row-sharded, equal to ``softmax(QKᵀ·scale [+mask]) V``.
+    """
+    mesh = mesh or mesh_lib.default_mesh()
+    axis = mesh_lib.DATA_AXIS
+    p = mesh.shape[axis]
+    Q = jnp.asarray(Q)
+    K = jnp.asarray(K)
+    V = jnp.asarray(V)
+    d = Q.shape[1]
+    sc = (1.0 / d**0.5) if scale is None else float(scale)
+    out_dtype = jnp.result_type(Q.dtype, K.dtype, V.dtype)
+    acc_dtype = jnp.promote_types(out_dtype, jnp.float32)
+    neg = jnp.asarray(-1e30, dtype=acc_dtype)
+    hi = dict(
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=acc_dtype,
+    )
+
+    def body(q_local, k_local, v_local):
+        n_loc = q_local.shape[0]
+        me = jax.lax.axis_index(axis)
+        q_pos = me * n_loc + jnp.arange(n_loc)
+
+        def step(s, carry):
+            k_blk, v_blk, m, l, acc = carry
+            src = (me - s) % p  # origin shard of the visiting block
+            scores = jnp.dot(q_local, k_blk.T, **hi) * sc
+            k_pos = src * n_loc + jnp.arange(n_loc)
+            if causal:
+                scores = jnp.where(
+                    q_pos[:, None] >= k_pos[None, :], scores, neg
+                )
+            if n_valid is not None:
+                scores = jnp.where(k_pos[None, :] < n_valid, scores, neg)
+            m_new = jnp.maximum(m, jnp.max(scores, axis=1))
+            # A fully-masked visiting block with m still at the -1e30 init
+            # would make exp(scores - m_new) = 1 spuriously. That cannot
+            # happen under this schedule: step 0 visits the SELF block,
+            # where every VALID query's own diagonal key is unmasked, so m
+            # is finite before any all-masked block arrives. (Padded query
+            # rows can see all-masked blocks; their garbage output is
+            # zeroed below.)
+            alpha = jnp.exp(m - m_new)
+            p_blk = jnp.exp(scores - m_new[:, None])
+            l = l * alpha + jnp.sum(p_blk, axis=1)
+            acc = acc * alpha[:, None] + jnp.dot(p_blk, v_blk, **hi)
+            k_blk = jax.lax.ppermute(k_blk, axis, _ring_perm(p))
+            v_blk = jax.lax.ppermute(v_blk, axis, _ring_perm(p))
+            return k_blk, v_blk, m_new, l, acc
+
+        m0 = jnp.full((n_loc,), neg, dtype=acc_dtype)
+        l0 = jnp.zeros((n_loc,), dtype=acc_dtype)
+        acc0 = jnp.zeros((n_loc, V.shape[1]), dtype=acc_dtype)
+        m0, l0, acc0 = (
+            jax.lax.pcast(x, (axis,), to="varying") for x in (m0, l0, acc0)
+        )
+        _, _, _, l, acc = jax.lax.fori_loop(
+            0, p, step, (k_local, v_local, m0, l0, acc0)
+        )
+        out = acc / jnp.maximum(l, 1e-30)[:, None]
+        if n_valid is not None:
+            out = out * (q_pos < n_valid)[:, None].astype(out.dtype)
+        return out.astype(out_dtype)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), P(axis, None)),
+        out_specs=P(axis, None),
+        check_vma=False,
+    )(Q, K, V)
+
+
 def ring_gram(A, mesh: Optional[Mesh] = None):
     """AᵀA over row-sharded A, with the (d, d) result scattered over the
     mesh: each device ends with a (d/P, d) row stripe via ``psum_scatter``

@@ -123,6 +123,125 @@ class TestRingPallas:
         np.testing.assert_allclose(K, K_ref, rtol=0, atol=1e-12)
 
 
+class TestRingAttention:
+    """Ring attention (Liu et al. 2023 schedule): queries resident, KV
+    circulating via ppermute with online-softmax folding. Must equal the
+    unsharded softmax(QKᵀ/√d)V exactly, including global-position causal
+    masking across shard boundaries."""
+
+    def _ref(self, Q, K, V, causal):
+        s = (Q @ K.T) / np.sqrt(Q.shape[1])
+        if causal:
+            n = Q.shape[0]
+            s = np.where(np.tril(np.ones((n, n), bool)), s, -np.inf)
+        p = np.exp(s - s.max(axis=1, keepdims=True))
+        p /= p.sum(axis=1, keepdims=True)
+        return p @ V
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_unsharded_attention(self, causal):
+        rng = np.random.default_rng(8)
+        n, d = 64, 16
+        Q = rng.normal(size=(n, d))
+        K = rng.normal(size=(n, d))
+        V = rng.normal(size=(n, d))
+        mesh = _mesh()
+        out = np.asarray(
+            ring.ring_attention(
+                mesh_lib.shard_rows(Q, mesh),
+                mesh_lib.shard_rows(K, mesh),
+                mesh_lib.shard_rows(V, mesh),
+                mesh=mesh,
+                causal=causal,
+            )
+        )
+        np.testing.assert_allclose(out, self._ref(Q, K, V, causal), atol=1e-10)
+
+    def test_padded_rows_masked_by_n_valid(self):
+        """pad_rows' zero-padding invariant does NOT hold under softmax
+        (a zero key still gets weight); n_valid masks both the ghost keys
+        and the padded query rows."""
+        rng = np.random.default_rng(10)
+        n, d = 500, 8  # pads to 504 over 8 shards
+        Q = rng.normal(size=(n, d))
+        K = rng.normal(size=(n, d))
+        V = rng.normal(size=(n, d))
+        mesh = _mesh()
+        Qp, _ = mesh_lib.pad_rows(Q, 8)
+        Kp, _ = mesh_lib.pad_rows(K, 8)
+        Vp, _ = mesh_lib.pad_rows(V, 8)
+        out = np.asarray(
+            ring.ring_attention(
+                mesh_lib.shard_rows(Qp, mesh),
+                mesh_lib.shard_rows(Kp, mesh),
+                mesh_lib.shard_rows(Vp, mesh),
+                mesh=mesh,
+                n_valid=n,
+            )
+        )
+        np.testing.assert_allclose(
+            out[:n], self._ref(Q, K, V, False), atol=1e-10
+        )
+        np.testing.assert_allclose(out[n:], 0.0, atol=0)
+
+    def test_bf16_operands_f32_state(self):
+        """bf16 layouts keep the online-softmax state in f32: error stays at
+        the bf16 output-quantization floor, not accumulation-driven."""
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(11)
+        n, d = 512, 16
+        Q = rng.normal(size=(n, d)).astype(np.float32)
+        mesh = _mesh()
+        out = np.asarray(
+            ring.ring_attention(
+                mesh_lib.shard_rows(jnp.asarray(Q, jnp.bfloat16), mesh),
+                mesh_lib.shard_rows(jnp.asarray(Q, jnp.bfloat16), mesh),
+                mesh_lib.shard_rows(jnp.asarray(Q, jnp.bfloat16), mesh),
+                mesh=mesh,
+            ).astype(jnp.float32)
+        )
+        ref = self._ref(
+            np.asarray(jnp.asarray(Q, jnp.bfloat16).astype(jnp.float32)),
+            np.asarray(jnp.asarray(Q, jnp.bfloat16).astype(jnp.float32)),
+            np.asarray(jnp.asarray(Q, jnp.bfloat16).astype(jnp.float32)),
+            False,
+        )
+        assert np.abs(out - ref).max() < 8e-3  # bf16 ulp at O(1) values
+
+    def test_mixed_dtypes_do_not_crash(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(12)
+        n, d = 64, 8
+        mesh = _mesh()
+        out = ring.ring_attention(
+            mesh_lib.shard_rows(jnp.asarray(rng.normal(size=(n, d)), jnp.bfloat16), mesh),
+            mesh_lib.shard_rows(jnp.asarray(rng.normal(size=(n, d)), jnp.float32), mesh),
+            mesh_lib.shard_rows(jnp.asarray(rng.normal(size=(n, d)), jnp.float32), mesh),
+            mesh=mesh,
+        )
+        assert np.isfinite(np.asarray(out, dtype=np.float32)).all()
+
+    def test_long_sequence_memory_shape(self):
+        # 8 shards of 128 rows: per-device score blocks are (128, 128) even
+        # though the full matrix would be (1024, 1024).
+        rng = np.random.default_rng(9)
+        n, d = 1024, 8
+        Q = rng.normal(size=(n, d)).astype(np.float32)
+        mesh = _mesh()
+        out = np.asarray(
+            ring.ring_attention(
+                mesh_lib.shard_rows(Q, mesh),
+                mesh_lib.shard_rows(Q, mesh),
+                mesh_lib.shard_rows(Q, mesh),
+                mesh=mesh,
+                causal=True,
+            )
+        )
+        assert out.shape == (n, d) and np.isfinite(out).all()
+
+
 class TestCosineFeaturesSharded:
     def test_sharded_batch_apply_uses_pallas_and_matches(self, force_pallas):
         from keystone_tpu.ops.stats import CosineRandomFeatures
