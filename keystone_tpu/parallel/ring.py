@@ -198,8 +198,14 @@ def ring_attention(
     out_dtype = jnp.result_type(Q.dtype, K.dtype, V.dtype)
     acc_dtype = jnp.promote_types(out_dtype, jnp.float32)
     neg = jnp.asarray(-1e30, dtype=acc_dtype)
+    # bf16 operands hit the MXU natively (one pass, f32 accumulation via
+    # preferred_element_type); f32 operands need HIGHEST, as everywhere.
     hi = dict(
-        precision=jax.lax.Precision.HIGHEST,
+        precision=(
+            jax.lax.Precision.DEFAULT
+            if out_dtype == jnp.bfloat16
+            else jax.lax.Precision.HIGHEST
+        ),
         preferred_element_type=acc_dtype,
     )
 

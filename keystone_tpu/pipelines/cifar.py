@@ -72,9 +72,13 @@ class CifarConfig:
     kernel_gamma: float = 5e-4
     block_size: int = 512
     num_epochs: int = 1
-    # Augmented variant (RandomPatchCifarAugmented.scala:27-90)
+    # Augmented variant (RandomPatchCifarAugmented.scala:27-90).
+    # horizontal_flips=None auto-selects: flips on real data (the reference
+    # behavior) and off for the synthetic demo, whose phase-sensitive
+    # sinusoid classes are not flip-invariant like real photos.
     augment_patch_size: int = 24
     augment_patches: int = 8
+    horizontal_flips: "bool | None" = None
     seed: int = 0
     synthetic_n: int = 512
 
@@ -257,14 +261,18 @@ def run_random_patch_cifar_kernel(config: CifarConfig):
 
 
 def run_random_patch_cifar_augmented(config: CifarConfig):
-    """Random train crops; center/corner+flip test crops voted per image
+    """Random train crops; center/corner test crops (plus horizontal flips
+    per ``config.horizontal_flips``) voted per image
     (RandomPatchCifarAugmented.scala:27-90)."""
     start = time.time()
     train, test = _load(config)
 
     aug = config.augment_patch_size
     train_patcher = RandomPatcher(config.augment_patches, aug, aug, seed=config.seed)
-    test_patcher = CenterCornerPatcher(aug, aug, horizontal_flips=True)
+    flips = config.horizontal_flips
+    if flips is None:
+        flips = bool(config.train_location)  # see CifarConfig comment
+    test_patcher = CenterCornerPatcher(aug, aug, horizontal_flips=flips)
 
     train_images = train_patcher.batch_apply(train.data)
     train_label_ints = np.repeat(
