@@ -104,16 +104,19 @@ class MaxClassifier(Transformer):
 
 @dataclass(frozen=True)
 class TopKClassifier(Transformer):
-    """Top-k score indices, descending (reference: nodes/util/TopKClassifier.scala:9-14)."""
+    """Top-k score indices, descending; k clamps at the vector size
+    (reference: nodes/util/TopKClassifier.scala:9-14 takes min(k, length))."""
 
     k: int
 
     def apply(self, x):
-        _, idx = jax.lax.top_k(x, self.k)
+        x = jnp.asarray(x)
+        _, idx = jax.lax.top_k(x, min(self.k, x.shape[-1]))
         return idx
 
     def batch_apply(self, data: Dataset) -> Dataset:
-        _, idx = jax.lax.top_k(data.array, self.k)
+        arr = jnp.asarray(data.array)
+        _, idx = jax.lax.top_k(arr, min(self.k, arr.shape[-1]))
         return Dataset(idx, n=data.n, mesh=data.mesh)
 
 

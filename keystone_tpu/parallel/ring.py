@@ -198,14 +198,22 @@ def ring_attention(
     out_dtype = jnp.result_type(Q.dtype, K.dtype, V.dtype)
     acc_dtype = jnp.promote_types(out_dtype, jnp.float32)
     neg = jnp.asarray(-1e30, dtype=acc_dtype)
-    # bf16 operands hit the MXU natively (one pass, f32 accumulation via
-    # preferred_element_type); f32 operands need HIGHEST, as everywhere.
-    hi = dict(
+    # QKᵀ: bf16 operands hit the MXU natively (one pass, f32 accumulation
+    # via preferred_element_type); f32 operands need HIGHEST, as everywhere.
+    qk_kwargs = dict(
         precision=(
             jax.lax.Precision.DEFAULT
             if out_dtype == jnp.bfloat16
             else jax.lax.Precision.HIGHEST
         ),
+        preferred_element_type=acc_dtype,
+    )
+    # P·V: p_blk is an f32 softmax weight (part of the documented f32
+    # state), so this dot always runs at full f32 precision — DEFAULT here
+    # would silently demote the weights to bf16 and mis-normalize against
+    # the f32 normalizer l.
+    pv_kwargs = dict(
+        precision=jax.lax.Precision.HIGHEST,
         preferred_element_type=acc_dtype,
     )
 
@@ -217,7 +225,7 @@ def ring_attention(
         def step(s, carry):
             k_blk, v_blk, m, l, acc = carry
             src = (me - s) % p  # origin shard of the visiting block
-            scores = jnp.dot(q_local, k_blk.T, **hi) * sc
+            scores = jnp.dot(q_local, k_blk.T, **qk_kwargs) * sc
             k_pos = src * n_loc + jnp.arange(n_loc)
             if causal:
                 scores = jnp.where(
@@ -236,7 +244,9 @@ def ring_attention(
             alpha = jnp.exp(m - m_new)
             p_blk = jnp.exp(scores - m_new[:, None])
             l = l * alpha + jnp.sum(p_blk, axis=1)
-            acc = acc * alpha[:, None] + jnp.dot(p_blk, v_blk, **hi)
+            acc = acc * alpha[:, None] + jnp.dot(
+                p_blk, v_blk.astype(acc_dtype), **pv_kwargs
+            )
             k_blk = jax.lax.ppermute(k_blk, axis, _ring_perm(p))
             v_blk = jax.lax.ppermute(v_blk, axis, _ring_perm(p))
             return k_blk, v_blk, m_new, l, acc

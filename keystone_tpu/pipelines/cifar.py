@@ -84,13 +84,15 @@ class CifarConfig:
 
 
 def _load(config: CifarConfig):
+    """Returns (train, test, is_synthetic) — the one place that decides the
+    data source, so policies keyed on it (flip augmentation) cannot drift."""
     if config.train_location:
         train = load_cifar_binary(config.train_location)
         test = load_cifar_binary(config.test_location)
-    else:
-        train = synthetic_cifar(config.synthetic_n, seed=config.seed)
-        test = synthetic_cifar(max(config.synthetic_n // 4, 128), seed=config.seed + 1)
-    return train, test
+        return train, test, False
+    train = synthetic_cifar(config.synthetic_n, seed=config.seed)
+    test = synthetic_cifar(max(config.synthetic_n // 4, 128), seed=config.seed + 1)
+    return train, test, True
 
 
 def _sample_whitened_filters(train: LabeledData, config: CifarConfig):
@@ -143,7 +145,7 @@ def run_linear_pixels(config: CifarConfig):
     """GrayScaler → vectorize → exact least squares → argmax
     (LinearPixels.scala:18-56)."""
     start = time.time()
-    train, test = _load(config)
+    train, test, _ = _load(config)
     labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
     pipeline = (
         PixelScaler()
@@ -168,7 +170,7 @@ def run_linear_pixels(config: CifarConfig):
 def run_random_cifar(config: CifarConfig):
     """Random (unwhitened) gaussian filters (RandomCifar.scala:20-77)."""
     start = time.time()
-    train, test = _load(config)
+    train, test, _ = _load(config)
     rng = np.random.default_rng(config.seed)
     filters = rng.normal(
         size=(config.num_filters, config.patch_size, config.patch_size, 3)
@@ -203,7 +205,7 @@ def run_random_patch_cifar(config: CifarConfig):
     """Whitened random-patch filters + block least squares
     (RandomPatchCifar.scala:21-86)."""
     start = time.time()
-    train, test = _load(config)
+    train, test, _ = _load(config)
     filters, whitener = _sample_whitened_filters(train, config)
     labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
     pipeline = (
@@ -232,7 +234,7 @@ def run_random_patch_cifar_kernel(config: CifarConfig):
     """Same featurization, Gaussian-kernel ridge regression solver
     (RandomPatchCifarKernel.scala:33-76)."""
     start = time.time()
-    train, test = _load(config)
+    train, test, _ = _load(config)
     filters, whitener = _sample_whitened_filters(train, config)
     labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
     featurizer = _conv_featurizer(filters, whitener, config).and_then(
@@ -265,13 +267,13 @@ def run_random_patch_cifar_augmented(config: CifarConfig):
     per ``config.horizontal_flips``) voted per image
     (RandomPatchCifarAugmented.scala:27-90)."""
     start = time.time()
-    train, test = _load(config)
+    train, test, is_synthetic = _load(config)
 
     aug = config.augment_patch_size
     train_patcher = RandomPatcher(config.augment_patches, aug, aug, seed=config.seed)
     flips = config.horizontal_flips
     if flips is None:
-        flips = bool(config.train_location)  # see CifarConfig comment
+        flips = not is_synthetic  # see CifarConfig comment
     test_patcher = CenterCornerPatcher(aug, aug, horizontal_flips=flips)
 
     train_images = train_patcher.batch_apply(train.data)
@@ -290,7 +292,6 @@ def run_random_patch_cifar_augmented(config: CifarConfig):
         Dataset.of(train_label_ints)
     )
 
-    conv_cfg = config
     conv = Convolver(
         jnp.asarray(filters, jnp.float32).reshape(len(filters), -1),
         img_x=aug,
@@ -352,6 +353,10 @@ def main(argv=None, variant: str = "RandomPatchCifar"):
     parser.add_argument("--gamma", type=float, default=5e-4)
     parser.add_argument("--blockSize", type=int, default=512)
     parser.add_argument("--numEpochs", type=int, default=1)
+    parser.add_argument(
+        "--horizontalFlips", choices=["auto", "on", "off"], default="auto",
+        help="augmented variant's test-crop flips (auto: on for real data)",
+    )
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -368,6 +373,7 @@ def main(argv=None, variant: str = "RandomPatchCifar"):
         kernel_gamma=args.gamma,
         block_size=args.blockSize,
         num_epochs=args.numEpochs,
+        horizontal_flips={"auto": None, "on": True, "off": False}[args.horizontalFlips],
         seed=args.seed,
     )
     results = RUNNERS[variant](config)

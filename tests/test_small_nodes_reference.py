@@ -1,0 +1,155 @@
+"""Exact contracts of the small util/stats/nlp nodes, ported from the
+reference's own suites (TopKClassifierSuite, VectorSplitterSuite,
+LinearRectifierSuite, SignedHellingerMapperSuite,
+SparseFeatureVectorizerSuite, StringUtilsSuite) — same inputs, same expected
+outputs."""
+
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.nlp import LowerCase, Tokenizer, Trim
+from keystone_tpu.ops.sparse import (
+    AllSparseFeatures,
+    CommonSparseFeatures,
+    SparseFeatureVectorizer,
+    densify_dataset,
+)
+from keystone_tpu.ops.stats import LinearRectifier, SignedHellingerMapper
+from keystone_tpu.ops.util import TopKClassifier, VectorSplitter
+
+
+class TestTopKClassifier:
+    def test_k_le_vector_size(self):
+        """TopKClassifierSuite 'k <= vector size'."""
+        assert list(TopKClassifier(2).apply(np.array([-10.0, 42.4, -43.0, 23.0]))) == [1, 3]
+        assert list(
+            TopKClassifier(4).apply(
+                np.array([-1.7976931348623157e308, 1.7976931348623157e308, 12.0, 11.0, 10.0])
+            )
+        ) == [1, 2, 3, 4]
+        assert list(TopKClassifier(3).apply(np.array([3.0, -23.2, 2.99]))) == [0, 2, 1]
+
+    def test_k_gt_vector_size(self):
+        """TopKClassifierSuite 'k > vector size'."""
+        assert list(TopKClassifier(5).apply(np.array([-10.0, 42.4, -43.0, 23.0]))) == [1, 3, 0, 2]
+        assert list(TopKClassifier(2).apply(np.array([-1.7976931348623157e308]))) == [0]
+        assert list(TopKClassifier(20).apply(np.array([3.0, -23.2, 2.99]))) == [0, 2, 1]
+
+
+class TestVectorSplitter:
+    def test_split_counts(self):
+        """VectorSplitterSuite 'vector splitter': ceil(d/bs) splits for every
+        (block size, dim, explicit-or-inferred feature count) combination."""
+        for bs in (128, 256, 512):
+            for mul in range(3):
+                for off in range(0, 21, 5):
+                    d = bs * mul + off
+                    if d == 0:
+                        continue
+                    for feats in (d, None):
+                        sp = VectorSplitter(bs, feats)
+                        splits = sp.split_vector(np.zeros(d))
+                        expected = d // bs + (0 if d % bs == 0 else 1)
+                        assert len(splits) == expected, (bs, d, feats)
+
+    def test_maintains_order(self):
+        """VectorSplitterSuite 'vector splitter maintains order'."""
+        rng = np.random.default_rng(0)
+        for bs in (128, 256, 512):
+            for mul in range(3):
+                for off in range(0, 21, 5):
+                    d = bs * mul + off
+                    if d == 0:
+                        continue
+                    vec = rng.normal(size=d)
+                    parts = VectorSplitter(bs, d).split_vector(vec)
+                    np.testing.assert_array_equal(
+                        np.concatenate([np.asarray(p) for p in parts]), vec
+                    )
+
+
+class TestLinearRectifier:
+    def test_maxval(self):
+        """LinearRectifierSuite 'Test MaxVal': a random matrix is not all
+        nonnegative; the rectified one is."""
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(128, 16))
+        assert not (X >= 0.0).all()
+        out = np.asarray(
+            LinearRectifier(0.0).batch_apply(Dataset.of(X)).array
+        )
+        assert (out >= 0.0).all()
+
+
+class TestSignedHellingerMapper:
+    def test_signed_square_root(self):
+        """SignedHellingerMapperSuite."""
+        x = np.array([1.0, -4.0, 0.0, -9.0, 16.0])
+        out = np.asarray(SignedHellingerMapper().apply(x))
+        np.testing.assert_allclose(out, [1.0, -2.0, 0.0, -3.0, 4.0], atol=1e-12)
+
+
+def _dense(vectorizer, item):
+    ds = vectorizer.batch_apply(Dataset.of([item]))
+    return np.asarray(
+        densify_dataset(ds, vectorizer.num_features).array
+    )[0]
+
+
+class TestSparseFeatureVectorization:
+    def test_fixed_feature_space(self):
+        """SparseFeatureVectorizerSuite 'sparse feature vectorization'."""
+        v = SparseFeatureVectorizer({"First": 0, "Second": 1, "Third": 2})
+        out = _dense(v, [("Third", 4.0), ("Fourth", 6.0), ("First", 1.0)])
+        np.testing.assert_array_equal(out, [1.0, 0.0, 4.0])
+
+    def test_all_sparse_features(self):
+        """'all sparse feature selection': every observed feature kept, in
+        first-appearance order."""
+        train = [
+            [("First", 0.0), ("Second", 6.0)],
+            [("Third", 3.0), ("Second", 4.0)],
+        ]
+        v = AllSparseFeatures().fit(Dataset.of(train))
+        out = _dense(v, [("Third", 4.0), ("Fourth", 6.0), ("First", 1.0)])
+        np.testing.assert_array_equal(out, [1.0, 0.0, 4.0])
+
+    def test_common_sparse_features(self):
+        """'common sparse feature selection': top-K by document frequency."""
+        train = [
+            [("First", 0.0), ("Second", 6.0)],
+            [("Third", 3.0), ("Second", 4.8)],
+            [("Third", 7.0), ("Fourth", 5.0)],
+            [("Fifth", 5.0), ("Second", 7.3)],
+        ]
+        v = CommonSparseFeatures(2).fit(Dataset.of(train))
+        out = _dense(
+            v,
+            [("Third", 4.0), ("Seventh", 8.0), ("Second", 1.3),
+             ("Fourth", 6.0), ("First", 1.0)],
+        )
+        np.testing.assert_allclose(out, [1.3, 4.0], atol=1e-6)
+
+
+class TestStringUtils:
+    STRINGS = ["  The quick BROWN fo.X ", " ! !.,)JumpeD. ovER the LAZy DOG.. ! "]
+
+    def test_trim(self):
+        assert [Trim().apply(s) for s in self.STRINGS] == [
+            "The quick BROWN fo.X",
+            "! !.,)JumpeD. ovER the LAZy DOG.. !",
+        ]
+
+    def test_lower_case(self):
+        assert [LowerCase().apply(s) for s in self.STRINGS] == [
+            "  the quick brown fo.x ",
+            " ! !.,)jumped. over the lazy dog.. ! ",
+        ]
+
+    def test_tokenizer_java_split_semantics(self):
+        """Leading empty token kept, trailing empties dropped
+        (StringUtilsSuite 'tokenizer')."""
+        assert [Tokenizer().apply(s) for s in self.STRINGS] == [
+            ["", "The", "quick", "BROWN", "fo", "X"],
+            ["", "JumpeD", "ovER", "the", "LAZy", "DOG"],
+        ]
