@@ -60,8 +60,25 @@ class MulticlassMetrics:
         return float(self.confusion[c, c] / denom) if denom > 0 else 0.0
 
     def class_f1(self, c: int) -> float:
+        return self.class_fscore(c)
+
+    def class_fscore(self, c: int, beta: float = 1.0) -> float:
+        """F_β (the reference's ``classMetrics(c).fScore(beta)``,
+        MulticlassClassifierEvaluator.scala:56-66)."""
         p, r = self.class_precision(c), self.class_recall(c)
-        return 2 * p * r / (p + r) if (p + r) > 0 else 0.0
+        b2 = beta * beta
+        denom = b2 * p + r
+        return (1 + b2) * p * r / denom if denom > 0 else 0.0
+
+    def macro_fscore(self, beta: float = 1.0) -> float:
+        return float(
+            np.mean([self.class_fscore(c, beta) for c in range(self.num_classes)])
+        )
+
+    def micro_fscore(self, beta: float = 1.0) -> float:
+        # Micro P == micro R == accuracy for single-label multiclass, so
+        # every F_β equals the accuracy too.
+        return self.accuracy
 
     # -- aggregate --
 
@@ -83,7 +100,7 @@ class MulticlassMetrics:
 
     @property
     def macro_f1(self) -> float:
-        return float(np.mean([self.class_f1(c) for c in range(self.num_classes)]))
+        return self.macro_fscore()
 
     @property
     def micro_precision(self) -> float:
@@ -94,7 +111,7 @@ class MulticlassMetrics:
 
     @property
     def micro_f1(self) -> float:
-        return self.accuracy
+        return self.micro_fscore()
 
     def summary(self, class_names=None) -> str:
         """Mahout-style pretty print (MulticlassClassifierEvaluator.scala:85-105)."""

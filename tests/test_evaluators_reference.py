@@ -1,0 +1,96 @@
+"""Exact evaluator contracts ported from the reference suites
+(MulticlassClassifierEvaluatorSuite, BinaryClassifierEvaluatorSuite,
+MeanAveragePrecisionSuite) — same inputs, same hand-computed (and, for MAP,
+MATLAB-derived) expected values."""
+
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.evaluation import (
+    BinaryClassifierEvaluator,
+    MeanAveragePrecisionEvaluator,
+    MulticlassClassifierEvaluator,
+)
+
+
+class TestMulticlassEvaluatorReference:
+    def test_metrics_on_nine_instance_confusion(self):
+        """MulticlassClassifierEvaluatorSuite: 3 classes, 9 instances,
+        confusion rows (true class) = [2,1,1], [1,3,0], [0,0,1]."""
+        pairs = [
+            (0, 0), (0, 1), (0, 0), (1, 0), (1, 1),
+            (1, 1), (1, 1), (2, 2), (2, 0),
+        ]
+        preds = Dataset.of(np.array([p for p, _ in pairs]))
+        labels = Dataset.of(np.array([l for _, l in pairs]))
+        m = MulticlassClassifierEvaluator(3).evaluate(preds, labels)
+
+        np.testing.assert_array_equal(
+            m.confusion, [[2, 1, 1], [1, 3, 0], [0, 0, 1]]
+        )
+
+        precision = [2 / 3, 3 / 4, 1 / 2]
+        recall = [2 / 4, 3 / 4, 1 / 1]
+
+        def fbeta(p, r, b):
+            return (1 + b * b) * p * r / (b * b * p + r)
+
+        delta = 1e-7
+        for c in range(3):
+            assert abs(m.class_precision(c) - precision[c]) < delta
+            assert abs(m.class_recall(c) - recall[c]) < delta
+            assert abs(
+                m.class_fscore(c) - fbeta(precision[c], recall[c], 1.0)
+            ) < delta
+            assert abs(
+                m.class_fscore(c, 2.0) - fbeta(precision[c], recall[c], 2.0)
+            ) < delta
+
+        assert abs(m.micro_recall - 6 / 9) < delta
+        assert abs(m.micro_recall - m.micro_precision) < delta
+        assert abs(m.micro_recall - m.micro_fscore()) < delta
+        assert abs(m.macro_precision - np.mean(precision)) < delta
+        assert abs(m.macro_recall - np.mean(recall)) < delta
+        f1s = [fbeta(p, r, 1.0) for p, r in zip(precision, recall)]
+        f2s = [fbeta(p, r, 2.0) for p, r in zip(precision, recall)]
+        assert abs(m.macro_fscore() - np.mean(f1s)) < delta
+        assert abs(m.macro_fscore(2.0) - np.mean(f2s)) < delta
+
+
+class TestBinaryEvaluatorReference:
+    def test_contingency_twelve_instances(self):
+        """BinaryClassifierEvaluatorSuite: tp=6 fp=1 tn=3 fn=2."""
+        preds = [True] * 6 + [False] * 2 + [True] * 1 + [False] * 3
+        labs = [True] * 8 + [False] * 4
+        m = BinaryClassifierEvaluator().evaluate(
+            Dataset.of(np.array(preds)), Dataset.of(np.array(labs))
+        )
+        assert (m.tp, m.fp, m.tn, m.fn) == (6, 1, 3, 2)
+        assert abs(m.precision - 6 / 7) < 1e-9
+        assert abs(m.recall - 6 / 8) < 1e-9
+        assert abs(m.accuracy - 9 / 12) < 1e-9
+        assert abs(m.specificity - 3 / 4) < 1e-9
+        assert abs(m.f1 - 2 * 6 / (2 * 6 + 2 + 1)) < 1e-9
+
+
+class TestMeanAveragePrecisionReference:
+    def test_matlab_golden_values(self):
+        """MeanAveragePrecisionSuite 'random map test': expected per-class AP
+        from MATLAB (the reference's external golden)."""
+        actual = [np.array([0, 3]), np.array([2]), np.array([1, 2]), np.array([0])]
+        predicted = np.array(
+            [
+                [0.1, -0.05, 0.12, 0.5],
+                [-0.23, -0.45, 0.23, 0.1],
+                [-0.34, -0.32, -0.66, 1.52],
+                [-0.1, -0.2, 0.5, 0.8],
+            ]
+        )
+        ap = np.asarray(
+            MeanAveragePrecisionEvaluator(4).evaluate(
+                Dataset.of(predicted), Dataset.of(actual)
+            )
+        )
+        np.testing.assert_allclose(
+            ap, [1.0, 0.3333, 0.5, 0.3333], atol=1e-4
+        )
