@@ -79,6 +79,10 @@ class ClassLabelIndicatorsFromIntArrayLabels(Transformer):
     num_classes: int
     valid_check: bool = True
 
+    def __post_init__(self):
+        if self.num_classes < 2:
+            raise ValueError("Must have at least two classes for ClassLabelIndicators")
+
     def apply(self, labels):
         labels = np.atleast_1d(np.asarray(labels))
         if self.valid_check and (labels.min() < 0 or labels.max() >= self.num_classes):

@@ -1,0 +1,141 @@
+"""More exact reference-suite ports: ClassLabelIndicatorsSuite,
+MaxClassifierSuite, RandomSignNodeSuite, PaddedFFTSuite (R-derived goldens),
+TermFrequencySuite, CoreNLPFeatureExtractorSuite (lemmatization + n-gram
+structure; the NER test is out of scope — our extractor lemmatizes tokens
+but does not run a named-entity recognizer)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.ops.nlp import CoreNLPFeatureExtractor
+from keystone_tpu.ops.stats import PaddedFFT, RandomSignNode, TermFrequency
+from keystone_tpu.ops.util import (
+    ClassLabelIndicatorsFromIntArrayLabels,
+    ClassLabelIndicatorsFromIntLabels,
+    MaxClassifier,
+)
+
+
+class TestClassLabelIndicators:
+    def test_single_label_indicators(self):
+        """ClassLabelIndicatorsSuite 'single label indicators'."""
+        with pytest.raises(ValueError):
+            ClassLabelIndicatorsFromIntLabels(0)
+        with pytest.raises(ValueError):
+            ClassLabelIndicatorsFromIntLabels(1)
+        five = ClassLabelIndicatorsFromIntLabels(5)
+        np.testing.assert_array_equal(
+            np.asarray(five.apply(2)), [-1.0, -1.0, 1.0, -1.0, -1.0]
+        )
+
+    def test_multi_label_indicators(self):
+        """'multiple label indicators without validation'."""
+        with pytest.raises(ValueError):
+            ClassLabelIndicatorsFromIntArrayLabels(0)
+        with pytest.raises(ValueError):
+            ClassLabelIndicatorsFromIntArrayLabels(1)
+        five = ClassLabelIndicatorsFromIntArrayLabels(5)
+        np.testing.assert_array_equal(
+            np.asarray(five.apply([2, 1])), [-1.0, 1.0, 1.0, -1.0, -1.0]
+        )
+        with pytest.raises(ValueError):
+            five.apply([4, 6])
+        # Unchecked mode: negative indices wrap — the reference's documented
+        # "weird behavior" for out-of-contract input.
+        unchecked = ClassLabelIndicatorsFromIntArrayLabels(5, valid_check=False)
+        np.testing.assert_array_equal(
+            np.asarray(unchecked.apply([-1, 2])), [-1.0, -1.0, 1.0, -1.0, 1.0]
+        )
+
+
+class TestMaxClassifier:
+    def test_exact_argmax(self):
+        """MaxClassifierSuite."""
+        assert int(MaxClassifier().apply(np.array([-10.0, 42.4, 335.23, -43.0]))) == 2
+        assert int(MaxClassifier().apply(np.array([-1.7976931348623157e308]))) == 0
+        assert int(MaxClassifier().apply(np.array([3.0, -23.2, 2.99]))) == 0
+
+
+class TestRandomSignNode:
+    def test_fixed_signs(self):
+        """RandomSignNodeSuite 'RandomSignNode'."""
+        node = RandomSignNode(np.array([1.0, -1.0, 1.0]))
+        np.testing.assert_array_equal(
+            np.asarray(node.apply(np.array([1.0, 2.0, 3.0]))), [1.0, -2.0, 3.0]
+        )
+
+    def test_create_draws_signs(self):
+        """'RandomSignNode.create': every element is ±1."""
+        node = RandomSignNode.create(1000, seed=0)
+        signs = np.asarray(node.signs)
+        assert np.all((signs == 1.0) | (signs == -1.0))
+
+
+class TestPaddedFFT:
+    def test_r_golden_values(self):
+        """PaddedFFTSuite: length-100 inputs pad to 128; expected real parts
+        from R (Re(fft(...))) — the reference's external golden."""
+        ones = np.zeros(100)
+        twos = np.zeros(100)
+        ones[0] = 1.0
+        twos[2] = 1.0
+
+        fft = PaddedFFT()
+        twosout = np.asarray(fft.apply(twos))
+        onesout = np.asarray(fft.apply(ones))
+
+        assert twosout.shape == (64,)
+        # Re(fft(c(0, 0, 1, rep(0, 125))))
+        assert abs(twosout[0] - 1.0) < 1e-8
+        assert abs(twosout[16] - 0.0) < 1e-8
+        assert abs(twosout[32] - (-1.0)) < 1e-8
+        assert abs(twosout[48] - 0.0) < 1e-8
+        # Re(fft(c(1, rep(0, 127)))) == 1 everywhere
+        np.testing.assert_allclose(onesout, np.ones(64), atol=1e-8)
+
+
+class TestTermFrequency:
+    def test_simple_strings(self):
+        out = dict(TermFrequency().apply(["b", "a", "c", "b", "b", "a", "b"]))
+        assert out == {"a": 2, "b": 4, "c": 1}
+
+    def test_varying_types(self):
+        items = ["b", "a", "c", ("b", "b"), ("b", "b"), 12, 12, "a", "b", 12]
+        out = dict(TermFrequency().apply(items))
+        assert out == {"a": 2, "b": 2, "c": 1, ("b", "b"): 2, 12: 3}
+
+    def test_log_weighting(self):
+        out = dict(
+            TermFrequency(lambda x: np.log(x + 1)).apply(
+                ["b", "a", "c", "b", "b", "a", "b"]
+            )
+        )
+        assert abs(out["a"] - np.log(3)) < 1e-12
+        assert abs(out["b"] - np.log(5)) < 1e-12
+        assert abs(out["c"] - np.log(2)) < 1e-12
+
+
+class TestCoreNLPFeatureExtractor:
+    def test_lemmatization(self):
+        """CoreNLPFeatureExtractorSuite 'lemmatization': the exact CoreNLP
+        outputs the reference asserts."""
+        grams = CoreNLPFeatureExtractor([1, 2, 3]).apply(
+            "jumping snakes lakes oceans hunted"
+        )
+        unigrams = {g[0] for g in grams if len(g) == 1}
+        for lemma in ("jump", "snake", "lake", "ocean", "hunt"):
+            assert lemma in unigrams, lemma
+        for raw in ("jumping", "snakes", "lakes", "oceans", "hunted"):
+            assert raw not in unigrams, raw
+
+    def test_one_two_three_grams(self):
+        """'1-2-3-grams' structural contract."""
+        grams = set(
+            tuple(g) for g in CoreNLPFeatureExtractor([1, 2, 3]).apply("a b c d")
+        )
+        for expected in [
+            ("a",), ("b",), ("c",), ("d",),
+            ("a", "b"), ("b", "c"), ("c", "d"),
+            ("a", "b", "c"), ("b", "c", "d"),
+        ]:
+            assert expected in grams, expected
