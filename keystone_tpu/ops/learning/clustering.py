@@ -250,10 +250,10 @@ def _em_loop(Xd, mu, var, w, key, x_var, small_threshold, tol,
     def body(carry):
         it, mu, var, w, _, ll, key = carry
         new_mu, new_var, new_w, new_ll, nk = em_step(mu, var, w)
-        # Variance floors (GaussianMixtureModelEstimator variance bounds).
-        floor = jnp.maximum(
-            abs_var_floor, rel_var_floor * new_var.mean(axis=0, keepdims=True)
-        )
+        # Variance floors: max(smallVarianceThreshold · GLOBAL per-dim data
+        # variance, absolute floor), fixed before EM
+        # (GaussianMixtureModelEstimator.scala:100 gmmVarLB).
+        floor = jnp.maximum(abs_var_floor, rel_var_floor * x_var[None, :])
         new_var = jnp.maximum(new_var, floor)
         # Restart clusters that collapsed below the minimum size with random
         # data points (device RNG replaces the host draws). Distinct indices
@@ -287,7 +287,8 @@ class GaussianMixtureModelEstimator(Estimator):
         tol: float = 1e-4,
         min_cluster_size: int = 40,
         absolute_variance_floor: float = 1e-9,
-        relative_variance_floor: float = 1e-4,
+        # smallVarianceThreshold default (GaussianMixtureModelEstimator.scala:31).
+        relative_variance_floor: float = 1e-2,
         kmeans_init: bool = True,
         seed: int = 0,
     ):

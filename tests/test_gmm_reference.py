@@ -1,0 +1,111 @@
+"""GaussianMixtureModelSuite ported exactly: EM recovery of hand-computable
+centers, the MLlib-derived 1-D golden fit, the committed gmm_data.txt fixture
+(read from the reference checkout), and hard posterior assignments."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.clustering import (
+    GaussianMixtureModel,
+    GaussianMixtureModelEstimator,
+)
+
+_RES = "/root/reference/src/test/resources"
+
+
+def _fit(data, k, **kw):
+    est = GaussianMixtureModelEstimator(k, min_cluster_size=1, seed=0, **kw)
+    return est.fit(Dataset.of(np.asarray(data, dtype=np.float64)))
+
+
+class TestGMMReference:
+    def test_single_center(self):
+        """'GMM Single Center': the mean of the three points, exactly."""
+        data = [[1.0, 2.0, 6.0], [1.0, 3.0, 0.0], [1.0, 4.0, 6.0]]
+        gmm = _fit(data, 1)
+        np.testing.assert_allclose(
+            np.asarray(gmm.means).T, [[1.0, 3.0, 4.0]], atol=1e-6
+        )
+
+    def test_two_centers_dataset_1(self):
+        """'GMM Two Centers dataset 1': exact centers {(1,2,0),(1,3,6)} and
+        variances (floor, 1.0, 0.09)."""
+        data = [
+            [1.0, 2.0, 6.0], [1.0, 3.0, 0.0],
+            [1.0, 4.0, 6.0], [1.0, 1.0, 0.0],
+        ]
+        gmm = _fit(data, 2)
+        centers = {tuple(np.round(r, 6)) for r in np.asarray(gmm.means).T}
+        assert centers == {(1.0, 2.0, 0.0), (1.0, 3.0, 6.0)}
+        for var_row in np.asarray(gmm.variances).T:
+            np.testing.assert_allclose(var_row[1:], [1.0, 0.09], atol=1e-6)
+            assert var_row[0] <= 1e-3  # floored near-zero variance
+
+    def test_two_centers_mllib_golden(self):
+        """'GMM Two Centers dataset 2': centers/variances from the Spark
+        MLlib gaussian mixture suite (external golden)."""
+        data = np.array(
+            [
+                -5.1971, -2.5359, -3.8220, -5.2211, -5.0602, 4.7118,
+                6.8989, 3.4592, 4.6322, 5.7048, 4.6567, 5.5026,
+                4.5605, 5.2043, 6.2734,
+            ]
+        )[:, None]
+        gmm = _fit(data, 2, tol=0.0, max_iterations=30)
+        means = np.sort(np.asarray(gmm.means).reshape(-1))
+        variances = np.asarray(gmm.variances).reshape(-1)[
+            np.argsort(np.asarray(gmm.means).reshape(-1))
+        ]
+        np.testing.assert_allclose(means, [-4.3673, 5.1604], atol=1e-3)
+        np.testing.assert_allclose(variances, [1.1098, 0.86644], atol=1e-3)
+
+    @pytest.mark.skipif(
+        not os.path.isdir(_RES), reason="reference fixture checkout not available"
+    )
+    def test_gmm_data_fixture(self):
+        """'GMM Two Centers dataset 3' on the committed gmm_data.txt: centers
+        ~0, variances ~{1, 25} crossed, weights ~1/2 (reference tolerances)."""
+        rows = []
+        with open(os.path.join(_RES, "gmm_data.txt")) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    rows.append([float(x) for x in line.split()])
+        data = np.asarray(rows)
+        gmm = _fit(data, 2, tol=0.0, max_iterations=30)
+
+        means = np.asarray(gmm.means).T  # (k, d)
+        variances = np.asarray(gmm.variances).T
+        weights = np.asarray(gmm.weights)
+
+        assert np.abs(means).max() < 0.5
+        # Variance rows are (1, 25) and (25, 1) in either order.
+        v = {tuple(np.round(r / 5.0).astype(int)) for r in variances}
+        assert v == {(0, 5), (5, 0)}
+        np.testing.assert_allclose(
+            np.sort(variances, axis=None)[:2], [1.0, 1.0], atol=2.0
+        )
+        np.testing.assert_allclose(weights, [0.5, 0.5], atol=0.05)
+
+    def test_posterior_assignments(self):
+        """'GaussianMixtureModel test': hard thresholded posteriors."""
+        means = np.array([[1.0, 2.0, 0.0], [1.0, 3.0, 6.0]]).T  # (d, k)
+        variances = np.array([[1e-8, 1.0, 0.09], [1e-8, 1.0, 0.09]]).T
+        weights = np.array([0.5, 0.5])
+        gmm = GaussianMixtureModel(means, variances, weights)
+
+        one = [1.0, 0.0]
+        two = [0.0, 1.0]
+        np.testing.assert_allclose(np.asarray(gmm.apply(np.array([1.0, 3.0, 0.0]))), one)
+        np.testing.assert_allclose(np.asarray(gmm.apply(np.array([1.0, 1.0, 0.0]))), one)
+        np.testing.assert_allclose(np.asarray(gmm.apply(np.array([1.0, 2.0, 6.0]))), two)
+        np.testing.assert_allclose(np.asarray(gmm.apply(np.array([1.0, 4.0, 6.0]))), two)
+
+        batch = np.array(
+            [[1.0, 2.0, 6.0], [1.0, 3.0, 0.0], [1.0, 4.0, 6.0], [1.0, 1.0, 0.0]]
+        )
+        out = np.asarray(gmm.posteriors(batch))
+        np.testing.assert_allclose(out, [two, one, two, one])
