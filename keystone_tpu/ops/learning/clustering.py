@@ -210,7 +210,7 @@ class GaussianMixtureModel(Transformer):
 
 
 @jax.jit
-def _em_loop(Xd, mu, var, w, key, x_var, small_threshold, tol,
+def _em_loop(Xd, mu, var, w, key, x_var, floor_var, small_threshold, tol,
              max_iterations, abs_var_floor, rel_var_floor):
     """Whole EM loop as one program: step + variance floors + collapsed-
     cluster restarts + convergence, no host round trips. Module-level jit:
@@ -252,8 +252,10 @@ def _em_loop(Xd, mu, var, w, key, x_var, small_threshold, tol,
         new_mu, new_var, new_w, new_ll, nk = em_step(mu, var, w)
         # Variance floors: max(smallVarianceThreshold · GLOBAL per-dim data
         # variance, absolute floor), fixed before EM
-        # (GaussianMixtureModelEstimator.scala:100 gmmVarLB).
-        floor = jnp.maximum(abs_var_floor, rel_var_floor * x_var[None, :])
+        # (GaussianMixtureModelEstimator.scala:100 gmmVarLB). floor_var is
+        # the EXACT data variance — x_var carries a +1e-6 init regularizer
+        # that would lift constant dimensions off the absolute floor.
+        floor = jnp.maximum(abs_var_floor, rel_var_floor * floor_var[None, :])
         new_var = jnp.maximum(new_var, floor)
         # Restart clusters that collapsed below the minimum size with random
         # data points (device RNG replaces the host draws). Distinct indices
@@ -316,17 +318,20 @@ class GaussianMixtureModelEstimator(Estimator):
             mu = np.array(km.means)
         else:
             mu = X[rng.choice(n, self.k, replace=False)]
-        base_var = X.var(axis=0) + 1e-6
+        exact_var = X.var(axis=0)
+        base_var = exact_var + 1e-6  # init/restart stability fudge only
         var = np.tile(base_var, (self.k, 1))
         w = np.full(self.k, 1.0 / self.k)
 
         Xd = jnp.asarray(X)
         x_var = jnp.asarray(base_var)
+        floor_var = jnp.asarray(exact_var)
         small_threshold = min(self.min_cluster_size, n / (2 * self.k))
 
         key = jax.random.key(int(rng.integers(0, 2**31 - 1)))
         it, mu_j, var_j, w_j, ll = _em_loop(
             Xd, jnp.asarray(mu), jnp.asarray(var), jnp.asarray(w), key, x_var,
+            floor_var,
             jnp.asarray(small_threshold, dtype=Xd.dtype),
             jnp.asarray(self.tol, dtype=Xd.dtype),
             jnp.asarray(self.max_iterations),

@@ -42,7 +42,9 @@ class TestGMMReference:
         assert centers == {(1.0, 2.0, 0.0), (1.0, 3.0, 6.0)}
         for var_row in np.asarray(gmm.variances).T:
             np.testing.assert_allclose(var_row[1:], [1.0, 0.09], atol=1e-6)
-            assert var_row[0] <= 1e-3  # floored near-zero variance
+            # Constant dimension clamps to the absolute floor exactly
+            # (gmmVarLB with zero global variance).
+            assert var_row[0] == pytest.approx(1e-9, rel=1e-6)
 
     def test_two_centers_mllib_golden(self):
         """'GMM Two Centers dataset 2': centers/variances from the Spark

@@ -1,0 +1,120 @@
+"""Remaining exact reference-suite ports: WindowingSuite (on the real
+000012.jpg), PoolingSuite's hand-computed max-pool values,
+WordFrequencyEncoderSuite, HashingTFSuite, and NGramSuite's exact
+featurizer emissions."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.images.conv import Pooler, Windower
+from keystone_tpu.ops.nlp import (
+    HashingTF,
+    NGramsFeaturizer,
+    Tokenizer,
+    WordFrequencyEncoder,
+)
+
+_RES = "/root/reference/src/test/resources"
+
+
+class TestWindowingReference:
+    @pytest.mark.skipif(
+        not os.path.isdir(_RES), reason="reference fixture checkout not available"
+    )
+    def test_windowing_real_image(self):
+        """WindowingSuite 'windowing': every window is size×size and the
+        count is (xDim/stride)·(yDim/stride) on the real test image."""
+        from PIL import Image
+
+        img = Image.open(os.path.join(_RES, "images/000012.jpg"))
+        arr = np.asarray(img, dtype=np.float64).transpose(1, 0, 2)  # (X, Y, C)
+        stride, size = 100, 50
+
+        windows = np.asarray(Windower(stride, size).apply(arr))
+        x_dim, y_dim = arr.shape[0], arr.shape[1]
+        assert windows.shape[1:] == (size, size, 3)
+        assert windows.shape[0] == (x_dim // stride) * (y_dim // stride)
+
+    def test_1x1_windowing(self):
+        """WindowingSuite '1x1 windowing': every pixel becomes a window."""
+        img = np.arange(16.0).reshape(4, 4, 1)
+        windows = np.asarray(Windower(1, 1).apply(img))
+        assert windows.shape == (16, 1, 1, 1)
+        assert set(windows.reshape(-1)) == set(range(16))
+
+
+class TestPoolingReference:
+    def test_exact_max_pool_values(self):
+        """PoolingSuite 'pooling': the channel-major 4×4 test image decodes
+        to pixel(x, y) = 4x + y; 2×2 max pooling must give the suite's
+        get(x, y) values 5/7/13/15."""
+        img = np.zeros((4, 4, 1))
+        for x in range(4):
+            for y in range(4):
+                img[x, y, 0] = 4 * x + y
+        out = np.asarray(Pooler(2, 2, pool_function="max").apply(img))
+        # poolImage.get(x, y, c): (0,0)->5, (0,1)->7, (1,0)->13, (1,1)->15
+        assert out[0, 0, 0] == 5.0
+        assert out[0, 1, 0] == 7.0
+        assert out[1, 0, 0] == 13.0
+        assert out[1, 1, 0] == 15.0
+
+
+class TestWordFrequencyEncoderReference:
+    def test_encoding_counts_and_oov(self):
+        """WordFrequencyEncoderSuite: ranks by descending frequency,
+        exposes unigramCounts, maps OOV to -1."""
+        text = ["Winter coming", "Winter Winter is coming"]
+        tokens = [Tokenizer().apply(t) for t in text]
+        encoder = WordFrequencyEncoder().fit(Dataset.of(tokens))
+
+        assert [encoder.apply(t) for t in tokens] == [[0, 1], [0, 0, 2, 1]]
+        assert encoder.unigram_counts == {0: 3, 1: 2, 2: 1}
+        assert encoder.apply(["hi"]) == [-1]
+
+
+class TestHashingTFReference:
+    def test_no_collisions(self):
+        """HashingTFSuite 'with no collisions': 3 active positions carrying
+        counts {1, 2, 4} in a 4000-dim space."""
+        tf = HashingTF(4000)
+        vec = tf.apply(["1", "2", "4", "4", "4", "4", "2"])
+        counts = {k: v for k, v in dict(vec).items() if v != 0}
+        assert len(counts) == 3
+        assert set(counts.values()) == {1, 2, 4}
+        assert all(0 <= k < 4000 for k in counts)
+
+    def test_with_collisions(self):
+        """'with collisions': 2 dims, total mass preserved."""
+        tf = HashingTF(2)
+        vec = dict(tf.apply(["1", "2", "4", "4", "4", "4", "2"]))
+        assert set(vec.keys()) <= {0, 1}
+        assert sum(vec.values()) == 7
+
+
+class TestNGramsFeaturizerReference:
+    def test_exact_emissions(self):
+        """NGramSuite 'NGramsFeaturizer': exact outputs per sentence."""
+        sents = ["Pipelines are awesome", "NLP is awesome"]
+        toks = [Tokenizer().apply(s) for s in sents]
+
+        def run(orders):
+            return [
+                [tuple(g) for g in NGramsFeaturizer(orders).apply(t)]
+                for t in toks
+            ]
+
+        assert run([1]) == [
+            [("Pipelines",), ("are",), ("awesome",)],
+            [("NLP",), ("is",), ("awesome",)],
+        ]
+        assert run([2, 3]) == [
+            [("Pipelines", "are"), ("Pipelines", "are", "awesome"),
+             ("are", "awesome")],
+            [("NLP", "is"), ("NLP", "is", "awesome"), ("is", "awesome")],
+        ]
+        # "returns 6-grams when there aren't any" -> empty
+        assert run([6]) == [[], []]
