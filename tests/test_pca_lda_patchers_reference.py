@@ -1,0 +1,140 @@
+"""Exact ports of PCASuite's transform values, KMeansPlusPlusSuite's exact
+centers, LinearDiscriminantAnalysisSuite's iris golden (the real iris.data
+fixture + externally published LDA axes), and the patcher geometry suites on
+the real reference image."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.images.core import CenterCornerPatcher, RandomPatcher
+from keystone_tpu.ops.learning.classifiers import LinearDiscriminantAnalysis
+from keystone_tpu.ops.learning.clustering import KMeansPlusPlusEstimator
+from keystone_tpu.ops.learning.pca import PCATransformer
+from keystone_tpu.ops.stats import StandardScaler
+
+_RES = "/root/reference/src/test/resources"
+
+needs_reference = pytest.mark.skipif(
+    not os.path.isdir(_RES), reason="reference fixture checkout not available"
+)
+
+
+def _real_image():
+    from PIL import Image
+
+    img = Image.open(os.path.join(_RES, "images/000012.jpg"))
+    return np.asarray(img, dtype=np.float64).transpose(1, 0, 2)  # (X, Y, C)
+
+
+class TestPCATransformReference:
+    def test_exact_transform_values(self):
+        """PCASuite 'PCA matrix transformation': hand-computed products."""
+        pca = PCATransformer(
+            np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0], [7.0, 8.0]])
+        )
+        # matOne: column-major (3, 4) over 0..11 -> rows are strided.
+        mat_one = np.arange(12.0).reshape(4, 3).T
+        out_one = np.asarray(pca.batch_apply(Dataset.of(mat_one)).array)
+        np.testing.assert_array_equal(
+            out_one, [[102.0, 120.0], [118.0, 140.0], [134.0, 160.0]]
+        )
+        mat_two = np.ones((8, 4))
+        out_two = np.asarray(pca.batch_apply(Dataset.of(mat_two)).array)
+        np.testing.assert_array_equal(out_two, np.tile([16.0, 20.0], (8, 1)))
+
+
+class TestKMeansPlusPlusReference:
+    def test_single_center(self):
+        """KMeansPlusPlusSuite 'Single Center': the data mean exactly."""
+        data = np.array(
+            [[1.0, 2.0, 6.0], [1.0, 3.0, 0.0], [1.0, 4.0, 6.0]]
+        )
+        for iters in (1, 10):
+            km = KMeansPlusPlusEstimator(1, iters, seed=0).fit(Dataset.of(data))
+            np.testing.assert_allclose(
+                np.asarray(km.means), [[1.0, 3.0, 4.0]], atol=1e-8
+            )
+
+    def test_two_centers(self):
+        """'Two Centers': exact center set {(1,2,0), (1,3,6)}."""
+        data = np.array(
+            [
+                [1.0, 2.0, 6.0], [1.0, 3.0, 0.0],
+                [1.0, 4.0, 6.0], [1.0, 1.0, 0.0],
+            ]
+        )
+        for iters in (5, 10):
+            km = KMeansPlusPlusEstimator(2, iters, seed=0).fit(Dataset.of(data))
+            centers = {tuple(np.round(r, 8)) for r in np.asarray(km.means)}
+            assert centers == {(1.0, 2.0, 0.0), (1.0, 3.0, 6.0)}
+
+
+class TestLDAIrisReference:
+    @needs_reference
+    def test_published_iris_axes(self):
+        """LinearDiscriminantAnalysisSuite: LDA(2) on the real iris.data
+        fixture must recover the published discriminant axes (Raschka's LDA
+        tutorial values, the reference's external golden), up to sign."""
+        X, y = [], []
+        with open(os.path.join(_RES, "iris.data")) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                parts = line.split(",")
+                X.append([float(v) for v in parts[:-1]])
+                y.append(
+                    {"Iris-setosa": 1, "Iris-versicolor": 2, "Iris-virginica": 3}[
+                        parts[-1]
+                    ]
+                )
+        X = np.asarray(X)
+        y = np.asarray(y)
+
+        feats = StandardScaler().fit(Dataset.of(X)).batch_apply(Dataset.of(X))
+        model = LinearDiscriminantAnalysis(2).fit(feats, Dataset.of(y))
+        W = np.asarray(model.x)  # (4, 2)
+
+        major = np.array([-0.1498, -0.1482, 0.8511, 0.4808])
+        minor = np.array([0.0095, 0.3272, -0.5748, 0.75])
+        for col, expected in zip(W.T, (major, minor)):
+            assert (
+                np.abs(col - expected).max() < 1e-4
+                or np.abs(col + expected).max() < 1e-4
+            ), (col, expected)
+
+
+class TestPatcherGeometryReference:
+    @needs_reference
+    def test_center_corner_counts_real_image(self):
+        """CenterCornerPatcherSuite: 10 patches with flips, 5 without, all
+        at the requested size, on the real image."""
+        img = _real_image()
+        px, py = img.shape[0] // 2, img.shape[1] // 2
+        with_flips = np.asarray(CenterCornerPatcher(px, py, True).apply(img))
+        assert with_flips.shape == (10, px, py, 3)
+        without = np.asarray(CenterCornerPatcher(px, py, False).apply(img))
+        assert without.shape == (5, px, py, 3)
+
+    def test_1x1_patch_positions(self):
+        """'1x1 image patches': the four corners and the center of a 5×5
+        image (value x + 5y), as a set — the reference itself notes the
+        emission order is incidental."""
+        img = np.zeros((5, 5, 1))
+        for x in range(5):
+            for y in range(5):
+                img[x, y, 0] = x + 5 * y
+        patches = np.asarray(CenterCornerPatcher(1, 1, False).apply(img))
+        values = {float(v) for v in patches.reshape(-1)}
+        assert values == {0.0, 20.0, 4.0, 24.0, 12.0}
+
+    @needs_reference
+    def test_random_patcher_real_image(self):
+        """RandomPatcherSuite 'patch dimensions, number'."""
+        img = _real_image()
+        px, py = img.shape[0] // 2, img.shape[1] // 2
+        patches = np.asarray(RandomPatcher(5, px, py, seed=0).apply(img))
+        assert patches.shape == (5, px, py, 3)
