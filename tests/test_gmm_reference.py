@@ -13,7 +13,7 @@ from keystone_tpu.ops.learning.clustering import (
     GaussianMixtureModelEstimator,
 )
 
-_RES = "/root/reference/src/test/resources"
+from conftest import REFERENCE_RESOURCES as _RES
 
 
 def _fit(data, k, **kw):

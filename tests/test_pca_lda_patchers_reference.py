@@ -15,18 +15,11 @@ from keystone_tpu.ops.learning.clustering import KMeansPlusPlusEstimator
 from keystone_tpu.ops.learning.pca import PCATransformer
 from keystone_tpu.ops.stats import StandardScaler
 
-_RES = "/root/reference/src/test/resources"
-
-needs_reference = pytest.mark.skipif(
-    not os.path.isdir(_RES), reason="reference fixture checkout not available"
+from conftest import (
+    REFERENCE_RESOURCES as _RES,
+    load_reference_image as _real_image,
+    needs_reference_fixtures as needs_reference,
 )
-
-
-def _real_image():
-    from PIL import Image
-
-    img = Image.open(os.path.join(_RES, "images/000012.jpg"))
-    return np.asarray(img, dtype=np.float64).transpose(1, 0, 2)  # (X, Y, C)
 
 
 class TestPCATransformReference:
@@ -128,6 +121,7 @@ class TestPatcherGeometryReference:
             for y in range(5):
                 img[x, y, 0] = x + 5 * y
         patches = np.asarray(CenterCornerPatcher(1, 1, False).apply(img))
+        assert patches.shape == (5, 1, 1, 1)
         values = {float(v) for v in patches.reshape(-1)}
         assert values == {0.0, 20.0, 4.0, 24.0, 12.0}
 

@@ -25,7 +25,7 @@ from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.bwls import BlockWeightedLeastSquaresEstimator
 from keystone_tpu.ops.learning.rwls import PerClassWeightedLeastSquaresEstimator
 
-_RES = "/root/reference/src/test/resources"
+from conftest import REFERENCE_RESOURCES as _RES
 
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(_RES), reason="reference fixture checkout not available"

@@ -24,7 +24,7 @@ import os
 import numpy as np
 import pytest
 
-_RES = "/root/reference/src/test/resources"
+from conftest import REFERENCE_RESOURCES as _RES
 
 pytestmark = pytest.mark.skipif(
     not os.path.isdir(_RES), reason="reference fixture checkout not available"
@@ -118,15 +118,9 @@ def numpy_dsift(image, bin_size, step):
 
 
 def _load_real_image(max_side=180):
-    from PIL import Image
+    from conftest import load_reference_image
 
-    img = Image.open(os.path.join(_RES, "images/000012.jpg")).convert("L")
-    scale = max_side / max(img.size)
-    img = img.resize(
-        (int(img.size[0] * scale), int(img.size[1] * scale)), Image.BILINEAR
-    )
-    # (X, Y) layout: transpose PIL's (W, H)-indexed array.
-    return np.asarray(img, dtype=np.float64).T / 255.0
+    return load_reference_image(max_side=max_side)
 
 
 class TestSIFTAgainstIndependentImplementation:

@@ -17,7 +17,7 @@ from keystone_tpu.ops.nlp import (
     WordFrequencyEncoder,
 )
 
-_RES = "/root/reference/src/test/resources"
+from conftest import REFERENCE_RESOURCES as _RES
 
 
 class TestWindowingReference:
@@ -27,10 +27,9 @@ class TestWindowingReference:
     def test_windowing_real_image(self):
         """WindowingSuite 'windowing': every window is size×size and the
         count is (xDim/stride)·(yDim/stride) on the real test image."""
-        from PIL import Image
+        from conftest import load_reference_image
 
-        img = Image.open(os.path.join(_RES, "images/000012.jpg"))
-        arr = np.asarray(img, dtype=np.float64).transpose(1, 0, 2)  # (X, Y, C)
+        arr = load_reference_image()
         stride, size = 100, 50
 
         windows = np.asarray(Windower(stride, size).apply(arr))
