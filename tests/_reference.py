@@ -1,0 +1,36 @@
+"""Shared access to the reference checkout's committed fixture data
+(real images, solver matrices, datasets). Tests import from here; everything
+skips gracefully when the checkout is absent."""
+
+import os
+
+import numpy as np
+import pytest
+
+RESOURCES = "/root/reference/src/test/resources"
+
+needs_reference_fixtures = pytest.mark.skipif(
+    not os.path.isdir(RESOURCES),
+    reason="reference fixture checkout not available",
+)
+
+
+def load_reference_image():
+    """The real 000012.jpg as an (X, Y, C) float array in [0, 255]."""
+    from PIL import Image
+
+    img = Image.open(os.path.join(RESOURCES, "images/000012.jpg"))
+    return np.asarray(img, dtype=np.float64).transpose(1, 0, 2)
+
+
+def load_reference_image_gray(max_side):
+    """The same image as grayscale in [0, 1], downscaled so its longer side
+    is ``max_side`` (the SIFT golden tests' working size)."""
+    from PIL import Image
+
+    img = Image.open(os.path.join(RESOURCES, "images/000012.jpg")).convert("L")
+    scale = max_side / max(img.size)
+    img = img.resize(
+        (int(img.size[0] * scale), int(img.size[1] * scale)), Image.BILINEAR
+    )
+    return np.asarray(img, dtype=np.float64).T / 255.0

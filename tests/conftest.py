@@ -54,34 +54,3 @@ def mesh4x2():
     """A 4×2 data×model mesh."""
     return mesh_lib.make_mesh((4, 2), (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
 
-
-# --- Reference-checkout fixtures (real data files committed by the
-# reference; tests skip gracefully when the checkout is absent) -------------
-
-REFERENCE_RESOURCES = "/root/reference/src/test/resources"
-
-needs_reference_fixtures = pytest.mark.skipif(
-    not os.path.isdir(REFERENCE_RESOURCES),
-    reason="reference fixture checkout not available",
-)
-
-
-def load_reference_image(max_side=None):
-    """The real 000012.jpg test image as an (X, Y, C) float array; optional
-    grayscale downscale used by the SIFT golden tests."""
-    from PIL import Image
-
-    img = Image.open(os.path.join(REFERENCE_RESOURCES, "images/000012.jpg"))
-    if max_side is not None:
-        img = img.convert("L")
-        scale = max_side / max(img.size)
-        img = img.resize(
-            (int(img.size[0] * scale), int(img.size[1] * scale)),
-            Image.BILINEAR,
-        )
-        import numpy as _np
-
-        return _np.asarray(img, dtype=_np.float64).T / 255.0
-    import numpy as _np
-
-    return _np.asarray(img, dtype=_np.float64).transpose(1, 0, 2)

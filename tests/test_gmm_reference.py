@@ -13,7 +13,7 @@ from keystone_tpu.ops.learning.clustering import (
     GaussianMixtureModelEstimator,
 )
 
-from conftest import REFERENCE_RESOURCES as _RES
+from _reference import RESOURCES as _RES, needs_reference_fixtures
 
 
 def _fit(data, k, **kw):
@@ -64,9 +64,7 @@ class TestGMMReference:
         np.testing.assert_allclose(means, [-4.3673, 5.1604], atol=1e-3)
         np.testing.assert_allclose(variances, [1.1098, 0.86644], atol=1e-3)
 
-    @pytest.mark.skipif(
-        not os.path.isdir(_RES), reason="reference fixture checkout not available"
-    )
+    @needs_reference_fixtures
     def test_gmm_data_fixture(self):
         """'GMM Two Centers dataset 3' on the committed gmm_data.txt: centers
         ~0, variances ~{1, 25} crossed, weights ~1/2 (reference tolerances)."""

@@ -15,8 +15,8 @@ from keystone_tpu.ops.learning.clustering import KMeansPlusPlusEstimator
 from keystone_tpu.ops.learning.pca import PCATransformer
 from keystone_tpu.ops.stats import StandardScaler
 
-from conftest import (
-    REFERENCE_RESOURCES as _RES,
+from _reference import (
+    RESOURCES as _RES,
     load_reference_image as _real_image,
     needs_reference_fixtures as needs_reference,
 )

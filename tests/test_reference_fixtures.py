@@ -25,11 +25,9 @@ from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.bwls import BlockWeightedLeastSquaresEstimator
 from keystone_tpu.ops.learning.rwls import PerClassWeightedLeastSquaresEstimator
 
-from conftest import REFERENCE_RESOURCES as _RES
+from _reference import RESOURCES as _RES, needs_reference_fixtures
 
-pytestmark = pytest.mark.skipif(
-    not os.path.isdir(_RES), reason="reference fixture checkout not available"
-)
+pytestmark = needs_reference_fixtures
 
 
 def _load(name):

@@ -24,11 +24,9 @@ import os
 import numpy as np
 import pytest
 
-from conftest import REFERENCE_RESOURCES as _RES
+from _reference import RESOURCES as _RES, needs_reference_fixtures
 
-pytestmark = pytest.mark.skipif(
-    not os.path.isdir(_RES), reason="reference fixture checkout not available"
-)
+pytestmark = needs_reference_fixtures
 
 
 # ---------------------------------------------------------------------------
@@ -118,9 +116,9 @@ def numpy_dsift(image, bin_size, step):
 
 
 def _load_real_image(max_side=180):
-    from conftest import load_reference_image
+    from _reference import load_reference_image_gray
 
-    return load_reference_image(max_side=max_side)
+    return load_reference_image_gray(max_side)
 
 
 class TestSIFTAgainstIndependentImplementation:

@@ -17,17 +17,15 @@ from keystone_tpu.ops.nlp import (
     WordFrequencyEncoder,
 )
 
-from conftest import REFERENCE_RESOURCES as _RES
+from _reference import RESOURCES as _RES, needs_reference_fixtures
 
 
 class TestWindowingReference:
-    @pytest.mark.skipif(
-        not os.path.isdir(_RES), reason="reference fixture checkout not available"
-    )
+    @needs_reference_fixtures
     def test_windowing_real_image(self):
         """WindowingSuite 'windowing': every window is size×size and the
         count is (xDim/stride)·(yDim/stride) on the real test image."""
-        from conftest import load_reference_image
+        from _reference import load_reference_image
 
         arr = load_reference_image()
         stride, size = 100, 50
