@@ -188,6 +188,30 @@ class TestSparseLBFGS:
             atol=1e-10,
         )
 
+    def test_fitted_sparse_mapper_survives_save_load(self, tmp_path):
+        """A SparseLinearMapper inside a FittedPipeline must serialize and
+        reload with identical predictions (the FittedPipeline contract,
+        FittedPipeline.scala:12-22, extended to the round-2 sparse tier)."""
+        from keystone_tpu.workflow import FittedPipeline, Identity
+
+        rng = np.random.default_rng(8)
+        n, d, k, nnz = 32, 10, 2, 4
+        indices, values = _random_sparse(rng, n, d, nnz)
+        Y = rng.normal(size=(n, k))
+        ds = Dataset({"indices": indices, "values": values}, n=n)
+
+        pipe = Identity().and_then(
+            SparseLBFGSwithL2(1e-2, 30, num_features=d), ds, Dataset.of(Y)
+        )
+        fitted = pipe.fit()
+        before = np.asarray(fitted.apply(ds).array)
+
+        path = str(tmp_path / "sparse.pipeline")
+        fitted.save(path)
+        reloaded = FittedPipeline.load(path)
+        after = np.asarray(reloaded.apply(ds).array)
+        np.testing.assert_allclose(after, before, atol=1e-12)
+
     def test_amazon_shaped_run_never_densifies(self):
         """Amazon-geometry smoke run: d=16384 at sparsity ~0.005 (82 nnz of
         16384 — constantEstimator.R:34). The padded-COO operands are ~0.1%
