@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.utils import images as image_utils
 from keystone_tpu.workflow import Transformer
 
 
@@ -113,7 +114,9 @@ class Convolver(Transformer):
         (reference: Convolver.apply, Convolver.scala:60-89)."""
         f = jnp.asarray(filter_images, dtype=jnp.float32)
         if flip_filters:
-            f = f[:, ::-1, ::-1, :]
+            # MATLAB convnd parity: full x/y/channel reversal
+            # (Convolver.scala:67-70 via ImageUtils.flipImage).
+            f = jax.vmap(image_utils.flip_image)(f)
         packed = cls.pack_filters(f)
         if whitener is not None:
             packed = whitener.apply(packed) @ whitener.whitener.T

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.utils import images as images_util
 from keystone_tpu.utils.images import separable_conv2d_same
 from keystone_tpu.workflow import Transformer
 
@@ -67,8 +68,10 @@ class DaisyExtractor(Transformer):
                 # in [0, T) — the (t−1) offset is kept for parity
                 # (DaisyExtractor.scala:82-88, 174).
                 theta = 2 * math.pi * (t - 1) / self.T
-                self.offsets[l, t, 0] = int(round(rad * math.sin(theta)))
-                self.offsets[l, t, 1] = int(round(rad * math.cos(theta)))
+                # Java math.round = floor(x + 0.5) (half-up), not Python's
+                # banker's rounding (DaisyExtractor.scala:86-87).
+                self.offsets[l, t, 0] = int(math.floor(rad * math.sin(theta) + 0.5))
+                self.offsets[l, t, 1] = int(math.floor(rad * math.cos(theta) + 0.5))
         self._jit_features = jax.jit(self._features)
 
     def _normalize(self, h, axis):
@@ -115,7 +118,7 @@ class DaisyExtractor(Transformer):
         return feats.reshape(feats.shape[0], nx * ny)
 
     def apply(self, image):
-        image = jnp.asarray(image, jnp.float32)
+        image = images_util.as_float(image)
         if image.ndim == 2:
             image = image[:, :, None]
         return self._jit_features(image)

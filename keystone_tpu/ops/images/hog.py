@@ -8,6 +8,7 @@ work over the cell grid, all inside one jit per image shape.
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -15,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.utils import images as images_util
 from keystone_tpu.workflow import Transformer
 
 _EPSILON = 0.0001
@@ -23,11 +25,11 @@ _EPSILON = 0.0001
 # (HogExtractor.scala:39-59).
 _UU = np.array(
     [1.0, 0.9397, 0.7660, 0.5, 0.1736, -0.1736, -0.5, -0.7660, -0.9397],
-    dtype=np.float32,
+    dtype=np.float64,
 )
 _VV = np.array(
     [0.0, 0.3420, 0.6428, 0.8660, 0.9848, 0.9848, 0.8660, 0.6428, 0.3420],
-    dtype=np.float32,
+    dtype=np.float64,
 )
 
 
@@ -47,14 +49,21 @@ def _hog(image, bin_size: int, nx: int, ny: int):
     bdx, bdy = take(dx), take(dy)
     magnitude = jnp.sqrt(take(mag_sq))
 
-    # Snap to one of 18 orientations (HogExtractor.scala:115-129).
-    dots = _UU[None, None, :] * bdy[..., None] + _VV[None, None, :] * bdx[..., None]
-    all_dots = jnp.concatenate([dots, -dots], axis=-1)  # (…, 18)
-    best_o = jnp.argmax(all_dots, axis=-1)
+    # Snap to one of 18 orientations (HogExtractor.scala:115-129). The
+    # reference scans o = 0..8 checking dot then -dot with strict >, so ties
+    # resolve to the earliest candidate in the order d0, -d0, d1, -d1, …
+    # Interleaving preserves that order under argmax's first-wins ties
+    # (e.g. vv[4] == vv[5] ties on pure-dx gradients).
+    uu = jnp.asarray(_UU, dtype=image.dtype)
+    vv = jnp.asarray(_VV, dtype=image.dtype)
+    dots = uu[None, None, :] * bdy[..., None] + vv[None, None, :] * bdx[..., None]
+    scan = jnp.stack([dots, -dots], axis=-1).reshape(dots.shape[:-1] + (18,))
+    best_j = jnp.argmax(scan, axis=-1)
+    best_o = (best_j >> 1) + 9 * (best_j & 1)
 
     # Bilinear binning into the cell grid (HogExtractor.scala:131-161).
-    xs = jnp.arange(1, vis_x - 1, dtype=jnp.float32)[:, None]
-    ys = jnp.arange(1, vis_y - 1, dtype=jnp.float32)[None, :]
+    xs = jnp.arange(1, vis_x - 1, dtype=image.dtype)[:, None]
+    ys = jnp.arange(1, vis_y - 1, dtype=image.dtype)[None, :]
     xp = (xs + 0.5) / bin_size - 0.5
     yp = (ys + 0.5) / bin_size - 0.5
     ixp = jnp.floor(xp).astype(jnp.int32)
@@ -71,7 +80,7 @@ def _hog(image, bin_size: int, nx: int, ny: int):
     wx1 = jnp.broadcast_to(vx1, magnitude.shape)
     wy1 = jnp.broadcast_to(vy1, magnitude.shape)
 
-    hist = jnp.zeros((nx, ny, 18), dtype=jnp.float32)
+    hist = jnp.zeros((nx, ny, 18), dtype=image.dtype)
     for cell_x, cell_y, w in (
         (ixp, iyp, wx1 * wy1),
         (ixp, iyp + 1, wx1 * wy0),
@@ -90,7 +99,7 @@ def _hog(image, bin_size: int, nx: int, ny: int):
 
     nxf, nyf = max(nx - 2, 0), max(ny - 2, 0)
     if nxf == 0 or nyf == 0:
-        return jnp.zeros((0, 32), dtype=jnp.float32)
+        return jnp.zeros((0, 32), dtype=image.dtype)
 
     # 2x2 block sums; the four normalizers per feature cell
     # (HogExtractor.scala:211-232).
@@ -112,7 +121,7 @@ def _hog(image, bin_size: int, nx: int, ny: int):
     texture = 0.2357 * jnp.stack(
         [jnp.sum(c, axis=-1) for c in (c1, c2, c3, c4)], axis=-1
     )  # 4
-    trunc = jnp.zeros(sensitive.shape[:2] + (1,), dtype=jnp.float32)
+    trunc = jnp.zeros(sensitive.shape[:2] + (1,), dtype=image.dtype)
 
     feats = jnp.concatenate([sensitive, insensitive, texture, trunc], axis=-1)
     return feats.reshape(nxf * nyf, 32)
@@ -126,16 +135,17 @@ class HogExtractor(Transformer):
         self.bin_size = bin_size
 
     def apply(self, image):
-        image = jnp.asarray(image, jnp.float32)
-        nx = int(round(image.shape[0] / self.bin_size))
-        ny = int(round(image.shape[1] / self.bin_size))
+        image = images_util.as_float(image)
+        # Java math.round = floor(x + 0.5) (HogExtractor.scala:64-65).
+        nx = int(math.floor(image.shape[0] / self.bin_size + 0.5))
+        ny = int(math.floor(image.shape[1] / self.bin_size + 0.5))
         return _hog(image, self.bin_size, nx, ny)
 
     def batch_apply(self, data: Dataset) -> Dataset:
         if data.is_host:
             return data.map(self.apply)
         X = jnp.asarray(data.array, jnp.float32)
-        nx = int(round(X.shape[1] / self.bin_size))
-        ny = int(round(X.shape[2] / self.bin_size))
+        nx = int(math.floor(X.shape[1] / self.bin_size + 0.5))
+        ny = int(math.floor(X.shape[2] / self.bin_size + 0.5))
         out = jax.vmap(lambda im: _hog(im, self.bin_size, nx, ny))(X)
         return Dataset(out, n=data.n, mesh=data.mesh)

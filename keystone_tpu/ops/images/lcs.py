@@ -13,6 +13,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.utils import images as images_util
 from keystone_tpu.utils.images import separable_conv2d_same
 from keystone_tpu.workflow import Transformer
 
@@ -66,7 +67,7 @@ class LCSExtractor(Transformer):
         return feats.reshape(feats.shape[0], len(xs) * len(ys))
 
     def apply(self, image):
-        image = jnp.asarray(image, jnp.float32)
+        image = images_util.as_float(image)
         if image.ndim == 2:
             image = image[:, :, None]
         return self._jit_features(image)

@@ -65,17 +65,36 @@ def load_image(source: Union[str, bytes]) -> np.ndarray:
 # Elementwise / geometric ops (reference: utils/ImageUtils.scala)
 # ---------------------------------------------------------------------------
 
-# ITU-R 601 luma weights, as used by the reference's grayscale conversion.
-_LUMA = np.array([0.299, 0.587, 0.114], dtype=np.float32)
+# MATLAB rgb2gray / NTSC weights, exactly as the reference spells them
+# (ImageUtils.toGrayScale: 0.2989 R + 0.5870 G + 0.1140 B on BGR data; our
+# arrays are RGB so the weight vector is applied in R,G,B order).
+_LUMA = np.array([0.2989, 0.5870, 0.1140], dtype=np.float64)
+
+
+def as_float(img):
+    """Promote to float32 unless the input is already float32-or-wider.
+
+    Golden-parity tests run the extractors in float64 (jax x64 mode) to match
+    the reference's double-precision math; normal TPU paths stay float32, and
+    half-precision inputs (bf16/f16) are promoted so histogram/gradient
+    accumulation never runs with an 8-bit mantissa."""
+    img = jnp.asarray(img)
+    if (
+        not jnp.issubdtype(img.dtype, jnp.floating)
+        or jnp.finfo(img.dtype).bits < 32
+    ):
+        img = img.astype(jnp.float32)
+    return img
 
 
 def to_grayscale(img):
     """(x, y, c) -> (x, y, 1) luminance (ImageUtils.toGrayScale)."""
-    img = jnp.asarray(img)
+    img = as_float(img)
     if img.shape[-1] == 1:
         return img
     if img.shape[-1] == 3:
-        return jnp.tensordot(img, jnp.asarray(_LUMA), axes=[[-1], [0]])[..., None]
+        luma = jnp.asarray(_LUMA, dtype=img.dtype)
+        return jnp.tensordot(img, luma, axes=[[-1], [0]])[..., None]
     return jnp.mean(img, axis=-1, keepdims=True)
 
 
@@ -90,16 +109,17 @@ def flip_horizontal(img):
 
 
 def flip_image(img):
-    """Flip both spatial axes (ImageUtils.flipImage; used for MATLAB-style
-    convolution filter flipping)."""
-    return jnp.asarray(img)[::-1, ::-1, :]
+    """Flip both spatial axes AND channels (ImageUtils.flipImage reverses
+    x, y and c — MATLAB convnd-style full reversal, ImageUtils.scala:376-389;
+    used for convolution filter flipping)."""
+    return jnp.asarray(img)[::-1, ::-1, ::-1]
 
 
 def conv2d_valid(img, kernel):
     """Per-channel 2-D valid cross-correlation of one (x, y, c) image with one
     (kx, ky) kernel (ImageUtils.conv2D). Compiles to an XLA conv (MXU)."""
-    img = jnp.asarray(img, dtype=jnp.float32)
-    kernel = jnp.asarray(kernel, dtype=jnp.float32)
+    img = as_float(img)
+    kernel = jnp.asarray(kernel, dtype=img.dtype)
     lhs = jnp.transpose(img, (2, 0, 1))[:, None, :, :]  # (c, 1, x, y)
     rhs = kernel[None, None, :, :]  # (1, 1, kx, ky)
     out = lax.conv_general_dilated(
@@ -113,11 +133,11 @@ def separable_conv2d_same(img, x_filter, y_filter):
     reference's ImageUtils.conv2D (utils/images/ImageUtils.scala:226-320):
     kernels are flipped (convolution, not correlation) and the output has the
     input's spatial size."""
-    img = jnp.asarray(img, dtype=jnp.float32)
+    img = as_float(img)
     if img.ndim == 2:
         img = img[:, :, None]
-    kx = jnp.asarray(x_filter, dtype=jnp.float32)[::-1]
-    ky = jnp.asarray(y_filter, dtype=jnp.float32)[::-1]
+    kx = jnp.asarray(x_filter, dtype=img.dtype)[::-1]
+    ky = jnp.asarray(y_filter, dtype=img.dtype)[::-1]
     lx = kx.shape[0]
     ly = ky.shape[0]
     pad_xl, pad_xh = (lx - 1) // 2, lx - 1 - (lx - 1) // 2
