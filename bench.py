@@ -128,10 +128,10 @@ def main():
         )
         R = Y - preds
         loss = jnp.sum(R * R) / R.shape[0]
-        train_err = jnp.mean(
+        train_acc = jnp.mean(
             jnp.argmax(preds, axis=1) == jnp.argmax(Y, axis=1)
         )
-        return loss, 1.0 - train_err
+        return loss, 1.0 - train_acc
 
     def run_once():
         W, checksum = train_step(X, Wrf_flat, brf_flat, Y)

@@ -8,6 +8,7 @@ distributed solvers, and example end-to-end workloads — with sharded
 `jax.Array`s over a TPU device mesh in place of RDDs over a Spark cluster.
 """
 
+import logging as _logging
 import os as _os
 
 import jax as _jax
@@ -28,6 +29,12 @@ if "KEYSTONE_MATMUL_PRECISION" in _os.environ:
     )
 elif _jax.config.jax_default_matmul_precision is None:
     _jax.config.update("jax_default_matmul_precision", "float32")
+    # Process-global side effect on host applications sharing this process:
+    # say so once (suppress with KEYSTONE_MATMUL_PRECISION).
+    _logging.getLogger("keystone_tpu").info(
+        "keystone_tpu set jax_default_matmul_precision=float32 for solver "
+        "accuracy on TPU; set KEYSTONE_MATMUL_PRECISION to override."
+    )
 
 from keystone_tpu.data import Dataset, LabeledData
 from keystone_tpu.workflow import (

@@ -315,8 +315,11 @@ VOC_NUM_CLASSES = 20
 
 def load_voc(data_path: str, labels_path: str, name_prefix: str = "") -> Dataset:
     """VOC2007 tar + CSV multi-labels -> Dataset of MultiLabeledImage
-    (reference: VOCLoader.scala:16-53). The CSV has a header; column 4 is the
-    quoted filename, column 1 the 1-based class id."""
+    (reference: VOCLoader.scala:29-50, ImageLoaderUtils.scala:72-92). The CSV
+    has a header; column 4 is the quoted filename — the FULL tar entry path,
+    which is also the label-map key and the stored filename — and column 1 the
+    1-based class id. ``name_prefix`` filters full entry names (the
+    reference's namePrefix, e.g. "VOCdevkit/VOC2007/JPEGImages/")."""
     from keystone_tpu.utils.images import crop_to_multiple
 
     labels_map: Dict[str, List[int]] = {}
@@ -331,16 +334,15 @@ def load_voc(data_path: str, labels_path: str, name_prefix: str = "") -> Dataset
     out: List[MultiLabeledImage] = []
     for tar_path in _tar_paths(data_path):
         for name, img in iter_tar_images(tar_path):
-            base = name.split("/")[-1]
-            if name_prefix and not base.startswith(name_prefix):
+            if name_prefix and not name.startswith(name_prefix):
                 continue
-            if base in labels_map:
+            if name in labels_map:
                 # Shape-bucket photos so similar sizes share XLA executables.
                 out.append(
                     MultiLabeledImage(
                         crop_to_multiple(img),
-                        np.asarray(sorted(labels_map[base])),
-                        base,
+                        np.asarray(sorted(labels_map[name])),
+                        name,
                     )
                 )
     return Dataset(out)

@@ -55,7 +55,11 @@ class SparseLinearMapper(Transformer):
         if isinstance(v, dict) and set(v.keys()) == {"indices", "values"}:
             idx = np.asarray(v["indices"])
             val = np.asarray(v["values"])
-            m = idx >= 0
+            # Drop out-of-range indices on both sides, matching
+            # sparse_matmul's documented drop semantics (a bare idx >= 0
+            # would clamp idx >= d to the last model row under JAX fancy
+            # indexing and add a spurious contribution).
+            m = (idx >= 0) & (idx < self.x.shape[0])
             out = jnp.asarray(val[m]) @ self.x[jnp.asarray(idx[m])]
         else:
             out = jnp.asarray(v) @ self.x

@@ -135,7 +135,7 @@ def _gram_cache_ok(num_iter: int, gram_bytes: int) -> bool:
     return num_iter > 1 and gram_bytes <= (1 << 30)
 
 
-@functools.lru_cache(maxsize=None)
+@functools.lru_cache(maxsize=8)  # bounded: cached meshes pin compiled executables
 def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
     """Compiled per-block BCD step for a row-sharded design matrix.
 

@@ -274,6 +274,43 @@ def ring_attention(
     )(Q, K, V)
 
 
+def ring_attention_dataset(
+    q_data,
+    k_data=None,
+    v_data=None,
+    mesh: Optional[Mesh] = None,
+    causal: bool = False,
+    scale: Optional[float] = None,
+):
+    """Dataset-aware :func:`ring_attention`: threads ``Dataset.n`` through as
+    ``n_valid`` so mesh zero-padding can never be silently softmax-weighted
+    (a zero-padded key row scores 0, and score 0 still gets weight — the one
+    padding case the zero-row invariant does NOT cover). ``k_data`` defaults
+    to ``q_data`` (self-attention) and ``v_data`` to ``k_data``; all inputs
+    must share one padded length and true row count. The mesh defaults to
+    the one ``q_data`` is sharded over."""
+    k_data = q_data if k_data is None else k_data
+    v_data = k_data if v_data is None else v_data
+    if not (q_data.n == k_data.n == v_data.n):
+        raise ValueError(
+            f"ring_attention_dataset needs matching true row counts, got "
+            f"{q_data.n}, {k_data.n}, {v_data.n}"
+        )
+    from keystone_tpu.data import Dataset
+
+    mesh = mesh or q_data.mesh
+    out = ring_attention(
+        q_data.array,
+        k_data.array,
+        v_data.array,
+        mesh=mesh,
+        causal=causal,
+        scale=scale,
+        n_valid=q_data.n,
+    )
+    return Dataset(out, n=q_data.n, mesh=mesh)
+
+
 def ring_gram(A, mesh: Optional[Mesh] = None):
     """AᵀA over row-sharded A, with the (d, d) result scattered over the
     mesh: each device ends with a (d/P, d) row stripe via ``psum_scatter``

@@ -50,6 +50,8 @@ def _exact_ridge_errors(F_train, Y_train, F_test, lam):
 
 
 def digits_parity(lam=1e-6):
+    import jax
+
     from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
     from keystone_tpu.pipelines import mnist_random_fft as mp
 
@@ -89,6 +91,8 @@ def digits_parity(lam=1e-6):
         "exact_train_err": round(exact_train_err, 4),
         "exact_test_err": round(exact_test_err, 4),
         "wallclock_s": round(wall, 2),
+        "wallclock_note": "dominated by per-FFT compile; not a perf claim (see bench.py)",
+        "device": str(jax.devices()[0]),
     }
 
 
@@ -176,15 +180,180 @@ def timit_loss_parity():
     }
 
 
+def voc_real_end_to_end():
+    """Real-data VOC end-to-end: the full image stack (real JPEG decode →
+    SIFT → PCA → GMM Fisher vectors → BlockLeastSquares → MAP) on the
+    reference's committed voctest.tar (VOCSIFTFisher.scala:23-105,
+    VOCLoaderSuite fixtures). With train == test == the 10 committed
+    images, every class present in the data must rank perfectly."""
+    import os
+
+    import jax
+
+    from keystone_tpu.pipelines.voc_sift_fisher import VOCConfig, run
+
+    images = "/root/reference/src/test/resources/images"
+    if not os.path.exists(os.path.join(images, "voc/voctest.tar")):
+        return {
+            "workload": "voc_sift_fisher_real_jpegs",
+            "skipped": "reference voctest.tar fixture not available",
+        }
+    cfg = VOCConfig(
+        train_location=os.path.join(images, "voc"),
+        train_labels=os.path.join(images, "voclabels.csv"),
+        test_location=os.path.join(images, "voc"),
+        test_labels=os.path.join(images, "voclabels.csv"),
+        descriptor_dim=32,
+        vocab_size=4,
+        sift_scale_step=2,
+        lam=0.5,
+    )
+    t0 = time.perf_counter()
+    _, aps, mean_ap = run(cfg)
+    wall = time.perf_counter() - t0
+    aps = np.asarray(aps)
+    return {
+        "workload": "voc_sift_fisher_real_jpegs",
+        "data": "real VOC2007 sample (committed voctest.tar: 10 JPEGs, 9 distinct classes)",
+        "config": "descDim=32, vocabSize=4, scaleStep=2, lam=0.5 (mini config; train==test)",
+        "mean_average_precision": round(float(mean_ap), 4),
+        "classes_with_perfect_ap": int((aps > 0.99).sum()),
+        "classes_present_in_data": 9,
+        "expectation": "all 9 present classes AP 1.0 -> MAP 9/20 = 0.45",
+        "wallclock_s": round(wall, 2),
+        "device": str(jax.devices()[0]),
+    }
+
+
+def cifar_shaped_parity():
+    """RandomPatchCifar-shaped parity (RandomPatchCifar.scala:21-86): the
+    conv → symmetric-rectify → sum-pool → StandardScaler featurization with
+    whitened random-patch filters, then the shipped BCD solver versus an
+    independent float64 exact ridge solve on the IDENTICAL features.
+    Synthetic 32x32 images — the claim is featurizer/solver parity, not
+    CIFAR accuracy (real CIFAR archives are unavailable offline)."""
+    import jax
+
+    from keystone_tpu.ops.stats import StandardScaler
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines import cifar as cp
+
+    config = cp.CifarConfig(synthetic_n=512, num_filters=64, lam=10.0)
+    t0 = time.perf_counter()
+    pipeline, train_eval, test_eval = cp.run_random_patch_cifar(config)
+    wall = time.perf_counter() - t0
+
+    # Rebuild the identical (seeded) featurization and solve exactly in f64.
+    train, test, _ = cp._load(config)
+    filters, whitener = cp._sample_whitened_filters(train, config)
+    featurizer = cp._conv_featurizer(filters, whitener, config)
+    train_feats = featurizer.apply(train.data).get()
+    scaler = StandardScaler().fit(train_feats)
+    F_train = np.asarray(scaler.batch_apply(train_feats).array)[: train.data.n]
+    F_test = np.asarray(
+        scaler.batch_apply(featurizer.apply(test.data).get()).array
+    )[: test.data.n]
+    Y = np.asarray(
+        ClassLabelIndicatorsFromIntLabels(10)(train.labels).array
+    )[: train.data.n]
+    p_tr, p_te = _exact_ridge_errors(F_train, Y, F_test, config.lam)
+    exact_train = float((p_tr.argmax(1) != np.asarray(train.labels.array)[: train.data.n]).mean())
+    exact_test = float((p_te.argmax(1) != np.asarray(test.labels.array)[: test.data.n]).mean())
+    # Per-example agreement with the exact solver (meaningful even when
+    # both error columns are 0 on the separable synthetic classes).
+    pipe_preds = np.asarray(pipeline.apply(test.data).get().array)[: test.data.n]
+    agreement = float((pipe_preds.reshape(-1) == p_te.argmax(1)).mean())
+    return {
+        "workload": "randompatch_cifar_shaped_parity",
+        "prediction_agreement_vs_exact": round(agreement, 4),
+        "data": "CIFAR-shaped synthetic 32x32x3 (real CIFAR archive unavailable offline)",
+        "config": "numFilters=64, patch=6, pool=10/9, alpha=0.25, lam=10, blockSize=512",
+        "train_err": round(float(train_eval.total_error), 4),
+        "test_err": round(float(test_eval.total_error), 4),
+        "exact_train_err": round(exact_train, 4),
+        "exact_test_err": round(exact_test, 4),
+        "wallclock_s": round(wall, 2),
+        "device": str(jax.devices()[0]),
+    }
+
+
+def amazon_shaped_parity():
+    """Amazon-shaped sparse parity (solver-comparisons-final.csv:2-13
+    geometry, subsampled): n >> d padded-COO text-like features through the
+    never-densify SparseLBFGSwithL2 versus an independent float64 exact
+    ridge solve of the same objective (½‖XW−Y‖²/n + ½λ‖W‖², intercept via
+    the append-ones column, LBFGS.scala:208-281)."""
+    import jax
+
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+
+    rng = np.random.default_rng(11)
+    n, d, k, nnz = 30_000, 2_048, 2, 16  # ~0.8% density, n >> d
+    lam = 1e-3
+    # Class-dependent sparse features so the error column is non-degenerate.
+    labels = rng.integers(0, k, size=n)
+    cols = rng.integers(0, d, size=(n, nnz)).astype(np.int32)
+    cols.sort(axis=1)
+    signal = np.where(cols < d // 8, (2.0 * labels[:, None] - 1.0), 0.0)
+    values = (rng.normal(size=(n, nnz)) + 1.5 * signal).astype(np.float32)
+    Y = (2.0 * np.eye(k)[labels] - 1.0).astype(np.float32)
+
+    ds = Dataset({"indices": cols, "values": values}, n=n)
+    t0 = time.perf_counter()
+    model = SparseLBFGSwithL2(
+        lam=lam, num_iterations=60, num_features=d
+    ).fit(ds, Dataset.of(Y))
+    preds = np.asarray(model.batch_apply(ds).array)
+    wall = time.perf_counter() - t0
+    lbfgs_err = float((preds.argmax(1) != labels).mean())
+    lbfgs_loss = float(0.5 * np.sum((preds - Y) ** 2) / n)
+
+    # Independent f64 exact solve of the identical objective (dense is
+    # feasible at this subsampled geometry: 30k x 2k).
+    X = np.zeros((n, d + 1))
+    np.add.at(X, (np.arange(n)[:, None], cols), values.astype(np.float64))
+    X[:, d] = 1.0
+    G = X.T @ X + n * lam * np.eye(d + 1)
+    W1 = np.linalg.solve(G, X.T @ Y.astype(np.float64))
+    p_exact = X @ W1
+    exact_err = float((p_exact.argmax(1) != labels).mean())
+    exact_loss = float(0.5 * np.sum((p_exact - Y) ** 2) / n)
+    return {
+        "workload": "amazon_shaped_sparse_parity",
+        "data": "Amazon-geometry synthetic sparse COO (real reviews corpus unavailable offline)",
+        "config": f"n={n}, d={d}, nnz/row={nnz} (~{nnz/d:.3%}), lam={lam}, iters=60, never-densify",
+        "lbfgs_err": round(lbfgs_err, 4),
+        "exact_err": round(exact_err, 4),
+        "lbfgs_loss": round(lbfgs_loss, 6),
+        "exact_loss": round(exact_loss, 6),
+        "loss_ratio": round(lbfgs_loss / max(exact_loss, 1e-12), 6),
+        "csv_reference": "Amazon LBFGS d=16384: err 11.4%, 52.29s @ 16 nodes (csv:13) — real-data target, unreachable offline",
+        "wallclock_s": round(wall, 2),
+        "device": str(jax.devices()[0]),
+    }
+
+
 def main():
     results = {
-        "rows": [digits_parity(), timit_loss_parity()],
+        "rows": [
+            digits_parity(),
+            timit_loss_parity(),
+            voc_real_end_to_end(),
+            cifar_shaped_parity(),
+            amazon_shaped_parity(),
+        ],
         "note": (
             "Parity evidence: the BCD solver reaches the independent exact "
-            "solver's error on real data at equal hyperparameters, and its "
+            "solver's error on real data at equal hyperparameters, its "
             "ridge loss matches the exact optimum at the reference's TIMIT "
-            "geometry. The CSV's absolute error targets require the "
-            "licensed TIMIT/ImageNet data, unavailable in this environment."
+            "geometry, the full real-JPEG image stack ranks the committed "
+            "VOC sample perfectly, and the CIFAR-shaped conv stack and "
+            "Amazon-shaped sparse LBFGS match independent float64 exact "
+            "solves. The CSV's absolute error targets require the licensed "
+            "TIMIT/ImageNet data, unavailable in this environment. "
+            "Wallclocks labeled by device; CPU rows are test-env numbers, "
+            "not perf claims (see bench.py for TPU perf)."
         ),
     }
     out = json.dumps(results, indent=2)

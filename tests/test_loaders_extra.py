@@ -114,11 +114,13 @@ class TestImageArchives:
         tar = tmp_path / "voc.tar"
         _make_tar(tar, [("VOC2007/img1.ppm", _ppm_bytes(img))])
         csv = tmp_path / "labels.csv"
+        # Filenames are full tar entry paths, as in the reference's
+        # voclabels.csv (VOCLoader.scala:40 keys labelsMap by entry name).
         csv.write_text(
             "header,class,x,y,filename\n"
-            'r,3,_,_,"img1.ppm"\n'
-            'r,7,_,_,"img1.ppm"\n'
-            'r,1,_,_,"other.ppm"\n'
+            'r,3,_,_,"VOC2007/img1.ppm"\n'
+            'r,7,_,_,"VOC2007/img1.ppm"\n'
+            'r,1,_,_,"VOC2007/other.ppm"\n'
         )
         out = load_voc(str(tar), str(csv)).to_list()
         assert len(out) == 1

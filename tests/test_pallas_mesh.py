@@ -223,6 +223,34 @@ class TestRingAttention:
         )
         assert np.isfinite(np.asarray(out, dtype=np.float32)).all()
 
+    def test_dataset_wrapper_threads_n_valid(self):
+        """ring_attention_dataset wires Dataset.n through as n_valid, so a
+        mesh-padded Dataset caller cannot silently softmax-weight ghost
+        keys (the ADVICE finding's failure mode)."""
+        from keystone_tpu.data import Dataset
+
+        rng = np.random.default_rng(13)
+        n, d = 500, 8  # pads to 504 over 8 shards
+        Q = rng.normal(size=(n, d))
+        mesh = _mesh()
+        ds = Dataset.of(Q).shard(mesh)
+        assert ds.array.shape[0] > n  # actually padded
+        out = ring.ring_attention_dataset(ds, mesh=mesh)
+        arr = np.asarray(out.array)
+        assert out.n == n
+        np.testing.assert_allclose(arr[:n], self._ref(Q, Q, Q, False), atol=1e-10)
+        np.testing.assert_allclose(arr[n:], 0.0, atol=0)
+
+    def test_dataset_wrapper_rejects_mismatched_counts(self):
+        from keystone_tpu.data import Dataset
+
+        rng = np.random.default_rng(14)
+        mesh = _mesh()
+        q = Dataset.of(rng.normal(size=(16, 4))).shard(mesh)
+        k = Dataset.of(rng.normal(size=(24, 4))).shard(mesh)
+        with pytest.raises(ValueError, match="matching true row counts"):
+            ring.ring_attention_dataset(q, k, mesh=mesh)
+
     def test_long_sequence_memory_shape(self):
         # 8 shards of 128 rows: per-device score blocks are (128, 128) even
         # though the full matrix would be (1024, 1024).

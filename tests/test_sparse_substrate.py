@@ -127,6 +127,21 @@ class TestSparseLinearMapper:
         )
         np.testing.assert_allclose(out, 2.0 * W[1] - W[3], atol=1e-12)
 
+    def test_out_of_range_indices_dropped_in_apply(self):
+        """apply must share sparse_matmul's drop semantics for idx >= d —
+        a bare idx >= 0 filter would clamp to the last model row under JAX
+        fancy indexing and add a spurious contribution."""
+        W = np.arange(12.0).reshape(4, 3)
+        out = np.asarray(
+            SparseLinearMapper(W).apply(
+                {
+                    "indices": np.array([1, 7, -1]),  # 7 >= d, -1 padding
+                    "values": np.array([2.0, 5.0, 3.0]),
+                }
+            )
+        )
+        np.testing.assert_allclose(out, 2.0 * W[1], atol=1e-12)
+
     def test_dense_input_falls_through(self):
         rng = np.random.default_rng(3)
         X = rng.normal(size=(10, 4))

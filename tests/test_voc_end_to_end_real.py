@@ -1,0 +1,50 @@
+"""Full VOCSIFTFisher end-to-end on the reference's real committed archive:
+load voctest.tar (real JPEG decode) → SIFT → PCA → GMM Fisher vectors →
+block least squares → mean average precision.
+
+This is the best offline-feasible real-data integration of the whole image
+stack (VOCSIFTFisher.scala:23-105 composition; VOCLoaderSuite fixtures).
+With train == test == the 10 committed images, a correct pipeline must rank
+its own training images perfectly for every class that appears in the data:
+9 distinct classes → 9 APs of 1.0 → MAP = 9/20 = 0.45 (absent classes
+score AP 0 by the evaluator's convention, matching the reference's
+MeanAveragePrecisionEvaluator on empty actuals).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from _reference import RESOURCES, needs_reference_fixtures
+
+IMAGES = os.path.join(RESOURCES, "images")
+
+
+@needs_reference_fixtures
+def test_voc_sift_fisher_on_real_archive():
+    if not os.path.exists(os.path.join(IMAGES, "voc/voctest.tar")):
+        pytest.skip("voctest.tar not available")
+
+    from keystone_tpu.pipelines.voc_sift_fisher import VOCConfig, run
+
+    cfg = VOCConfig(
+        train_location=os.path.join(IMAGES, "voc"),
+        train_labels=os.path.join(IMAGES, "voclabels.csv"),
+        test_location=os.path.join(IMAGES, "voc"),
+        test_labels=os.path.join(IMAGES, "voclabels.csv"),
+        # Mini config: enough capacity to separate 10 images, small enough
+        # to run in CI (full reference config: descDim=80, vocab=64).
+        descriptor_dim=32,
+        vocab_size=4,
+        sift_scale_step=2,
+        lam=0.5,
+    )
+    _, aps, mean_ap = run(cfg)
+    aps = np.asarray(aps)
+
+    assert aps.shape == (20,)
+    # The 9 classes present among the 10 images must all rank (near-)
+    # perfectly on their own training data; absent classes score 0.
+    assert (aps > 0.99).sum() >= 8
+    assert mean_ap >= 0.4
