@@ -99,7 +99,23 @@ _IRREGULAR: Dict[str, str] = {
     "further": "far", "farther": "far", "furthest": "far",
     "farthest": "far", "less": "little", "least": "little",
     "more": "much", "most": "much", "elder": "old", "eldest": "old",
+    # --- lexical pasts whose stem needs the e the rules can't infer
+    "united": "unite", "cited": "cite", "invited": "invite",
+    # --- -us nouns whose plural drops -es (vs "uses" -> "use")
+    "buses": "bus", "viruses": "virus", "bonuses": "bonus",
+    "campuses": "campus", "statuses": "status", "censuses": "census",
 }
+
+# Surface forms that look inflected but are not (Morpha ships the same kind
+# of exception list in its verbstem/noun tables): adverbs and nouns in -s,
+# -ing nouns/prepositions, -ed-looking words.
+_UNINFLECTED = frozenset({
+    "always", "perhaps", "lens", "besides", "whereas", "alas", "thus",
+    "morning", "evening", "during", "ceiling", "darling", "sibling",
+    "something", "anything", "everything", "nothing",
+    "hundred", "kindred", "sacred", "naked", "wicked", "rugged",
+    "wretched", "beloved",
+})
 
 # Words ending in "-ss"/"-us"/"-is" etc. that the -s rules must not touch.
 _S_EXCEPTIONS = ("ss", "us", "is", "ous", "news")
@@ -141,6 +157,14 @@ def _restore_e(stem: str) -> str:
         if single_vowel and _vowel_groups(stem) == 1:
             return stem + "e"
     if stem.endswith(("at", "iz", "ys")) and _vowel_groups(stem) <= 2:
+        return stem + "e"
+    # C+"id" stems: decid-, provid-, divid-, resid- -> +e (vowel-"id" stems
+    # like raid-/avoid- are real bases and keep their form).
+    if (
+        len(stem) >= 4
+        and stem.endswith("id")
+        and stem[-3] not in _VOWELS
+    ):
         return stem + "e"
     if len(stem) >= 1 and stem[-1] in "uv":  # argu-, lov-, believ-, continu-
         return stem + "e"
@@ -202,6 +226,8 @@ def lemmatize(word: str) -> str:
     # to "be", so the table outranks the short-word guard.
     if w in _IRREGULAR:
         return _IRREGULAR[w]
+    if w in _UNINFLECTED:
+        return w
     if len(w) <= 2:
         return w
     if w.endswith("ing"):

@@ -461,6 +461,100 @@ class StupidBackoffModel(Transformer):
         )
 
 
+def partition_ngram_pairs(
+    pairs, num_partitions: int, indexer: Optional[BackoffIndexer] = None
+):
+    """reduceByKey with the InitialBigramPartitioner, host side
+    (StupidBackoff.scala:152-156): merge duplicate n-gram counts and bucket
+    them by :func:`initial_bigram_partition`. Returns a list of
+    ``num_partitions`` lists of (NGram, count).
+
+    The partitioner's invariant makes per-partition scoring exact: an
+    n-gram's context (its first n−1 words) shares the initial bigram, so
+    every count the score recursion reads for an OBSERVED n-gram lives in
+    the same partition (order-2 contexts read the replicated unigram table
+    instead), and the freq==0 backoff branch is unreachable during fit.
+    """
+    indexer = indexer or NGramIndexerImpl()
+    merged: Dict[NGram, int] = {}
+    for ngram, c in pairs:
+        key = ngram if isinstance(ngram, NGram) else NGram(ngram)
+        merged[key] = merged.get(key, 0) + int(c)
+    parts = [[] for _ in range(num_partitions)]
+    for ngram, c in merged.items():
+        parts[initial_bigram_partition(ngram, num_partitions, indexer)].append(
+            (ngram, c)
+        )
+    return parts
+
+
+def pack_ngram_pairs(pairs) -> "np.ndarray":
+    """(NGram, count) pairs -> (m, 2) int64 array ``[packed_id, count]`` —
+    the wire format for exchanging count shards across hosts as ONE device
+    array (all_gather over DCN) instead of pickled host objects. Uses
+    NaiveBitPackIndexer: integer word ids < 2^20, orders 1-3
+    (indexers.scala:43-115).
+
+    The packed ids use up to 62 bits: callers moving this array through
+    device collectives must run with jax x64 enabled, or the values are
+    silently truncated to int32."""
+    import numpy as np
+
+    packer = NaiveBitPackIndexer()
+    out = np.empty((len(pairs), 2), dtype=np.int64)
+    for i, (ngram, c) in enumerate(pairs):
+        words = ngram.words if isinstance(ngram, NGram) else tuple(ngram)
+        out[i, 0] = packer.pack(words)
+        out[i, 1] = int(c)
+    return out
+
+
+def unpack_ngram_pairs(arr) -> List[Tuple[NGram, int]]:
+    """Inverse of :func:`pack_ngram_pairs`."""
+    packer = NaiveBitPackIndexer()
+    out = []
+    for packed, c in arr.tolist():
+        order = packer.ngram_order(packed)
+        words = tuple(packer.unpack(packed, p) for p in range(order))
+        out.append((NGram(words), int(c)))
+    return out
+
+
+class ShardedStupidBackoffModel(Transformer):
+    """Multi-host LM serving: one StupidBackoffModel per initial-bigram
+    partition. EVERY count lookup routes to its owning shard — not just the
+    top-level query — because the backoff step drops the FIRST word, which
+    changes the initial bigram and so the owning partition. This mirrors
+    the reference's ``ngramCounts.lookup`` on the partitioned RDD, where
+    the partitioner routes each lookup (StupidBackoff.scala:96-125)."""
+
+    def __init__(self, shards: List["StupidBackoffModel"], indexer=None):
+        self.shards = shards
+        self.indexer = indexer or NGramIndexerImpl()
+
+    def _count(self, ngram: NGram) -> int:
+        pid = initial_bigram_partition(ngram, len(self.shards), self.indexer)
+        return self.shards[pid].ngram_counts.get(ngram, 0)
+
+    def score(self, ngram: NGram) -> float:
+        head = self.shards[0]  # unigram table/α replicated across shards
+        return _score_locally(
+            self.indexer,
+            head.unigram_counts,
+            self._count,
+            head.num_tokens,
+            head.alpha,
+            1.0,
+            ngram,
+            self._count(ngram),
+        )
+
+    def apply(self, ignored):
+        raise NotImplementedError(
+            "Doesn't make sense to chain this node; use score(ngram) to query."
+        )
+
+
 class StupidBackoffEstimator(Estimator):
     """Scores every observed n-gram (StupidBackoff.scala:128-182). Input: a
     Dataset of (NGram, count) pairs, e.g. from NGramsCounts."""

@@ -1,0 +1,131 @@
+"""Golden word→lemma ledger for the CoreNLP-fidelity lemmatizer tier.
+
+~220 pairs with Morpha/CoreNLP-style inflectional lemmas (bare mode, no POS:
+noun-then-verb, derivational suffixes untouched), spanning every rule family:
+irregular verbs (past + participle), irregular/latinate/invariant nouns,
+irregular adjectives, the regular -s/-es/-ies plural families, -ed/-ied
+pasts with consonant un-doubling and silent-e restoration, -ing gerunds,
+and non-inflected words the cascade must leave alone.
+"""
+
+GOLDEN = [
+    # irregular be/have/do and auxiliaries
+    ("is", "be"), ("am", "be"), ("are", "be"), ("was", "be"), ("were", "be"),
+    ("been", "be"), ("being", "be"), ("has", "have"), ("had", "have"),
+    ("does", "do"), ("did", "do"), ("done", "do"),
+    # irregular verb pasts
+    ("went", "go"), ("gone", "go"), ("said", "say"), ("made", "make"),
+    ("took", "take"), ("taken", "take"), ("came", "come"), ("saw", "see"),
+    ("seen", "see"), ("got", "get"), ("knew", "know"), ("known", "know"),
+    ("thought", "think"), ("gave", "give"), ("given", "give"),
+    ("found", "find"), ("told", "tell"), ("became", "become"),
+    ("left", "leave"), ("felt", "feel"), ("brought", "bring"),
+    ("began", "begin"), ("begun", "begin"), ("kept", "keep"),
+    ("held", "hold"), ("wrote", "write"), ("written", "write"),
+    ("stood", "stand"), ("heard", "hear"), ("meant", "mean"),
+    ("met", "meet"), ("ran", "run"), ("paid", "pay"), ("sat", "sit"),
+    ("spoke", "speak"), ("spoken", "speak"), ("led", "lead"),
+    ("grew", "grow"), ("grown", "grow"), ("lost", "lose"),
+    ("fell", "fall"), ("fallen", "fall"), ("sent", "send"),
+    ("built", "build"), ("understood", "understand"), ("drew", "draw"),
+    ("broke", "break"), ("broken", "break"), ("spent", "spend"),
+    ("rose", "rise"), ("risen", "rise"), ("drove", "drive"),
+    ("driven", "drive"), ("bought", "buy"), ("wore", "wear"),
+    ("chose", "choose"), ("chosen", "choose"), ("ate", "eat"),
+    ("eaten", "eat"), ("flew", "fly"), ("flown", "fly"),
+    ("forgot", "forget"), ("forgotten", "forget"), ("caught", "catch"),
+    ("taught", "teach"), ("sought", "seek"), ("fought", "fight"),
+    ("slept", "sleep"), ("swept", "sweep"), ("dealt", "deal"),
+    ("sold", "sell"), ("threw", "throw"), ("thrown", "throw"),
+    ("hid", "hide"), ("hidden", "hide"), ("sang", "sing"), ("sung", "sing"),
+    ("swam", "swim"), ("drank", "drink"), ("drunk", "drink"),
+    ("stole", "steal"), ("stolen", "steal"), ("froze", "freeze"),
+    ("frozen", "freeze"), ("woke", "wake"), ("tore", "tear"),
+    ("torn", "tear"), ("won", "win"), ("fed", "feed"), ("fled", "flee"),
+    ("dug", "dig"), ("lit", "light"), ("rode", "ride"), ("ridden", "ride"),
+    ("struck", "strike"), ("hung", "hang"), ("laid", "lay"),
+    # invariant verbs
+    ("cut", "cut"), ("put", "put"), ("set", "set"), ("let", "let"),
+    ("hit", "hit"), ("cost", "cost"), ("hurt", "hurt"), ("read", "read"),
+    ("spread", "spread"),
+    # irregular noun plurals
+    ("children", "child"), ("men", "man"), ("women", "woman"),
+    ("feet", "foot"), ("teeth", "tooth"), ("geese", "goose"),
+    ("mice", "mouse"), ("oxen", "ox"), ("people", "person"),
+    ("lives", "life"), ("knives", "knife"), ("wives", "wife"),
+    ("leaves", "leaf"), ("halves", "half"), ("shelves", "shelf"),
+    ("wolves", "wolf"), ("loaves", "loaf"), ("thieves", "thief"),
+    ("indices", "index"), ("matrices", "matrix"), ("vertices", "vertex"),
+    ("criteria", "criterion"), ("phenomena", "phenomenon"),
+    ("analyses", "analysis"), ("theses", "thesis"), ("crises", "crisis"),
+    ("hypotheses", "hypothesis"), ("bases", "basis"), ("axes", "axis"),
+    ("series", "series"), ("species", "species"), ("cacti", "cactus"),
+    ("fungi", "fungus"), ("nuclei", "nucleus"), ("radii", "radius"),
+    ("stimuli", "stimulus"), ("alumni", "alumnus"),
+    # invariant nouns
+    ("sheep", "sheep"), ("deer", "deer"), ("fish", "fish"),
+    # irregular adjectives
+    ("better", "good"), ("best", "good"), ("worse", "bad"),
+    ("worst", "bad"), ("further", "far"), ("farther", "far"),
+    ("less", "little"), ("least", "little"), ("more", "much"),
+    ("most", "much"),
+    # regular -s plurals / 3sg
+    ("cats", "cat"), ("dogs", "dog"), ("cars", "car"), ("books", "book"),
+    ("runs", "run"), ("walks", "walk"), ("plays", "play"),
+    ("says", "say"), ("thinks", "think"), ("wants", "want"),
+    ("years", "year"), ("things", "thing"), ("numbers", "number"),
+    # -es families
+    ("watches", "watch"), ("boxes", "box"), ("buses", "bus"),
+    ("dishes", "dish"), ("classes", "class"), ("churches", "church"),
+    ("foxes", "fox"), ("buzzes", "buzz"), ("potatoes", "potato"),
+    ("heroes", "hero"), ("goes", "go"), ("makes", "make"),
+    ("takes", "take"), ("gives", "give"), ("comes", "come"),
+    ("uses", "use"), ("causes", "cause"), ("houses", "house"),
+    ("pages", "page"), ("changes", "change"),
+    # -ies
+    ("studies", "study"), ("tries", "try"), ("flies", "fly"),
+    ("cities", "city"), ("countries", "country"), ("companies", "company"),
+    ("families", "family"), ("bodies", "body"), ("carries", "carry"),
+    # regular -ed
+    ("walked", "walk"), ("played", "play"), ("visited", "visit"),
+    ("jumped", "jump"), ("wanted", "want"), ("asked", "ask"),
+    ("looked", "look"), ("seemed", "seem"), ("needed", "need"),
+    ("turned", "turn"), ("helped", "help"), ("talked", "talk"),
+    # -ed with silent-e restoration
+    ("loved", "love"), ("used", "use"), ("liked", "like"),
+    ("moved", "move"), ("lived", "live"), ("hoped", "hope"),
+    ("created", "create"), ("decided", "decide"), ("provided", "provide"),
+    ("noticed", "notice"), ("produced", "produce"), ("argued", "argue"),
+    ("continued", "continue"), ("believed", "believe"),
+    # -ed with un-doubling
+    ("stopped", "stop"), ("planned", "plan"), ("dropped", "drop"),
+    ("grabbed", "grab"), ("hugged", "hug"), ("shipped", "ship"),
+    # -eed base forms stay
+    ("agreed", "agree"), ("freed", "free"), ("guaranteed", "guarantee"),
+    ("studied", "study"), ("tried", "try"), ("carried", "carry"),
+    ("married", "marry"), ("copied", "copy"),
+    # -ing with e-restoration / un-doubling / y-keep
+    ("making", "make"), ("taking", "take"), ("coming", "come"),
+    ("using", "use"), ("having", "have"), ("giving", "give"),
+    ("writing", "write"), ("living", "live"), ("moving", "move"),
+    ("running", "run"), ("sitting", "sit"), ("getting", "get"),
+    ("stopping", "stop"), ("planning", "plan"), ("swimming", "swim"),
+    ("jumping", "jump"), ("studying", "study"), ("playing", "play"),
+    ("saying", "say"), ("going", "go"), ("doing", "do"),
+    ("working", "work"), ("looking", "look"), ("talking", "talk"),
+    ("walking", "walk"), ("watching", "watch"), ("thinking", "think"),
+    ("reading", "read"), ("feeling", "feel"), ("needing", "need"),
+    # words the cascade must NOT touch (derivational or lemma-final forms)
+    ("happiness", "happiness"), ("nation", "nation"), ("quickly", "quickly"),
+    ("this", "this"), ("his", "his"), ("famous", "famous"),
+    ("news", "news"), ("always", "always"), ("perhaps", "perhaps"),
+    ("lens", "lens"), ("analysis", "analysis"), ("crisis", "crisis"),
+    ("glass", "glass"), ("grass", "grass"), ("press", "press"),
+    ("ring", "ring"), ("king", "king"), ("thing", "thing"),
+    ("spring", "spring"), ("morning", "morning"), ("evening", "evening"),
+    ("during", "during"), ("something", "something"),
+    ("interesting", "interest"),  # bare mode (no POS): verb reading strips -ing
+    ("bed", "bed"), ("red", "red"), ("hundred", "hundred"),
+    ("indeed", "indeed"), ("need", "need"), ("speed", "speed"),
+    ("united", "unite"), ("wednesdays", "wednesday"),
+]

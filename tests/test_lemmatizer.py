@@ -78,3 +78,36 @@ class TestLemmatizer:
         grams = CoreNLPFeatureExtractor([1]).apply("the children were running")
         flat = [g[0] if isinstance(g, tuple) else g for g in grams]
         assert "child" in flat and "be" in flat and "run" in flat
+
+
+class TestGoldenLedgerFidelity:
+    """The round-3 fidelity ledger (VERDICT #7): ~310 word→lemma pairs
+    spanning every rule family, scored as a percentage. Current score: 100%.
+    The contract is ≥95% so the ledger can keep growing without each new
+    genuinely-ambiguous pair becoming a hard failure; the achieved number
+    is recorded in PARITY.md."""
+
+    def test_fidelity_at_least_95_percent(self):
+        from lemma_golden import GOLDEN
+
+        wrong = [
+            (w, lemmatize(w), want) for w, want in GOLDEN if lemmatize(w) != want
+        ]
+        acc = 1.0 - len(wrong) / len(GOLDEN)
+        assert len(GOLDEN) >= 200
+        assert acc >= 0.95, f"fidelity {acc:.2%}; misses: {wrong[:20]}"
+
+    def test_ledger_lemmas_are_fixed_points(self):
+        from lemma_golden import GOLDEN
+
+        # Every golden lemma must be stable under re-lemmatization (the
+        # irregular table maps comparatives to base adjectives whose own
+        # lemma is themselves, etc.). "lay" is genuinely ambiguous: base
+        # verb AND past of "lie" — bare-mode Morpha picks "lie".
+        skip = {"lay"}
+        wrong = [
+            (g, lemmatize(g))
+            for _, g in GOLDEN
+            if g not in skip and lemmatize(g) != g
+        ]
+        assert not wrong, wrong[:20]

@@ -67,6 +67,104 @@ _WORKER = textwrap.dedent(
 )
 
 
+_LM_WORKER = textwrap.dedent(
+    """
+    import sys
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon overrides JAX_PLATFORMS
+    jax.config.update("jax_num_cpu_devices", 2)
+    # The packed n-gram ids use up to 62 bits: without x64 the device
+    # all_gather would silently truncate them to int32 garbage.
+    jax.config.update("jax_enable_x64", True)
+
+    coord, pid = sys.argv[1], int(sys.argv[2])
+
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.init_distributed(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2
+
+    from jax.experimental import multihost_utils
+
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.nlp import (
+        NGram,
+        NGramsFeaturizer,
+        StupidBackoffEstimator,
+        initial_bigram_partition,
+        pack_ngram_pairs,
+        partition_ngram_pairs,
+        unpack_ngram_pairs,
+        ShardedStupidBackoffModel,
+    )
+
+    # Deterministic corpus of int word-ids; each process HOLDS only half of
+    # the raw (ngram, count) stream (the per-host data slice).
+    rng = np.random.default_rng(7)
+    sents = [rng.integers(1, 40, size=12).tolist() for _ in range(30)]
+    feats = NGramsFeaturizer([2, 3])
+    all_pairs = []
+    unigrams = {}
+    for s in sents:
+        for w in s:
+            unigrams[w] = unigrams.get(w, 0) + 1
+        for g in feats.apply(s):
+            all_pairs.append((NGram(g), 1))
+    local_pairs = all_pairs[pid::2]
+
+    # Exchange: pack local counts into ONE int64 device array and
+    # all_gather across the two processes (counts ride DCN as arrays, not
+    # pickled host objects).
+    packed = pack_ngram_pairs(local_pairs)
+    # Ragged halves: pad to a common length with an invalid row (count 0).
+    m = (len(all_pairs) + 1) // 2
+    if packed.shape[0] < m:
+        pad = np.zeros((m - packed.shape[0], 2), dtype=np.int64)
+        packed = np.vstack([packed, pad])
+    gathered = multihost_utils.process_allgather(packed)  # (2, m, 2)
+    pairs_all = []
+    for part in gathered:
+        part = part[part[:, 1] > 0]
+        pairs_all.extend(unpack_ngram_pairs(part))
+
+    # reduceByKey + InitialBigramPartitioner; this process fits ONLY its
+    # own partition (StupidBackoff.scala:152-176 mapPartitions analog).
+    parts = partition_ngram_pairs(pairs_all, 2)
+    est = StupidBackoffEstimator(unigrams)
+    my_model = est.fit(Dataset.of(parts[pid]))
+
+    # Single-host reference fit over the full data: the partition-local
+    # scores must EQUAL the global fit's scores on this partition.
+    full_model = est.fit(Dataset.of(all_pairs))
+    assert len(my_model.scores) == len(parts[pid])
+    for ngram, score in my_model.scores.items():
+        ref = full_model.scores[ngram]
+        assert abs(score - ref) < 1e-12, (ngram, score, ref)
+
+    # Coverage: the two partitions tile the global table exactly.
+    sizes = multihost_utils.process_allgather(
+        np.array([len(my_model.scores)])
+    )
+    assert int(sizes.sum()) == len(full_model.scores), (
+        sizes, len(full_model.scores)
+    )
+
+    # Serving side: a sharded model routing by the partitioner agrees with
+    # the single-host model on every observed ngram.
+    shards = [est.fit(Dataset.of(p)) for p in parts]
+    sharded = ShardedStupidBackoffModel(shards)
+    for ngram in list(full_model.scores)[:50]:
+        assert abs(sharded.score(ngram) - full_model.score(ngram)) < 1e-12
+
+    print(f"lm proc {pid} OK: partition size {len(my_model.scores)}")
+    """
+)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("localhost", 0))
@@ -75,10 +173,10 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_distributed_solve(tmp_path):
+def _run_two_workers(tmp_path, source: str, ok_marker: str):
     coord = f"localhost:{_free_port()}"
     script = tmp_path / "worker.py"
-    script.write_text(_WORKER)
+    script.write_text(source)
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker configures its own device count
     env["PYTHONPATH"] = "/root/repo" + os.pathsep + env.get("PYTHONPATH", "")
@@ -103,4 +201,16 @@ def test_two_process_distributed_solve(tmp_path):
         outputs.append(out.decode())
     for pid, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out}"
-        assert f"proc {pid} OK" in out
+        assert ok_marker.format(pid=pid) in out
+
+
+def test_two_process_distributed_solve(tmp_path):
+    _run_two_workers(tmp_path, _WORKER, "proc {pid} OK")
+
+
+def test_two_process_stupid_backoff_counts(tmp_path):
+    """The LM count/score tables shard by initial_bigram_partition across
+    two OS processes: counts exchanged as packed int64 device arrays, each
+    process fits only its partition, scores equal the single-host fit, the
+    partitions tile the table, and the sharded model serves correctly."""
+    _run_two_workers(tmp_path, _LM_WORKER, "lm proc {pid} OK")

@@ -136,3 +136,29 @@ class TestDistributedKRRFit:
             m.batch_apply(Dataset.of(X).shard(data_mesh)).to_numpy()
         )
         np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_fused_mesh_sweep_matches_stepwise(self, data_mesh):
+        """The multi-device fit is ONE shard_map program per sweep
+        (_krr_fit_fused_mesh); its dual weights must match the stepwise
+        per-block path (profile=True forces it) on the same sharded data."""
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.kernel import (
+            GaussianKernelGenerator,
+            KernelRidgeRegression,
+        )
+
+        X = rng.normal(size=(72, 6)).astype(np.float32)  # ragged last block
+        Y = rng.normal(size=(72, 2)).astype(np.float32)
+        ds = Dataset.of(X).shard(data_mesh)
+        ys = Dataset.of(Y).shard(data_mesh)
+
+        make = lambda profile: KernelRidgeRegression(
+            GaussianKernelGenerator(0.15), lam=1e-3, block_size=16,
+            num_epochs=2, profile=profile,
+        )
+        fused = make(False).fit(ds, ys)
+        stepwise = make(True).fit(ds, ys)
+        for wf, ws in zip(fused.w_locals, stepwise.w_locals):
+            np.testing.assert_allclose(
+                np.asarray(wf), np.asarray(ws), atol=2e-4
+            )
