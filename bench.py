@@ -1,24 +1,31 @@
-"""Benchmark: TIMIT-shaped CosineRandomFeatures -> BlockLeastSquares.
+"""Benchmark suite: four reference workload geometries, each with a stated
+FLOP model, measured device time, achieved TFLOP/s and MFU.
 
-The reference's headline number (BASELINE.md, scripts/solver-comparisons-final.csv:26):
-TIMIT d=16384 block least squares on a 16-node r3.4xlarge Spark cluster:
-580,555 ms at n=2.2e6 rows (440 input dims, 147 classes, blockSize 1024-4096).
+Headline (the printed JSON line): TIMIT-shaped CosineRandomFeatures ->
+BlockLeastSquares against the reference's only committed wall-clock
+(BASELINE.md, scripts/solver-comparisons-final.csv:26 — TIMIT d=16384 Block
+on 16x r3.4xlarge Spark: 580,555 ms at n=2.2e6), n-scaled. Additional
+metrics ride in detail.additional_metrics:
 
-This bench runs the same computation shape on the available TPU (single chip
-under the driver) at a row count that fits in HBM, and compares against the
-baseline wall-clock scaled linearly by row count (the solver's cost is linear
-in n: per-block Gramian + correlation + residual GEMMs) and by epochs
-(baseline assumed to be 3 BCD sweeps per its own cost-model fit,
-scripts/constantEstimator.R:12 — see the scaling-site comment).
+  - amazon_sparse_lbfgs_d16384: the csv:13 sparse geometry through the
+    never-densify SparseLBFGSwithL2 (honest gather-bound numbers: on this
+    workload one chip loses the n-scaled wall-clock to the 16-node cluster
+    and wins on capacity — the full n=65e6 fits one chip's HBM).
+  - krr_cifar_kernel_geometry: RandomPatchCifarKernel's KRR solver shape
+    (no reference timing exists; absolute + MFU only).
+  - mnist_random_fft_end_to_end: the README example geometry end-to-end
+    (no reference timing exists; absolute + MFU only).
 
-TPU-native path: the whole train step — 4 random-feature blocks fused
-matmul+cos (Pallas, bfloat16 feature layout) + a full Gauss-Seidel BCD epoch
-(Pallas symmetric Gramian+correlation kernels, f32 accumulation/solves) — is
-ONE compiled XLA program: zero host round-trips between blocks, unlike the
-reference's per-block Spark job waves.
+Timing method: the tunneled dev TPU adds ~80-110 ms of per-dispatch
+overhead (HTTP round trip; a real TPU host dispatches in <1 ms), so each
+metric reports BOTH the single-dispatch wall-clock (value / wallclock_s —
+conservative, used for vs_baseline) and the marginal device time from
+in-program repetition ((t_reps3 - t_reps1) / 2 — what the hardware actually
+spends; used for achieved TFLOP/s + MFU).
 
 Env knobs: BENCH_SCALE (row multiplier), BENCH_PRECISION=bf16|f32,
-BENCH_EPOCHS (BCD epochs, default 1).
+BENCH_EPOCHS (BCD epochs, default 3), BENCH_ONLY=timit (skip the extra
+metrics).
 
 Prints ONE JSON line:
   {"metric": ..., "value": <seconds>, "unit": "s", "vs_baseline": <speedup x>}
@@ -45,11 +52,41 @@ BLOCK_SIZE = 4096  # reference TimitPipeline blockSize (TimitPipeline.scala:37-1
 # Default 3 BCD sweeps — the baseline CSV row's inferred count (see the
 # scaling-site comment), so the default comparison needs no epoch-ratio
 # adjustment at all. Epochs 2+ reuse the stashed per-block Gramians and
-# cost ~4% of the first sweep.
+# factors; they cost ~15% of the first sweep.
 NUM_EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 
+# v5e per-chip peaks for MFU accounting (bf16 MXU; f32 runs the MXU's
+# 3-pass emulation). MFU is computed against the precision the metric's
+# dominant GEMMs use.
+PEAK_TFLOPS_BF16 = 197.0
+PEAK_TFLOPS_F32 = 49.0
 
-def main():
+
+def _sync_scalar(x) -> float:
+    """Host transfer: the only reliable execution barrier on the tunneled
+    backend (block_until_ready returns before remote execution finishes)."""
+    return float(x)
+
+
+def marginal_device_time(make_repeated, reps: int = 3):
+    """(t_repsN - t_reps1)/(N-1): in-program repetition isolates device
+    execution time from the tunnel's per-dispatch overhead. Returns
+    (device_s, wall_single_s, dispatch_overhead_s)."""
+    r1 = make_repeated(1)
+    rN = make_repeated(reps)
+    _sync_scalar(r1())  # compile + warm
+    _sync_scalar(rN())
+    t0 = time.perf_counter()
+    _sync_scalar(r1())
+    t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _sync_scalar(rN())
+    tN = time.perf_counter() - t0
+    device = max((tN - t1) / (reps - 1), 1e-9)
+    return device, t1, max(t1 - device, 0.0)
+
+
+def timit_metric():
     scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     precision = os.environ.get("BENCH_PRECISION", "bf16")
     if precision not in ("bf16", "f32"):
@@ -150,6 +187,43 @@ def main():
         float(x) for x in quality_step(X, Wrf_flat, brf_flat, Y, W)
     )
 
+    # Marginal device time (tunnel dispatch overhead excluded): fori_loop
+    # the whole train step inside one program and difference reps=3 vs 1.
+    def make_repeated(reps):
+        @jax.jit
+        def run(X, Wrf_flat, brf_flat, Y):
+            def body(i, acc):
+                # The 0.0*acc carries defeat XLA's loop-invariant hoisting:
+                # both featurize and the solve must execute on EVERY
+                # repetition or the reps-difference under-counts the work.
+                F = featurize(X + 0.0 * acc)
+                Wr = linalg.bcd_least_squares_fused_flat(
+                    F, Y + 0.0 * acc, BLOCK_SIZE, lam=1e-4,
+                    num_iter=NUM_EPOCHS, use_pallas=use_pallas,
+                )
+                return acc + jnp.sum(jnp.abs(Wr))
+            return jax.lax.fori_loop(0, reps, body, 0.0)
+        return lambda: run(X, Wrf_flat, brf_flat, Y)
+
+    device_s, _, dispatch_s = marginal_device_time(make_repeated)
+
+    # Stated FLOP model (algorithmic, dense-equivalent; the syrk kernels do
+    # ~half the Gramian MACs but MFU accounts the algorithm's work):
+    #   featurize 2·n·440·16384; epoch-1 Gramians nb·2·n·bs²; every epoch's
+    #   correlation+residual nb·2·2·n·bs·k; Cholesky nb·bs³/3 (factors
+    #   cached across epochs); triangular solves epochs·nb·4·bs²·k.
+    nb = NUM_FEATURES // BLOCK_SIZE
+    k = TIMIT_NUM_CLASSES
+    flops = (
+        2.0 * n * TIMIT_INPUT_DIMS * NUM_FEATURES
+        + nb * 2.0 * n * BLOCK_SIZE**2
+        + NUM_EPOCHS * nb * 2 * 2.0 * n * BLOCK_SIZE * k
+        + nb * BLOCK_SIZE**3 / 3.0
+        + NUM_EPOCHS * nb * 4.0 * BLOCK_SIZE**2 * k
+    )
+    achieved_tflops = flops / device_s / 1e12
+    peak = PEAK_TFLOPS_BF16 if bf16 else PEAK_TFLOPS_F32
+
     # The baseline CSV row is one full solver run whose epoch count is not
     # recorded. The reference's own cost-model fit multiplies the Block
     # solver's FLOPs/mem/network by 3 (scripts/constantEstimator.R:12,20,27)
@@ -166,42 +240,250 @@ def main():
     )
     speedup = baseline_scaled_s / elapsed
 
-    print(
-        json.dumps(
-            {
-                "metric": "timit_cosine_blockls_d16384_wallclock",
-                "value": round(elapsed, 3),
-                "unit": "s",
-                "vs_baseline": round(speedup, 2),
-                "detail": {
-                    "n": n,
-                    "d": NUM_FEATURES,
-                    "k": TIMIT_NUM_CLASSES,
-                    "block_size": BLOCK_SIZE,
-                    "epochs": NUM_EPOCHS,
-                    "precision": "bf16" if bf16 else "f32",
-                    "train_loss": round(loss, 4),
-                    "train_err": round(train_err, 4),
-                    "quality_note": (
-                        "synthetic labels; error/loss parity vs an exact "
-                        "solver on real data lives in parity.py / "
-                        "PARITY_RESULTS.json"
-                    ),
-                    "pallas": use_pallas,
-                    "single_dispatch": True,
-                    "baseline": (
-                        "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), "
-                        "n-scaled, assumed 3 epochs (constantEstimator.R:12)"
-                    ),
-                    "baseline_scaled_s": round(baseline_scaled_s, 3),
-                    "baseline_assumed_epochs": BASELINE_ASSUMED_EPOCHS,
-                    "vs_baseline_if_5_epochs": round(speedup * 3.0 / 5.0, 2),
-                    "vs_baseline_if_1_epoch": round(speedup * 3.0, 2),
-                    "device": str(jax.devices()[0]),
-                },
-            }
-        )
+    return {
+        "metric": "timit_cosine_blockls_d16384_wallclock",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(speedup, 2),
+        "detail": {
+            "n": n,
+            "d": NUM_FEATURES,
+            "k": TIMIT_NUM_CLASSES,
+            "block_size": BLOCK_SIZE,
+            "epochs": NUM_EPOCHS,
+            "precision": "bf16" if bf16 else "f32",
+            "device_time_s": round(device_s, 3),
+            "dispatch_overhead_s": round(dispatch_s, 3),
+            "flop_model_tflops": round(flops / 1e12, 2),
+            "achieved_tflops": round(achieved_tflops, 1),
+            "peak_tflops": peak,
+            "mfu": round(achieved_tflops / peak, 3),
+            "vs_baseline_device_time": round(baseline_scaled_s / device_s, 2),
+            "train_loss": round(loss, 4),
+            "train_err": round(train_err, 4),
+            "quality_note": (
+                "synthetic labels; error/loss parity vs an exact "
+                "solver on real data lives in parity.py / "
+                "PARITY_RESULTS.json"
+            ),
+            "pallas": use_pallas,
+            "single_dispatch": True,
+            "baseline": (
+                "16x r3.4xlarge Spark, 580.6s @ n=2.2e6 (csv:26), "
+                "n-scaled, assumed 3 epochs (constantEstimator.R:12)"
+            ),
+            "baseline_scaled_s": round(baseline_scaled_s, 3),
+            "baseline_assumed_epochs": BASELINE_ASSUMED_EPOCHS,
+            "vs_baseline_if_5_epochs": round(speedup * 3.0 / 5.0, 2),
+            "vs_baseline_if_1_epoch": round(speedup * 3.0, 2),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def amazon_sparse_metric():
+    """csv:13 geometry (Amazon LS-LBFGS d=16384, sparsity 0.005 -> 82
+    nnz/row, k=2) through the never-densify sparse LBFGS at n=500k (the
+    full n=65e6 fits one chip's HBM — round-2 scale check — but would make
+    the bench run minutes). Honest numbers: sparse gather/segment-sum is
+    capacity-bound on TPU (~65M random indices/s), so one chip LOSES the
+    n-scaled wall-clock against 16 CPU nodes on this workload while
+    winning on capacity (no 131 GB densified design matrix, no cluster)."""
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+
+    n, d, nnz, k = 500_000, NUM_FEATURES, 82, 2
+    iters = 20  # AmazonReviewsPipeline default numIters (scala :52)
+    rng = np.random.default_rng(1)
+    idx = rng.integers(0, d, size=(n, nnz)).astype(np.int32)
+    idx.sort(axis=1)
+    vals = rng.normal(size=(n, nnz)).astype(np.float32)
+    labels = rng.integers(0, k, size=n)
+    Y = (2.0 * np.eye(k)[labels] - 1.0).astype(np.float32)
+    ds = Dataset({"indices": jnp.asarray(idx), "values": jnp.asarray(vals)}, n=n)
+    Yd = Dataset.of(jnp.asarray(Y))
+
+    est = SparseLBFGSwithL2(lam=1e-3, num_iterations=iters, num_features=d)
+    model = est.fit(ds, Yd)  # warm (compile)
+    t0 = time.perf_counter()
+    model = est.fit(ds, Yd)
+    _sync_scalar(jnp.sum(jnp.abs(model.x)))
+    elapsed = time.perf_counter() - t0
+
+    # FLOP model: per L-BFGS iteration one Hessian-apply = forward +
+    # transpose sparse matmul (2·nnz_total·k each) + O(d·k) vector work.
+    nnz_total = n * (nnz + 1)  # +1: append-ones intercept column
+    flops = iters * 2 * 2.0 * nnz_total * k
+    # The real resource on TPU is random-access rate, not FLOPs.
+    gathers_per_s = iters * 2 * nnz_total / elapsed
+    baseline_scaled_s = 52.290 * (n / 65e6)  # csv:13, n-scaled, same iters
+    return {
+        "metric": "amazon_sparse_lbfgs_d16384",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline_scaled_s / elapsed, 4),
+        "detail": {
+            "n": n, "d": d, "nnz_per_row": nnz, "k": k, "iters": iters,
+            "flop_model_tflops": round(flops / 1e12, 4),
+            "achieved_tflops": round(flops / 1e12 / elapsed, 4),
+            "mfu": round(flops / 1e12 / elapsed / PEAK_TFLOPS_F32, 5),
+            "gather_rate_per_s": round(gathers_per_s / 1e6, 1),
+            "gather_rate_note": (
+                "M random indices/s vs ~65M/s v5e gather capability — this "
+                "workload is random-access-bound, not MXU-bound; MFU is "
+                "structurally tiny and reported for completeness"
+            ),
+            "baseline": (
+                "16x r3.4xlarge Spark LBFGS 52.29s @ n=65e6 (csv:13), "
+                "n-scaled, 20 iters (AmazonReviewsPipeline default)"
+            ),
+            "baseline_scaled_s": round(baseline_scaled_s, 3),
+            "honesty": (
+                "one chip loses wall-clock to the 16-node cluster on sparse "
+                "gather; the win is capacity (full n=65e6 COO fits one "
+                "chip, dense would be 131 GB) and zero cluster"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def krr_metric():
+    """RandomPatchCifarKernel's KRR solver geometry
+    (RandomPatchCifarKernel.scala:33-76: Gaussian-kernel ridge, CIFAR-scale
+    n, block Gauss-Seidel). No reference wall-clock exists for this
+    pipeline, so the row reports absolute device time + MFU only."""
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.kernel import (
+        GaussianKernelGenerator,
+        KernelRidgeRegression,
     )
+
+    n, d, k, bs, epochs = 32_768, 2_048, 10, 4_096, 2
+    rng = np.random.default_rng(2)
+    X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+    Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+    krr = KernelRidgeRegression(
+        GaussianKernelGenerator(gamma=5e-4), lam=1e-3,
+        block_size=bs, num_epochs=epochs,
+    )
+    ds, ys = Dataset.of(X), Dataset.of(Y)
+    m = krr.fit(ds, ys)  # warm (compile)
+    t0 = time.perf_counter()
+    m = krr.fit(ds, ys)
+    _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
+    elapsed = time.perf_counter() - t0
+
+    # FLOP model per epoch: kernel column+diag blocks 2·n·bs·d + bs²·d per
+    # block, residual K_blockᵀW 2·n·bs·k, block solve bs³/3 + 2·bs²·k.
+    nb = -(-n // bs)
+    flops = epochs * nb * (
+        2.0 * n * bs * d + 2.0 * bs * bs * d
+        + 2.0 * n * bs * k + bs**3 / 3.0 + 4.0 * bs**2 * k
+    )
+    achieved = flops / 1e12 / elapsed
+    return {
+        "metric": "krr_cifar_kernel_geometry",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": {
+            "n": n, "d": d, "k": k, "block_size": bs, "epochs": epochs,
+            "flop_model_tflops": round(flops / 1e12, 2),
+            "achieved_tflops": round(achieved, 1),
+            "mfu": round(achieved / PEAK_TFLOPS_F32, 3),
+            "precision": "f32 (HIGHEST) kernel blocks + solves",
+            "peak_tflops": PEAK_TFLOPS_F32,
+            "single_dispatch": True,
+            "baseline_note": (
+                "no reference wall-clock exists for "
+                "RandomPatchCifarKernel; absolute + MFU only"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def mnist_fft_metric():
+    """MnistRandomFFT end-to-end (README example geometry: 4 FFT branches,
+    blockSize 2048) at MNIST-train scale on synthetic 784-dim rows. No
+    reference wall-clock exists (the README quotes no time), so the row
+    reports absolute end-to-end time + MFU of the solve-dominated work."""
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_featurizer,
+    )
+
+    n, d_in, num_ffts, bs = 65_536, 784, 4, 2_048
+    cfg = MnistRandomFFTConfig(num_ffts=num_ffts, block_size=bs, image_size=d_in)
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(n, d_in)).astype(np.float32)
+    y = rng.integers(0, 10, size=n)
+    labels = ClassLabelIndicatorsFromIntLabels(10)(Dataset.of(y))
+    featurizer = build_featurizer(cfg)
+
+    def fit_once():
+        pipe = featurizer.and_then(
+            BlockLeastSquaresEstimator(bs, 1, 1e-4), Dataset.of(X), labels
+        )
+        out = pipe.apply(Dataset.of(X)).get()
+        return _sync_scalar(jnp.sum(jnp.abs(jnp.asarray(out.array))))
+
+    fit_once()  # warm (compile)
+    t0 = time.perf_counter()
+    fit_once()
+    elapsed = time.perf_counter() - t0
+
+    # FLOP model: FFT featurize num_ffts·(5·n·p·log2 p) on the padded width
+    # p=1024, + BCD epoch on d=4096: gramians nb·2·n·bs², corr+resid
+    # nb·2·2·n·bs·k, cholesky nb·bs³/3.
+    p = 1024
+    d_feat = num_ffts * p
+    nb = d_feat // bs
+    k = 10
+    flops = (
+        num_ffts * 5.0 * n * p * np.log2(p)
+        + nb * 2.0 * n * bs**2
+        + nb * 2 * 2.0 * n * bs * k
+        + nb * bs**3 / 3.0
+    )
+    achieved = flops / 1e12 / elapsed
+    return {
+        "metric": "mnist_random_fft_end_to_end",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": {
+            "n": n, "num_ffts": num_ffts, "block_size": bs,
+            "flop_model_tflops": round(flops / 1e12, 3),
+            "achieved_tflops": round(achieved, 1),
+            "mfu": round(achieved / PEAK_TFLOPS_F32, 3),
+            "precision": "f32 end-to-end (pipeline default)",
+            "peak_tflops": PEAK_TFLOPS_F32,
+            "includes": "full pipeline fit + apply (graph executor overhead included)",
+            "baseline_note": (
+                "no reference wall-clock exists for the MnistRandomFFT "
+                "README example; absolute + MFU only"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def main():
+    headline = timit_metric()
+    if os.environ.get("BENCH_ONLY", "") != "timit":
+        extras = []
+        for fn in (amazon_sparse_metric, krr_metric, mnist_fft_metric):
+            try:
+                extras.append(fn())
+            except Exception as e:  # a broken extra must not kill the headline
+                extras.append({"metric": fn.__name__, "error": str(e)[:300]})
+        headline["detail"]["additional_metrics"] = extras
+    print(json.dumps(headline))
 
 
 if __name__ == "__main__":

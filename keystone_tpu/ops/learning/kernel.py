@@ -406,15 +406,15 @@ class KernelRidgeRegression(LabelEstimator):
         rng = np.random.default_rng(self.block_permuter) if self.block_permuter is not None else None
 
         timing_on = self.profile
-        # Per-block syncs: only the profiled stepwise path needs them (for
-        # timing attribution). Multi-device fits now run the fused shard_map
-        # sweep — one compiled program, so the forced-host CPU test backend's
-        # multi-program collective deadlock cannot arise either.
+        # The stepwise per-block path is only reachable under profiling now
+        # (multi-device fits run the fused shard_map sweep — one compiled
+        # program, so the forced-host CPU test backend's multi-program
+        # collective deadlock cannot arise either), and profiling always
+        # syncs per block for timing attribution.
         multi_device = data.mesh is not None and any(
             s > 1 for s in dict(data.mesh.shape).values()
         )
-        cpu_multi_device = multi_device and jax.default_backend() == "cpu"
-        sync_blocks = timing_on or cpu_multi_device
+        sync_blocks = timing_on
         use_fused = not timing_on
 
         if use_fused:

@@ -415,48 +415,46 @@ def gram_corr(
 
 
 def _gram_corr_sym_kernel(
-    ii_ref, jj_ref, ai_ref, aj_ref, r_ref, gram_ref, corr_ref, gacc_ref,
-    cacc_ref, *, nk, compute_dtype
+    ii_ref, jj_ref, ai_ref, aj_ref, r_ref, gram_ref, corr_ref, *,
+    nk, compute_dtype
 ):
     """Grid (p, k): p walks the upper-triangle block pairs (ii[p], jj[p]) in
     row-major order; k sweeps row tiles. The correlation AᵀR rides along on
-    the diagonal pairs (one per block row) where Aᵢ is already resident."""
+    the diagonal pairs (one per block row) where Aᵢ is already resident.
+
+    Accumulation happens directly in the f32 OUTPUT tiles: their block
+    indices are k-invariant, so Mosaic keeps them resident in VMEM across
+    the whole k sweep. Dropping the separate scratch accumulators frees
+    enough scoped VMEM to double the column tile to 1024, which halves the
+    number of block pairs' HBM re-reads of A."""
     p = pl.program_id(0)
     k = pl.program_id(1)
     diag = ii_ref[p] == jj_ref[p]
 
     @pl.when(k == 0)
     def _():
-        gacc_ref[:] = jnp.zeros_like(gacc_ref)
+        gram_ref[:] = jnp.zeros_like(gram_ref)
 
     ai = ai_ref[:].astype(compute_dtype)
-    gacc_ref[:] += jax.lax.dot_general(
+    gram_ref[:] += jax.lax.dot_general(
         ai,
         aj_ref[:].astype(compute_dtype),
         dimension_numbers=(((0,), (0,)), ((), ())),
         **_dot_kwargs(compute_dtype),
     )
 
-    @pl.when(k == nk - 1)
-    def _():
-        gram_ref[:] = gacc_ref[:].astype(gram_ref.dtype)
-
     @pl.when(diag & (k == 0))
     def _():
-        cacc_ref[:] = jnp.zeros_like(cacc_ref)
+        corr_ref[:] = jnp.zeros_like(corr_ref)
 
     @pl.when(diag)
     def _():
-        cacc_ref[:] += jax.lax.dot_general(
+        corr_ref[:] += jax.lax.dot_general(
             ai,
             r_ref[:].astype(compute_dtype),
             dimension_numbers=(((0,), (0,)), ((), ())),
             **_dot_kwargs(compute_dtype),
         )
-
-    @pl.when(diag & (k == nk - 1))
-    def _():
-        corr_ref[:] = cacc_ref[:].astype(corr_ref.dtype)
 
 
 def gram_corr_sym(
@@ -474,6 +472,10 @@ def gram_corr_sym(
 
     A may be bfloat16 — tiles then hit the MXU natively with float32
     accumulation, and HBM traffic is half that of an f32 layout.
+
+    (Column-window variants for the fused BCD solvers live in
+    :func:`block_gram_sym` / :func:`block_corr` — those read the window
+    strided out of the flat feature buffer with no slice copy.)
     """
     A = jnp.asarray(A)
     R = jnp.asarray(R, dtype=jnp.float32)
@@ -482,11 +484,17 @@ def gram_corr_sym(
     n, d = A.shape
     kdim = R.shape[1]
 
-    ti = min(512, ((d + 127) // 128) * 128)
+    # 1024-wide column tiles for bf16 layouts (VMEM budget: 4 MB resident
+    # gram tile + 1 MB corr + double-buffered input tiles, inside the 16 MB
+    # scoped-VMEM limit now that accumulation lives in the output tiles).
+    # f32 inputs double the tile bytes, so they stay at 512. Smaller models
+    # fall back to one 128-multiple tile.
+    ti = _strided_ti(compute_dtype, d)
     tk = min(_TILE_K, n)
     Ap = _pad_to(_pad_to(A, tk, 0), ti, 1)
+    Rp = _pad_to(R, tk, 0)
     tr = max(128, ((kdim + 127) // 128) * 128)
-    Rp = _pad_to(_pad_to(R, tk, 0), tr, 1)
+    Rp = _pad_to(Rp, tr, 1)
     npad, dp = Ap.shape
     nk = npad // tk
     nt = dp // ti
@@ -501,15 +509,17 @@ def gram_corr_sym(
         in_specs=[
             pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, ii[p])),
             pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, jj[p])),
-            pl.BlockSpec((tk, tr), lambda p, k, ii, jj: (k, 0)),
+            # Off-diagonal pairs never read R: pin their index to block
+            # (0, 0) so the tile stays resident instead of streaming the
+            # whole of R past every pair.
+            pl.BlockSpec(
+                (tk, tr),
+                lambda p, k, ii, jj: (jnp.where(ii[p] == jj[p], k, 0), 0),
+            ),
         ],
         out_specs=[
             pl.BlockSpec((ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])),
             pl.BlockSpec((ti, tr), lambda p, k, ii, jj: (ii[p], 0)),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((ti, ti), jnp.float32),
-            pltpu.VMEM((ti, tr), jnp.float32),
         ],
     )
     gram_u, corr = pl.pallas_call(
@@ -528,3 +538,187 @@ def gram_corr_sym(
     upper = jnp.triu(gram_u)
     gram = upper + jnp.triu(gram_u, 1).T
     return gram[:d, :d], corr[:d, :kdim]
+
+
+def _strided_ti(dtype, block: int) -> int:
+    """Column-tile width for the strided window kernels: 1024 for bf16
+    layouts, 512 for f32 (whose doubled tile bytes overflow the 16 MB
+    scoped-VMEM limit at 1024)."""
+    wide = 1024 if dtype == jnp.bfloat16 else 512
+    return min(wide, ((block + 127) // 128) * 128)
+
+
+def _gram_sym_kernel(ii_ref, jj_ref, ai_ref, aj_ref, gram_ref, *, nk,
+                     compute_dtype):
+    """Gram-only variant of _gram_corr_sym_kernel: no R operand, no corr
+    output — the in-loop strided BCD path computes the correlation with
+    :func:`block_corr` instead, because the riding-R buffers are exactly
+    what pushes the 1024-tile layout past the 16 MB scoped-VMEM limit
+    inside a while_loop."""
+    p = pl.program_id(0)
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        gram_ref[:] = jnp.zeros_like(gram_ref)
+
+    gram_ref[:] += jax.lax.dot_general(
+        ai_ref[:].astype(compute_dtype),
+        aj_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+
+def block_gram_sym(F, col_start, block: int, interpret: Optional[bool] = None):
+    """Symmetric Gramian of a column window of F, tiles read strided (no
+    slice copy); ``col_start`` may be traced. Requires ``strided_gram_ok``."""
+    F = jnp.asarray(F)
+    compute_dtype = jnp.bfloat16 if F.dtype == jnp.bfloat16 else jnp.float32
+    n, d = F.shape
+    ti = _strided_ti(F.dtype, block)
+    tk = min(_TILE_K, n)
+    nt = block // ti
+    nk = n // tk
+    base = jnp.asarray(col_start, jnp.int32) // ti
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = base + jnp.asarray(np.array([p[0] for p in pairs], dtype=np.int32))
+    jj = base + jnp.asarray(np.array([p[1] for p in pairs], dtype=np.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, jj[p])),
+        ],
+        out_specs=pl.BlockSpec(
+            (ti, ti), lambda p, k, ii, jj: (ii[p] - ii[0], jj[p] - ii[0])
+        ),
+    )
+    gram_u = pl.pallas_call(
+        functools.partial(_gram_sym_kernel, nk=nk, compute_dtype=compute_dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((block, block), jnp.float32),
+        interpret=_interpret() if interpret is None else interpret,
+    )(ii, jj, F, F)
+    upper = jnp.triu(gram_u)
+    return upper + jnp.triu(gram_u, 1).T
+
+
+def strided_gram_ok(F, block: int) -> bool:
+    """Static alignment check for the strided column-window kernels: row
+    count divisible by the k tile, block width by the column tile."""
+    n, d = F.shape
+    ti = _strided_ti(F.dtype, block)
+    return n % min(_TILE_K, n) == 0 and block % ti == 0 and d % block == 0
+
+
+def _block_corr_kernel(base_ref, f_ref, r_ref, out_ref, *, compute_dtype):
+    """out[p] = F_windowᵀ R accumulated over row tiles (grid (p, k))."""
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    out_ref[:] += jax.lax.dot_general(
+        f_ref[:].astype(compute_dtype),
+        r_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+
+def block_corr(F, col_start, block: int, R, interpret: Optional[bool] = None):
+    """F[:, col_start:col_start+block]ᵀ @ R with strided reads of F (no
+    column-slice copy). ``col_start`` may be traced. Returns (block, k) f32.
+    Requires ``strided_gram_ok``."""
+    F = jnp.asarray(F)
+    R = jnp.asarray(R, dtype=jnp.float32)
+    compute_dtype = jnp.bfloat16 if F.dtype == jnp.bfloat16 else jnp.float32
+    n, d = F.shape
+    kdim = R.shape[1]
+    ti = _strided_ti(F.dtype, block)
+    tk = min(_TILE_K, n)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Rp = _pad_to(R, tr, 1)
+    nt = block // ti
+    nk = n // tk
+    base = jnp.asarray(col_start, jnp.int32).reshape(1) // ti
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nt, nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda p, k, b: (k, b[0] + p)),
+            pl.BlockSpec((tk, tr), lambda p, k, b: (k, 0)),
+        ],
+        out_specs=pl.BlockSpec((ti, tr), lambda p, k, b: (p, 0)),
+    )
+    corr = pl.pallas_call(
+        functools.partial(_block_corr_kernel, compute_dtype=compute_dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((block, tr), jnp.float32),
+        interpret=_interpret() if interpret is None else interpret,
+    )(base, F, Rp)
+    return corr[:, :kdim]
+
+
+def _block_resid_kernel(base_ref, f_ref, w_ref, r_ref, out_ref, *, compute_dtype):
+    """out[m] = R[m] − F_window[m] @ dW accumulated over column tiles
+    (grid (m, dstep); the R tile is resident across dstep)."""
+    dstep = pl.program_id(1)
+
+    @pl.when(dstep == 0)
+    def _():
+        out_ref[:] = r_ref[:]
+
+    out_ref[:] -= jax.lax.dot_general(
+        f_ref[:].astype(compute_dtype),
+        w_ref[:].astype(compute_dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+
+def block_residual_update(
+    F, col_start, block: int, dW, R, interpret: Optional[bool] = None
+):
+    """R − F[:, col_start:col_start+block] @ dW with strided reads of F —
+    the Gauss-Seidel residual update without the column-slice copy. dW is
+    (block, k) (cast to F's compute dtype by the caller for MXU-native
+    bf16); R is (n, k) f32 and the result keeps f32 accumulation. Requires
+    ``strided_gram_ok``."""
+    F = jnp.asarray(F)
+    R = jnp.asarray(R, dtype=jnp.float32)
+    dW = jnp.asarray(dW)
+    compute_dtype = jnp.bfloat16 if F.dtype == jnp.bfloat16 else jnp.float32
+    n, d = F.shape
+    kdim = R.shape[1]
+    ti = _strided_ti(F.dtype, block)
+    tm = min(_TILE_K, n)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Rp = _pad_to(R, tr, 1)
+    Wp = _pad_to(jnp.asarray(dW, dtype=compute_dtype), tr, 1)
+    nd = block // ti
+    nm = n // tm
+    base = jnp.asarray(col_start, jnp.int32).reshape(1) // ti
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nm, nd),
+        in_specs=[
+            pl.BlockSpec((tm, ti), lambda m, ds, b: (m, b[0] + ds)),
+            pl.BlockSpec((ti, tr), lambda m, ds, b: (ds, 0)),
+            pl.BlockSpec((tm, tr), lambda m, ds, b: (m, 0)),
+        ],
+        out_specs=pl.BlockSpec((tm, tr), lambda m, ds, b: (m, 0)),
+    )
+    out = pl.pallas_call(
+        functools.partial(_block_resid_kernel, compute_dtype=compute_dtype),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n, tr), jnp.float32),
+        interpret=_interpret() if interpret is None else interpret,
+    )(base, F, Wp, Rp)
+    return out[:, :kdim]

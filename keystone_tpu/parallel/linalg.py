@@ -39,7 +39,15 @@ def _corr(a, r):
     )
 
 
-def _solve_psd(gram, rhs, lam):
+def _psd_factor(gram, lam):
+    """Cholesky factor of (gram + lam I) — loop-invariant across BCD epochs
+    for a fixed block, so multi-epoch sweeps stash it next to the Gramian
+    and later epochs pay only the two triangular solves."""
+    eye = jnp.eye(gram.shape[0], dtype=gram.dtype)
+    return jax.scipy.linalg.cholesky(gram + lam * eye, lower=True)
+
+
+def _solve_psd(gram, rhs, lam, chol=None):
     """Solve (gram + lam I) x = rhs via Cholesky (gram PSD).
 
     Rank-deficient Gramians (fewer rows than block columns — demo-scale fits
@@ -49,10 +57,15 @@ def _solve_psd(gram, rhs, lam):
     cannot compile at d=16384 — scoped-VMEM overflow — so the rescue stays
     Cholesky-shaped); healthy Gramians keep the exact path bit for bit.
     (The reference inherits robustness from Breeze's `\\`, which LU-solves.)
+
+    Pass ``chol`` (from :func:`_psd_factor` on the same gram/lam) to skip
+    the factorization; acceptance is still checked per solve, so a stale or
+    unhealthy factor falls into the same rescue path.
     """
     d = gram.shape[0]
     eye = jnp.eye(d, dtype=gram.dtype)
-    chol = jax.scipy.linalg.cholesky(gram + lam * eye, lower=True)
+    if chol is None:
+        chol = _psd_factor(gram, lam)
     sol = jax.scipy.linalg.cho_solve((chol, True), rhs)
 
     def rescue(_):
@@ -290,36 +303,53 @@ def bcd_least_squares(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("lam", "num_iter", "use_pallas", "sym")
+    jax.jit,
+    static_argnames=("lam", "num_iter", "use_pallas", "sym", "cache_stash"),
 )
 def _bcd_fused_kernel(A_stack, B, W0, lam: float, num_iter: int,
-                      use_pallas: bool, sym: bool):
+                      use_pallas: bool, sym: bool, cache_stash: bool = True):
     def first_epoch_step(R, xs):
-        """First sweep: compute (and, for multi-epoch runs, stash) each
-        block's Gramian. Single-epoch runs skip the stash — at bench shapes
-        it costs nb*db^2 f32 (~268 MB) of HBM for nothing."""
+        """First sweep: compute (and, when caching, stash) each block's
+        Gramian + Cholesky factor. Single-epoch runs — and models past the
+        _gram_cache_ok budget (the stash is 2x nb*db^2 f32, ~536 MB at
+        bench shapes) — skip the stash."""
         Ab, Wb = xs
-        R, Wb_new, gram = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
-        stash = (Wb_new, gram) if num_iter > 1 else (Wb_new, jnp.zeros((0,)))
+        R, Wb_new, gram, chol = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
+        empty = jnp.zeros((0,))
+        stash = (
+            (Wb_new, gram, chol)
+            if (num_iter > 1 and cache_stash)
+            else (Wb_new, empty, empty)
+        )
         return R, stash
 
     def later_epoch_step(R, xs):
-        """Later sweeps reuse the loop-invariant Gramians — only the
-        correlation AᵀR depends on the evolving residual."""
-        Ab, Wb, gram = xs
-        R, Wb_new, _ = _bcd_block_update(
-            Ab, R, Wb, lam, use_pallas, sym, gram=gram
+        """Later sweeps reuse the loop-invariant Gramians and factors —
+        only the correlation AᵀR depends on the evolving residual."""
+        Ab, Wb, gram, chol = xs
+        R, Wb_new, _, _ = _bcd_block_update(
+            Ab, R, Wb, lam, use_pallas, sym, gram=gram, chol=chol
         )
         return R, Wb_new
 
-    R, (W, grams) = jax.lax.scan(first_epoch_step, B, (A_stack, W0))
+    R, (W, grams, chols) = jax.lax.scan(first_epoch_step, B, (A_stack, W0))
     if num_iter == 1:
         return W, R
 
-    def epoch(carry, _):
-        R, W = carry
-        R, W = jax.lax.scan(later_epoch_step, R, (A_stack, W, grams))
-        return (R, W), None
+    if cache_stash:
+        def epoch(carry, _):
+            R, W = carry
+            R, W = jax.lax.scan(
+                later_epoch_step, R, (A_stack, W, grams, chols)
+            )
+            return (R, W), None
+    else:
+        # Over-budget stash: later epochs recompute Gramian + factor
+        # (rematerialization economics — the same policy as the flat path).
+        def epoch(carry, _):
+            R, W = carry
+            R, (W, _, _) = jax.lax.scan(first_epoch_step, R, (A_stack, W))
+            return (R, W), None
 
     (R, W), _ = jax.lax.scan(epoch, (R, W), None, length=num_iter - 1)
     return W, R
@@ -342,14 +372,15 @@ def _hi_kwargs(feat_dtype):
 
 
 def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
-                      gram=None):
+                      gram=None, chol=None):
     """One Gauss-Seidel block update shared by the fused solvers.
 
     Solves (AbᵀAb + λI) Wb' = AbᵀR + (AbᵀAb) Wb and returns
-    (R - Ab (Wb' - Wb), Wb', AbᵀAb). The residual delta is accumulated in f32
-    regardless of the feature layout dtype (preferred_element_type) so bf16
-    GEMM inputs never quantize the running residual. Pass ``gram`` to reuse a
-    precomputed Gramian (only the correlation then recomputes).
+    (R - Ab (Wb' - Wb), Wb', AbᵀAb, cholesky). The residual delta is
+    accumulated in f32 regardless of the feature layout dtype
+    (preferred_element_type) so bf16 GEMM inputs never quantize the running
+    residual. Pass ``gram`` (and ``chol``) to reuse the precomputed,
+    loop-invariant Gramian/factor — only the correlation then recomputes.
     """
     from keystone_tpu.ops import pallas_ops
 
@@ -370,23 +401,26 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
                 preferred_element_type=acc_dtype, **hi,
             )
         corr = _corr(Ab, R)
+    lam_t = jnp.asarray(lam, dtype=gram.dtype)
+    if chol is None:
+        chol = _psd_factor(gram, lam_t)
     rhs = corr + gram @ Wb
-    Wb_new = _solve_psd(gram, rhs, jnp.asarray(lam, dtype=gram.dtype))
+    Wb_new = _solve_psd(gram, rhs, lam_t, chol=chol)
     delta = jax.lax.dot_general(
         Ab, (Wb_new - Wb).astype(feat_dtype), (((1,), (0,)), ((), ())),
         preferred_element_type=acc_dtype, **hi,
     )
-    return R - delta, Wb_new, gram
+    return R - delta, Wb_new, gram, chol
 
 
 @functools.partial(
     jax.jit,
     static_argnames=("block", "lam", "num_iter", "use_pallas", "sym",
-                     "cache_grams"),
+                     "cache_grams", "strided"),
 )
 def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
                            use_pallas: bool, sym: bool,
-                           cache_grams: bool = False):
+                           cache_grams: bool = False, strided: bool = False):
     nb = F.shape[1] // block
     acc_dtype = jnp.promote_types(F.dtype, jnp.float32)
 
@@ -395,33 +429,69 @@ def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
         Wb = jax.lax.dynamic_index_in_dim(W, bi, axis=0, keepdims=False)
         return Ab, Wb
 
+    from keystone_tpu.ops import pallas_ops
+
+    def strided_update(bi, R, Wb, gram=None, chol=None):
+        """Block update where every F access streams the column window
+        straight out of the flat buffer (scalar-prefetched base index) —
+        no 2 GB dynamic_slice copy per block, which is pure HBM traffic
+        the MXU never sees."""
+        if gram is None:
+            gram = pallas_ops.block_gram_sym(F, bi * block, block)
+        corr = pallas_ops.block_corr(F, bi * block, block, R)
+        lam_b = jnp.asarray(lam, dtype=gram.dtype)
+        if chol is None:
+            chol = _psd_factor(gram, lam_b)
+        rhs = corr + gram @ Wb
+        Wb_new = _solve_psd(gram, rhs, lam_b, chol=chol)
+        R_new = pallas_ops.block_residual_update(
+            F, bi * block, block, (Wb_new - Wb).astype(F.dtype), R
+        )
+        return R_new, Wb_new, gram, chol
+
     def first_block(bi, carry):
         """First sweep: compute (and, when caching, stash) each block's
-        Gramian — it is loop-invariant across epochs, and recomputing it is
-        the dominant per-epoch cost (n·d_b² vs the correlation's n·d_b·k)."""
-        R, W, G = carry
-        Ab, Wb = slice_block(F, W, bi)
-        R, Wb_new, gram = _bcd_block_update(Ab, R, Wb, lam, use_pallas, sym)
+        Gramian AND its Cholesky factor — both are loop-invariant across
+        epochs; the Gramian recompute is the dominant per-epoch GEMM cost
+        (n·d_b² vs the correlation's n·d_b·k) and the factorization is the
+        dominant per-epoch non-GEMM cost."""
+        R, W, G, C = carry
+        if strided:
+            Wb = jax.lax.dynamic_index_in_dim(W, bi, axis=0, keepdims=False)
+            R, Wb_new, gram, chol = strided_update(bi, R, Wb)
+        else:
+            Ab, Wb = slice_block(F, W, bi)
+            R, Wb_new, gram, chol = _bcd_block_update(
+                Ab, R, Wb, lam, use_pallas, sym
+            )
         W = jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0)
         if cache_grams:
             G = jax.lax.dynamic_update_index_in_dim(
                 G, gram.astype(acc_dtype), bi, 0
             )
-        return R, W, G
+            C = jax.lax.dynamic_update_index_in_dim(
+                C, chol.astype(acc_dtype), bi, 0
+            )
+        return R, W, G, C
 
     def later_block(bi, carry):
-        R, W, G = carry
-        Ab, Wb = slice_block(F, W, bi)
+        R, W, G, C = carry
         gram = jax.lax.dynamic_index_in_dim(G, bi, axis=0, keepdims=False)
-        R, Wb_new, _ = _bcd_block_update(
-            Ab, R, Wb, lam, use_pallas, sym, gram=gram
-        )
-        return R, jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0), G
+        chol = jax.lax.dynamic_index_in_dim(C, bi, axis=0, keepdims=False)
+        if strided:
+            Wb = jax.lax.dynamic_index_in_dim(W, bi, axis=0, keepdims=False)
+            R, Wb_new, _, _ = strided_update(bi, R, Wb, gram=gram, chol=chol)
+        else:
+            Ab, Wb = slice_block(F, W, bi)
+            R, Wb_new, _, _ = _bcd_block_update(
+                Ab, R, Wb, lam, use_pallas, sym, gram=gram, chol=chol
+            )
+        return R, jax.lax.dynamic_update_index_in_dim(W, Wb_new, bi, 0), G, C
 
-    G0 = jnp.zeros(
-        (nb, block, block) if cache_grams else (0, 0, 0), dtype=acc_dtype
-    )
-    R, W, G = jax.lax.fori_loop(0, nb, first_block, (B, W0, G0))
+    stash_shape = (nb, block, block) if cache_grams else (0, 0, 0)
+    G0 = jnp.zeros(stash_shape, dtype=acc_dtype)
+    C0 = jnp.zeros(stash_shape, dtype=acc_dtype)
+    R, W, G, C = jax.lax.fori_loop(0, nb, first_block, (B, W0, G0, C0))
 
     if num_iter > 1:
         body = later_block if cache_grams else first_block
@@ -429,7 +499,7 @@ def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
         def epoch(_, carry):
             return jax.lax.fori_loop(0, nb, body, carry)
 
-        R, W, G = jax.lax.fori_loop(0, num_iter - 1, epoch, (R, W, G))
+        R, W, G, C = jax.lax.fori_loop(0, num_iter - 1, epoch, (R, W, G, C))
     return W, R
 
 
@@ -469,13 +539,32 @@ def bcd_least_squares_fused_flat(
         use_pallas = pallas_ops.pallas_direct_ok(F)
     W0 = jnp.zeros((nb, block_size, B.shape[1]), dtype=B.dtype)
     acc_itemsize = jnp.promote_types(F.dtype, jnp.float32).itemsize
+    # x2: the stash holds Gramians AND their Cholesky factors.
     cache_grams = _gram_cache_ok(
-        int(num_iter), nb * block_size * block_size * acc_itemsize
+        int(num_iter), 2 * nb * block_size * block_size * acc_itemsize
     )
+    # Strided column-window kernels (no per-block dynamic_slice copy of F)
+    # need tile-aligned shapes and an f32 accumulation dtype; everything in
+    # the update then runs lane-padded to a 128 multiple, so pad the labels
+    # once up front and slice the model on the way out (the padded label
+    # columns are zero, and stay zero through every solve).
+    strided = (
+        bool(use_pallas)
+        and jnp.promote_types(F.dtype, jnp.float32) == jnp.float32
+        and pallas_ops.strided_gram_ok(F, block_size)
+    )
+    k_orig = B.shape[1]
+    if strided and k_orig % 128:
+        tr = ((k_orig + 127) // 128) * 128
+        B = jnp.pad(B, ((0, 0), (0, tr - k_orig)))
+        W0 = jnp.zeros((nb, block_size, tr), dtype=B.dtype)
     W, R = _bcd_fused_flat_kernel(
         F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
-        bool(use_pallas), True, cache_grams,
+        bool(use_pallas), True, cache_grams, strided,
     )
+    if W.shape[2] != k_orig:
+        W = W[:, :, :k_orig]
+        R = R[:, :k_orig]
     return (W, R) if return_residual else W
 
 
@@ -532,9 +621,15 @@ def bcd_least_squares_fused(
             )
             for i in range(nb)
         )
+    acc_itemsize = jnp.promote_types(A_stack.dtype, jnp.float32).itemsize
+    # x2: the stash holds Gramians AND their Cholesky factors (same budget
+    # policy as the flat path).
+    cache_stash = _gram_cache_ok(
+        int(num_iter), 2 * nb * db * db * acc_itemsize
+    )
     W, R = _bcd_fused_kernel(
         A_stack, B, W0, float(lam), max(int(num_iter), 1),
-        bool(use_pallas), True,
+        bool(use_pallas), True, cache_stash,
     )
     return (W, R) if return_residual else W
 

@@ -1,0 +1,61 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time
+import numpy as np, jax, jax.numpy as jnp
+from keystone_tpu.ops import pallas_ops as po
+from keystone_tpu.ops.stats import CosineRandomFeatures
+from keystone_tpu.parallel import linalg
+
+n, d_in, D, k, bs = 262144, 440, 16384, 147, 4096
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.normal(size=(n, d_in)).astype(np.float32))
+Y = 2.0 * jax.nn.one_hot(rng.integers(0, k, size=n), k, dtype=jnp.float32) - 1.0
+rfs = [CosineRandomFeatures(d_in, bs, gamma=0.05, seed=i) for i in range(D//bs)]
+Wrf = jnp.concatenate([rf.W for rf in rfs], axis=0); brf = jnp.concatenate([rf.b for rf in rfs])
+
+def timed(f, *a, label="", n_rep=3):
+    s = float(f(*a)); ts = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter(); s = float(f(*a)); ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f} ms", flush=True)
+
+timed(jax.jit(lambda X: jnp.sum(X[:8])), X, label="RTT floor")
+
+@jax.jit
+def train3(X, Y):
+    F = po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16)
+    W = linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=3, use_pallas=True)
+    return jnp.sum(jnp.abs(W))
+timed(train3, X, Y, label="featurize+solve3 one program")
+
+@jax.jit
+def train1(X, Y):
+    F = po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16)
+    W = linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=1, use_pallas=True)
+    return jnp.sum(jnp.abs(W))
+timed(train1, X, Y, label="featurize+solve1 one program")
+
+def marginal(f, *a, label="", n=3):
+    # 1 run vs n runs, single host sync each: difference isolates device time.
+    s = float(f(*a))
+    t0 = time.perf_counter(); s = float(f(*a)); t1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    outs = [f(*a) for _ in range(n)]
+    s = float(sum(outs))
+    tn = time.perf_counter() - t0
+    print(f"{label}: single={t1*1000:.1f} ms, marginal={(tn-t1)/(n-1)*1000:.1f} ms", flush=True)
+
+marginal(train3, X, Y, label="train3 marginal")
+
+def make_repeat(reps):
+    @jax.jit
+    def run(X, Y):
+        def body(i, acc):
+            F = po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16)
+            W = linalg.bcd_least_squares_fused_flat(F, Y + 0.0 * acc, bs, lam=1e-4, num_iter=3, use_pallas=True)
+            return acc + jnp.sum(jnp.abs(W))
+        return jax.lax.fori_loop(0, reps, body, 0.0)
+    return run
+
+r1, r3 = make_repeat(1), make_repeat(3)
+timed(r1, X, Y, label="in-program reps=1")
+timed(r3, X, Y, label="in-program reps=3")

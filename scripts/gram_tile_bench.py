@@ -1,0 +1,66 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, functools
+import numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from keystone_tpu.ops import pallas_ops as po
+from keystone_tpu.ops.pallas_ops import _gram_corr_sym_kernel, _pad_to, _TILE_K
+
+n, d, k = 262144, 4096, 147
+rng = np.random.default_rng(0)
+A = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32), dtype=jnp.bfloat16)
+R = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+
+def gram_corr_ti(A, R, ti, tk=512):
+    Af = jnp.asarray(A); Rf = jnp.asarray(R, jnp.float32)
+    nn, dd = Af.shape
+    kdim = Rf.shape[1]
+    Ap = _pad_to(_pad_to(Af, tk, 0), ti, 1)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Rp = _pad_to(_pad_to(Rf, tk, 0), tr, 1)
+    npad, dp = Ap.shape
+    nk = npad // tk; nt = dp // ti
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
+    jj = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2, grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda p, kk, ii, jj: (kk, ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, kk, ii, jj: (kk, jj[p])),
+            pl.BlockSpec((tk, tr), lambda p, kk, ii, jj: (kk, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, ti), lambda p, kk, ii, jj: (ii[p], jj[p])),
+            pl.BlockSpec((ti, tr), lambda p, kk, ii, jj: (ii[p], 0)),
+        ],
+        scratch_shapes=[pltpu.VMEM((ti, ti), jnp.float32), pltpu.VMEM((ti, tr), jnp.float32)],
+    )
+    gram_u, corr = pl.pallas_call(
+        functools.partial(_gram_corr_sym_kernel, nk=nk, compute_dtype=jnp.bfloat16),
+        grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((dp, dp), jnp.float32), jax.ShapeDtypeStruct((dp, tr), jnp.float32)],
+    )(ii, jj, Ap, Ap, Rp)
+    upper = jnp.triu(gram_u)
+    return (upper + jnp.triu(gram_u, 1).T)[:dd, :dd], corr[:dd, :kdim]
+
+def timed(f, *a, label="", n_rep=4):
+    s = float(sum(jnp.sum(jnp.abs(t)) for t in f(*a)))
+    ts = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter(); s = float(sum(jnp.sum(jnp.abs(t)) for t in f(*a))); ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f} ms (incl ~60ms RTT)", flush=True)
+
+ref = jax.jit(lambda A, R: po.gram_corr_sym(A, R))
+timed(ref, A, R, label="current ti=512")
+for ti in (1024, 2048):
+    f = jax.jit(functools.partial(gram_corr_ti, ti=ti))
+    g1, c1 = f(A, R)
+    g0, c0 = ref(A, R)
+    err = float(jnp.max(jnp.abs(g1 - g0))), float(jnp.max(jnp.abs(c1 - c0)))
+    timed(f, A, R, label=f"ti={ti} (err {err[0]:.2e}/{err[1]:.2e})")
+# also try tk=1024 at ti=1024
+f = jax.jit(functools.partial(gram_corr_ti, ti=1024, tk=1024))
+timed(f, A, R, label="ti=1024 tk=1024")
+f = jax.jit(functools.partial(gram_corr_ti, ti=2048, tk=1024))
+timed(f, A, R, label="ti=2048 tk=1024")

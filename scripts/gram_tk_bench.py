@@ -1,0 +1,55 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, functools
+import numpy as np, jax, jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from keystone_tpu.ops.pallas_ops import _gram_corr_sym_kernel, _pad_to
+
+n, D, k, blk = 262144, 16384, 147, 4096
+rng = np.random.default_rng(0)
+F = jax.random.normal(jax.random.PRNGKey(0), (n, D), dtype=jnp.bfloat16)
+R = jax.random.normal(jax.random.PRNGKey(1), (n, 256), dtype=jnp.float32)
+
+def strided_gram(F, R, col_start, ti, tk):
+    nt = blk // ti; nk = n // tk; tr = 256
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = jnp.asarray(np.array([p[0] for p in pairs], np.int32))
+    jj = jnp.asarray(np.array([p[1] for p in pairs], np.int32))
+    base = jnp.asarray(col_start, jnp.int32).reshape(1) // ti
+    gs = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3, grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((tk, ti), lambda p, kk, b, ii, jj: (kk, b[0] + ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, kk, b, ii, jj: (kk, b[0] + jj[p])),
+            pl.BlockSpec((tk, tr), lambda p, kk, b, ii, jj: (jnp.where(ii[p]==jj[p], kk, 0), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, ti), lambda p, kk, b, ii, jj: (ii[p], jj[p])),
+            pl.BlockSpec((ti, tr), lambda p, kk, b, ii, jj: (ii[p], 0)),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_gram_corr_sym_kernel, nk=nk, compute_dtype=jnp.bfloat16),
+        grid_spec=gs,
+        out_shape=[jax.ShapeDtypeStruct((blk, blk), jnp.float32), jax.ShapeDtypeStruct((blk, tr), jnp.float32)],
+    )(base, ii, jj, F, F, R)
+
+def timed(f, *a, label="", n_rep=3):
+    s = float(f(*a)); ts = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter(); s = float(f(*a)); ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f} ms", flush=True)
+
+timed(jax.jit(lambda F: jnp.sum(F[:8].astype(jnp.float32))), F, label="RTT floor")
+import sys as _s
+for ti, tk in [tuple(int(x) for x in _s.argv[1].split(","))]:
+    try:
+        def four(F, R, ti=ti, tk=tk):
+            out = 0.0
+            for b in range(4):
+                g, c = strided_gram(F, R, b * blk, ti, tk)
+                out += jnp.sum(g) + jnp.sum(c)
+            return out
+        timed(jax.jit(four), F, R, label=f"4-block strided gram ti={ti} tk={tk} (22-25 TF syrk)")
+    except Exception as e:
+        print(f"ti={ti} tk={tk}: FAILED {str(e)[:120]}", flush=True)

@@ -1,0 +1,30 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, functools
+import numpy as np, jax, jax.numpy as jnp
+from keystone_tpu.ops import pallas_ops as po
+
+n, d, k = 262144, 4096, 147
+rng = np.random.default_rng(0)
+A = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32), dtype=jnp.bfloat16)
+R = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+
+def timed(f, *a, label="", n_rep=4):
+    s = float(sum(jnp.sum(jnp.abs(t)) for t in f(*a)))
+    ts = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter(); s = float(sum(jnp.sum(jnp.abs(t)) for t in f(*a))); ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f} ms", flush=True)
+
+# RTT floor
+timed(jax.jit(lambda A: (jnp.sum(A[:8].astype(jnp.float32)),)), A, label="RTT floor")
+timed(jax.jit(lambda A, R: po.gram_corr_sym(A, R)), A, R, label="pallas sym ti=1024 (5.5TF syrk / 9.4TF-equiv)")
+def xla_gram(A, R):
+    g = jax.lax.dot_general(A, A, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    c = jax.lax.dot_general(A, R.astype(jnp.bfloat16), (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    return g, c
+timed(jax.jit(xla_gram), A, R, label="XLA full gram+corr (9.4 TF)")
+def xla_gram_f32r(A, R):
+    g = jax.lax.dot_general(A, A, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+    c = jax.lax.dot_general(A.astype(jnp.float32), R, (((0,), (0,)), ((), ())), precision=jax.lax.Precision.HIGHEST, preferred_element_type=jnp.float32)
+    return g, c
+timed(jax.jit(xla_gram_f32r), A, R, label="XLA gram bf16 + corr f32-hi")

@@ -1,0 +1,50 @@
+import sys; sys.path.insert(0, "/root/repo")
+import time, numpy as np, jax, jax.numpy as jnp
+from keystone_tpu.ops import pallas_ops as po
+from keystone_tpu.ops.stats import CosineRandomFeatures
+from keystone_tpu.parallel import linalg
+
+n, d_in, D, k, bs = 262144, 440, 16384, 147, 4096
+rng = np.random.default_rng(0)
+X = jnp.asarray(rng.normal(size=(n, d_in)).astype(np.float32))
+Y = 2.0 * jax.nn.one_hot(rng.integers(0, k, size=n), k, dtype=jnp.float32) - 1.0
+rfs = [CosineRandomFeatures(d_in, bs, gamma=0.05, seed=i) for i in range(D//bs)]
+Wrf = jnp.concatenate([rf.W for rf in rfs], axis=0); brf = jnp.concatenate([rf.b for rf in rfs])
+
+def timed(f, *a, label="", n_rep=3):
+    s = float(jnp.sum(jnp.abs(f(*a))))
+    ts = []
+    for _ in range(n_rep):
+        t0 = time.perf_counter(); s = float(jnp.sum(jnp.abs(f(*a)))); ts.append(time.perf_counter() - t0)
+    print(f"{label}: {min(ts)*1000:.1f} ms", flush=True)
+
+import sys
+which = sys.argv[1]
+if which == "big":
+    F = jax.jit(lambda X: po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16))(X)
+    jax.block_until_ready(F)
+    timed(jax.jit(lambda F, Y: jnp.sum(jnp.abs(linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=1, use_pallas=True)))), F, Y, label="solve only 1 epoch (38.2 TF)")
+    timed(jax.jit(lambda F, Y: jnp.sum(jnp.abs(linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=3, use_pallas=True)))), F, Y, label="solve only 3 epochs (43.3 TF)")
+    def grams4(F, Y):
+        out = 0.0
+        for i in range(4):
+            Ab = jax.lax.dynamic_slice_in_dim(F, i*bs, bs, axis=1)
+            g, c = po.gram_corr_sym(Ab, Y)
+            out += jnp.sum(jnp.abs(g)) + jnp.sum(jnp.abs(c))
+        return out
+    timed(jax.jit(grams4), F, Y, label="4x gram_corr_sym (37.6 TF)")
+    timed(jax.jit(lambda X: jnp.sum(jnp.abs(po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16).astype(jnp.float32)))), X, label="featurize (3.8 TF)")
+else:
+    G = jnp.asarray(rng.normal(size=(bs, bs)).astype(np.float32)); G = G @ G.T + bs * jnp.eye(bs)
+    rhs = jnp.asarray(rng.normal(size=(bs, k)).astype(np.float32))
+    def chol4(M):
+        return sum(jnp.sum(jnp.abs(jax.scipy.linalg.cholesky(M + (i+1)*1e-4*jnp.eye(bs), lower=True))) for i in range(4))
+    timed(jax.jit(chol4), G, label="4x cholesky 4096")
+    def sp4(G, rhs):
+        return sum(jnp.sum(jnp.abs(linalg._solve_psd(G + i*1e-5*jnp.eye(bs), rhs, jnp.float32(1e-4)))) for i in range(4))
+    timed(jax.jit(sp4), G, rhs, label="4x _solve_psd 4096")
+    # triangular solve alone
+    L = jax.scipy.linalg.cholesky(G + 1e-4*jnp.eye(bs), lower=True)
+    def cs4(L, rhs):
+        return sum(jnp.sum(jnp.abs(jax.scipy.linalg.cho_solve((L, True), rhs + i))) for i in range(4))
+    timed(jax.jit(cs4), L, rhs, label="4x cho_solve 4096x147")
