@@ -151,8 +151,14 @@ class Convolver(Transformer):
         out = self._convolve(batch)
         return out[0] if single else out
 
+    def _batch_fn(self, X):
+        return self._convolve(jnp.asarray(X, jnp.float32))
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(lambda X: self._convolve(jnp.asarray(X, jnp.float32)))
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 class Pooler(Transformer):
@@ -213,8 +219,14 @@ class Pooler(Transformer):
         out = self._pool(batch)
         return out[0] if single else out
 
+    def _batch_fn(self, X):
+        return self._pool(jnp.asarray(X, jnp.float32))
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(lambda X: self._pool(jnp.asarray(X, jnp.float32)))
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 class Windower(Transformer):
@@ -267,3 +279,6 @@ class SymmetricRectifier(Transformer):
 
     def batch_apply(self, data: Dataset) -> Dataset:
         return data.map_batch(self._rectify)
+
+    def device_fn(self):
+        return self._rectify

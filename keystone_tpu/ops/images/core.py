@@ -52,6 +52,9 @@ class GrayScaler(Transformer):
             return data.map(image_utils.to_grayscale)
         return data.map_batch(image_utils.to_grayscale)
 
+    def device_fn(self):
+        return image_utils.to_grayscale
+
 
 class PixelScaler(Transformer):
     """Rescale byte pixels to [0, 1) (reference: nodes/images/PixelScaler.scala)."""
@@ -59,10 +62,16 @@ class PixelScaler(Transformer):
     def apply(self, img):
         return jnp.asarray(img, jnp.float32) / 255.0
 
+    def _batch_fn(self, X):
+        return jnp.asarray(X, jnp.float32) / 255.0
+
     def batch_apply(self, data: Dataset) -> Dataset:
         if data.is_host:
             return data.map(self.apply)
-        return data.map_batch(lambda X: jnp.asarray(X, jnp.float32) / 255.0)
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 class Cropper(Transformer):
@@ -90,10 +99,16 @@ class ImageVectorizer(Transformer):
     def apply(self, img):
         return jnp.asarray(img).reshape(-1)
 
+    def _batch_fn(self, X):
+        return X.reshape(X.shape[0], -1)
+
     def batch_apply(self, data: Dataset) -> Dataset:
         if data.is_host:
             return data.map(self.apply)
-        return data.map_batch(lambda X: X.reshape(X.shape[0], -1))
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 class RandomImageTransformer(Transformer):

@@ -164,13 +164,16 @@ class PaddedFFT(Transformer):
         padded = jnp.pad(x, [(0, p - x.shape[-1])])
         return jnp.real(jnp.fft.fft(padded))[: p // 2]
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        def f(X):
-            p = self._padded_size(X.shape[-1])
-            padded = jnp.pad(X, [(0, 0), (0, p - X.shape[-1])])
-            return jnp.real(jnp.fft.fft(padded, axis=-1))[:, : p // 2]
+    def _batch_fn(self, X):
+        p = self._padded_size(X.shape[-1])
+        padded = jnp.pad(X, [(0, 0), (0, p - X.shape[-1])])
+        return jnp.real(jnp.fft.fft(padded, axis=-1))[:, : p // 2]
 
-        return data.map_batch(f)
+    def batch_apply(self, data: Dataset) -> Dataset:
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 class RandomSignNode(Transformer):
@@ -190,8 +193,14 @@ class RandomSignNode(Transformer):
     def apply(self, x):
         return jnp.asarray(x) * self.signs
 
+    def _batch_fn(self, X):
+        return X * self.signs
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(lambda X: X * self.signs)
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 # ---------------------------------------------------------------------------
@@ -209,9 +218,15 @@ class LinearRectifier(Transformer):
     def apply(self, x):
         return jnp.maximum(jnp.asarray(x) - self.alpha, self.max_val)
 
+    def _batch_fn(self, X):
+        return jnp.maximum(X - self.alpha, self.max_val)
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        out = data.map_batch(lambda X: jnp.maximum(X - self.alpha, self.max_val))
+        out = data.map_batch(self._batch_fn)
         return out._rezero_padding() if (self.max_val != 0.0 or self.alpha != 0.0) else out
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 @dataclass(frozen=True)
@@ -222,8 +237,14 @@ class SignedHellingerMapper(Transformer):
         x = jnp.asarray(x)
         return jnp.sign(x) * jnp.sqrt(jnp.abs(x))
 
+    def _batch_fn(self, X):
+        return jnp.sign(X) * jnp.sqrt(jnp.abs(X))
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(lambda X: jnp.sign(X) * jnp.sqrt(jnp.abs(X)))
+        return data.map_batch(self._batch_fn)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 @dataclass(frozen=True)
@@ -239,6 +260,9 @@ class NormalizeRows(Transformer):
 
     def batch_apply(self, data: Dataset) -> Dataset:
         return data.map_batch(self.apply)
+
+    def device_fn(self):
+        return self.apply
 
 
 @dataclass(frozen=True)

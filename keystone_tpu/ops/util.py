@@ -102,8 +102,14 @@ class MaxClassifier(Transformer):
     def apply(self, x):
         return jnp.argmax(x, axis=-1)
 
+    def _batch_fn(self, X):
+        return jnp.argmax(X, axis=-1)
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return Dataset(jnp.argmax(data.array, axis=-1), n=data.n, mesh=data.mesh)
+        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 @dataclass(frozen=True)
@@ -150,10 +156,14 @@ class MatrixVectorizer(Transformer):
     def apply(self, x):
         return jnp.asarray(x).T.reshape(-1)
 
+    def _batch_fn(self, X):
+        return jnp.transpose(X, (0, 2, 1)).reshape(X.shape[0], -1)
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        arr = data.array
-        out = jnp.transpose(arr, (0, 2, 1)).reshape(arr.shape[0], -1)
-        return Dataset(out, n=data.n, mesh=data.mesh)
+        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 @dataclass(frozen=True)
@@ -173,8 +183,14 @@ class FloatToDouble(Transformer):
     def apply(self, x):
         return jnp.asarray(x, dtype=self._dtype())
 
+    def _batch_fn(self, X):
+        return jnp.asarray(X, dtype=self._dtype())
+
     def batch_apply(self, data: Dataset) -> Dataset:
-        return Dataset(jnp.asarray(data.array, dtype=self._dtype()), n=data.n, mesh=data.mesh)
+        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
+
+    def device_fn(self):
+        return self._batch_fn
 
 
 @dataclass(frozen=True)

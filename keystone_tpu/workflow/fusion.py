@@ -1,0 +1,197 @@
+"""Stage fusion: compile chains of device-pure transformers into ONE XLA
+program.
+
+The reference executes one Spark stage per node; its per-node overhead is a
+job wave. The TPU analog of that overhead is one XLA dispatch per node — and
+one missed fusion opportunity per node boundary, because elementwise work
+(rectifiers, scalers, sign flips) that XLA would fuse straight into a
+neighboring matmul/FFT instead round-trips HBM between programs. This module
+is the whole-pipeline optimizer's TPU-specific answer (SURVEY §3's optimizer
+layer doing a transform Spark has no analog of):
+
+  - Transformers that are *row-local pure array functions* declare it by
+    implementing ``device_fn()`` (returns the array->array function).
+  - :class:`StageFusionRule` rewrites maximal linear chains of such nodes
+    into one :class:`FusedBatchTransformer` whose batch path is a single
+    ``jax.jit`` of the composed functions: one dispatch, full XLA fusion
+    across the old node boundaries.
+
+Chains never fuse across: estimator fits, multi-input nodes (gather/
+combiner), sinks, prefix-published nodes (their intermediate result must
+stay materializable for the state table — e.g. everything a Cacher marks),
+or nodes whose results another branch consumes.
+
+Row-local contract for ``device_fn``: output row i depends only on input row
+i (elementwise over the leading axis), so mesh zero-padding rows cannot leak
+into valid rows and a single trailing ``_rezero_padding`` is equivalent to
+per-stage rezeroing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import jax
+
+from .env import Prefix
+from .graph import Graph, NodeId, SinkId
+from .optimizer import Plan, Rule
+from .pipeline import Transformer
+
+__all__ = ["FusedBatchTransformer", "StageFusionRule", "fusable"]
+
+
+def fusable(op) -> bool:
+    """True when the operator participates in stage fusion."""
+    fn = getattr(op, "device_fn", None)
+    return callable(fn) and fn() is not None
+
+
+class FusedBatchTransformer(Transformer):
+    """A chain of row-local transformers compiled as one program.
+
+    Single-datum ``apply`` keeps exact per-node semantics (composition of
+    the members' ``apply``); the batch path jits the composition of the
+    members' ``device_fn`` functions. Host-form datasets fall back to the
+    sequential member chain.
+    """
+
+    def __init__(self, members: Sequence[Transformer]):
+        if len(members) < 2:
+            raise ValueError("fusion needs at least two members")
+        for m in members:
+            if not isinstance(m, Transformer) or m.device_fn() is None:
+                raise ValueError(f"member {m!r} is not device-fusable")
+        self.members = list(members)
+        fns = [m.device_fn() for m in self.members]
+
+        def composed(X):
+            for f in fns:
+                X = f(X)
+            return X
+
+        self._composed = jax.jit(composed)
+
+    @property
+    def label(self) -> str:
+        return "Fused[" + " > ".join(m.label for m in self.members) + "]"
+
+    def device_fn(self):
+        return self._composed
+
+    def apply(self, x):
+        for m in self.members:
+            x = m.apply(x)
+        return x
+
+    def batch_apply(self, data):
+        if data.is_host:
+            for m in self.members:
+                data = m.batch_apply(data)
+            return data
+        return data.map_batch(self._composed)
+
+
+def _consumers(plan: Graph) -> Dict[NodeId, List]:
+    out: Dict[NodeId, List] = {}
+    for node, deps in plan.dependencies.items():
+        for d in deps:
+            out.setdefault(d, []).append(node)
+    for sink in plan.sinks:
+        out.setdefault(plan.get_sink_dependency(sink), []).append(sink)
+    return out
+
+
+class StageFusionRule(Rule):
+    """Fuse maximal linear chains of device-fusable transformer nodes.
+
+    A node chains onto its single dependency when BOTH are fusable, the
+    dependency has exactly one consumer (this node), and neither is
+    prefix-published (prefix results must materialize for the state table).
+
+    Fused transformers are memoized by member identity: re-optimizing a
+    graph that contains the same transformer instances (the normal case —
+    pipelines are re-applied with the same node objects) reuses the same
+    ``jax.jit`` callable, so XLA's compile cache hits instead of retracing
+    a fresh closure every optimization pass.
+    """
+
+    _CACHE_MAX = 64
+
+    def __init__(self) -> None:
+        # key: tuple of member object ids; value keeps the members alive so
+        # the ids cannot be recycled while the entry exists. Bounded FIFO —
+        # sessions building many distinct pipelines (sweeps) must not pin
+        # executables forever.
+        self._cache: Dict[tuple, FusedBatchTransformer] = {}
+
+    def _fused(self, ops) -> FusedBatchTransformer:
+        key = tuple(id(o) for o in ops)
+        hit = self._cache.get(key)
+        if hit is not None and all(
+            a is b for a, b in zip(hit.members, ops)
+        ):
+            return hit
+        fused = FusedBatchTransformer(ops)
+        if len(self._cache) >= self._CACHE_MAX:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = fused
+        return fused
+
+    def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        consumers = _consumers(plan)
+
+        def chainable(node) -> bool:
+            return (
+                isinstance(node, NodeId)
+                and node not in prefixes
+                and fusable(plan.get_operator(node))
+                and len(plan.get_dependencies(node)) == 1
+            )
+
+        # Walk heads: a chain head is chainable but its dependency link
+        # upward is not extendable.
+        chains: List[List[NodeId]] = []
+        seen = set()
+        for node in sorted(plan.nodes, key=lambda n: n.id):
+            if node in seen or not chainable(node):
+                continue
+            # extend upward
+            head = node
+            while True:
+                dep = plan.get_dependencies(head)[0]
+                if (
+                    chainable(dep)
+                    and len(consumers.get(dep, [])) == 1
+                ):
+                    head = dep
+                else:
+                    break
+            # collect downward from head
+            chain = [head]
+            cur = head
+            while True:
+                nexts = consumers.get(cur, [])
+                if len(nexts) != 1 or isinstance(nexts[0], SinkId):
+                    break
+                nxt = nexts[0]
+                if not chainable(nxt) or plan.get_dependencies(nxt)[0] != cur:
+                    break
+                chain.append(nxt)
+                cur = nxt
+            seen.update(chain)
+            if len(chain) >= 2:
+                chains.append(chain)
+
+        for chain in chains:
+            ops = [plan.get_operator(n) for n in chain]
+            fused = self._fused(ops)
+            head_deps = plan.get_dependencies(chain[0])
+            tail = chain[-1]
+            # Reuse the tail node id so downstream consumers stay wired.
+            plan = plan.set_operator(tail, fused)
+            plan = plan.set_dependencies(tail, head_deps)
+            for n in chain[:-1]:
+                plan = plan.remove_node(n)
+
+        return plan, prefixes

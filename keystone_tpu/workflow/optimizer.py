@@ -103,6 +103,12 @@ class Optimizer(RuleExecutor):
     """Base class for whole-pipeline optimizers (DefaultOptimizer.scala)."""
 
 
+def _make_stage_fusion():
+    from .fusion import StageFusionRule
+
+    return StageFusionRule()
+
+
 class DefaultOptimizer(Optimizer):
     """Standard batches: saved-state load, CSE to fixpoint, node-level optimization
     (reference: workflow/DefaultOptimizer.scala:8-14)."""
@@ -128,6 +134,10 @@ class DefaultOptimizer(Optimizer):
                 [EquivalentNodeMergeRule()],
             ),
             Batch("Node Level Optimization", Once(), [NodeOptimizationRule()]),
+            # TPU-specific: compile chains of row-local device transformers
+            # into one XLA program (workflow/fusion.py). Runs last so CSE /
+            # prefix extraction see the original node granularity.
+            Batch("Stage Fusion", Once(), [_make_stage_fusion()]),
         ]
 
 
@@ -157,4 +167,7 @@ class AutoCachingOptimizer(Optimizer):
             ),
             Batch("Node Level Optimization", Once(), [NodeOptimizationRule()]),
             Batch("Auto Cache", Once(), [AutoCacheRule(strategy or GreedyCache())]),
+            # After cache placement: cached/prefix nodes are excluded from
+            # chains, so fusion never hides a materialization point.
+            Batch("Stage Fusion", Once(), [_make_stage_fusion()]),
         ]

@@ -314,6 +314,15 @@ class Transformer(TransformerOperator, Chainable[A, B]):
     def batch_apply(self, data: Dataset) -> Dataset:
         return data.map(self.apply)
 
+    def device_fn(self) -> Optional[Callable]:
+        """Pure batched array function equivalent to ``batch_apply`` on
+        array-form datasets, or None when the node is not expressible as
+        one. Implementing it opts the node into whole-pipeline stage fusion
+        (workflow/fusion.py): chains of such nodes compile into ONE XLA
+        program. Contract: row-local (output row i depends only on input
+        row i) and side-effect free."""
+        return None
+
     def __call__(self, x: Any) -> Any:
         """Eager application to a datum or Dataset; lazy on pipeline handles."""
         if isinstance(x, Dataset):

@@ -1,0 +1,176 @@
+"""Stage fusion (workflow/fusion.py): chains of row-local device
+transformers compile into ONE XLA program via the whole-pipeline optimizer's
+final batch — the TPU-specific optimizer transform (one dispatch per chain,
+XLA fusing across old node boundaries, vs the reference's one Spark stage
+per node)."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.stats import (
+    LinearRectifier,
+    NormalizeRows,
+    PaddedFFT,
+    RandomSignNode,
+    SignedHellingerMapper,
+)
+from keystone_tpu.ops.util import Cacher, MaxClassifier
+from keystone_tpu.workflow import Pipeline
+from keystone_tpu.workflow.fusion import (
+    FusedBatchTransformer,
+    StageFusionRule,
+    fusable,
+)
+
+rng = np.random.default_rng(0)
+
+
+def _chain_pipeline():
+    return (
+        RandomSignNode.create(64, seed=3)
+        .to_pipeline()
+        .and_then(PaddedFFT())
+        .and_then(LinearRectifier(0.0))
+    )
+
+
+def _unfused_result(X):
+    out = Dataset.of(X)
+    for t in (
+        RandomSignNode.create(64, seed=3),
+        PaddedFFT(),
+        LinearRectifier(0.0),
+    ):
+        out = t.batch_apply(out)
+    return np.asarray(out.array)
+
+
+class TestFusedBatchTransformer:
+    def test_composed_matches_sequential(self):
+        X = rng.normal(size=(16, 64)).astype(np.float32)
+        members = [RandomSignNode.create(64, seed=3), PaddedFFT(), LinearRectifier(0.0)]
+        fused = FusedBatchTransformer(members)
+        out = np.asarray(fused.batch_apply(Dataset.of(X)).array)
+        np.testing.assert_allclose(out, _unfused_result(X), atol=1e-5)
+
+    def test_single_datum_apply(self):
+        x = rng.normal(size=(64,)).astype(np.float32)
+        members = [RandomSignNode.create(64, seed=3), PaddedFFT(), LinearRectifier(0.0)]
+        fused = FusedBatchTransformer(members)
+        seq = x
+        for m in members:
+            seq = m.apply(seq)
+        np.testing.assert_allclose(np.asarray(fused.apply(x)), np.asarray(seq), atol=1e-5)
+
+    def test_rejects_non_fusable(self):
+        from keystone_tpu.ops.nlp import Tokenizer
+
+        with pytest.raises(ValueError):
+            FusedBatchTransformer([NormalizeRows(), Tokenizer()])
+
+    def test_padded_dataset_matches_unfused(self):
+        """Mesh zero-padding: one trailing rezero (fused) must equal the
+        per-stage rezeroing of the sequential chain — the row-local
+        contract. Exercises a stage mapping 0 -> nonzero mid-chain
+        (LinearRectifier with negative alpha)."""
+        from keystone_tpu.parallel import mesh as mesh_lib
+
+        mesh = mesh_lib.make_mesh()
+        X = rng.normal(size=(13, 8)).astype(np.float32)  # pads
+        members = [LinearRectifier(0.0, -0.5), NormalizeRows()]
+        fused = FusedBatchTransformer(members)
+        ds = Dataset.of(X).shard(mesh)
+        out = fused.batch_apply(ds)
+        seq = ds
+        for m in members:
+            seq = m.batch_apply(seq)
+        np.testing.assert_allclose(
+            np.asarray(out.array)[:13], np.asarray(seq.array)[:13], atol=1e-6
+        )
+        assert out.n == 13
+        np.testing.assert_allclose(np.asarray(out.array)[13:], 0.0, atol=0)
+
+
+class TestStageFusionRule:
+    def test_pipeline_chain_fuses_to_one_node(self):
+        pipe = _chain_pipeline()
+        X = rng.normal(size=(12, 64)).astype(np.float32)
+        handle = pipe.apply(Dataset.of(X))
+        out = np.asarray(handle.get().array)
+        np.testing.assert_allclose(out, _unfused_result(X), atol=1e-5)
+
+        # The executed (optimized) graph is the applied data source plus
+        # exactly one fused node — the three originals are gone.
+        graph = handle.executor.optimized_graph
+        labels = sorted(graph.get_operator(n).label for n in graph.nodes)
+        assert sum(l.startswith("Fused[") for l in labels) == 1, labels
+        assert len(labels) == 2, labels
+
+    def test_cacher_is_a_fusion_barrier(self):
+        # Cacher marks a prefix-published materialization point; chains must
+        # not fuse across (or swallow) it.
+        pipe = (
+            SignedHellingerMapper()
+            .to_pipeline()
+            .and_then(Cacher())
+            .and_then(NormalizeRows())
+        )
+        X = rng.normal(size=(10, 8)).astype(np.float32)
+        handle = pipe.apply(Dataset.of(X))
+        ref = NormalizeRows().batch_apply(
+            SignedHellingerMapper().batch_apply(Dataset.of(X))
+        )
+        np.testing.assert_allclose(
+            np.asarray(handle.get().array), np.asarray(ref.array), atol=1e-6
+        )
+        graph = handle.executor.optimized_graph
+        labels = [graph.get_operator(n).label for n in graph.nodes]
+        assert not any(l.startswith("Fused[") for l in labels), labels
+
+    def test_branch_consumers_prevent_fusion(self):
+        # A node consumed by two branches must stay materialized.
+        from keystone_tpu.ops.util import VectorCombiner
+
+        base = SignedHellingerMapper().to_pipeline()
+        b1 = base.and_then(NormalizeRows())
+        b2 = base.and_then(LinearRectifier(0.0))
+        pipe = Pipeline.gather([b1, b2]).and_then(VectorCombiner())
+        X = rng.normal(size=(6, 8)).astype(np.float32)
+        out = np.asarray(pipe.apply(Dataset.of(X)).get().array)
+        h = SignedHellingerMapper().batch_apply(Dataset.of(X))
+        ref = np.concatenate(
+            [
+                np.asarray(NormalizeRows().batch_apply(h).array),
+                np.asarray(LinearRectifier(0.0).batch_apply(h).array),
+            ],
+            axis=-1,
+        )
+        np.testing.assert_allclose(out, ref, atol=1e-6)
+
+    def test_mnist_fft_branches_fuse(self):
+        """The MnistRandomFFT featurizer's per-branch RandomSign -> PaddedFFT
+        -> LinearRectifier chains (the bench's serialization hotspot) each
+        collapse into one node."""
+        from keystone_tpu.pipelines.mnist_random_fft import (
+            MnistRandomFFTConfig,
+            build_featurizer,
+        )
+
+        cfg = MnistRandomFFTConfig(num_ffts=3, block_size=32, image_size=48)
+        pipe = build_featurizer(cfg)
+        X = rng.normal(size=(8, 48)).astype(np.float32)
+        handle = pipe.apply(Dataset.of(X))
+        out = np.asarray(handle.get().array)
+        assert out.shape == (8, 3 * 32)  # 3 branches x (64-pad FFT)/2
+        graph = handle.executor.optimized_graph
+        fused = [
+            n for n in graph.nodes
+            if graph.get_operator(n).label.startswith("Fused[")
+        ]
+        assert len(fused) == 3  # one per branch
+
+    def test_fusable_predicate(self):
+        assert fusable(NormalizeRows())
+        assert fusable(MaxClassifier())
+        assert not fusable(Cacher())
