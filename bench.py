@@ -305,6 +305,7 @@ def amazon_sparse_metric():
 
     est = SparseLBFGSwithL2(lam=1e-3, num_iterations=iters, num_features=d)
     model = est.fit(ds, Yd)  # warm (compile)
+    _sync_scalar(jnp.sum(jnp.abs(model.x)))  # drain warm execution + program load
     t0 = time.perf_counter()
     model = est.fit(ds, Yd)
     _sync_scalar(jnp.sum(jnp.abs(model.x)))
@@ -369,19 +370,48 @@ def krr_metric():
     )
     ds, ys = Dataset.of(X), Dataset.of(Y)
     m = krr.fit(ds, ys)  # warm (compile)
+    # Sync the warm fit: on the tunneled backend the first execution also
+    # pays a one-time program-load (~15 s for this program) that would
+    # otherwise land in the timed fit's queue.
+    _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
     t0 = time.perf_counter()
     m = krr.fit(ds, ys)
     _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
     elapsed = time.perf_counter() - t0
 
-    # FLOP model per epoch: kernel column+diag blocks 2·n·bs·d + bs²·d per
-    # block, residual K_blockᵀW 2·n·bs·k, block solve bs³/3 + 2·bs²·k.
+    # Marginal device time of the same fused sweep program fit() dispatches,
+    # repeated in-program to strip the tunnel's per-dispatch overhead
+    # (identical method to the TIMIT row).
+    from keystone_tpu.ops import pallas_ops
+    from keystone_tpu.ops.learning.kernel import _krr_fit_fused
+
     nb = -(-n // bs)
-    flops = epochs * nb * (
-        2.0 * n * bs * d + 2.0 * bs * bs * d
-        + 2.0 * n * bs * k + bs**3 / 3.0 + 4.0 * bs**2 * k
+    order = jnp.asarray(
+        np.tile(np.arange(nb, dtype=np.int32), epochs)
     )
-    achieved = flops / 1e12 / elapsed
+    use_pallas = pallas_ops.pallas_direct_ok(X)
+
+    def make_repeated(reps):
+        @jax.jit
+        def run(X, Y):
+            def body(i, acc):
+                _, w_stack = _krr_fit_fused(
+                    X + 0.0 * acc, Y, order, 5e-4, 1e-3, bs, n, nb,
+                    use_pallas,
+                )
+                return acc + jnp.sum(jnp.abs(w_stack))
+            return jax.lax.fori_loop(0, reps, body, 0.0)
+        return lambda: run(X, Y)
+
+    device_s, _, dispatch_s = marginal_device_time(make_repeated)
+
+    # FLOP model per block: kernel column block 2·n·bs·d (the diag block is
+    # a slice of it, not a second GEMM), residual K_blockᵀW 2·n·bs·k +
+    # K_bbᵀw_old 2·bs²·k, Cholesky bs³/3, triangular+check solves ~6·bs²·k.
+    flops = epochs * nb * (
+        2.0 * n * bs * d + 2.0 * n * bs * k + bs**3 / 3.0 + 8.0 * bs**2 * k
+    )
+    achieved = flops / 1e12 / device_s
     return {
         "metric": "krr_cifar_kernel_geometry",
         "value": round(elapsed, 3),
@@ -389,10 +419,12 @@ def krr_metric():
         "vs_baseline": None,
         "detail": {
             "n": n, "d": d, "k": k, "block_size": bs, "epochs": epochs,
+            "device_time_s": round(device_s, 3),
+            "dispatch_overhead_s": round(dispatch_s, 3),
             "flop_model_tflops": round(flops / 1e12, 2),
             "achieved_tflops": round(achieved, 1),
             "mfu": round(achieved / PEAK_TFLOPS_F32, 3),
-            "precision": "f32 (HIGHEST) kernel blocks + solves",
+            "precision": "f32 kernel blocks + Cholesky solves",
             "peak_tflops": PEAK_TFLOPS_F32,
             "single_dispatch": True,
             "baseline_note": (

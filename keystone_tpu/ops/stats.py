@@ -222,8 +222,8 @@ class LinearRectifier(Transformer):
         return jnp.maximum(X - self.alpha, self.max_val)
 
     def batch_apply(self, data: Dataset) -> Dataset:
-        out = data.map_batch(self._batch_fn)
-        return out._rezero_padding() if (self.max_val != 0.0 or self.alpha != 0.0) else out
+        # map_batch already restores the zero-padding invariant.
+        return data.map_batch(self._batch_fn)
 
     def device_fn(self):
         return self._batch_fn

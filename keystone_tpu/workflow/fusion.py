@@ -63,6 +63,9 @@ class FusedBatchTransformer(Transformer):
             if not isinstance(m, Transformer) or m.device_fn() is None:
                 raise ValueError(f"member {m!r} is not device-fusable")
         self.members = list(members)
+        self._build_composed()
+
+    def _build_composed(self) -> None:
         fns = [m.device_fn() for m in self.members]
 
         def composed(X):
@@ -71,6 +74,19 @@ class FusedBatchTransformer(Transformer):
             return X
 
         self._composed = jax.jit(composed)
+
+    # The jitted closure is not picklable; FittedPipeline.save() pickles the
+    # whole transformer graph (the serializable-pipeline contract,
+    # Pipeline.scala:38-65 / FittedPipeline.scala:12-22), so persist only the
+    # members and rebuild the composition on load.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_composed", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._build_composed()
 
     @property
     def label(self) -> str:

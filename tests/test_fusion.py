@@ -54,6 +54,23 @@ class TestFusedBatchTransformer:
         out = np.asarray(fused.batch_apply(Dataset.of(X)).array)
         np.testing.assert_allclose(out, _unfused_result(X), atol=1e-5)
 
+    def test_fitted_pipeline_with_fused_chain_pickles(self, tmp_path):
+        # FittedPipeline.save() pickles the optimized transformer graph; the
+        # fused node must survive the round trip and rebuild its jitted
+        # composition on load (regression: the jitted local closure used to
+        # make every fused fitted pipeline unpicklable).
+        X = rng.normal(size=(12, 64)).astype(np.float32)
+        fitted = _chain_pipeline().fit()
+        before = np.asarray(fitted.apply(Dataset.of(X)).array)
+        path = str(tmp_path / "fused.pkl")
+        fitted.save(path)
+
+        from keystone_tpu.workflow.pipeline import FittedPipeline
+
+        loaded = FittedPipeline.load(path)
+        after = np.asarray(loaded.apply(Dataset.of(X)).array)
+        np.testing.assert_allclose(after, before, atol=1e-6)
+
     def test_single_datum_apply(self):
         x = rng.normal(size=(64,)).astype(np.float32)
         members = [RandomSignNode.create(64, seed=3), PaddedFFT(), LinearRectifier(0.0)]
