@@ -154,9 +154,6 @@ class Convolver(Transformer):
     def _batch_fn(self, X):
         return self._convolve(jnp.asarray(X, jnp.float32))
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -222,9 +219,6 @@ class Pooler(Transformer):
     def _batch_fn(self, X):
         return self._pool(jnp.asarray(X, jnp.float32))
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -276,9 +270,6 @@ class SymmetricRectifier(Transformer):
 
     def apply(self, img):
         return self._rectify(jnp.asarray(img))
-
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._rectify)
 
     def device_fn(self):
         return self._rectify

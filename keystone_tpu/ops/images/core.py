@@ -47,11 +47,6 @@ class GrayScaler(Transformer):
     def apply(self, img):
         return image_utils.to_grayscale(img)
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        if data.is_host:
-            return data.map(image_utils.to_grayscale)
-        return data.map_batch(image_utils.to_grayscale)
-
     def device_fn(self):
         return image_utils.to_grayscale
 
@@ -64,11 +59,6 @@ class PixelScaler(Transformer):
 
     def _batch_fn(self, X):
         return jnp.asarray(X, jnp.float32) / 255.0
-
-    def batch_apply(self, data: Dataset) -> Dataset:
-        if data.is_host:
-            return data.map(self.apply)
-        return data.map_batch(self._batch_fn)
 
     def device_fn(self):
         return self._batch_fn
@@ -101,11 +91,6 @@ class ImageVectorizer(Transformer):
 
     def _batch_fn(self, X):
         return X.reshape(X.shape[0], -1)
-
-    def batch_apply(self, data: Dataset) -> Dataset:
-        if data.is_host:
-            return data.map(self.apply)
-        return data.map_batch(self._batch_fn)
 
     def device_fn(self):
         return self._batch_fn

@@ -22,6 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
+from keystone_tpu.parallel.linalg import _solve_psd
 from keystone_tpu.utils import profiling
 from keystone_tpu.workflow import Estimator, LabelEstimator, Transformer
 
@@ -135,8 +136,6 @@ def _krr_block_step_math(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, st
     bs=4096 and the same robustness story as the BCD solvers. Ghost
     rows/columns of a ragged final block get an identity diagonal so they
     solve to exactly zero (their rhs is masked to zero)."""
-    from keystone_tpu.parallel.linalg import _solve_psd
-
     K_block = K_block * valid_row[:, None] * valid_col[None, :]
     residual = K_block.T @ W
     K_bb = K_bb * valid_col[:, None] * valid_col[None, :]
@@ -252,8 +251,6 @@ def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
             rhs = y_bb - (residual - K_bb.T @ w_old)
             # Replicated SPD solve — same Cholesky-with-rescue path as the
             # single-device form, so mesh and 1-device fits stay in parity.
-            from keystone_tpu.parallel.linalg import _solve_psd
-
             gram = jnp.where(
                 (valid_col[:, None] * valid_col[None, :]) > 0,
                 K_bb,

@@ -169,9 +169,6 @@ class PaddedFFT(Transformer):
         padded = jnp.pad(X, [(0, 0), (0, p - X.shape[-1])])
         return jnp.real(jnp.fft.fft(padded, axis=-1))[:, : p // 2]
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -196,9 +193,6 @@ class RandomSignNode(Transformer):
     def _batch_fn(self, X):
         return X * self.signs
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -221,10 +215,6 @@ class LinearRectifier(Transformer):
     def _batch_fn(self, X):
         return jnp.maximum(X - self.alpha, self.max_val)
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        # map_batch already restores the zero-padding invariant.
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -240,9 +230,6 @@ class SignedHellingerMapper(Transformer):
     def _batch_fn(self, X):
         return jnp.sign(X) * jnp.sqrt(jnp.abs(X))
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self._batch_fn)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -257,9 +244,6 @@ class NormalizeRows(Transformer):
         x = jnp.asarray(x)
         norm = jnp.maximum(jnp.linalg.norm(x, axis=-1, keepdims=True), self.eps)
         return x / norm
-
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return data.map_batch(self.apply)
 
     def device_fn(self):
         return self.apply

@@ -105,9 +105,6 @@ class MaxClassifier(Transformer):
     def _batch_fn(self, X):
         return jnp.argmax(X, axis=-1)
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -159,9 +156,6 @@ class MatrixVectorizer(Transformer):
     def _batch_fn(self, X):
         return jnp.transpose(X, (0, 2, 1)).reshape(X.shape[0], -1)
 
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
-
     def device_fn(self):
         return self._batch_fn
 
@@ -185,9 +179,6 @@ class FloatToDouble(Transformer):
 
     def _batch_fn(self, X):
         return jnp.asarray(X, dtype=self._dtype())
-
-    def batch_apply(self, data: Dataset) -> Dataset:
-        return Dataset(self._batch_fn(data.array), n=data.n, mesh=data.mesh)
 
     def device_fn(self):
         return self._batch_fn

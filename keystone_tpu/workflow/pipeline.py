@@ -303,15 +303,20 @@ class Transformer(TransformerOperator, Chainable[A, B]):
     """A function on single items, batchable over datasets.
 
     Subclasses implement ``apply`` (single item). ``batch_apply`` defaults to
-    mapping ``apply`` over the dataset (vmap for device arrays, Python map for
-    host collections) and should be overridden with directly vectorized code
-    where that is faster (Transformer.scala:18-70).
+    the node's ``device_fn`` via ``map_batch`` when one is declared (so a
+    device-pure node implements ONE batched function, not three methods kept
+    in sync), else to mapping ``apply`` over the dataset (vmap for device
+    arrays, Python map for host collections); override it only for batch
+    semantics neither default expresses (Transformer.scala:18-70).
     """
 
     def apply(self, x: A) -> B:
         raise NotImplementedError
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        fn = self.device_fn()
+        if fn is not None and not data.is_host:
+            return data.map_batch(fn)
         return data.map(self.apply)
 
     def device_fn(self) -> Optional[Callable]:
