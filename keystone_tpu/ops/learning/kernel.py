@@ -156,13 +156,17 @@ def _krr_block_step_math(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, st
     static_argnames=("gamma", "lam", "bs", "n_train", "num_blocks", "use_pallas"),
 )
 def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
-                   n_train: int, num_blocks: int, use_pallas: bool):
+                   n_train: int, num_blocks: int, use_pallas: bool,
+                   carry0=None):
     """The whole KRR training sweep as ONE program: lax.scan over the
     (epochs × blocks) order, kernel column blocks generated in-loop (fused
     Pallas on TPU) with the diag block sliced out of them, dual model
     updated in place. No host round trips — the single-dispatch replacement
     for the reference's per-block driver loop
-    (KernelRidgeRegression.scala:136-231)."""
+    (KernelRidgeRegression.scala:136-231).
+
+    ``carry0``: optional ``(W0, stack0)`` initial carry — the resume hook
+    for checkpointed fits, which run this program over order *segments*."""
     n_pad, k = Y.shape
     x_norms = jnp.sum(X * X, axis=1)
     valid_row = (jnp.arange(n_pad) < n_train).astype(Y.dtype)
@@ -186,38 +190,33 @@ def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
         w_stack = jax.lax.dynamic_update_index_in_dim(w_stack, w_new, block, 0)
         return (W, w_stack), None
 
-    W0 = jnp.zeros((n_pad, k), dtype=Y.dtype)
-    stack0 = jnp.zeros((num_blocks, bs, k), dtype=Y.dtype)
-    (W, w_stack), _ = jax.lax.scan(step, (W0, stack0), order)
+    if carry0 is None:
+        carry0 = (
+            jnp.zeros((n_pad, k), dtype=Y.dtype),
+            jnp.zeros((num_blocks, bs, k), dtype=Y.dtype),
+        )
+    (W, w_stack), _ = jax.lax.scan(step, carry0, order)
     return W, w_stack
 
 
-def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
-                        n_train: int, num_blocks: int, mesh):
-    """The whole KRR training sweep as ONE shard_map program over the mesh's
-    ``data`` axis — the multi-device form of :func:`_krr_fit_fused`, so
-    sharded fits keep the single-dispatch speed story instead of a host
-    loop with per-block syncs (KernelRidgeRegression.scala:136-231 driver
-    loop → one compiled scan).
-
-    Layout: train rows X, labels Y and the dual model W stay row-sharded;
-    each device all_gathers X once (the KRR regime is n·d ≪ n², so a
-    replicated X is cheap next to the never-materialized kernel — for
-    sequences too long to replicate, the ring tier in ``parallel.ring`` is
-    the right tool). Per block step: every device computes its local slice
-    of the kernel column block, the (bs, k) residual is one ``psum`` over
-    ICI, the (bs, bs) solve is replicated, and each device scatters the new
-    block weights into whatever slice of the block its local rows cover
-    (blocks need not align with shard boundaries).
-    """
+@functools.lru_cache(maxsize=8)
+def _krr_mesh_program(mesh, gamma: float, lam: float, bs: int,
+                      n_train: int, num_blocks: int):
+    """Build (and cache) the shard_map sweep program for one (mesh, fit
+    geometry). The cache makes checkpointed fits — which dispatch this
+    program once per order *segment* — reuse one traced callable, so
+    shard_map's jit cache hits instead of retracing and recompiling the
+    whole scan every segment. Bounded like the BCD mesh cache
+    (``parallel.linalg._mesh_bcd_step``)."""
     from keystone_tpu.parallel import mesh as mesh_lib
 
     axis = mesh_lib.DATA_AXIS
-    n_pad, k = Y.shape
-    lam_t = jnp.asarray(lam, dtype=Y.dtype)
+    psize = dict(mesh.shape)[axis]
 
-    def body(x_local, y_local):
+    def body(x_local, y_local, order, stack_init):
         ln = x_local.shape[0]
+        n_pad = ln * psize
+        lam_t = jnp.asarray(lam, dtype=y_local.dtype)
         me = jax.lax.axis_index(axis)
         g_idx = me * ln + jnp.arange(ln)
         valid_local = (g_idx < n_train).astype(y_local.dtype)
@@ -266,9 +265,17 @@ def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
             )
             return (W_local, w_stack), None
 
-        W0 = jnp.zeros((ln, k), dtype=y_local.dtype)
-        stack0 = jnp.zeros((num_blocks, bs, k), dtype=y_local.dtype)
-        (_, w_stack), _ = jax.lax.scan(step, (W0, stack0), order)
+        # Resume hook: the dual model's rows for block b are exactly the
+        # block's latest stack entry, so W_local re-derives from the
+        # replicated stack (zeros on a fresh fit) — each device slices the
+        # rows it owns out of the flattened stack. Rows past num_blocks·bs
+        # (mesh-divisibility padding) belong to no block: zero-pad so the
+        # slice stays in range.
+        flat = stack_init.reshape(num_blocks * bs, stack_init.shape[2])
+        if n_pad > num_blocks * bs:
+            flat = jnp.pad(flat, ((0, n_pad - num_blocks * bs), (0, 0)))
+        W0 = jax.lax.dynamic_slice_in_dim(flat, me * ln, ln, axis=0)
+        (_, w_stack), _ = jax.lax.scan(step, (W0, stack_init), order)
         # w_stack is built from psum-backed replicated solves, so it is
         # identical on every device — replicated out_spec (check_vma=False:
         # the static checker cannot see through the masked arithmetic).
@@ -279,10 +286,36 @@ def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(P(axis, None), P(axis, None)),
+        in_specs=(P(axis, None), P(axis, None), P(), P()),
         out_specs=P(),
         check_vma=False,
-    )(X, Y)
+    )
+
+
+def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
+                        n_train: int, num_blocks: int, mesh, stack0=None):
+    """The whole KRR training sweep as ONE shard_map program over the mesh's
+    ``data`` axis — the multi-device form of :func:`_krr_fit_fused`, so
+    sharded fits keep the single-dispatch speed story instead of a host
+    loop with per-block syncs (KernelRidgeRegression.scala:136-231 driver
+    loop → one compiled scan).
+
+    Layout: train rows X, labels Y and the dual model W stay row-sharded;
+    each device all_gathers X once (the KRR regime is n·d ≪ n², so a
+    replicated X is cheap next to the never-materialized kernel — for
+    sequences too long to replicate, the ring tier in ``parallel.ring`` is
+    the right tool). Per block step: every device computes its local slice
+    of the kernel column block, the (bs, k) residual is one ``psum`` over
+    ICI, the (bs, bs) solve is replicated, and each device scatters the new
+    block weights into whatever slice of the block its local rows cover
+    (blocks need not align with shard boundaries).
+    """
+    if stack0 is None:
+        stack0 = jnp.zeros((num_blocks, bs, Y.shape[1]), dtype=Y.dtype)
+    program = _krr_mesh_program(
+        mesh, float(gamma), float(lam), bs, int(n_train), num_blocks
+    )
+    return program(X, Y, order, stack0)
 
 
 @functools.partial(jax.jit, static_argnames=("lam",), donate_argnums=(1,))
@@ -372,6 +405,8 @@ class KernelRidgeRegression(LabelEstimator):
         num_epochs: int,
         block_permuter: Optional[int] = None,
         profile: bool = False,
+        checkpoint_path: Optional[str] = None,
+        checkpoint_every_blocks: int = 25,
     ):
         self.kernel_generator = kernel_generator
         self.lam = lam
@@ -383,6 +418,22 @@ class KernelRidgeRegression(LabelEstimator):
         # Profiling forces the stepwise per-block path with a sync per block;
         # logging configuration alone never changes which solver path runs.
         self.profile = profile
+        # Mid-solver checkpoint/resume — the preemption story the reference
+        # could not have (Spark lineage recomputes; there is no TPU analog).
+        # The fused sweep runs in segments of ``checkpoint_every_blocks``
+        # block updates (each still one dispatch — the default mirrors the
+        # reference's blocksBeforeCheckpoint=25 lineage truncation cadence,
+        # KernelRidgeRegression.scala:199-203); after each segment the
+        # (position, block-weight stack) pair is written atomically to
+        # ``checkpoint_path``. A later fit with the same geometry resumes
+        # from the last completed segment and deletes the file on success.
+        if profile and checkpoint_path is not None:
+            raise ValueError(
+                "profile=True forces the stepwise path; checkpointing "
+                "segments the fused path — pick one"
+            )
+        self.checkpoint_path = checkpoint_path
+        self.checkpoint_every_blocks = int(checkpoint_every_blocks)
 
     def fit(self, data: Dataset, labels: Dataset) -> KernelBlockLinearMapper:
         n_train = data.n
@@ -436,19 +487,46 @@ class KernelRidgeRegression(LabelEstimator):
                     extra = p - X.shape[0] % p
                     X = jnp.pad(X, ((0, extra), (0, 0)))
                     Y = jnp.pad(Y, ((0, extra), (0, 0)))
-                w_stack = _krr_fit_fused_mesh(
-                    X, Y, order_arr,
-                    float(self.kernel_generator.gamma), float(self.lam),
-                    bs, int(n_train), num_blocks, data.mesh,
-                )
-            else:
+
+            gamma_f, lam_f = float(self.kernel_generator.gamma), float(self.lam)
+
+            def run_segment(seg, stack0):
+                """One dispatch over a slice of the block order."""
+                if multi_device:
+                    return _krr_fit_fused_mesh(
+                        X, Y, seg, gamma_f, lam_f, bs, int(n_train),
+                        num_blocks, data.mesh, stack0=stack0,
+                    )
                 from keystone_tpu.ops import pallas_ops
 
+                carry0 = None
+                if stack0 is not None:
+                    flat = stack0.reshape(num_blocks * bs, k)
+                    if Y.shape[0] > num_blocks * bs:
+                        flat = jnp.pad(
+                            flat, ((0, Y.shape[0] - num_blocks * bs), (0, 0))
+                        )
+                    carry0 = (flat, stack0)
                 _, w_stack = _krr_fit_fused(
-                    X, Y, order_arr,
-                    float(self.kernel_generator.gamma), float(self.lam),
-                    bs, int(n_train), num_blocks,
-                    pallas_ops.pallas_direct_ok(X),
+                    X, Y, seg, gamma_f, lam_f, bs, int(n_train), num_blocks,
+                    pallas_ops.pallas_direct_ok(X), carry0=carry0,
+                )
+                return w_stack
+
+            if self.checkpoint_path is None or order_arr.shape[0] == 0:
+                # (an empty order — num_epochs=0 — has nothing to resume)
+                w_stack = run_segment(order_arr, None)
+            else:
+                if jax.process_count() > 1:
+                    # The fingerprint samples rows of a globally-sharded X
+                    # (non-addressable from one process) and every process
+                    # would race the same file; single-controller only.
+                    raise NotImplementedError(
+                        "checkpoint_path is not supported on multi-host "
+                        "meshes; checkpoint from a single-controller fit"
+                    )
+                w_stack = self._fit_checkpointed(
+                    run_segment, X, Y, order_arr, num_blocks, bs, k, n_train
                 )
             w_locals = [w_stack[i] for i in range(num_blocks)]
             return KernelBlockLinearMapper(w_locals, bs, transformer, n_train)
@@ -504,6 +582,78 @@ class KernelRidgeRegression(LabelEstimator):
         if timing_on:
             timer.log_summary()
         return KernelBlockLinearMapper(w_locals, bs, transformer, n_train)
+
+    # -- mid-solver checkpoint/resume ------------------------------------
+
+    def _fingerprint(self, X, Y, order_arr, num_blocks, bs, k,
+                     n_train) -> str:
+        """Geometry + hyperparameter + block-order + data digest: a
+        checkpoint may only resume the fit that wrote it. Data is pinned by
+        a bitwise sample of up to 64 evenly-spaced (X, Y) rows — inputs are
+        stored values, so the sample is topology-independent — which catches
+        'same shapes, different data' (e.g. a reseeded upstream featurizer)
+        without hashing a dataset that may be most of HBM."""
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(np.asarray(order_arr, dtype=np.int32).tobytes())
+        spec = (
+            f"n={int(n_train)} d={X.shape[1]} bs={bs} k={k} nb={num_blocks} "
+            f"gamma={float(self.kernel_generator.gamma)!r} "
+            f"lam={float(self.lam)!r} epochs={self.num_epochs} "
+            f"permuter={self.block_permuter!r} "
+            f"dtypes={X.dtype}/{Y.dtype}"
+        )
+        h.update(spec.encode())
+        idx = np.unique(
+            np.linspace(0, max(int(n_train) - 1, 0), 64).astype(np.int64)
+        )
+        h.update(np.asarray(X[idx]).tobytes())
+        h.update(np.asarray(Y[idx]).tobytes())
+        return h.hexdigest()
+
+    def _fit_checkpointed(self, run_segment, X, Y, order_arr, num_blocks,
+                          bs, k, n_train):
+        """Run the fused sweep in segments, persisting (position, stack)
+        after each; resume from ``checkpoint_path`` when a compatible
+        checkpoint exists. The write is atomic (tmp + rename), so a
+        preemption mid-save leaves the previous checkpoint intact."""
+        import os
+
+        path = self.checkpoint_path
+        fp = self._fingerprint(X, Y, order_arr, num_blocks, bs, k, n_train)
+        total = int(order_arr.shape[0])
+        pos, stack = 0, None
+
+        if os.path.exists(path):
+            ck = np.load(path, allow_pickle=False)
+            if str(ck["fingerprint"]) != fp:
+                raise ValueError(
+                    f"checkpoint at {path} was written by a different KRR "
+                    "fit (geometry/hyperparameters/block order differ); "
+                    "delete it or point checkpoint_path elsewhere"
+                )
+            pos = int(ck["pos"])
+            stack = jnp.asarray(ck["stack"])
+            logger.info("KRR resume from %s: block update %d/%d", path, pos, total)
+
+        every = max(self.checkpoint_every_blocks, 1)
+        while pos < total:
+            seg = order_arr[pos : pos + every]
+            stack = run_segment(seg, stack)
+            pos += int(seg.shape[0])
+            if pos < total:
+                host_stack = np.asarray(stack)  # syncs the segment
+                tmp = f"{path}.tmp.npz"  # .npz: stops savez renaming it
+                np.savez(tmp, pos=pos, stack=host_stack, fingerprint=fp)
+                os.replace(tmp, path)
+        # Sync the (async-dispatched) final segment BEFORE deleting the
+        # checkpoint: a preemption while the device is still inside that
+        # segment must find the last save intact, not gone.
+        jax.block_until_ready(stack)
+        if os.path.exists(path):
+            os.remove(path)  # completed: the model supersedes the checkpoint
+        return stack
 
     @property
     def weight(self) -> int:

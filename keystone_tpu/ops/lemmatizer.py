@@ -104,6 +104,9 @@ _IRREGULAR: Dict[str, str] = {
     # --- -us nouns whose plural drops -es (vs "uses" -> "use")
     "buses": "bus", "viruses": "virus", "bonuses": "bonus",
     "campuses": "campus", "statuses": "status", "censuses": "census",
+    # --- -as/-os singulars' plurals drop -es the same way
+    "gases": "gas", "biases": "bias", "aliases": "alias",
+    "atlases": "atlas", "canvases": "canvas",
 }
 
 # Surface forms that look inflected but are not (Morpha ships the same kind
@@ -115,6 +118,10 @@ _UNINFLECTED = frozenset({
     "something", "anything", "everything", "nothing",
     "hundred", "kindred", "sacred", "naked", "wicked", "rugged",
     "wretched", "beloved",
+    # singular nouns in -as/-os/-ics the plural strip must not touch (found
+    # by the idempotence property: bias -> "bia")
+    "bias", "alias", "atlas", "canvas", "gas", "pancreas",
+    "chaos", "cosmos", "ethos", "pathos", "mathematics", "physics",
 })
 
 # Words ending in "-ss"/"-us"/"-is" etc. that the -s rules must not touch.

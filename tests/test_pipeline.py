@@ -224,3 +224,44 @@ class TestLambdaAndCSE:
         assert [float(v) for v in out] == [6.0, 6.0]
         # CSE merged the two equal nodes: only one execution.
         assert len(calls) == 1
+
+
+class TestBatchApplyDefault:
+    """Transformer.batch_apply derives from device_fn: batched on device
+    datasets AND on rectangular host collections (one dispatch, not one per
+    item); ragged host items fall back to per-item apply."""
+
+    def test_rectangular_host_list_takes_batched_path(self):
+        from keystone_tpu.ops.util import FloatToDouble
+
+        items = [np.full(3, i, dtype=np.float32) for i in range(4)]
+        # Direct construction keeps the list (host form) — Dataset.of would
+        # eagerly stack a rectangular list, bypassing the branch under test.
+        ds = Dataset(list(items))
+        assert ds.is_host
+        calls = []
+        t = FloatToDouble()
+        orig = t._batch_fn
+        object.__setattr__(t, "_batch_fn", lambda X: calls.append(X.shape) or orig(X))
+        out = t.batch_apply(ds)
+        assert calls == [(4, 3)]  # one batched call over the stacked array
+        assert not out.is_host
+        assert out.n == 4
+        np.testing.assert_allclose(np.asarray(out.array), np.stack(items))
+
+    def test_ragged_host_items_fall_back_per_item(self):
+        from keystone_tpu.ops.images.core import GrayScaler
+
+        rng = np.random.default_rng(0)
+        imgs = [rng.random((5 + i, 4, 3)).astype(np.float32) for i in range(3)]
+        out = GrayScaler().batch_apply(Dataset.of(imgs))
+        shapes = [np.asarray(a).shape for a in out.to_list()]
+        assert shapes == [(5, 4, 1), (6, 4, 1), (7, 4, 1)]
+
+    def test_no_device_fn_maps_apply(self):
+        class PlusOne(Transformer):
+            def apply(self, x):
+                return x + 1
+
+        out = PlusOne().batch_apply(Dataset.of([1.0, 2.0]))
+        assert [float(v) for v in out.to_list()] == [2.0, 3.0]
