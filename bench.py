@@ -286,7 +286,7 @@ def amazon_sparse_metric():
     nnz/row, k=2) through the never-densify sparse LBFGS at n=500k (the
     full n=65e6 fits one chip's HBM — round-2 scale check — but would make
     the bench run minutes). Honest numbers: sparse gather/segment-sum is
-    capacity-bound on TPU (~65M random indices/s), so one chip LOSES the
+    capacity-bound on TPU (~130-180M random indices/s), so one chip LOSES the
     n-scaled wall-clock against 16 CPU nodes on this workload while
     winning on capacity (no 131 GB densified design matrix, no cluster)."""
     from keystone_tpu.data import Dataset
@@ -330,7 +330,7 @@ def amazon_sparse_metric():
             "mfu": round(flops / 1e12 / elapsed / PEAK_TFLOPS_F32, 5),
             "gather_rate_per_s": round(gathers_per_s / 1e6, 1),
             "gather_rate_note": (
-                "M random indices/s vs ~65M/s v5e gather capability — this "
+                "M random indices/s achieved (the v5e's gather rate) — this "
                 "workload is random-access-bound, not MXU-bound; MFU is "
                 "structurally tiny and reported for completeness"
             ),

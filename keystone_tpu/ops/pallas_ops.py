@@ -484,12 +484,14 @@ def gram_corr_sym(
     n, d = A.shape
     kdim = R.shape[1]
 
-    # 1024-wide column tiles for bf16 layouts (VMEM budget: 4 MB resident
-    # gram tile + 1 MB corr + double-buffered input tiles, inside the 16 MB
-    # scoped-VMEM limit now that accumulation lives in the output tiles).
-    # f32 inputs double the tile bytes, so they stay at 512. Smaller models
-    # fall back to one 128-multiple tile.
-    ti = _strided_ti(compute_dtype, d)
+    # 512-wide column tiles: with R riding along (corr output + its tile
+    # double-buffered next to the gram tile), 1024-wide bf16 tiles measure
+    # ~16.01 MB scoped VMEM — 12 KB OVER the 16 MB limit at bs=4096
+    # blocks (found by parity.py's TIMIT row through the stacked BCD
+    # path). The 1024-wide bf16 layout lives in the R-free split kernels
+    # (:func:`block_gram_sym` / :func:`block_corr`), which the flat BCD
+    # path uses. Smaller models fall back to one 128-multiple tile.
+    ti = min(512, ((d + 127) // 128) * 128)
     tk = min(_TILE_K, n)
     Ap = _pad_to(_pad_to(A, tk, 0), ti, 1)
     Rp = _pad_to(R, tk, 0)

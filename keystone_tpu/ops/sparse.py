@@ -16,10 +16,12 @@ padded-COO operands are ~100× smaller than the dense design matrix the old
 densify path would have materialized. ``densify_dataset`` remains for small
 inputs where one dense GEMM beats gather+scatter dispatch.
 
-Measured characteristics (v5e, n=2e6 × nnz=82): both kernels run at the
-chip's random-access rate (~65M indices/s — ~2.5 s per data pass), which is
-the honest TPU trade-off for this workload class: the sparse tier is a
-*capacity* play (the dense matrix would be 131 GB), not a FLOP play. A
+Measured characteristics (v5e): both kernels run at the chip's
+random-access rate — 129M indices/s on the raw column-take microbenchmark,
+179M/s inside the full LBFGS solve (bench.py's amazon row, round 3; earlier
+rounds' 65M/s figure predates the per-column layouts) — which is the honest
+TPU trade-off for this workload class: the sparse tier is a *capacity* play
+(the dense matrix would be 131 GB), not a FLOP play. A
 transposed-layout gather variant and a complex-packed gather were measured
 and do not beat the scatter, so the simple formulations stay. Layout rule
 learned the hard way: never put a tiny label dimension minor-most in a big

@@ -1,0 +1,90 @@
+"""Full ImageNetSiftLcsFV end-to-end on real committed JPEGs.
+
+The companion of tests/test_voc_end_to_end_real.py for the reference's
+largest pipeline (ImageNetSiftLcsFV.scala:33-135): real JPEG decode → two
+featurization branches (dense SIFT + LCS), each PCA → GMM Fisher vector →
+normalize → gather/combine → block *weighted* least squares → top-k.
+
+Offline-feasible real data: a two-synset ImageNet-layout dataset assembled
+from the reference's committed archives — the real `n15075141.tar` synset
+(5 JPEGs) plus a second synset re-tarred from `voctest.tar`'s real VOC
+JPEGs (raw bytes unchanged, entries renamed into synset-directory layout,
+as ImageNetLoader only cares about the `classdir/file` convention,
+ImageNetLoader.scala:12-39). Two visually distinct photo sources → a real
+two-class separation problem through the full image stack.
+"""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+from _reference import RESOURCES, needs_reference_fixtures
+
+IMAGES = os.path.join(RESOURCES, "images")
+
+
+def _build_two_synset_dir(tmp_path):
+    data_dir = tmp_path / "imagenet2"
+    data_dir.mkdir()
+    # Synset 1: the committed archive, verbatim.
+    src_tar = os.path.join(IMAGES, "imagenet/n15075141.tar")
+    (data_dir / "n15075141.tar").write_bytes(open(src_tar, "rb").read())
+
+    # Synset 2: real VOC JPEGs re-tarred under a synset directory.
+    voc_tar = os.path.join(IMAGES, "voc/voctest.tar")
+    out_tar = data_dir / "nvoc000000.tar"
+    with tarfile.open(voc_tar) as src, tarfile.open(out_tar, "w") as dst:
+        for member in src:
+            if not member.name.lower().endswith((".jpg", ".jpeg")):
+                continue
+            blob = src.extractfile(member).read()
+            info = tarfile.TarInfo(
+                "nvoc000000/" + os.path.basename(member.name)
+            )
+            info.size = len(blob)
+            import io
+
+            dst.addfile(info, io.BytesIO(blob))
+
+    labels = tmp_path / "labels"
+    labels.write_text("n15075141 0\nnvoc000000 1\n")
+    return str(data_dir), str(labels)
+
+
+@needs_reference_fixtures
+def test_imagenet_sift_lcs_fv_on_real_jpegs(tmp_path):
+    for need in ("imagenet/n15075141.tar", "voc/voctest.tar"):
+        if not os.path.exists(os.path.join(IMAGES, need)):
+            pytest.skip(f"{need} not available")
+
+    from keystone_tpu.pipelines.imagenet_sift_lcs_fv import (
+        ImageNetConfig,
+        run,
+    )
+
+    data_dir, labels_path = _build_two_synset_dir(tmp_path)
+    cfg = ImageNetConfig(
+        train_location=data_dir,
+        train_labels=labels_path,
+        test_location=data_dir,
+        test_labels=labels_path,
+        num_classes=2,
+        # Mini capacity: enough to separate 15 real photos in two classes,
+        # small enough for CI (full config: pca 64, vocab 16).
+        sift_pca_dim=32,
+        lcs_pca_dim=32,
+        vocab_size=4,
+        block_size=1024,
+        lam=1e-3,
+    )
+    _, top1_eval, top5_err = run(cfg)
+
+    # 15 real images (5 ImageNet + 10 VOC), train == test: the full stack
+    # must rank its own training images correctly. With 2 classes top-5 is
+    # degenerate (always 0); top-1 is the meaningful check.
+    assert top5_err == 0.0
+    assert top1_eval.total_error <= 0.2, top1_eval.total_error
+    cm = np.asarray(top1_eval.confusion)
+    assert cm.sum() == 15  # every committed JPEG decoded and classified

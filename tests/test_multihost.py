@@ -165,6 +165,73 @@ _LM_WORKER = textwrap.dedent(
 )
 
 
+_KRR_WORKER = textwrap.dedent(
+    """
+    import sys
+    import numpy as np
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")  # axon overrides JAX_PLATFORMS
+    jax.config.update("jax_num_cpu_devices", 2)
+
+    coord, pid = sys.argv[1], int(sys.argv[2])
+
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    mesh_lib.init_distributed(
+        coordinator_address=coord, num_processes=2, process_id=pid
+    )
+    assert jax.process_count() == 2
+
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.kernel import (
+        GaussianKernelGenerator,
+        KernelRidgeRegression,
+        _krr_fit_fused,
+    )
+
+    # data axis spans 2 hosts x 2 devices: the fused shard_map sweep's
+    # all_gather(X) and psum(residual) must cross the process (DCN)
+    # boundary, not just ICI.
+    mesh = mesh_lib.make_hybrid_mesh(
+        ici_shape=(2,), dcn_shape=(2,), axis_names=(mesh_lib.DATA_AXIS,)
+    )
+    assert dict(mesh.shape) == {"data": 4}
+
+    n, d, k, bs, epochs = 256, 8, 3, 64, 2
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(n, d)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+
+    sharding = NamedSharding(mesh, P("data", None))
+    def put(x):
+        return jax.make_array_from_process_local_data(
+            sharding, x[pid * (n // 2) : (pid + 1) * (n // 2)]
+        )
+
+    data = Dataset(put(X), n=n, mesh=mesh)
+    labels = Dataset(put(Y), n=n, mesh=mesh)
+    krr = KernelRidgeRegression(GaussianKernelGenerator(0.05), 0.2, bs, epochs)
+    model = krr.fit(data, labels)
+
+    # Reference: the single-device fused sweep on the full local copy.
+    order = jnp.asarray(np.tile(np.arange(n // bs, dtype=np.int32), epochs))
+    _, ref_stack = _krr_fit_fused(
+        jnp.asarray(X), jnp.asarray(Y), order, 0.05, 0.2, bs, n, n // bs,
+        False,
+    )
+    for b in range(n // bs):
+        got = np.asarray(model.w_locals[b].addressable_data(0))
+        want = np.asarray(ref_stack[b])
+        np.testing.assert_allclose(got, want, atol=2e-4)
+    print(f"krr proc {pid} OK: {n // bs} blocks match single-device fit")
+    """
+)
+
+
 def _free_port() -> int:
     s = socket.socket()
     s.bind(("localhost", 0))
@@ -214,3 +281,10 @@ def test_two_process_stupid_backoff_counts(tmp_path):
     process fits only its partition, scores equal the single-host fit, the
     partitions tile the table, and the sharded model serves correctly."""
     _run_two_workers(tmp_path, _LM_WORKER, "lm proc {pid} OK")
+
+
+def test_two_process_fused_krr_fit(tmp_path):
+    """The fused KRR shard_map sweep runs with its data axis spanning two
+    OS processes (all_gather + psum over the DCN boundary) and matches the
+    single-device fused fit block for block."""
+    _run_two_workers(tmp_path, _KRR_WORKER, "krr proc {pid} OK")
