@@ -424,9 +424,10 @@ def _gram_corr_sym_kernel(
 
     Accumulation happens directly in the f32 OUTPUT tiles: their block
     indices are k-invariant, so Mosaic keeps them resident in VMEM across
-    the whole k sweep. Dropping the separate scratch accumulators frees
-    enough scoped VMEM to double the column tile to 1024, which halves the
-    number of block pairs' HBM re-reads of A."""
+    the whole k sweep. With the riding R/corr buffers the column tile must
+    stay at 512 (1024-wide bf16 tiles measure ~16.01 MB scoped VMEM — just
+    over the limit; see the tiling comment in :func:`gram_corr_sym`); the
+    1024-wide layout lives in the R-free split kernels."""
     p = pl.program_id(0)
     k = pl.program_id(1)
     diag = ii_ref[p] == jj_ref[p]

@@ -226,3 +226,84 @@ class TestLemmatizerProperties:
             if once in _IRREGULAR:
                 continue
             assert lemmatize(once) == once, (word, once, lemmatize(once))
+
+
+class TestSolverProperties:
+    """Optimality/structure invariants of the numerical heart over random
+    problem instances (the reference proves solvers on fixed fixtures; these
+    check the defining equations at whatever shapes hypothesis draws)."""
+
+    @given(
+        st.integers(min_value=0, max_value=32),
+        st.integers(min_value=1, max_value=8),
+        st.integers(min_value=1, max_value=4),
+        st.sampled_from([0.0, 1e-3, 0.5]),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_normal_equations_solution_is_stationary(self, extra, d, k, lam):
+        # KKT: the ridge optimum satisfies (AᵀA + λI) W = AᵀB exactly.
+        # Overdetermined draws only (n > d): underdetermined + lam=0 makes
+        # the Gramian singular, where the solver's DOCUMENTED jitter-rescue
+        # path returns the jittered system's optimum instead (a design
+        # choice, tested in test_linalg.py, not a KKT violation).
+        from keystone_tpu.parallel import linalg
+
+        n = d + 2 + extra
+        rng = np.random.default_rng(n * 100 + d * 10 + k)
+        A = rng.normal(size=(n, d)).astype(np.float64)
+        B = rng.normal(size=(n, k)).astype(np.float64)
+        W = np.asarray(linalg.normal_equations_solve(A, B, lam=lam))
+        resid = A.T @ A @ W + lam * W - A.T @ B
+        scale = max(np.abs(A.T @ B).max(), 1.0)
+        assert np.abs(resid).max() / scale < 5e-5, (n, d, k, lam)
+
+    @given(
+        st.integers(min_value=8, max_value=48),
+        st.integers(min_value=2, max_value=6),
+    )
+    @settings(max_examples=15, deadline=None)
+    def test_bcd_multi_epoch_never_increases_loss(self, n, blocks):
+        # Gauss-Seidel descent: each extra epoch cannot raise the ridge
+        # objective (exact block minimization per step).
+        from keystone_tpu.parallel import linalg
+
+        d, k, lam = blocks * 8, 3, 1e-3
+        rng = np.random.default_rng(n * 7 + blocks)
+        F = rng.normal(size=(n, d)).astype(np.float64)
+        Y = rng.normal(size=(n, k)).astype(np.float64)
+
+        def loss(W):
+            Wf = np.asarray(W).reshape(d, k)
+            R = Y - F @ Wf
+            return float(np.sum(R * R) + lam * np.sum(Wf * Wf))
+
+        prev = None
+        for epochs in (1, 2, 4):
+            W = linalg.bcd_least_squares_fused_flat(
+                F, Y, 8, lam=lam, num_iter=epochs
+            )
+            cur = loss(W)
+            if prev is not None:
+                assert cur <= prev * (1 + 1e-8), (epochs, prev, cur)
+            prev = cur
+
+    @given(
+        st.integers(min_value=10, max_value=60),
+        st.integers(min_value=2, max_value=6),
+    )
+    @settings(max_examples=15, deadline=None)
+    def test_pca_basis_is_orthonormal_and_ordered(self, n, p):
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.pca import PCAEstimator
+
+        d = p + 2
+        rng = np.random.default_rng(n * 13 + p)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        model = PCAEstimator(p).fit(Dataset.of(X))
+        V = np.asarray(model.pca_mat)  # (d, p) basis
+        assert V.shape == (d, p)
+        np.testing.assert_allclose(V.T @ V, np.eye(p), atol=1e-4)
+        # projected variances are non-increasing (principal order)
+        Z = (X - X.mean(0)) @ V
+        var = Z.var(axis=0)
+        assert np.all(var[:-1] >= var[1:] - 1e-4), var
