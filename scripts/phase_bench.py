@@ -26,13 +26,16 @@ if which == "big":
     timed(jax.jit(lambda F, Y: jnp.sum(jnp.abs(linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=1, use_pallas=True)))), F, Y, label="solve only 1 epoch (38.2 TF)")
     timed(jax.jit(lambda F, Y: jnp.sum(jnp.abs(linalg.bcd_least_squares_fused_flat(F, Y, bs, lam=1e-4, num_iter=3, use_pallas=True)))), F, Y, label="solve only 3 epochs (43.3 TF)")
     def grams4(F, Y):
+        # Strided window kernels (what the flat BCD path actually runs):
+        # the sliced gram_corr_sym form OOMs HBM here — four remat'd 2 GB
+        # block copies next to the 8 GB feature buffer.
         out = 0.0
         for i in range(4):
-            Ab = jax.lax.dynamic_slice_in_dim(F, i*bs, bs, axis=1)
-            g, c = po.gram_corr_sym(Ab, Y)
+            g = po.block_gram_sym(F, i*bs, bs)
+            c = po.block_corr(F, i*bs, bs, Y)
             out += jnp.sum(jnp.abs(g)) + jnp.sum(jnp.abs(c))
         return out
-    timed(jax.jit(grams4), F, Y, label="4x gram_corr_sym (37.6 TF)")
+    timed(jax.jit(grams4), F, Y, label="4x block_gram_sym+corr (37.6 TF)")
     timed(jax.jit(lambda X: jnp.sum(jnp.abs(po.cosine_features(X, Wrf, brf, compute_dtype=jnp.bfloat16, out_dtype=jnp.bfloat16).astype(jnp.float32)))), X, label="featurize (3.8 TF)")
 else:
     G = jnp.asarray(rng.normal(size=(bs, bs)).astype(np.float32)); G = G @ G.T + bs * jnp.eye(bs)
