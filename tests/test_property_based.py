@@ -307,3 +307,57 @@ class TestSolverProperties:
         Z = (X - X.mean(0)) @ V
         var = Z.var(axis=0)
         assert np.all(var[:-1] >= var[1:] - 1e-4), var
+
+
+class TestEvaluatorProperties:
+    @given(
+        st.integers(min_value=2, max_value=8),
+        st.integers(min_value=5, max_value=60),
+    )
+    @settings(max_examples=30, deadline=None)
+    def test_multiclass_metrics_identities(self, k, n):
+        # Confusion-matrix identities that hold for ANY predictions:
+        # micro-averaged recall == accuracy == 1 - total_error, and the
+        # matrix counts every example exactly once.
+        from keystone_tpu.evaluation.metrics import (
+            MulticlassClassifierEvaluator,
+        )
+
+        rng = np.random.default_rng(k * 1000 + n)
+        y = rng.integers(0, k, size=n)
+        p = rng.integers(0, k, size=n)
+        m = MulticlassClassifierEvaluator(k).evaluate(
+            Dataset.of(p), Dataset.of(y)
+        )
+        cm = np.asarray(m.confusion)
+        assert cm.sum() == n
+        acc = float(np.trace(cm)) / n
+        np.testing.assert_allclose(m.accuracy, acc, atol=1e-12)
+        np.testing.assert_allclose(m.total_error, 1.0 - acc, atol=1e-12)
+        # per-class rows sum to the class's true count
+        for c in range(k):
+            assert cm[c].sum() == int((y == c).sum())
+
+    @given(st.integers(min_value=2, max_value=6), st.integers(5, 40))
+    @settings(max_examples=30, deadline=None)
+    def test_map_perfect_ranking_is_one(self, k, n):
+        # MAP == 1 for every class when scores rank all true positives
+        # above all negatives (and classes with no positives score 0).
+        from keystone_tpu.evaluation.metrics import (
+            MeanAveragePrecisionEvaluator,
+        )
+
+        rng = np.random.default_rng(k * 99 + n)
+        labels = [np.asarray([int(rng.integers(0, k))]) for _ in range(n)]
+        scores = np.full((n, k), -1.0, dtype=np.float64)
+        for i, l in enumerate(labels):
+            scores[i, l[0]] = 1.0 + rng.random()
+        aps = MeanAveragePrecisionEvaluator(k).evaluate(
+            Dataset.of(scores), labels
+        )
+        present = {int(l[0]) for l in labels}
+        for c in range(k):
+            if c in present:
+                np.testing.assert_allclose(aps[c], 1.0, atol=1e-12)
+            else:
+                assert aps[c] == 0.0
