@@ -19,7 +19,7 @@ Transformers consume and produce Datasets; solvers read ``.array`` +
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Any, Callable, List, Optional
 
 import jax
 import jax.numpy as jnp

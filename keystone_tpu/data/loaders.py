@@ -8,13 +8,11 @@ for each workload's data so pipelines and benchmarks run hermetically.
 
 from __future__ import annotations
 
-import io
 import json
 import os
-import struct
 import tarfile
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -9,7 +9,6 @@ the whole extractor jits into one program per image shape.
 from __future__ import annotations
 
 import math
-from functools import partial
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.data import Dataset
-from keystone_tpu.ops.stats import StandardScaler, StandardScalerModel
+from keystone_tpu.ops.stats import StandardScaler
 from keystone_tpu.ops.util import VectorSplitter
 from keystone_tpu.parallel import linalg
 from keystone_tpu.workflow import LabelEstimator, Transformer

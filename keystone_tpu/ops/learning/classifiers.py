@@ -18,7 +18,7 @@ import optax
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.linear import LinearMapper
-from keystone_tpu.ops.sparse import densify_dataset, is_sparse_dataset
+from keystone_tpu.ops.sparse import densify_dataset
 from keystone_tpu.workflow import LabelEstimator, Transformer
 
 logger = logging.getLogger("keystone_tpu.classifiers")

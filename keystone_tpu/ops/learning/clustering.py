@@ -12,7 +12,6 @@ as in the reference.
 from __future__ import annotations
 
 import logging
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

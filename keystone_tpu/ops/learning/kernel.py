@@ -24,7 +24,7 @@ import numpy as np
 from keystone_tpu.data import Dataset
 from keystone_tpu.parallel.linalg import _solve_psd
 from keystone_tpu.utils import profiling
-from keystone_tpu.workflow import Estimator, LabelEstimator, Transformer
+from keystone_tpu.workflow import LabelEstimator, Transformer
 
 logger = logging.getLogger("keystone_tpu.kernel")
 

@@ -9,7 +9,7 @@ from them), replacing the reference's implicit global RNG draws.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

@@ -7,8 +7,8 @@ sparse-feature nodes live in :mod:`keystone_tpu.ops.nlp_sparse`.
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, List, Optional
+from dataclasses import dataclass
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

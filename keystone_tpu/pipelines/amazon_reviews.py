@@ -14,7 +14,6 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from keystone_tpu.data import Dataset, LabeledData
 from keystone_tpu.data.loaders import load_amazon_reviews, synthetic_documents
 from keystone_tpu.evaluation import BinaryClassifierEvaluator
 from keystone_tpu.ops.learning.classifiers import LogisticRegressionEstimator

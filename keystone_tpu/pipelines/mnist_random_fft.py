@@ -13,7 +13,6 @@ import time
 from dataclasses import dataclass
 from typing import Optional
 
-from keystone_tpu.data import Dataset, LabeledData
 from keystone_tpu.data.loaders import load_labeled_csv, synthetic_mnist
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator
 from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator

@@ -12,7 +12,6 @@ import argparse
 import logging
 import time
 from dataclasses import dataclass
-from typing import Optional
 
 from keystone_tpu.data.loaders import TimitFeaturesDataLoader, synthetic_timit
 from keystone_tpu.evaluation import MulticlassClassifierEvaluator

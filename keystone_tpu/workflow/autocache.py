@@ -32,7 +32,7 @@ import numpy as np
 
 from . import analysis
 from .env import Prefix
-from .graph import Graph, NodeId, SinkId, SourceId
+from .graph import Graph, NodeId, SinkId
 from .operators import (
     DatasetExpression,
     DatasetOperator,

@@ -15,7 +15,7 @@ the reference.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import TYPE_CHECKING, Dict, Iterable, Mapping, Sequence, Set, Tuple, Union
+from typing import TYPE_CHECKING, Dict, Mapping, Sequence, Set, Tuple, Union
 
 if TYPE_CHECKING:  # pragma: no cover
     from .operators import Operator
