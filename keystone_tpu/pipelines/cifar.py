@@ -72,6 +72,10 @@ class CifarConfig:
     kernel_gamma: float = 5e-4
     block_size: int = 512
     num_epochs: int = 1
+    # Preemption-safe KRR fits: segment the fused sweep and persist
+    # (position, stack) here; a rerun with the same config+data resumes.
+    checkpoint_path: str = ""
+    checkpoint_every_blocks: int = 25
     # Augmented variant (RandomPatchCifarAugmented.scala:27-90).
     # horizontal_flips=None auto-selects: flips on real data (the reference
     # behavior) and off for the synthetic demo, whose phase-sensitive
@@ -246,6 +250,8 @@ def run_random_patch_cifar_kernel(config: CifarConfig):
             config.lam,
             config.block_size,
             config.num_epochs,
+            checkpoint_path=config.checkpoint_path or None,
+            checkpoint_every_blocks=config.checkpoint_every_blocks,
         ),
         train.data,
         labels,
@@ -354,6 +360,14 @@ def main(argv=None, variant: str = "RandomPatchCifar"):
     parser.add_argument("--blockSize", type=int, default=512)
     parser.add_argument("--numEpochs", type=int, default=1)
     parser.add_argument(
+        "--checkpointPath", default="",
+        help="kernel variant: mid-solver checkpoint/resume file",
+    )
+    parser.add_argument(
+        "--checkpointEveryBlocks", type=int, default=25,
+        help="kernel variant: block updates between checkpoint saves",
+    )
+    parser.add_argument(
         "--horizontalFlips", choices=["auto", "on", "off"], default="auto",
         help="augmented variant's test-crop flips (auto: on for real data)",
     )
@@ -373,6 +387,8 @@ def main(argv=None, variant: str = "RandomPatchCifar"):
         kernel_gamma=args.gamma,
         block_size=args.blockSize,
         num_epochs=args.numEpochs,
+        checkpoint_path=args.checkpointPath,
+        checkpoint_every_blocks=args.checkpointEveryBlocks,
         horizontal_flips={"auto": None, "on": True, "off": False}[args.horizontalFlips],
         seed=args.seed,
     )

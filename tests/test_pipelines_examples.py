@@ -57,6 +57,37 @@ class TestCifarFamily:
         _, train_eval, test_eval = run_random_patch_cifar_kernel(self.CFG)
         assert test_eval.total_error < 0.5
 
+    def test_random_patch_cifar_kernel_checkpoint_flag(self, tmp_path,
+                                                       monkeypatch):
+        # The CLI-exposed checkpoint knobs plumb through to the KRR solver:
+        # with a 1-block save cadence and 3 epochs the fit REALLY saves
+        # mid-sweep (counted via the atomic-rename hook), removes the
+        # checkpoint on completion, and matches the uncheckpointed fit.
+        import dataclasses
+        import os
+
+        ckpt = str(tmp_path / "krr.ckpt")
+        cfg = dataclasses.replace(
+            self.CFG, checkpoint_path=ckpt, checkpoint_every_blocks=1,
+            num_epochs=3,
+        )
+        saves, real_replace = [], os.replace
+
+        def counting_replace(src, dst):
+            real_replace(src, dst)
+            if str(dst) == ckpt:
+                saves.append(dst)
+
+        monkeypatch.setattr(os, "replace", counting_replace)
+        _, train_eval, test_eval = run_random_patch_cifar_kernel(cfg)
+        monkeypatch.undo()
+
+        assert len(saves) == 2  # 3 single-block updates -> saves at 1 and 2
+        assert not os.path.exists(ckpt)  # removed on completion
+        ref_cfg = dataclasses.replace(self.CFG, num_epochs=3)
+        _, _, ref_eval = run_random_patch_cifar_kernel(ref_cfg)
+        assert test_eval.total_error == ref_eval.total_error
+
     def test_augmented_votes_over_crops(self):
         _, test_eval = run_random_patch_cifar_augmented(self.CFG)
         assert test_eval.total_error < 0.6
