@@ -361,3 +361,56 @@ class TestEvaluatorProperties:
                 np.testing.assert_allclose(aps[c], 1.0, atol=1e-12)
             else:
                 assert aps[c] == 0.0
+
+
+class TestSparseProperties:
+    @given(
+        st.integers(min_value=1, max_value=24),
+        st.integers(min_value=1, max_value=12),
+        st.integers(min_value=1, max_value=6),
+        st.integers(min_value=1, max_value=5),
+        st.floats(min_value=0.0, max_value=1.0),
+    )
+    @settings(max_examples=40, deadline=None)
+    def test_sparse_matmuls_equal_dense(self, n, d, w, k, pad_frac):
+        # The never-densify kernels must agree with the densified form for
+        # ANY padded-COO pattern: duplicate indices accumulate, -1 padding
+        # and out-of-range indices drop — identically in X@W and XᵀV.
+        from keystone_tpu.ops.sparse import sparse_matmul, sparse_matmul_t
+
+        rng = np.random.default_rng(n * 1000 + d * 100 + w * 10 + k)
+        idx = rng.integers(0, d + 2, size=(n, w)).astype(np.int32)  # some ≥ d
+        pad_mask = rng.random(size=(n, w)) < pad_frac
+        idx[pad_mask] = -1
+        vals = rng.normal(size=(n, w)).astype(np.float32)
+        W = rng.normal(size=(d, k)).astype(np.float32)
+        V = rng.normal(size=(n, k)).astype(np.float32)
+
+        dense = np.zeros((n, d), dtype=np.float64)
+        for i in range(n):
+            for j in range(w):
+                if 0 <= idx[i, j] < d:
+                    dense[i, idx[i, j]] += vals[i, j]
+
+        np.testing.assert_allclose(
+            np.asarray(sparse_matmul(idx, vals, W)), dense @ W, atol=1e-4
+        )
+        np.testing.assert_allclose(
+            np.asarray(sparse_matmul_t(idx, vals, V, d)), dense.T @ V,
+            atol=1e-4,
+        )
+
+    @given(
+        st.integers(min_value=1, max_value=16),
+        st.integers(min_value=1, max_value=10),
+    )
+    @settings(max_examples=25, deadline=None)
+    def test_sparsify_densify_round_trip(self, n, d):
+        from keystone_tpu.ops.sparse import Densify, Sparsify
+
+        rng = np.random.default_rng(n * 31 + d)
+        X = rng.normal(size=(n, d)).astype(np.float32)
+        X[rng.random(size=X.shape) < 0.6] = 0.0
+        sp = Sparsify().batch_apply(Dataset.of(X))
+        back = Densify(num_features=d).batch_apply(sp)
+        np.testing.assert_allclose(np.asarray(back.array), X, atol=0)
