@@ -401,6 +401,40 @@ class TestSparseProperties:
         )
 
     @given(
+        st.integers(min_value=3, max_value=12),
+        st.integers(min_value=33, max_value=48),
+        st.integers(min_value=1, max_value=3),
+    )
+    @settings(max_examples=15, deadline=None)
+    def test_wide_k_chunked_paths_equal_dense(self, n, k, chunk_elems_pow):
+        # k > _COLWISE_MAX_K forces the lax.map / scan row-chunked paths;
+        # shrinking _CHUNK_ELEMS forces nchunks > 1 AND a ragged final
+        # chunk (the ghost-index padding most likely to hide an off-by-one).
+        from keystone_tpu.ops import sparse as sp
+
+        d, w = 20, 4
+        rng = np.random.default_rng(n * 17 + k)
+        idx = rng.integers(-1, d + 1, size=(n, w)).astype(np.int32)
+        vals = rng.normal(size=(n, w)).astype(np.float32)
+        W = rng.normal(size=(d, k)).astype(np.float32)
+        V = rng.normal(size=(n, k)).astype(np.float32)
+        dense = np.zeros((n, d))
+        for i in range(n):
+            for j in range(w):
+                if 0 <= idx[i, j] < d:
+                    dense[i, idx[i, j]] += vals[i, j]
+
+        old = sp._CHUNK_ELEMS
+        sp._CHUNK_ELEMS = 1 << (4 + chunk_elems_pow)  # tiny: many chunks
+        try:
+            out = np.asarray(sp.sparse_matmul(idx, vals, W))
+            out_t = np.asarray(sp.sparse_matmul_t(idx, vals, V, d))
+        finally:
+            sp._CHUNK_ELEMS = old
+        np.testing.assert_allclose(out, dense @ W, atol=1e-4)
+        np.testing.assert_allclose(out_t, dense.T @ V, atol=1e-4)
+
+    @given(
         st.integers(min_value=1, max_value=16),
         st.integers(min_value=1, max_value=10),
     )
