@@ -10,6 +10,7 @@ randomly generated DAGs, which is where surgery bugs actually hide.
 from dataclasses import dataclass
 
 import numpy as np
+import jax.numpy as jnp
 from hypothesis import given, settings, strategies as st
 
 from keystone_tpu.data import Dataset
@@ -425,10 +426,24 @@ class TestSparseProperties:
                     dense[i, idx[i, j]] += vals[i, j]
 
         old = sp._CHUNK_ELEMS
-        sp._CHUNK_ELEMS = 1 << (4 + chunk_elems_pow)  # tiny: many chunks
+        # chunk = _CHUNK_ELEMS // (w*k) must land in [2, n) so there are
+        # MULTIPLE chunks and (usually) a ragged final one; and the un-jitted
+        # wrapped functions must run, because the module-level jit cache is
+        # keyed on shapes only and would replay the first example's chunking.
+        sp._CHUNK_ELEMS = 1 << (8 + chunk_elems_pow)
         try:
-            out = np.asarray(sp.sparse_matmul(idx, vals, W))
-            out_t = np.asarray(sp.sparse_matmul_t(idx, vals, V, d))
+            chunk = max(1, sp._CHUNK_ELEMS // (w * k))
+            assert chunk >= 2, (w, k, sp._CHUNK_ELEMS)
+            out = np.asarray(
+                sp.sparse_matmul.__wrapped__(
+                    jnp.asarray(idx), jnp.asarray(vals), jnp.asarray(W)
+                )
+            )
+            out_t = np.asarray(
+                sp.sparse_matmul_t.__wrapped__(
+                    jnp.asarray(idx), jnp.asarray(vals), jnp.asarray(V), d
+                )
+            )
         finally:
             sp._CHUNK_ELEMS = old
         np.testing.assert_allclose(out, dense @ W, atol=1e-4)
