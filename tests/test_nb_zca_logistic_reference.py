@@ -1,0 +1,193 @@
+"""Ports of the last three un-mirrored reference suites:
+NaiveBayesModelSuite.scala (parameter recovery from generated multinomial
+data), ZCAWhiteningSuite.scala (identity covariance incl. the negative
+large-epsilon assertion), LogisticRegressionModelSuite.scala (binary
+slope/accuracy recovery and the multinomial fit against R-computed golden
+weights — an external golden committed upstream in the suite source).
+"""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.classifiers import (
+    LogisticRegressionEstimator,
+    NaiveBayesEstimator,
+)
+from keystone_tpu.ops.learning.pca import ZCAWhitenerEstimator
+
+
+# ---------------------------------------------------------------------------
+# NaiveBayesModelSuite.scala
+# ---------------------------------------------------------------------------
+
+
+def _generate_nb_input(log_pi, log_theta, n, seed, sample=10):
+    """Reference generator (NaiveBayesModelSuite.scala:23-57): class drawn
+    from exp(log_pi), features are counts of `sample` multinomial draws from
+    exp(log_theta[class])."""
+    rng = np.random.default_rng(seed)
+    pi = np.exp(log_pi)
+    theta = np.exp(log_theta)
+    ys, xs = [], []
+    for _ in range(n):
+        y = int(rng.choice(len(pi), p=pi / pi.sum()))
+        counts = rng.multinomial(sample, theta[y] / theta[y].sum())
+        ys.append(y)
+        xs.append(counts.astype(np.float64))
+    return np.asarray(xs), np.asarray(ys)
+
+
+class TestNaiveBayesReference:
+    def test_multinomial_parameter_recovery(self):
+        # NaiveBayesModelSuite.scala:95-117 ("Naive Bayes Multinomial").
+        log_pi = np.log([0.5, 0.1, 0.4])
+        log_theta = np.log(
+            [
+                [0.70, 0.10, 0.10, 0.10],
+                [0.10, 0.70, 0.10, 0.10],
+                [0.10, 0.10, 0.70, 0.10],
+            ]
+        )
+        X, y = _generate_nb_input(log_pi, log_theta, 1000, seed=42)
+        model = NaiveBayesEstimator(3, lam=1.0).fit(
+            Dataset.of(X), Dataset.of(y)
+        )
+        # validateModelFit: recovered exp(pi)/exp(theta) within 0.05
+        np.testing.assert_allclose(
+            np.exp(np.asarray(model.pi)), np.exp(log_pi), atol=0.05
+        )
+        np.testing.assert_allclose(
+            np.exp(np.asarray(model.theta)), np.exp(log_theta), atol=0.05
+        )
+        # validatePrediction on fresh data: < 20% wrong
+        Xv, yv = _generate_nb_input(log_pi, log_theta, 1000, seed=17)
+        preds = np.asarray(model.batch_apply(Dataset.of(Xv)).array).argmax(1)
+        assert (preds != yv).mean() < 0.2
+
+
+# ---------------------------------------------------------------------------
+# ZCAWhiteningSuite.scala
+# ---------------------------------------------------------------------------
+
+
+class TestZCAWhiteningReference:
+    NROWS, NDIM = 10000, 10
+
+    @classmethod
+    def _cov_deviation(cls, eps):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(cls.NROWS, cls.NDIM))
+        wx = np.asarray(
+            ZCAWhitenerEstimator(eps=eps).fit_single(X).apply(X),
+            dtype=np.float64,
+        )
+        cov = np.cov(wx, rowvar=False)
+        return np.abs(cov - np.eye(cls.NDIM)).max()
+
+    def test_whitening_with_small_epsilon(self):
+        # ZCAWhiteningSuite.scala:26-29
+        assert self._cov_deviation(1e-12) < 1e-4
+
+    def test_whitening_with_large_epsilon(self):
+        # ZCAWhiteningSuite.scala:31-37: still roughly white at 0.1, but a
+        # large epsilon must be measurably noisy (the negative assertion).
+        dev = self._cov_deviation(0.1)
+        assert dev < 0.1
+        assert dev >= 1e-4
+
+
+# ---------------------------------------------------------------------------
+# LogisticRegressionModelSuite.scala
+# ---------------------------------------------------------------------------
+
+
+def _generate_logistic_input(offset, scale, n, seed):
+    """Reference generator: y ~ Bernoulli(logistic(offset + scale*x))."""
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=n)
+    p = 1.0 / (1.0 + np.exp(-(offset + scale * x)))
+    y = (rng.random(n) < p).astype(np.int64)
+    return x[:, None], y
+
+
+class TestLogisticRegressionReference:
+    def test_binary_recovers_slope(self):
+        # "logistic regression with LBFGS": A=0, B=-0.8, n=10000; the
+        # learned slope within 0.03 of B and validation accuracy > 0.65.
+        # (Our model is softmax-parameterized; the MLlib pivot slope is
+        # W[:,1] - W[:,0].)
+        A, B = 0.0, -0.8
+        # n=50000 (reference: 10000): our RNG stream differs from Scala's,
+        # so the slope must be compared to the POPULATION value; at n=10000
+        # the slope's sampling SE (~0.025) alone can exceed the reference's
+        # 0.03 tolerance. 5x the rows keeps the same tolerance honest.
+        X, y = _generate_logistic_input(A, B, 50000, seed=42)
+        model = LogisticRegressionEstimator(2, num_iters=200).fit(
+            Dataset.of(X), Dataset.of(y)
+        )
+        W = np.asarray(model.weights)
+        slope = float(W[0, 1] - W[0, 0])
+        assert abs(slope - B) < 0.03, slope
+
+        Xv, yv = _generate_logistic_input(A, B, 10000, seed=17)
+        preds = np.asarray(model.batch_apply(Dataset.of(Xv)).array)
+        acc = (preds.reshape(-1) == yv).mean()
+        assert acc > 0.65, acc
+
+    def test_multinomial_matches_r_golden_weights(self):
+        # "multinomial logistic regression with LBFGS": data drawn from the
+        # iris-fitted model (intercept layout, stride d+1 — the Spark
+        # original these constants come from); the fitted pivot weights
+        # must match the R-computed goldens committed in the reference
+        # suite source (LogisticRegressionModelSuite.scala:199-203) at the
+        # reference's own 0.05 tolerance. weights_r is the first 8 entries
+        # of the stride-5 pivot layout (2 classes x [4 features,
+        # intercept]). n=100000 (reference: 10000) because our RNG stream
+        # differs from Scala's — the golden only reproduces at a sample
+        # large enough that sampling noise sits inside the tolerance.
+        weights = [
+            -0.57997, 0.912083, -0.371077, -0.819866, 2.688191,
+            -0.16624, -0.84355, -0.048509, -0.301789, 4.170682,
+        ]
+        x_mean = np.array([5.843, 3.057, 3.758, 1.199])
+        x_var = np.array([0.6856, 0.1899, 3.116, 0.581])
+        weights_r = np.array([
+            -0.5837166, 0.9285260, -0.3783612, -0.8123411, 2.6228269,
+            -0.1691865, -0.811048, -0.0646380,
+        ])
+
+        d, k, n = 4, 3, 100_000
+        Wgen = np.asarray(weights).reshape(k - 1, d + 1)
+        rng = np.random.default_rng(42)
+
+        def draw(n, rng):
+            X = rng.normal(size=(n, d)) * np.sqrt(x_var) + x_mean
+            margins = np.concatenate(
+                [np.zeros((n, 1)), X @ Wgen[:, :d].T + Wgen[:, d]], axis=1
+            )
+            margins -= margins.max(axis=1, keepdims=True)
+            probs = np.exp(margins)
+            probs /= probs.sum(axis=1, keepdims=True)
+            u = rng.random(n)
+            y = (u[:, None] > probs.cumsum(axis=1)).sum(axis=1)
+            return X, y
+
+        X, y = draw(n, rng)
+        # Our softmax estimator has no intercept term; the reference-
+        # faithful form is the append-ones trick (the same one our sparse
+        # LBFGS uses), with the pivot = columns minus the reference class.
+        Xa = np.concatenate([X, np.ones((n, 1))], axis=1)
+        model = LogisticRegressionEstimator(
+            3, num_iters=400, convergence_tol=1e-15
+        ).fit(Dataset.of(Xa), Dataset.of(y))
+        W = np.asarray(model.weights, dtype=np.float64)  # (d+1, k)
+        pivot = (W[:, 1:] - W[:, :1]).T.reshape(-1)  # stride-5 layout
+        np.testing.assert_allclose(pivot[:8], weights_r, atol=0.05)
+
+        # Prediction on fresh data beats the reference's 0.47 floor (the
+        # generating curve is shallow by design).
+        Xv, yv = draw(10_000, rng)
+        Xva = np.concatenate([Xv, np.ones((len(Xv), 1))], axis=1)
+        preds = np.asarray(model.batch_apply(Dataset.of(Xva)).array)
+        assert (preds.reshape(-1) == yv).mean() > 0.47

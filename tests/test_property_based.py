@@ -427,13 +427,16 @@ class TestSparseProperties:
 
         old = sp._CHUNK_ELEMS
         # chunk = _CHUNK_ELEMS // (w*k) must land in [2, n) so there are
-        # MULTIPLE chunks and (usually) a ragged final one; and the un-jitted
-        # wrapped functions must run, because the module-level jit cache is
-        # keyed on shapes only and would replay the first example's chunking.
-        sp._CHUNK_ELEMS = 1 << (8 + chunk_elems_pow)
+        # MULTIPLE chunks and (usually) a ragged final one; derive the
+        # quantum from the target chunk so no draw degenerates to a single
+        # chunk. The un-jitted wrapped functions must run, because the
+        # module-level jit cache is keyed on shapes only and would replay
+        # the first example's chunking.
+        chunk_target = min(1 + chunk_elems_pow, n - 1)  # in [2, n)
+        sp._CHUNK_ELEMS = chunk_target * w * k
         try:
             chunk = max(1, sp._CHUNK_ELEMS // (w * k))
-            assert chunk >= 2, (w, k, sp._CHUNK_ELEMS)
+            assert 2 <= chunk < n, (n, w, k, chunk)
             out = np.asarray(
                 sp.sparse_matmul.__wrapped__(
                     jnp.asarray(idx), jnp.asarray(vals), jnp.asarray(W)
