@@ -1,16 +1,23 @@
-"""Benchmark suite: four reference workload geometries, each with a stated
+"""Benchmark suite: reference workload geometries, each with a stated
 FLOP model, measured device time, achieved TFLOP/s and MFU.
 
-Headline (the printed JSON line): TIMIT-shaped CosineRandomFeatures ->
-BlockLeastSquares against the reference's only committed wall-clock
-(BASELINE.md, scripts/solver-comparisons-final.csv:26 — TIMIT d=16384 Block
-on 16x r3.4xlarge Spark: 580,555 ms at n=2.2e6), n-scaled. Additional
-metrics ride in detail.additional_metrics:
+Headline (the printed JSON line): the REAL TIMIT baseline row — n=2,200,000
+rows, d=16384 cosine features, BlockLeastSquares — run at full n through
+the streaming (out-of-core) fit path and compared against the reference's
+literal committed wall-clock (BASELINE.md, scripts/solver-comparisons-
+final.csv:26 — TIMIT d=16384 Block on 16x r3.4xlarge Spark: 580,555 ms at
+n=2.2e6) with NO n-scaling term. The 72 GB bf16 feature matrix never
+exists: features are generated per row tile inside one compiled scan, each
+tile folds into the (d, d) Gramian + correlation (parallel/streaming.py),
+and the BCD epochs run on the accumulated normal equations.
 
+Additional metrics ride in detail.additional_metrics:
+
+  - timit_resident_262k: the round-1..3 resident-feature headline geometry
+    (kept for continuity; exercises the strided in-loop BCD kernels).
   - amazon_sparse_lbfgs_d16384: the csv:13 sparse geometry through the
-    never-densify SparseLBFGSwithL2 (honest gather-bound numbers: on this
-    workload one chip loses the n-scaled wall-clock to the 16-node cluster
-    and wins on capacity — the full n=65e6 fits one chip's HBM).
+    never-densify SparseLBFGSwithL2 (honest gather-bound numbers: one chip
+    loses the n-scaled wall-clock to the 16-node cluster on this workload).
   - krr_cifar_kernel_geometry: RandomPatchCifarKernel's KRR solver shape
     (no reference timing exists; absolute + MFU only).
   - mnist_random_fft_end_to_end: the README example geometry end-to-end
@@ -84,6 +91,220 @@ def marginal_device_time(make_repeated, reps: int = 3):
     tN = time.perf_counter() - t0
     device = max((tN - t1) / (reps - 1), 1e-9)
     return device, t1, max(t1 - device, 0.0)
+
+
+def timit_streaming_metric():
+    """The REAL baseline row, full n, no scaling: n=2,200,000 × d=16384
+    cosine features → 3-epoch block coordinate descent, via the streaming
+    tier (features generated per 65536-row tile inside one compiled scan;
+    the 72 GB feature matrix never exists — parallel/streaming.py).
+
+    Inputs are generated device-side (untimed), mirroring every other
+    row's device-resident-input convention; the raw TIMIT input at this
+    geometry is 3.9 GB (2.2e6×440 f32) and stays resident, exactly like a
+    production host would hold it. The timed region is ONE dispatch:
+    tile sweep (fused featurize + accumulating syrk) + BCD epochs on the
+    accumulated normal equations + algebraic train loss.
+    """
+    precision = os.environ.get("BENCH_PRECISION", "bf16")
+    bf16 = precision == "bf16"
+    n = int(os.environ.get("BENCH_N", str(BASELINE_N)))
+    epochs = NUM_EPOCHS
+
+    from keystone_tpu.ops import pallas_ops as po
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.parallel import streaming
+
+    use_pallas = po.pallas_enabled()
+    feat_dtype = jnp.bfloat16 if bf16 else jnp.float32
+    tile_rows = streaming.pick_tile_rows(
+        NUM_FEATURES, 2 if bf16 else 4
+    )  # 65536 bf16 / 32768 f32 — one ~2 GB slab
+
+    num_blocks = NUM_FEATURES // BLOCK_SIZE
+    rfs = [
+        CosineRandomFeatures(TIMIT_INPUT_DIMS, BLOCK_SIZE, gamma=0.05, seed=i)
+        for i in range(num_blocks)
+    ]
+    Wrf_flat = jnp.stack([rf.W for rf in rfs]).reshape(
+        NUM_FEATURES, TIMIT_INPUT_DIMS
+    )
+    brf_flat = jnp.stack([rf.b for rf in rfs]).reshape(NUM_FEATURES)
+
+    def make_featurize(bias):
+        def featurize(X_t):
+            if use_pallas:
+                return po.cosine_features(
+                    X_t, Wrf_flat, bias,
+                    compute_dtype=feat_dtype, out_dtype=feat_dtype,
+                )
+            return jnp.cos(
+                X_t.astype(jnp.float32) @ Wrf_flat.T + bias
+            ).astype(feat_dtype)
+        return featurize
+
+    featurize = make_featurize(brf_flat)
+
+    # Device-side input generation (untimed): PRE-TILED X (an in-program
+    # reshape would make XLA hold a second lane-padded ~4.5 GB copy of X —
+    # the difference between fitting 16 GB HBM and not) + int labels (the
+    # one-hot target is built per tile by `labelize`, so the 1.3 GB target
+    # matrix never exists at full n). In bf16 mode X is STORED bf16: the
+    # bf16 MXU pass quantizes the operands to bf16 regardless, so the f32
+    # copy holds no extra information — only 2.3 GB of extra HBM.
+    num_tiles = -(-n // tile_rows)
+    n_pad = num_tiles * tile_rows
+
+    @jax.jit
+    def gen(key):
+        kx, ky = jax.random.split(key)
+        X = jax.random.normal(
+            kx, (num_tiles, tile_rows, TIMIT_INPUT_DIMS), jnp.float32
+        ).astype(feat_dtype)
+        y = jax.random.randint(
+            ky, (num_tiles, tile_rows), 0, TIMIT_NUM_CLASSES
+        )
+        return X, y
+
+    X, y = gen(jax.random.PRNGKey(0))
+    _sync_scalar(jnp.sum(X[0, 0]) + jnp.sum(y[0, 0]))  # drain generation
+
+    def labelize(y_t):
+        return 2.0 * jax.nn.one_hot(
+            y_t, TIMIT_NUM_CLASSES, dtype=jnp.float32
+        ) - 1.0
+
+    fit_kw = dict(
+        featurize=featurize, d_feat=NUM_FEATURES, tile_rows=tile_rows,
+        block_size=BLOCK_SIZE, lam=1e-4, num_iter=epochs,
+        use_pallas=use_pallas, labelize=labelize,
+        valid=n if n != n_pad else None,
+    )
+
+    def run_once():
+        W, loss, _ = streaming.streaming_bcd_fit(X, y, **fit_kw)
+        loss = float(loss)  # host transfer: the reliable execution barrier
+        assert np.isfinite(loss), f"bad streamed solve: loss={loss}"
+        return W, loss
+
+    run_once()  # warmup (compile)
+    elapsed = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        W, loss = run_once()
+        elapsed = min(elapsed, time.perf_counter() - t0)
+
+    # Untimed quality pass: train error from tile-wise predictions
+    # (padding rows masked out of the mean).
+    @jax.jit
+    def err_of(X, y, W):
+        preds = streaming.streaming_predict(X, W, featurize, tile_rows)
+        hits = jnp.argmax(preds, axis=1) == y.reshape(-1)
+        ok = jnp.arange(preds.shape[0]) < n
+        return 1.0 - jnp.sum(hits * ok) / n
+
+    train_err = float(err_of(X, y, W))
+
+    # Marginal device time: repeat the full streamed fit in-program and
+    # difference reps=3 vs 1 (strips the tunnel's dispatch overhead). The
+    # hoisting-defeat perturbation rides on the 16384-float featurizer
+    # bias, NOT on X — `X + 0.0*acc` would materialize a second full-size
+    # X and push the program back over HBM.
+    def make_repeated(reps):
+        valid = n if n != n_pad else None
+
+        @jax.jit
+        def run(X, y):
+            def body(i, acc):
+                f = make_featurize(brf_flat + 0.0 * acc)
+                G, FY, yty = streaming.gram_stats(
+                    X, y, f, NUM_FEATURES, tile_rows,
+                    use_pallas=use_pallas, valid=valid, labelize=labelize,
+                )
+                W = streaming.bcd_from_gram(
+                    G, FY, BLOCK_SIZE, 1e-4, epochs
+                )
+                return acc + jnp.sum(jnp.abs(W))
+            return jax.lax.fori_loop(0, reps, body, 0.0)
+        return lambda: run(X, y)
+
+    device_s, _, dispatch_s = marginal_device_time(make_repeated)
+
+    # FLOP accounting — two stated models:
+    #   executed: the MACs the program actually issues. The Gramian is a
+    #     symmetric rank-n update (syrk): n·d² FLOPs, not the dense 2·n·d².
+    #   dense_equiv: what a dense implementation of the same algorithm
+    #     (full FᵀF) must do — the convention rounds 1-3 used for the
+    #     resident row's MFU. For a syrk-dominated program that convention
+    #     can exceed peak, so MFU here is computed against EXECUTED work
+    #     (i.e. it reads as true hardware utilization).
+    d, k = NUM_FEATURES, TIMIT_NUM_CLASSES
+    feat_fl = 2.0 * n * TIMIT_INPUT_DIMS * d
+    syrk_fl = 1.0 * n * d * d
+    fy_fl = 2.0 * n * d * k
+    nb = d // BLOCK_SIZE
+    epoch_fl = epochs * nb * 2 * 2.0 * d * BLOCK_SIZE * k
+    chol_fl = nb * BLOCK_SIZE**3 / 3.0
+    executed = feat_fl + syrk_fl + fy_fl + epoch_fl + chol_fl
+    dense_equiv = executed + syrk_fl  # full Gramian doubles the syrk term
+    achieved = executed / device_s / 1e12
+    peak = PEAK_TFLOPS_BF16 if bf16 else PEAK_TFLOPS_F32
+
+    baseline_s = BASELINE_MS / 1000.0
+    return {
+        "metric": "timit_full_n_streaming_d16384_wallclock",
+        "value": round(elapsed, 3),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / elapsed, 2),
+        "detail": {
+            "n": n,
+            "d": d,
+            "k": k,
+            "block_size": BLOCK_SIZE,
+            "epochs": epochs,
+            "tile_rows": tile_rows,
+            "precision": "bf16" if bf16 else "f32",
+            "streaming": (
+                "out-of-core tier: features generated per tile inside one "
+                "compiled scan; the feature matrix (72 GB bf16 at this "
+                "geometry) is never materialized (parallel/streaming.py)"
+            ),
+            "timing": "wallclock = min of 3 timed single-dispatch runs",
+            "device_time_s": round(device_s, 3),
+            "dispatch_overhead_s": round(dispatch_s, 3),
+            "flop_model_executed_tflops": round(executed / 1e12, 2),
+            "flop_model_dense_equiv_tflops": round(dense_equiv / 1e12, 2),
+            "achieved_tflops": round(achieved, 1),
+            "peak_tflops": peak,
+            "mfu": round(achieved / peak, 3),
+            "mfu_note": (
+                "MFU against EXECUTED MACs (syrk counts n*d^2, so this is "
+                "true hardware utilization; the rounds-1..3 dense-equiv "
+                "convention would read "
+                f"{round(dense_equiv / device_s / 1e12 / peak, 3)})"
+            ),
+            "vs_baseline_device_time": round(baseline_s / device_s, 2),
+            "train_loss": round(loss, 4),
+            "train_err": round(train_err, 4),
+            "quality_note": (
+                "synthetic labels; error/loss parity vs an exact solver on "
+                "real data lives in parity.py / PARITY_RESULTS.json"
+            ),
+            "pallas": use_pallas,
+            "single_dispatch": True,
+            "baseline": (
+                "16x r3.4xlarge Spark, 580.555s at the SAME n=2.2e6 and "
+                "d=16384 (csv:26) — literal comparison, NO n-scaling. "
+                "Epoch count: the CSV row's inferred 3 sweeps "
+                "(constantEstimator.R:12); this run uses the same 3. "
+                "Streamed epochs 2+ cost no data pass, so a 5-epoch run "
+                "(TimitPipeline.scala:34 default) adds <2% — the epoch "
+                "assumption no longer moves the comparison"
+            ),
+            "baseline_s": round(baseline_s, 3),
+            "device": str(jax.devices()[0]),
+        },
+    }
 
 
 def timit_metric():
@@ -246,7 +467,7 @@ def timit_metric():
     speedup = baseline_scaled_s / elapsed
 
     return {
-        "metric": "timit_cosine_blockls_d16384_wallclock",
+        "metric": "timit_resident_262k",
         "value": round(elapsed, 3),
         "unit": "s",
         "vs_baseline": round(speedup, 2),
@@ -371,11 +592,12 @@ def krr_metric():
     )
 
     n, d, k, bs, epochs = 32_768, 2_048, 10, 4_096, 2
+    gamma, lam = 5e-4, 1e-3
     rng = np.random.default_rng(2)
     X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
     Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
     krr = KernelRidgeRegression(
-        GaussianKernelGenerator(gamma=5e-4), lam=1e-3,
+        GaussianKernelGenerator(gamma=gamma), lam=lam,
         block_size=bs, num_epochs=epochs,
     )
     ds, ys = Dataset.of(X), Dataset.of(Y)
@@ -406,7 +628,7 @@ def krr_metric():
         def run(X, Y):
             def body(i, acc):
                 _, w_stack = _krr_fit_fused(
-                    X + 0.0 * acc, Y, order, 5e-4, 1e-3, bs, n, nb,
+                    X + 0.0 * acc, Y, order, gamma, lam, bs, n, nb,
                     use_pallas,
                 )
                 return acc + jnp.sum(jnp.abs(w_stack))
@@ -525,11 +747,98 @@ def mnist_fft_metric():
     }
 
 
+def stupidbackoff_metric():
+    """Vectorized StupidBackoff batch scoring vs the dict-loop oracle
+    (host CPU; the reference scored data-parallel over the cluster,
+    StupidBackoff.scala:128-182). Reports n-grams/s for the batched path;
+    vs_baseline is the speedup over the per-query dict recursion."""
+    from keystone_tpu.ops.nlp import (
+        NGram,
+        NGramIndexerImpl,
+        NaiveBitPackIndexer,
+        StupidBackoffModel,
+    )
+
+    rng = np.random.default_rng(7)
+    vocab, n_tri, n_bi = 50_000, 400_000, 150_000
+    unigrams = {int(w): int(c) for w, c in enumerate(
+        rng.integers(1, 500, size=vocab)
+    )}
+    # Count-CONSISTENT tables (the corpus invariant the fit relies on):
+    # every observed trigram's bigram context is itself observed, so the
+    # dict oracle's context division never hits zero. Trigrams extend
+    # observed bigrams; unigrams cover the whole vocab.
+    counts = {}
+    bigrams = rng.integers(0, vocab, (n_bi, 2))
+    for row in bigrams:
+        counts[NGram(int(w) for w in row)] = int(rng.integers(1, 40))
+    ext = np.concatenate(
+        [bigrams[rng.integers(0, n_bi, n_tri)],
+         rng.integers(0, vocab, (n_tri, 1))], axis=1
+    )
+    for row in ext:
+        counts[NGram(int(w) for w in row)] = int(rng.integers(1, 40))
+    model = StupidBackoffModel(
+        {}, counts, NGramIndexerImpl(), unigrams,
+        num_tokens=sum(unigrams.values()), alpha=0.4,
+    )
+
+    packer = NaiveBitPackIndexer()
+    observed = list(counts.keys())[: 10 ** 6]
+    queries = observed + [
+        NGram(int(w) for w in row)
+        for row in rng.integers(0, vocab, (200_000, 3))
+    ]
+    packed = np.array([packer.pack(g.words) for g in queries], dtype=np.int64)
+
+    model.batch_score_packed(packed[:1000])  # build sorted tables untimed
+    t0 = time.perf_counter()
+    scores = model.batch_score_packed(packed)
+    t_vec = time.perf_counter() - t0
+    vec_rate = len(packed) / t_vec
+
+    n_dict = 20_000
+    t0 = time.perf_counter()
+    for g in queries[:n_dict]:
+        model.score(g)
+    t_dict = time.perf_counter() - t0
+    dict_rate = n_dict / t_dict
+
+    assert np.isfinite(scores).all()
+    return {
+        "metric": "stupidbackoff_batch_scoring",
+        "value": round(vec_rate, 0),
+        "unit": "ngrams/s",
+        "vs_baseline": round(vec_rate / dict_rate, 1),
+        "detail": {
+            "num_queries": len(packed),
+            "table_ngrams": len(counts),
+            "dict_loop_ngrams_per_s": round(dict_rate, 0),
+            "baseline": (
+                "per-query dict recursion (_score_locally) on the same "
+                "host — the oracle the batch path is equality-tested "
+                "against (tests/test_nlp_batch_scoring.py)"
+            ),
+            "note": (
+                "host-side serving path (searchsorted over packed int64 "
+                "tables, one lookup batch per backoff level); no reference "
+                "wall-clock exists for scoring throughput"
+            ),
+        },
+    }
+
+
 def main():
-    headline = timit_metric()
+    headline = timit_streaming_metric()
     if os.environ.get("BENCH_ONLY", "") != "timit":
         extras = []
-        for fn in (amazon_sparse_metric, krr_metric, mnist_fft_metric):
+        for fn in (
+            timit_metric,  # the rounds-1..3 resident-feature geometry
+            amazon_sparse_metric,
+            krr_metric,
+            mnist_fft_metric,
+            stupidbackoff_metric,
+        ):
             try:
                 extras.append(fn())
             except Exception as e:  # a broken extra must not kill the headline

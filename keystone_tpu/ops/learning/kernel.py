@@ -626,15 +626,18 @@ class KernelRidgeRegression(LabelEstimator):
         pos, stack = 0, None
 
         if os.path.exists(path):
-            ck = np.load(path, allow_pickle=False)
-            if str(ck["fingerprint"]) != fp:
-                raise ValueError(
-                    f"checkpoint at {path} was written by a different KRR "
-                    "fit (geometry/hyperparameters/block order differ); "
-                    "delete it or point checkpoint_path elsewhere"
-                )
-            pos = int(ck["pos"])
-            stack = jnp.asarray(ck["stack"])
+            # Close the NpzFile before the fit runs: a handle left open for
+            # the fit's duration would make the completed-fit os.remove
+            # below fail on non-POSIX platforms.
+            with np.load(path, allow_pickle=False) as ck:
+                if str(ck["fingerprint"]) != fp:
+                    raise ValueError(
+                        f"checkpoint at {path} was written by a different KRR "
+                        "fit (geometry/hyperparameters/block order differ); "
+                        "delete it or point checkpoint_path elsewhere"
+                    )
+                pos = int(ck["pos"])
+                stack = jnp.asarray(ck["stack"])
             logger.info("KRR resume from %s: block update %d/%d", path, pos, total)
 
         every = max(self.checkpoint_every_blocks, 1)

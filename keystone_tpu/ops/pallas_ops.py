@@ -617,6 +617,92 @@ def strided_gram_ok(F, block: int) -> bool:
     return n % min(_TILE_K, n) == 0 and block % ti == 0 and d % block == 0
 
 
+def _gram_sym_acc_kernel(ii_ref, jj_ref, g_ref, ai_ref, aj_ref, out_ref, *,
+                         compute_dtype):
+    """out[pair p] = g[pair p] + Σ_k AᵢᵀAⱼ — the accumulating syrk the
+    streaming (out-of-core) fit path folds over row tiles: the running
+    Gramian rides through as an operand, so the per-tile contribution never
+    materializes as a separate (d, d) buffer + add. Upper-triangle pairs
+    only (mirror once at the end of the sweep)."""
+    k = pl.program_id(1)
+
+    @pl.when(k == 0)
+    def _():
+        out_ref[:] = g_ref[:]
+
+    out_ref[:] += jax.lax.dot_general(
+        ai_ref[:].astype(compute_dtype),
+        aj_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+
+def gram_sym_acc(G, F, interpret: Optional[bool] = None):
+    """G + FᵀF, accumulating only upper-triangle blocks of the Gramian.
+
+    G: (d, d) float32 with a *meaningful upper triangle only*; F: (n, d).
+    Returns a NEW (d, d) buffer whose upper-triangle blocks hold the
+    accumulation and whose strictly-lower blocks are UNDEFINED memory
+    (never written by any grid step — do not read them). Callers mirror
+    once after the last accumulation
+    (``jnp.triu(G) + jnp.triu(G, 1).T``). This is the
+    per-partition Gramian accumulation of the reference's streaming
+    solvers (BlockWeightedLeastSquares.scala:177-313's per-partition
+    AᵀA + treeReduce) as a TPU kernel folded over row tiles.
+
+    Alignment: requires ``gram_acc_ok(F)`` (row count divisible by the k
+    tile, d by the column tile).
+    """
+    F = jnp.asarray(F)
+    G = jnp.asarray(G, dtype=jnp.float32)
+    compute_dtype = jnp.bfloat16 if F.dtype == jnp.bfloat16 else jnp.float32
+    n, d = F.shape
+    ti = _strided_ti(F.dtype, d)
+    tk = min(_TILE_K, n)
+    nt = d // ti
+    nk = n // tk
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = jnp.asarray(np.array([p[0] for p in pairs], dtype=np.int32))
+    jj = jnp.asarray(np.array([p[1] for p in pairs], dtype=np.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, jj[p])),
+        ],
+        out_specs=pl.BlockSpec(
+            (ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])
+        ),
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _gram_sym_acc_kernel, compute_dtype=compute_dtype
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((d, d), jnp.float32),
+        # The riding G operand (f32 in + out at (ti, ti)) pushes scoped
+        # VMEM to ~20 MB at 1024-wide bf16 tiles — past the compiler's
+        # conservative 16 MB default but well under the chip's 128 MB.
+        # Raising the limit keeps the wide tiles (F is re-read (nt+1)
+        # times per row tile, so halving nt halves that traffic).
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=48 * 1024 * 1024
+        ),
+        interpret=_interpret() if interpret is None else interpret,
+    )(ii, jj, G, F, F)
+
+
+def gram_acc_ok(F) -> bool:
+    """Static alignment check for :func:`gram_sym_acc`."""
+    n, d = F.shape
+    ti = _strided_ti(F.dtype, d)
+    return n % min(_TILE_K, n) == 0 and d % ti == 0
+
+
 def _block_corr_kernel(base_ref, f_ref, r_ref, out_ref, *, compute_dtype):
     """out[p] = F_windowᵀ R accumulated over row tiles (grid (p, k))."""
     k = pl.program_id(1)

@@ -328,8 +328,12 @@ class Transformer(TransformerOperator, Chainable[A, B]):
             # mirroring Dataset.map's vmap-or-loop policy.
             try:
                 batch = data.array
-            except Exception:
-                return data.map(self.apply)  # ragged items cannot stack
+            except (ValueError, TypeError):
+                # Ragged items cannot stack (the expected case). Any other
+                # exception class is a genuine stacking bug and propagates —
+                # swallowing it would silently degrade the pipeline to the
+                # per-item path with no visible cause.
+                return data.map(self.apply)
             try:
                 out = fn(jnp.asarray(batch))
                 # Sync inside the try: dispatch is async, so runtime

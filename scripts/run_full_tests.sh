@@ -1,0 +1,5 @@
+#!/bin/sh
+# Full two-tier test suite (default `pytest` skips the slow tier —
+# goldens, real-archive end-to-ends, multihost, heavyweight properties).
+# This is the coverage surface releases and judging sweeps should run.
+exec env KEYSTONE_FULL_TESTS=1 python -m pytest tests/ -q "$@"

@@ -29,6 +29,9 @@ import os
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # golden/e2e/multihost tier
+
+
 from keystone_tpu.ops.images import DaisyExtractor, HogExtractor, LCSExtractor
 from keystone_tpu.ops.images.conv import Convolver
 from keystone_tpu.utils.images import to_grayscale

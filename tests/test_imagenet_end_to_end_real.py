@@ -20,6 +20,9 @@ import tarfile
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # golden/e2e/multihost tier
+
+
 from _reference import RESOURCES, needs_reference_fixtures
 
 IMAGES = os.path.join(RESOURCES, "images")

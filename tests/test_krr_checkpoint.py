@@ -42,15 +42,22 @@ def _weights(model):
 
 
 class _PreemptAfter:
-    """os.replace wrapper that completes the Nth save, then 'preempts'."""
+    """os.replace wrapper that completes the Nth checkpoint save, then
+    'preempts'. Only renames landing on ``path`` count — other machinery
+    (e.g. a persistent JAX compilation cache) also uses os.replace, and
+    counting those would make the save-count assertions environment-
+    sensitive (same filter as examples/krr_preemption.py)."""
 
-    def __init__(self, monkeypatch, n_saves: int):
+    def __init__(self, monkeypatch, n_saves: int, path: str):
         self.remaining = n_saves
+        self.path = str(path)
         self._real = os.replace
         monkeypatch.setattr(os, "replace", self)
 
     def __call__(self, src, dst):
         self._real(src, dst)
+        if str(dst) != self.path:
+            return
         self.remaining -= 1
         if self.remaining == 0:
             raise KeyboardInterrupt("simulated preemption after save")
@@ -74,7 +81,7 @@ class TestCheckpointResume:
         ref = _weights(_est().fit(data, labels))
         path = str(tmp_path / "krr.ckpt")
 
-        _PreemptAfter(monkeypatch, n_saves=3)
+        _PreemptAfter(monkeypatch, n_saves=3, path=path)
         with pytest.raises(KeyboardInterrupt):
             _est(checkpoint_path=path, checkpoint_every_blocks=2).fit(
                 data, labels
@@ -96,7 +103,7 @@ class TestCheckpointResume:
     def test_foreign_checkpoint_is_rejected(self, tmp_path, monkeypatch):
         data, labels = _problem()
         path = str(tmp_path / "krr.ckpt")
-        _PreemptAfter(monkeypatch, n_saves=1)
+        _PreemptAfter(monkeypatch, n_saves=1, path=path)
         with pytest.raises(KeyboardInterrupt):
             _est(checkpoint_path=path, checkpoint_every_blocks=2).fit(
                 data, labels
@@ -118,7 +125,7 @@ class TestCheckpointResume:
         # featurizer) must not resume.
         data, labels = _problem(seed=0)
         path = str(tmp_path / "krr.ckpt")
-        _PreemptAfter(monkeypatch, n_saves=1)
+        _PreemptAfter(monkeypatch, n_saves=1, path=path)
         with pytest.raises(KeyboardInterrupt):
             _est(checkpoint_path=path, checkpoint_every_blocks=2).fit(
                 data, labels
@@ -144,6 +151,7 @@ class TestCheckpointResume:
         with pytest.raises(ValueError, match="pick one"):
             _est(checkpoint_path="/tmp/x", profile=True)
 
+    @pytest.mark.slow
     def test_mesh_fit_resumes_to_same_model(self, tmp_path, monkeypatch):
         from keystone_tpu.parallel import mesh as mesh_lib
 
@@ -153,7 +161,7 @@ class TestCheckpointResume:
         ref = _weights(_est().fit(data, labels))
 
         path = str(tmp_path / "krr_mesh.ckpt")
-        _PreemptAfter(monkeypatch, n_saves=2)
+        _PreemptAfter(monkeypatch, n_saves=2, path=path)
         with pytest.raises(KeyboardInterrupt):
             _est(checkpoint_path=path, checkpoint_every_blocks=3).fit(
                 data, labels
@@ -167,6 +175,7 @@ class TestCheckpointResume:
         np.testing.assert_allclose(out, ref, atol=1e-5)
         assert not os.path.exists(path)
 
+    @pytest.mark.slow
     def test_mesh_segments_reuse_one_program(self, tmp_path):
         # Checkpointed mesh fits dispatch the cached shard_map program once
         # per segment; the program must be built once, not re-traced per
@@ -192,7 +201,7 @@ class TestCheckpointResume:
         data, labels = _problem()
         ref = _weights(_est(block_permuter=7).fit(data, labels))
         path = str(tmp_path / "krr_perm.ckpt")
-        _PreemptAfter(monkeypatch, n_saves=2)
+        _PreemptAfter(monkeypatch, n_saves=2, path=path)
         with pytest.raises(KeyboardInterrupt):
             _est(
                 block_permuter=7, checkpoint_path=path,

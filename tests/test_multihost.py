@@ -14,6 +14,10 @@ import subprocess
 import sys
 import textwrap
 
+import pytest
+
+pytestmark = pytest.mark.slow  # golden/e2e/multihost tier
+
 _WORKER = textwrap.dedent(
     """
     import sys

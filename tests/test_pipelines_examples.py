@@ -93,6 +93,7 @@ class TestCifarFamily:
         assert test_eval.total_error < 0.6
 
 
+@pytest.mark.slow
 class TestVocImageNet:
     def test_voc_sift_fisher(self):
         from keystone_tpu.pipelines.voc_sift_fisher import VOCConfig

@@ -22,6 +22,8 @@ from keystone_tpu.workflow.rules import (
     UnusedBranchRemovalRule,
 )
 
+import pytest
+
 
 @dataclass(frozen=True)
 class Op(Transformer):
@@ -263,6 +265,7 @@ class TestSolverProperties:
         st.integers(min_value=2, max_value=6),
     )
     @settings(max_examples=15, deadline=None)
+    @pytest.mark.slow
     def test_bcd_multi_epoch_never_increases_loss(self, n, blocks):
         # Gauss-Seidel descent: each extra epoch cannot raise the ridge
         # objective (exact block minimization per step).
@@ -293,6 +296,7 @@ class TestSolverProperties:
         st.integers(min_value=2, max_value=6),
     )
     @settings(max_examples=15, deadline=None)
+    @pytest.mark.slow
     def test_pca_basis_is_orthonormal_and_ordered(self, n, p):
         from keystone_tpu.data import Dataset
         from keystone_tpu.ops.learning.pca import PCAEstimator
@@ -316,6 +320,7 @@ class TestEvaluatorProperties:
         st.integers(min_value=5, max_value=60),
     )
     @settings(max_examples=30, deadline=None)
+    @pytest.mark.slow
     def test_multiclass_metrics_identities(self, k, n):
         # Confusion-matrix identities that hold for ANY predictions:
         # micro-averaged recall == accuracy == 1 - total_error, and the
@@ -373,6 +378,7 @@ class TestSparseProperties:
         st.floats(min_value=0.0, max_value=1.0),
     )
     @settings(max_examples=40, deadline=None)
+    @pytest.mark.slow
     def test_sparse_matmuls_equal_dense(self, n, d, w, k, pad_frac):
         # The never-densify kernels must agree with the densified form for
         # ANY padded-COO pattern: duplicate indices accumulate, -1 padding
@@ -407,6 +413,7 @@ class TestSparseProperties:
         st.integers(min_value=1, max_value=3),
     )
     @settings(max_examples=15, deadline=None)
+    @pytest.mark.slow
     def test_wide_k_chunked_paths_equal_dense(self, n, k, chunk_elems_pow):
         # k > _COLWISE_MAX_K forces the lax.map / scan row-chunked paths;
         # shrinking _CHUNK_ELEMS forces nchunks > 1 AND a ragged final

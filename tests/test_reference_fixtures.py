@@ -54,6 +54,7 @@ def _model_of(mapper):
 
 
 class TestBWLSOnReferenceFixtures:
+    @pytest.mark.slow
     def test_solution_has_zero_gradient(self):
         A, B = _load("aMat.csv"), _load("bMat.csv")
         est = BlockWeightedLeastSquaresEstimator(4, 10, 0.1, 0.3)
@@ -88,6 +89,7 @@ class TestBWLSOnReferenceFixtures:
         )
         assert np.isfinite(_model_of(m)).all()
 
+    @pytest.mark.slow
     def test_block_size_not_dividing_num_features(self):
         A, B = _load("aMat.csv"), _load("bMat.csv")  # d=12, bs=5
         m = BlockWeightedLeastSquaresEstimator(5, 10, 0.1, 0.3).fit(

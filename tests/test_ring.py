@@ -110,6 +110,7 @@ class TestKernelMapperRingApply:
 
 
 class TestDistributedKRRFit:
+    @pytest.mark.slow
     def test_sharded_fit_matches_single_device(self, data_mesh):
         """The full KRR training loop (kernel blocks, residual psums, dual
         updates) partitions over the mesh via GSPMD and matches the
@@ -137,6 +138,7 @@ class TestDistributedKRRFit:
         )
         np.testing.assert_allclose(out, ref, atol=1e-4)
 
+    @pytest.mark.slow
     def test_fused_mesh_sweep_matches_stepwise(self, data_mesh):
         """The multi-device fit is ONE shard_map program per sweep
         (_krr_fit_fused_mesh); its dual weights must match the stepwise

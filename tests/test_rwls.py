@@ -103,6 +103,7 @@ def _pcwls_reference(X, Y, block_size, num_iter, lam, mw):
 
 class TestPerClassWeightedLS:
     @pytest.mark.parametrize("num_iter", [1, 3])
+    @pytest.mark.slow
     def test_matches_per_class_reference_structure(self, num_iter):
         rng = np.random.default_rng(2)
         n, d, k = 48, 8, 4
@@ -120,6 +121,7 @@ class TestPerClassWeightedLS:
         np.testing.assert_allclose(W, W_ref, atol=1e-7)
         np.testing.assert_allclose(b, b_ref, atol=1e-7)
 
+    @pytest.mark.slow
     def test_absent_class_is_finite(self):
         rng = np.random.default_rng(3)
         n, d, k = 32, 6, 5

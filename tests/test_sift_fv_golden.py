@@ -26,7 +26,7 @@ import pytest
 
 from _reference import RESOURCES as _RES, needs_reference_fixtures
 
-pytestmark = needs_reference_fixtures
+pytestmark = [needs_reference_fixtures, pytest.mark.slow]
 
 
 # ---------------------------------------------------------------------------

@@ -75,6 +75,7 @@ class TestBlockLeastSquares:
         expected = (X - xm) @ W + ym
         np.testing.assert_allclose(preds, expected, atol=1e-5)
 
+    @pytest.mark.slow
     def test_sharded_matches_unsharded(self, regression_problem, mesh8):
         X, Y = regression_problem
         est = BlockLeastSquaresEstimator(block_size=8, num_iter=3, lam=0.1)
@@ -139,6 +140,7 @@ class TestEndToEndClassification:
 
 
 class TestSketchedLeastSquares:
+    @pytest.mark.slow
     def test_recovers_solution_with_refinement(self):
         from keystone_tpu.ops.learning.linear import (
             LinearMapEstimator,

@@ -1,0 +1,225 @@
+"""Streaming (out-of-core) fit path: tiled Gramian accumulation + BCD on
+the normal equations must reproduce the resident residual-form solver.
+
+This is the memory-wall tier (VERDICT r3 Missing #1): the feature matrix
+is generated per row tile and never materialized; correctness here means
+the streamed solve is the SAME algorithm as ``bcd_least_squares_fused_flat``
+— identical iterates up to f32 summation-order noise — plus exact padding /
+masking semantics (a zero input row featurizes to cos(b) ≠ 0, so padding
+must be excluded after featurization, not before).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.parallel import streaming
+from keystone_tpu.parallel.linalg import bcd_least_squares_fused_flat
+
+D_IN, D_FEAT, BLOCK, K = 24, 128, 32, 3
+LAM = 1e-2
+
+
+def _featurizer(seed=0):
+    rng = np.random.default_rng(seed)
+    Wr = jnp.asarray(rng.normal(size=(D_FEAT, D_IN)).astype(np.float32) * 0.3)
+    br = jnp.asarray(
+        rng.uniform(0, 2 * np.pi, size=(D_FEAT,)).astype(np.float32)
+    )
+
+    def featurize(X_t):
+        return jnp.cos(X_t @ Wr.T + br)
+
+    return featurize
+
+
+def _problem(n, seed=1):
+    rng = np.random.default_rng(seed)
+    X = jnp.asarray(rng.normal(size=(n, D_IN)).astype(np.float32))
+    Y = jnp.asarray(rng.normal(size=(n, K)).astype(np.float32))
+    return X, Y
+
+
+class TestStreamingMatchesResident:
+    @pytest.mark.parametrize("epochs", [1, 3])
+    @pytest.mark.parametrize("n,tile", [(512, 128), (529, 128), (100, 256)])
+    def test_matches_fused_flat(self, n, tile, epochs):
+        # n=529: ragged remainder; n=100 < tile: remainder-only path.
+        featurize = _featurizer()
+        X, Y = _problem(n)
+        W_s, loss, _ = streaming.streaming_bcd_fit(
+            X, Y, featurize=featurize, d_feat=D_FEAT, tile_rows=tile,
+            block_size=BLOCK, lam=LAM, num_iter=epochs,
+        )
+        F = featurize(X)
+        W_ref = bcd_least_squares_fused_flat(
+            F, Y, BLOCK, lam=LAM, num_iter=epochs, use_pallas=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_s), np.asarray(W_ref), atol=2e-3, rtol=2e-3
+        )
+        # The algebraic loss (from G/FY/yty) equals the explicit residual.
+        Wf = np.asarray(W_s).reshape(D_FEAT, K)
+        R = np.asarray(Y) - np.asarray(F, np.float64) @ Wf
+        np.testing.assert_allclose(
+            float(loss), float((R * R).sum() / n), rtol=2e-3
+        )
+
+    def test_streaming_predict(self):
+        featurize = _featurizer()
+        X, Y = _problem(300)
+        W, _, _ = streaming.streaming_bcd_fit(
+            X, Y, featurize=featurize, d_feat=D_FEAT, tile_rows=128,
+            block_size=BLOCK, lam=LAM, num_iter=2,
+        )
+        preds = streaming.streaming_predict(X, W, featurize, tile_rows=128)
+        expected = featurize(X) @ np.asarray(W).reshape(D_FEAT, K)
+        np.testing.assert_allclose(
+            np.asarray(preds), np.asarray(expected), atol=1e-4
+        )
+
+    def test_pretiled_static_valid_labelize_matches_flat(self):
+        # The large-fit calling convention: pre-tiled 3-D X, int labels
+        # turned into ±1 one-hot targets per tile, static valid masking
+        # the boundary tile. Must equal the flat-X dense-Y fit on the true
+        # rows.
+        featurize = _featurizer()
+        n_true, tile = 450, 128
+        rng = np.random.default_rng(8)
+        X, _ = _problem(n_true, seed=2)
+        y = rng.integers(0, K, size=n_true)
+        Y = jnp.asarray(2.0 * np.eye(K, dtype=np.float32)[y] - 1.0)
+
+        T = -(-n_true // tile)
+        pad = T * tile - n_true
+        Xp = jnp.concatenate(
+            [X, jnp.asarray(rng.normal(size=(pad, D_IN)).astype(np.float32))]
+        ).reshape(T, tile, D_IN)
+        yp = jnp.asarray(
+            np.concatenate([y, rng.integers(0, K, size=pad)])
+        ).reshape(T, tile)
+
+        def labelize(y_t):
+            return 2.0 * jax.nn.one_hot(y_t, K, dtype=jnp.float32) - 1.0
+
+        W_t, loss_t, _ = streaming.streaming_bcd_fit(
+            Xp, yp, featurize=featurize, d_feat=D_FEAT, tile_rows=tile,
+            block_size=BLOCK, lam=LAM, num_iter=2, valid=n_true,
+            labelize=labelize,
+        )
+        W_f, loss_f, _ = streaming.streaming_bcd_fit(
+            X, Y, featurize=featurize, d_feat=D_FEAT, tile_rows=tile,
+            block_size=BLOCK, lam=LAM, num_iter=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_t), np.asarray(W_f), atol=1e-4, rtol=1e-4
+        )
+        np.testing.assert_allclose(float(loss_t), float(loss_f), rtol=1e-5)
+        # Pre-tiled predict path flattens back to (T*tile, k).
+        preds = streaming.streaming_predict(Xp, W_t, featurize, tile)
+        preds_flat = streaming.streaming_predict(X, W_t, featurize, tile)
+        np.testing.assert_allclose(
+            np.asarray(preds)[:n_true], np.asarray(preds_flat), atol=1e-4
+        )
+
+    def test_valid_masks_garbage_padding(self):
+        # Garbage (NOT zero) padding rows with valid= must give the exact
+        # result of fitting the true rows only.
+        featurize = _featurizer()
+        X, Y = _problem(200)
+        rng = np.random.default_rng(9)
+        Xp = jnp.concatenate(
+            [X, jnp.asarray(rng.normal(size=(56, D_IN)).astype(np.float32))]
+        )
+        Yp = jnp.concatenate(
+            [Y, jnp.asarray(rng.normal(size=(56, K)).astype(np.float32))]
+        )
+        G_p, FY_p, yty_p = jax.jit(
+            lambda a, b: streaming.gram_stats(
+                a, b, featurize, D_FEAT, 128,
+                valid=jnp.asarray(200, jnp.int32),
+            )
+        )(Xp, Yp)
+        G, FY, yty = jax.jit(
+            lambda a, b: streaming.gram_stats(a, b, featurize, D_FEAT, 128)
+        )(X, Y)
+        np.testing.assert_allclose(np.asarray(G_p), np.asarray(G), atol=1e-4)
+        np.testing.assert_allclose(np.asarray(FY_p), np.asarray(FY), atol=1e-5)
+        np.testing.assert_allclose(float(yty_p), float(yty), rtol=1e-6)
+
+
+class TestStreamingPallasKernel:
+    def test_gram_sym_acc_interpret_matches_xla(self):
+        # Aligned shapes so the accumulating syrk path engages (interpret
+        # mode on CPU); upper triangle must match G0 + FᵀF.
+        from keystone_tpu.ops import pallas_ops
+
+        rng = np.random.default_rng(3)
+        F = jnp.asarray(rng.normal(size=(1024, 256)).astype(np.float32))
+        G0 = jnp.asarray(rng.normal(size=(256, 256)).astype(np.float32))
+        assert pallas_ops.gram_acc_ok(F)
+        out = pallas_ops.gram_sym_acc(G0, F, interpret=True)
+        expected = np.asarray(G0) + np.asarray(F).T @ np.asarray(F)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(out)), np.triu(expected), atol=1e-3
+        )
+
+    def test_streaming_fit_pallas_interpret_matches_xla(self):
+        # The full streamed fit with the Pallas accumulation on (interpret)
+        # must match the XLA accumulation path.
+        rng = np.random.default_rng(4)
+        Wr = jnp.asarray(rng.normal(size=(256, D_IN)).astype(np.float32) * 0.3)
+        br = jnp.asarray(rng.uniform(0, 6.0, size=(256,)).astype(np.float32))
+
+        def featurize(X_t):
+            return jnp.cos(X_t @ Wr.T + br)
+
+        X, Y = _problem(1024, seed=5)
+        kw = dict(
+            featurize=featurize, d_feat=256, tile_rows=512, block_size=128,
+            lam=LAM, num_iter=2,
+        )
+        import os
+        os.environ["KEYSTONE_PALLAS"] = "1"
+        try:
+            W_p, _, _ = streaming.streaming_bcd_fit(X, Y, use_pallas=True, **kw)
+        finally:
+            os.environ.pop("KEYSTONE_PALLAS", None)
+        W_x, _, _ = streaming.streaming_bcd_fit(X, Y, use_pallas=False, **kw)
+        np.testing.assert_allclose(
+            np.asarray(W_p), np.asarray(W_x), atol=2e-3, rtol=2e-3
+        )
+
+
+class TestStreamingMesh:
+    def test_mesh_matches_single_device(self):
+        # Rows padded to shard over 8 devices; n_true masks the padding.
+        featurize = _featurizer()
+        n_true = 700
+        X, Y = _problem(n_true, seed=7)
+        mesh = mesh_lib.make_mesh()
+        num = mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS)
+        pad = (-n_true) % (num * 64)
+        rng = np.random.default_rng(11)
+        Xp = jnp.concatenate(
+            [X, jnp.asarray(rng.normal(size=(pad, D_IN)).astype(np.float32))]
+        )
+        Yp = jnp.concatenate(
+            [Y, jnp.asarray(rng.normal(size=(pad, K)).astype(np.float32))]
+        )
+        Xs = mesh_lib.shard_rows(Xp, mesh)
+        Ys = mesh_lib.shard_rows(Yp, mesh)
+        W_mesh = streaming.streaming_bcd_fit_mesh(
+            Xs, Ys, featurize=featurize, d_feat=D_FEAT, tile_rows=64,
+            block_size=BLOCK, lam=LAM, num_iter=2, mesh=mesh, n_true=n_true,
+        )
+        W_one, _, _ = streaming.streaming_bcd_fit(
+            X, Y, featurize=featurize, d_feat=D_FEAT, tile_rows=64,
+            block_size=BLOCK, lam=LAM, num_iter=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_mesh), np.asarray(W_one), atol=2e-3, rtol=2e-3
+        )
