@@ -56,6 +56,40 @@ class BlockLinearMapper(Transformer):
             out = out + self.b_opt
         return out
 
+    def device_fn(self):
+        """Stage-fusion contract: the whole blockwise model as one
+        row-local array function — center by the concatenated means, one
+        flat GEMM, add the intercept. Lets the apply path fuse with an
+        upstream featurize program into a single dispatch."""
+        W_flat = jnp.concatenate(list(self.xs), axis=0)
+        mean = std = None
+        if self.feature_scalers is not None:
+            if any(getattr(s, "mean", None) is None for s in self.feature_scalers):
+                return None  # non-scaler transformers: keep the block path
+            mean = jnp.concatenate(
+                [jnp.asarray(s.mean) for s in self.feature_scalers]
+            )
+            stds = [getattr(s, "std", None) for s in self.feature_scalers]
+            if any(s is not None for s in stds):
+                std = jnp.concatenate(
+                    [
+                        jnp.ones_like(jnp.asarray(self.feature_scalers[i].mean))
+                        if stds[i] is None else jnp.asarray(stds[i])
+                        for i in range(len(stds))
+                    ]
+                )
+        b = self.b_opt
+
+        def fn(X):
+            if mean is not None:
+                X = X - mean
+            if std is not None:
+                X = X / std
+            out = X @ W_flat
+            return out if b is None else out + b
+
+        return fn
+
     def batch_apply(self, data: Dataset) -> Dataset:
         blocks = self.splitter.apply(data)
         return self.apply_blocks(blocks)
@@ -143,6 +177,49 @@ class BlockLeastSquaresEstimator(LabelEstimator):
     @property
     def weight(self) -> int:
         return 3 * self.num_iter + 1
+
+    def device_fit_fn(self):
+        """Fit-fusion contract (workflow/fusion.py): the whole fit —
+        feature/label mean-centering + the fused-flat BCD sweep — as one
+        traceable function, so the optimizer can compile upstream
+        featurization INTO it (featurize + solve = ONE program; the
+        feature matrix never materializes between dispatches)."""
+        from keystone_tpu.workflow.fusion import DeviceFit
+        from keystone_tpu.ops.stats import StandardScalerModel
+
+        bs = self.block_size
+
+        def fit_fn(F, Y, n_true: int):
+            valid = (
+                jnp.arange(F.shape[0]) < n_true
+            ).astype(F.dtype)[:, None]
+            fmean = jnp.sum(F, axis=0) / n_true  # padding rows are zero
+            # Centering un-zeroes padding rows (0 - mean); re-mask so the
+            # solver's zero-padding contract holds.
+            Fc = (F - fmean) * valid
+            ymean = jnp.sum(Y, axis=0) / n_true
+            Yc = (Y - ymean) * valid.astype(Y.dtype)
+            W_stack = linalg.bcd_least_squares_fused_flat(
+                Fc, Yc, bs, lam=self.lam, num_iter=self.num_iter
+            )
+            return W_stack, fmean, ymean
+
+        def build(params):
+            W_stack, fmean, ymean = params
+            nb = W_stack.shape[0]
+            scalers = [
+                StandardScalerModel(fmean[i * bs : (i + 1) * bs])
+                for i in range(nb)
+            ]
+            return BlockLinearMapper(
+                [W_stack[i] for i in range(nb)], bs, b_opt=ymean,
+                feature_scalers=scalers,
+            )
+
+        def supports(d_feat: int) -> bool:
+            return d_feat % bs == 0 and self.num_features in (None, d_feat)
+
+        return DeviceFit(fit_fn, build, supports)
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         splitter = VectorSplitter(self.block_size, self.num_features)

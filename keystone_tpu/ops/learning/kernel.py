@@ -34,20 +34,59 @@ logger = logging.getLogger("keystone_tpu.kernel")
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("gamma", "use_pallas"))
-def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float, use_pallas: bool):
+@functools.partial(
+    jax.jit, static_argnames=("gamma", "use_pallas", "kdtype")
+)
+def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float, use_pallas: bool,
+                    kdtype: str = "f32"):
     """K[i, j] = exp(-γ ‖X_i − Xb_j‖²) via ‖x‖² + ‖y‖² − 2x·y
     (reference: KernelGenerator.scala:121-205). On TPU the distance+exp
     epilogue is fused into the matmul by the Pallas kernel so the squared-
     distance intermediate never round-trips HBM. ``use_pallas`` is resolved
     by the *eager* caller (pallas_direct_ok) — a bare pallas_call on a
     mesh-sharded operand would force a gather, so sharded callers pass
-    False here and reach the kernels through shard_map (parallel.ring)."""
+    False here and reach the kernels through shard_map (parallel.ring).
+
+    ``kdtype`` picks the MXU recipe for the cross-term GEMM (the norms,
+    distance assembly, exp epilogue — and the RESULT — stay f32 in all
+    modes; Cholesky solves downstream are untouched):
+      - "f32": 6-pass (HIGHEST) — exact-f32, the default.
+      - "bf16x3": 3-pass bf16 decomposition (HIGH) — HALF the MXU cost at
+        ~2⁻¹⁶ operand error; kernel entries match f32 to ~1e-5. The
+        recommended fast mode.
+      - "bf16": single-pass bf16 operands — 6x cheaper, but the kernel-
+        entry error (~γ·‖x‖‖y‖·2⁻⁸) can EXCEED small ridge λ, making
+        K+λI indefinite — and block Gauss-Seidel then DIVERGES (measured:
+        XOR at λ=1e-3 collapses to 25% accuracy while a direct solve of
+        the same perturbed system stays at 97%; tests/test_kernel_bf16).
+        Use only with λ comfortably above the kernel-error scale.
+    """
     from keystone_tpu.ops import pallas_ops
 
-    if use_pallas:
-        return pallas_ops.gaussian_kernel_block(X, Xb, x_norms, xb_norms, gamma)
-    sq = x_norms[:, None] + xb_norms[None, :] - 2.0 * (X @ Xb.T)
+    cd = jnp.bfloat16 if kdtype == "bf16" else jnp.float32
+    # bf16x3 takes the XLA path even when Pallas is available: Mosaic has
+    # no lowering for 3-pass dot precision, and the unfused norm+exp
+    # epilogue costs only ~5% extra HBM traffic here — the GEMM pass count
+    # is what dominates.
+    if use_pallas and kdtype != "bf16x3":
+        return pallas_ops.gaussian_kernel_block(
+            X, Xb, x_norms, xb_norms, gamma, compute_dtype=cd
+        )
+    if kdtype == "bf16":
+        dot = jax.lax.dot_general(
+            X.astype(jnp.bfloat16), Xb.astype(jnp.bfloat16),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    elif kdtype == "bf16x3":
+        dot = jax.lax.dot_general(
+            X, Xb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGH,
+        )
+    else:
+        dot = X @ Xb.T
+    sq = x_norms[:, None] + xb_norms[None, :] - 2.0 * dot
     return jnp.exp(-gamma * jnp.maximum(sq, 0.0))
 
 
@@ -58,28 +97,32 @@ def _slice_block(train_X, train_norms, start, size: int):
 
 
 def _column_block(train_X, train_norms, start, size: int, gamma: float,
-                  use_pallas: bool):
+                  use_pallas: bool, kdtype: str = "f32"):
     """K(train, train[start:start+size]) — (n_padded, size)."""
     Xb, nb = _slice_block(train_X, train_norms, start, size)
-    return _gaussian_block(train_X, Xb, train_norms, nb, gamma, use_pallas)
+    return _gaussian_block(
+        train_X, Xb, train_norms, nb, gamma, use_pallas, kdtype
+    )
 
 
 def _diag_block(train_X, train_norms, start, size: int, gamma: float,
-                use_pallas: bool):
+                use_pallas: bool, kdtype: str = "f32"):
     """K(block, block) — (size, size)."""
     Xb, nb = _slice_block(train_X, train_norms, start, size)
-    return _gaussian_block(Xb, Xb, nb, nb, gamma, use_pallas)
+    return _gaussian_block(Xb, Xb, nb, nb, gamma, use_pallas, kdtype)
 
 
 class GaussianKernelTransformer:
     """Holds the train rows; produces kernel column blocks on demand."""
 
-    def __init__(self, gamma: float, train_X, n_train: int):
+    def __init__(self, gamma: float, train_X, n_train: int,
+                 kernel_dtype: str = "f32"):
         from keystone_tpu.ops import pallas_ops
 
         self.gamma = float(gamma)
         self.train_X = jnp.asarray(train_X)
         self.n_train = n_train
+        self.kernel_dtype = kernel_dtype
         self._train_norms = jnp.sum(self.train_X * self.train_X, axis=1)
         # Resolved once per transformer: direct Pallas dispatch is only safe
         # when the captured train rows are not mesh-sharded.
@@ -89,7 +132,7 @@ class GaussianKernelTransformer:
         """K(train, train[start:start+size]) — (n_padded, size)."""
         return _column_block(
             self.train_X, self._train_norms, start, size, self.gamma,
-            self._use_pallas,
+            self._use_pallas, self.kernel_dtype,
         )
 
     def test_block(self, test_X, start: int, size: int):
@@ -101,25 +144,41 @@ class GaussianKernelTransformer:
         Xb = jax.lax.dynamic_slice_in_dim(self.train_X, start, size, axis=0)
         nb = jax.lax.dynamic_slice_in_dim(self._train_norms, start, size, axis=0)
         use_pallas = self._use_pallas and pallas_ops.pallas_direct_ok(test_X)
-        return _gaussian_block(test_X, Xb, t_norms, nb, self.gamma, use_pallas)
+        return _gaussian_block(
+            test_X, Xb, t_norms, nb, self.gamma, use_pallas,
+            self.kernel_dtype,
+        )
 
     def diag_block(self, start: int, size: int):
         """K(train[start:start+size], train[start:start+size])."""
         return _diag_block(
             self.train_X, self._train_norms, start, size, self.gamma,
-            self._use_pallas,
+            self._use_pallas, self.kernel_dtype,
         )
 
 
 class GaussianKernelGenerator:
     """Factory binding γ; ``fit(data)`` captures the training rows
-    (reference: KernelGenerator.scala:18-60)."""
+    (reference: KernelGenerator.scala:18-60).
 
-    def __init__(self, gamma: float):
+    ``kernel_dtype="bf16"`` generates kernel blocks with the bf16-operand/
+    f32-accumulate MXU recipe — solves stay f32 Cholesky. See
+    :func:`_gaussian_block` for the quantified error model.
+    """
+
+    def __init__(self, gamma: float, kernel_dtype: str = "f32"):
+        if kernel_dtype not in ("f32", "bf16", "bf16x3"):
+            raise ValueError(
+                'kernel_dtype must be "f32", "bf16x3" or "bf16", got '
+                f"{kernel_dtype!r}"
+            )
         self.gamma = gamma
+        self.kernel_dtype = kernel_dtype
 
     def fit(self, data: Dataset) -> GaussianKernelTransformer:
-        return GaussianKernelTransformer(self.gamma, data.array, data.n)
+        return GaussianKernelTransformer(
+            self.gamma, data.array, data.n, self.kernel_dtype
+        )
 
 
 # ---------------------------------------------------------------------------
@@ -153,11 +212,13 @@ def _krr_block_step_math(K_block, W, K_bb, y_bb, w_old, valid_col, valid_row, st
 
 @functools.partial(
     jax.jit,
-    static_argnames=("gamma", "lam", "bs", "n_train", "num_blocks", "use_pallas"),
+    static_argnames=(
+        "gamma", "lam", "bs", "n_train", "num_blocks", "use_pallas", "kdtype"
+    ),
 )
 def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
                    n_train: int, num_blocks: int, use_pallas: bool,
-                   carry0=None):
+                   carry0=None, kdtype: str = "f32"):
     """The whole KRR training sweep as ONE program: lax.scan over the
     (epochs × blocks) order, kernel column blocks generated in-loop (fused
     Pallas on TPU) with the diag block sliced out of them, dual model
@@ -177,7 +238,9 @@ def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
         # The diag block IS rows [start, start+bs) of the column block —
         # slice it instead of re-running the (bs, bs, d) GEMM+exp. (The
         # mesh form can't: those rows are scattered across devices.)
-        K_block = _column_block(X, x_norms, start, bs, gamma, use_pallas)
+        K_block = _column_block(
+            X, x_norms, start, bs, gamma, use_pallas, kdtype
+        )
         K_bb = jax.lax.dynamic_slice_in_dim(K_block, start, bs, axis=0)
         valid_col = ((jnp.arange(bs) + start) < n_train).astype(Y.dtype)
         y_bb = jax.lax.dynamic_slice_in_dim(Y, start, bs, axis=0)
@@ -201,7 +264,8 @@ def _krr_fit_fused(X, Y, order, gamma: float, lam: float, bs: int,
 
 @functools.lru_cache(maxsize=8)
 def _krr_mesh_program(mesh, gamma: float, lam: float, bs: int,
-                      n_train: int, num_blocks: int):
+                      n_train: int, num_blocks: int,
+                      kdtype: str = "f32"):
     """Build (and cache) the shard_map sweep program for one (mesh, fit
     geometry). The cache makes checkpointed fits — which dispatch this
     program once per order *segment* — reuse one traced callable, so
@@ -233,9 +297,9 @@ def _krr_mesh_program(mesh, gamma: float, lam: float, bs: int,
             valid_col = ((jnp.arange(bs) + start) < n_train).astype(y_local.dtype)
 
             K_local = _gaussian_block(
-                x_local, Xb, local_norms, nb, gamma, False
+                x_local, Xb, local_norms, nb, gamma, False, kdtype
             ) * (valid_local[:, None] * valid_col[None, :])
-            K_bb = _gaussian_block(Xb, Xb, nb, nb, gamma, False) * (
+            K_bb = _gaussian_block(Xb, Xb, nb, nb, gamma, False, kdtype) * (
                 valid_col[:, None] * valid_col[None, :]
             )
 
@@ -293,7 +357,8 @@ def _krr_mesh_program(mesh, gamma: float, lam: float, bs: int,
 
 
 def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
-                        n_train: int, num_blocks: int, mesh, stack0=None):
+                        n_train: int, num_blocks: int, mesh, stack0=None,
+                        kdtype: str = "f32"):
     """The whole KRR training sweep as ONE shard_map program over the mesh's
     ``data`` axis — the multi-device form of :func:`_krr_fit_fused`, so
     sharded fits keep the single-dispatch speed story instead of a host
@@ -313,7 +378,7 @@ def _krr_fit_fused_mesh(X, Y, order, gamma: float, lam: float, bs: int,
     if stack0 is None:
         stack0 = jnp.zeros((num_blocks, bs, Y.shape[1]), dtype=Y.dtype)
     program = _krr_mesh_program(
-        mesh, float(gamma), float(lam), bs, int(n_train), num_blocks
+        mesh, float(gamma), float(lam), bs, int(n_train), num_blocks, kdtype
     )
     return program(X, Y, order, stack0)
 
@@ -489,13 +554,14 @@ class KernelRidgeRegression(LabelEstimator):
                     Y = jnp.pad(Y, ((0, extra), (0, 0)))
 
             gamma_f, lam_f = float(self.kernel_generator.gamma), float(self.lam)
+            kdtype = getattr(self.kernel_generator, "kernel_dtype", "f32")
 
             def run_segment(seg, stack0):
                 """One dispatch over a slice of the block order."""
                 if multi_device:
                     return _krr_fit_fused_mesh(
                         X, Y, seg, gamma_f, lam_f, bs, int(n_train),
-                        num_blocks, data.mesh, stack0=stack0,
+                        num_blocks, data.mesh, stack0=stack0, kdtype=kdtype,
                     )
                 from keystone_tpu.ops import pallas_ops
 
@@ -510,6 +576,7 @@ class KernelRidgeRegression(LabelEstimator):
                 _, w_stack = _krr_fit_fused(
                     X, Y, seg, gamma_f, lam_f, bs, int(n_train), num_blocks,
                     pallas_ops.pallas_direct_ok(X), carry0=carry0,
+                    kdtype=kdtype,
                 )
                 return w_stack
 
@@ -602,7 +669,8 @@ class KernelRidgeRegression(LabelEstimator):
             f"gamma={float(self.kernel_generator.gamma)!r} "
             f"lam={float(self.lam)!r} epochs={self.num_epochs} "
             f"permuter={self.block_permuter!r} "
-            f"dtypes={X.dtype}/{Y.dtype}"
+            f"dtypes={X.dtype}/{Y.dtype} "
+            f"kdtype={getattr(self.kernel_generator, 'kernel_dtype', 'f32')}"
         )
         h.update(spec.encode())
         idx = np.unique(

@@ -164,7 +164,9 @@ def gaussian_kernel_block(
     X: (m, d), Y: (n, d), x_norms: (m,), y_norms: (n,). The distance matrix
     is never materialized in HBM — the norm-broadcast + exp epilogue runs on
     the accumulator tile in VMEM (reference computes the same algebra
-    unfused: KernelGenerator.scala:121-205).
+    unfused: KernelGenerator.scala:121-205). (The bf16x3 / Precision.HIGH
+    kernel mode lives on the XLA path only — Mosaic has no 3-pass dot
+    lowering; see kernel.py::_gaussian_block.)
     """
     X = jnp.asarray(X, dtype=jnp.float32)
     Y = jnp.asarray(Y, dtype=jnp.float32)

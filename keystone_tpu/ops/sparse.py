@@ -21,7 +21,9 @@ random-access rate — 129M indices/s on the raw column-take microbenchmark,
 179M/s inside the full LBFGS solve (bench.py's amazon row, round 3; earlier
 rounds' 65M/s figure predates the per-column layouts) — which is the honest
 TPU trade-off for this workload class: the sparse tier is a *capacity* play
-(the dense matrix would be 131 GB), not a FLOP play. A
+(dense f32 would be 131 GB at n=2e6 and ~4.3 TB at the full n=65e6; the
+COO itself is ~43 GB at n=65e6 — int16+bf16 compression and the streamed
+gram tier below are what actually cross that wall), not a FLOP play. A
 transposed-layout gather variant and a complex-packed gather were measured
 and do not beat the scatter, so the simple formulations stay. Layout rule
 learned the hard way: never put a tiny label dimension minor-most in a big
@@ -209,6 +211,83 @@ def sparse_matmul_t(indices, values, V, d: int):
         (safe_p, vals_p, V_p),
     )
     return out[:d]
+
+
+def gram_pad_dim(d: int, val_dtype) -> int:
+    """Column padding for :func:`sparse_gram_stream`'s dense slabs: round d
+    up to the accumulating-syrk column tile (zero columns contribute zero
+    Gramian rows/cols, and zero-initialized solver blocks stay exactly
+    zero, so callers may solve on the padded shape and slice)."""
+    tile = 1024 if jnp.dtype(val_dtype) == jnp.bfloat16 else 512
+    return -(-d // tile) * tile
+
+
+def sparse_gram_stream(
+    chunk_fn,
+    num_chunks: int,
+    d: int,
+    k: int,
+    use_pallas: bool = False,
+    val_dtype=jnp.float32,
+):
+    """Fold (G = AᵀA, AᵀY, ΣY²) over padded-COO row chunks — the sparse
+    arm of the out-of-core streaming tier (parallel/streaming.py).
+
+    ``chunk_fn(cid)`` returns ``(indices (c, w) int, values (c, w), Y
+    (c, k))`` for chunk ``cid`` — sliced from resident (possibly
+    int16/bf16-compressed) buffers, or REGENERATED/loaded per chunk so the
+    full dataset never exists on device. Negative indices are inactive
+    lanes.
+
+    Each chunk is DENSIFIED into a (c, d_pad) slab and folded through the
+    accumulating symmetric Pallas kernel. Deliberately so: at TPU rates —
+    dense bf16 GEMM ~150 TF/s vs ~2e8 random accesses/s — the ~200
+    "wasted" multiplies per zero at Amazon sparsity (0.005) still beat
+    per-element gather/scatter by an order of magnitude for AᵀA, and the
+    L-BFGS iterations on the folded G then cost no data pass at all
+    (ops/learning/lbfgs.py::_lbfgs_gram_core). This is the same
+    per-partition Gramian + treeReduce pattern as the dense tier
+    (BlockWeightedLeastSquares.scala:177-313), with densify-then-syrk as
+    the per-partition kernel.
+
+    Returns (G, AtY, yty) at d_pad = :func:`gram_pad_dim` (slice [:d] to
+    drop the padding). Traceable — call under jit.
+    """
+    from keystone_tpu.ops import pallas_ops
+
+    d_pad = gram_pad_dim(d, val_dtype)
+    G0 = jnp.zeros((d_pad, d_pad), jnp.float32)
+    AtY0 = jnp.zeros((d_pad, k), jnp.float32)
+
+    def body(carry, cid):
+        G, AtY, yty = carry
+        indices, values, Yc = chunk_fn(cid)
+        c, w = indices.shape
+        mask = (indices >= 0) & (indices < d)
+        safe = jnp.where(mask, indices, 0).astype(jnp.int32)
+        vals = jnp.where(mask, values, 0).astype(val_dtype)
+        rows = jnp.broadcast_to(jnp.arange(c)[:, None], (c, w))
+        dense = jnp.zeros((c, d_pad), val_dtype).at[rows, safe].add(vals)
+        if use_pallas and pallas_ops.gram_acc_ok(dense):
+            G = pallas_ops.gram_sym_acc(G, dense)
+        else:
+            G = G + jax.lax.dot_general(
+                dense, dense, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        AtY = AtY + jax.lax.dot_general(
+            dense, Yc.astype(dense.dtype), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        Yf = Yc.astype(jnp.float32)
+        return (G, AtY, yty + jnp.sum(Yf * Yf)), None
+
+    (G, AtY, yty), _ = jax.lax.scan(
+        body, (G0, AtY0, jnp.zeros((), jnp.float32)),
+        jnp.arange(num_chunks),
+    )
+    G = jnp.triu(G) + jnp.triu(G, 1).T
+    return G, AtY, yty
 
 
 @functools.partial(jax.jit, static_argnames=("d",))

@@ -144,6 +144,13 @@ class VectorCombiner(Transformer):
             return Dataset(out, n=data.n, mesh=data.mesh)
         return Dataset.of([self.apply(x) for x in data.to_list()])
 
+    def device_combine_fn(self):
+        """Gather-fusion contract: merge branch ARRAYS inside one program
+        (workflow/fusion.py::GatherFusionRule)."""
+        return lambda arrays: jnp.concatenate(
+            [jnp.asarray(a) for a in arrays], axis=-1
+        )
+
 
 @dataclass(frozen=True)
 class MatrixVectorizer(Transformer):

@@ -372,6 +372,149 @@ def streaming_predict(
     return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block_size", "lam", "num_iter", "mesh", "n_true", "feat_dtype",
+    ),
+)
+def streaming_block_bcd_mesh(
+    X: Array,
+    Y: Array,
+    Wrf: Array,
+    brf: Array,
+    *,
+    block_size: int,
+    lam: float,
+    num_iter: int,
+    mesh,
+    n_true: Optional[int] = None,
+    feat_dtype=jnp.float32,
+) -> Array:
+    """The north-star program: cosine-featurize + block coordinate descent
+    where feature BLOCKS are generated per step and discarded — the plan
+    that runs TIMIT at ~200k feature dims on a v5e-16 (NORTHSTAR.md).
+
+    Rows of X (n_pad, d_in) and Y (n_pad, k) shard over the mesh ``data``
+    axis; the random-feature bank Wrf (d_feat, d_in) / brf (d_feat,)
+    replicates (352 MB at the full 200k×440 — small beside HBM). The whole
+    (epochs × blocks) sweep is ONE shard_map program:
+
+      per block b:  F_b = cos(X_local Wrf_bᵀ + brf_b)   local slab, freed
+                    gram, corr = psum(F_bᵀF_b), psum(F_bᵀR)   ← the ONLY
+                        per-step collective: bs² + bs·k floats over ICI
+                    W_b ← replicated Cholesky solve
+                    R_local ← R_local − F_b ΔW_b
+
+    so the (n × d_feat) feature matrix — 880 GB of bf16 at the full
+    geometry — never exists; the resident working set per device is the
+    raw rows, the residual, one block slab and the epoch-invariant
+    Gramian/factor stash (HBM table in NORTHSTAR.md). Epochs 2+ reuse the
+    stashed factors and pay only featurize + correlation + update.
+
+    Padding rows (``n_true``) are masked AFTER featurization (a zero row
+    featurizes to cos(b) ≠ 0). Returns the (nb, bs, k) block weights,
+    replicated.
+    """
+    axis = mesh_lib.DATA_AXIS
+    d_feat = Wrf.shape[0]
+    d_in = X.shape[1]
+    k = Y.shape[1]
+    if d_feat % block_size:
+        raise ValueError(f"d_feat {d_feat} not divisible by {block_size}")
+    nb = d_feat // block_size
+    n_pad = X.shape[0]
+    num = mesh_lib.axis_size(mesh, axis)
+    ln = n_pad // num
+
+    def body(x_local, y_local, Wrf, brf):
+        lam_t = jnp.asarray(lam, jnp.float32)
+        if n_true is not None and n_true != n_pad:
+            start = jax.lax.axis_index(axis) * ln
+            valid = (
+                (start + jnp.arange(ln)) < n_true
+            ).astype(jnp.float32)[:, None]
+        else:
+            valid = None
+
+        def featurize_block(b):
+            Wb = jax.lax.dynamic_slice(
+                Wrf, (b * block_size, 0), (block_size, d_in)
+            )
+            bb = jax.lax.dynamic_slice(brf, (b * block_size,), (block_size,))
+            F = jnp.cos(x_local @ Wb.T + bb).astype(feat_dtype)
+            if valid is not None:
+                F = F * valid.astype(F.dtype)
+            return F
+
+        def update(b, R, Wst, gram, chol):
+            acc = jnp.promote_types(feat_dtype, jnp.float32)
+            F = featurize_block(b)
+            corr = jax.lax.psum(
+                jax.lax.dot_general(
+                    F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
+                    preferred_element_type=acc,
+                ),
+                axis,
+            )
+            w_old = jax.lax.dynamic_index_in_dim(Wst, b, 0, keepdims=False)
+            rhs = corr + gram @ w_old
+            w_new = _solve_psd(gram, rhs, lam_t, chol=chol)
+            delta = jax.lax.dot_general(
+                F, (w_new - w_old).astype(F.dtype), (((1,), (0,)), ((), ())),
+                preferred_element_type=acc,
+            )
+            R = R - delta.astype(R.dtype)
+            return R, jax.lax.dynamic_update_index_in_dim(Wst, w_new, b, 0)
+
+        def first_step(carry, b):
+            R, Wst, G, C = carry
+            acc = jnp.promote_types(feat_dtype, jnp.float32)
+            F = featurize_block(b)
+            gram = jax.lax.psum(
+                jax.lax.dot_general(
+                    F, F, (((0,), (0,)), ((), ())),
+                    preferred_element_type=acc,
+                ),
+                axis,
+            )
+            chol = _psd_factor(gram, lam_t)
+            R, Wst = update(b, R, Wst, gram, chol)
+            G = jax.lax.dynamic_update_index_in_dim(G, gram, b, 0)
+            C = jax.lax.dynamic_update_index_in_dim(C, chol, b, 0)
+            return (R, Wst, G, C), None
+
+        def later_step(carry, b):
+            R, Wst, G, C = carry
+            gram = jax.lax.dynamic_index_in_dim(G, b, 0, keepdims=False)
+            chol = jax.lax.dynamic_index_in_dim(C, b, 0, keepdims=False)
+            R, Wst = update(b, R, Wst, gram, chol)
+            return (R, Wst, G, C), None
+
+        R0 = y_local.astype(jnp.float32)
+        if valid is not None:
+            R0 = R0 * valid
+        Wst0 = jnp.zeros((nb, block_size, k), jnp.float32)
+        G0 = jnp.zeros((nb, block_size, block_size), jnp.float32)
+        C0 = jnp.zeros((nb, block_size, block_size), jnp.float32)
+        order = jnp.arange(nb)
+        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0), order)
+        if num_iter > 1:
+            def epoch(carry, _):
+                carry, _ = jax.lax.scan(later_step, carry, order)
+                return carry, None
+            carry, _ = jax.lax.scan(epoch, carry, None, length=num_iter - 1)
+        return carry[1]
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(), P()),
+        out_specs=P(),
+        check_vma=False,
+    )(X, Y, Wrf, brf)
+
+
 def gram_stats_mesh(
     X: Array,
     Y: Array,

@@ -35,10 +35,19 @@ import jax
 
 from .env import Prefix
 from .graph import Graph, NodeId, SinkId
+from .operators import GatherTransformerOperator
 from .optimizer import Plan, Rule
-from .pipeline import Transformer
+from .pipeline import LabelEstimator, Transformer
 
-__all__ = ["FusedBatchTransformer", "StageFusionRule", "fusable"]
+__all__ = [
+    "FusedBatchTransformer",
+    "FusedGatherTransformer",
+    "FusedFitEstimator",
+    "StageFusionRule",
+    "GatherFusionRule",
+    "EstimatorFusionRule",
+    "fusable",
+]
 
 
 def fusable(op) -> bool:
@@ -106,6 +115,184 @@ class FusedBatchTransformer(Transformer):
                 data = m.batch_apply(data)
             return data
         return data.map_batch(self._composed)
+
+
+class DeviceFit:
+    """The traceable-fit contract estimators opt into for fit fusion.
+
+    ``fit(F, Y, n_true) -> params`` must be traceable (jittable) on the
+    featurized array; ``build(params) -> Transformer`` runs on host with
+    the concrete params; ``supports(d_feat)`` gates geometry (e.g. block
+    divisibility) before any tracing happens.
+    """
+
+    def __init__(self, fit, build, supports=lambda d: True):
+        self.fit = fit
+        self.build = build
+        self.supports = supports
+
+
+class FusedGatherTransformer(Transformer):
+    """A gather-of-branches + combiner compiled as one program.
+
+    Each branch is a (possibly empty — identity) list of row-local
+    device-fusable transformers applied to the SAME input; the combiner's
+    ``device_combine_fn`` merges the branch outputs (e.g. VectorCombiner's
+    concat). The batch path is one jit: branch intermediates never
+    round-trip HBM between programs, and XLA schedules the branches inside
+    one computation (the gather's per-branch dispatch waves disappear —
+    the tree analog of :class:`FusedBatchTransformer`'s chains).
+    """
+
+    def __init__(self, branches: Sequence[Sequence[Transformer]], combiner):
+        if not branches:
+            raise ValueError("gather fusion needs at least one branch")
+        for br in branches:
+            for m in br:
+                if not isinstance(m, Transformer) or m.device_fn() is None:
+                    raise ValueError(f"branch member {m!r} is not fusable")
+        if getattr(combiner, "device_combine_fn", None) is None or (
+            combiner.device_combine_fn() is None
+        ):
+            raise ValueError(f"combiner {combiner!r} has no device_combine_fn")
+        self.branches = [list(b) for b in branches]
+        self.combiner = combiner
+        self._build_composed()
+
+    def _build_composed(self) -> None:
+        branch_fns = [[m.device_fn() for m in br] for br in self.branches]
+        combine = self.combiner.device_combine_fn()
+
+        def composed(X):
+            outs = []
+            for fns in branch_fns:
+                b = X
+                for f in fns:
+                    b = f(b)
+                outs.append(b)
+            return combine(outs)
+
+        self._composed = jax.jit(composed)
+
+    # Same pickling contract as FusedBatchTransformer: jitted closures are
+    # rebuilt on load.
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_composed", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._build_composed()
+
+    @property
+    def label(self) -> str:
+        inner = " | ".join(
+            " > ".join(m.label for m in br) or "id" for br in self.branches
+        )
+        return f"FusedGather[{inner} -> {self.combiner.label}]"
+
+    def device_fn(self):
+        return self._composed
+
+    def apply(self, x):
+        outs = []
+        for br in self.branches:
+            b = x
+            for m in br:
+                b = m.apply(b)
+            outs.append(b)
+        return self.combiner.apply(tuple(outs))
+
+    def batch_apply(self, data):
+        if data.is_host:
+            branch_out = []
+            for br in self.branches:
+                d = data
+                for m in br:
+                    d = m.batch_apply(d)
+                branch_out.append(d)
+            gathered = GatherTransformerOperator().batch_transform(branch_out)
+            return self.combiner.batch_apply(gathered)
+        return data.map_batch(self._composed)
+
+
+class FusedFitEstimator(LabelEstimator):
+    """An estimator fit fused with its upstream featurize program.
+
+    Wraps a LabelEstimator exposing ``device_fit_fn()`` (a ``DeviceFit``
+    with traceable ``fit(F, Y, n_true) -> params``, host ``build(params)
+    -> Transformer`` and ``supports(d_feat) -> bool``) together with the
+    device-fusable transformer(s) feeding it. ``fit`` then compiles
+    featurize + solve into ONE program — the feature matrix never
+    materializes between them (the pipeline form of the bench's hand-fused
+    featurize+BCD region). Falls back to the sequential path for host
+    datasets, multi-device meshes, or unsupported geometry.
+    """
+
+    def __init__(self, members: Sequence[Transformer], est):
+        self.members = list(members)
+        self.est = est
+        # (n_true, input shape/dtype) -> jitted featurize+fit program. The
+        # rule memoizes FusedFitEstimator instances, so a λ-sweep refitting
+        # the same geometry reuses ONE compiled program instead of paying
+        # the multi-second featurize+solve compile per fit (the same trap
+        # _gram_streamed_program documents in ops/learning/lbfgs.py).
+        self._programs: Dict[tuple, object] = {}
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state["_programs"] = {}  # jitted closures are not picklable
+        return state
+
+    @property
+    def label(self) -> str:
+        inner = " > ".join(m.label for m in self.members)
+        return f"FusedFit[{inner} -> {self.est.label}]"
+
+    @property
+    def weight(self) -> int:
+        return getattr(self.est, "weight", 1)
+
+    def _fallback(self, data, labels):
+        for m in self.members:
+            data = m.batch_apply(data)
+        return self.est.fit(data, labels)
+
+    def fit(self, data, labels):
+        dev = self.est.device_fit_fn()
+        multi = data.mesh is not None and any(
+            s > 1 for s in dict(data.mesh.shape).values()
+        )
+        if dev is None or data.is_host or labels.is_host or multi:
+            return self._fallback(data, labels)
+        fns = [m.device_fn() for m in self.members]
+        X = data.array
+        d_feat = int(
+            jax.eval_shape(lambda a: _compose(fns, a), X).shape[-1]
+        )
+        if not dev.supports(d_feat):
+            return self._fallback(data, labels)
+        n_true = int(data.n)
+
+        key = (n_true, X.shape, str(X.dtype))
+        fused = self._programs.get(key)
+        if fused is None:
+
+            @jax.jit
+            def fused(X, Y):
+                return dev.fit(_compose(fns, X), Y, n_true)
+
+            self._programs[key] = fused
+
+        params = fused(X, labels.array)
+        return dev.build(params)
+
+
+def _compose(fns, X):
+    for f in fns:
+        X = f(X)
+    return X
 
 
 def _consumers(plan: Graph) -> Dict[NodeId, List]:
@@ -210,4 +397,151 @@ class StageFusionRule(Rule):
             for n in chain[:-1]:
                 plan = plan.remove_node(n)
 
+        return plan, prefixes
+
+
+class GatherFusionRule(Rule):
+    """Fuse gather(branch...) -> combiner trees into one program.
+
+    Applies when: a :class:`GatherTransformerOperator` node's single
+    consumer is a combiner exposing ``device_combine_fn``; every branch
+    feeding the gather is the common input itself (identity branch) or a
+    device-fusable node consumed only by the gather; and all branches hang
+    off ONE common dependency. Runs after :class:`StageFusionRule`, so
+    multi-node branches have already collapsed to single fused nodes.
+    """
+
+    def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        consumers = _consumers(plan)
+        for node in sorted(plan.nodes, key=lambda n: n.id):
+            if node not in plan.nodes:  # removed by an earlier rewrite
+                continue
+            op = plan.get_operator(node)
+            if not isinstance(op, GatherTransformerOperator):
+                continue
+            outs = consumers.get(node, [])
+            if len(outs) != 1 or isinstance(outs[0], SinkId):
+                continue
+            comb_node = outs[0]
+            comb = plan.get_operator(comb_node)
+            if (
+                getattr(comb, "device_combine_fn", None) is None
+                or comb.device_combine_fn() is None
+                or comb_node in prefixes
+                or node in prefixes
+            ):
+                continue
+            tails = plan.get_dependencies(node)
+            if not tails:
+                continue
+            branches, common = [], None
+            ok = True
+            for t in tails:
+                if isinstance(t, NodeId):
+                    top = plan.get_operator(t)
+                    if (
+                        not fusable(top)
+                        or t in prefixes
+                        or len(plan.get_dependencies(t)) != 1
+                        or consumers.get(t, []) != [node]
+                    ):
+                        ok = False
+                        break
+                    dep = plan.get_dependencies(t)[0]
+                    members = (
+                        top.members
+                        if isinstance(top, FusedBatchTransformer)
+                        else [top]
+                    )
+                else:
+                    dep, members = t, []  # identity branch off the source
+                if common is None:
+                    common = dep
+                elif dep != common:
+                    ok = False
+                    break
+                branches.append(members)
+            if not ok or common is None:
+                continue
+            fused = FusedGatherTransformer(branches, comb)
+            plan = plan.set_operator(comb_node, fused)
+            plan = plan.set_dependencies(comb_node, [common])
+            plan = plan.remove_node(node)
+            for t in tails:
+                if isinstance(t, NodeId):
+                    plan = plan.remove_node(t)
+            consumers = _consumers(plan)
+        return plan, prefixes
+
+
+class EstimatorFusionRule(Rule):
+    """Fuse an estimator fit with the device-fusable node feeding it.
+
+    Applies when a LabelEstimator node exposing ``device_fit_fn()`` takes
+    its DATA input from a fusable transformer whose only consumer is this
+    estimator (and which is not prefix-published). The featurize + solve
+    then compile as one program (:class:`FusedFitEstimator`) — the
+    pipeline-level form of the manually fused featurize+BCD bench region.
+    Runs after Stage/Gather fusion so the upstream is a single node.
+
+    Fused estimators are memoized by (member, estimator) identity — the
+    same policy as StageFusionRule — so a λ-sweep re-optimizing graphs
+    built from the same node objects reuses ONE FusedFitEstimator, whose
+    per-geometry compiled program cache then hits across fits.
+    """
+
+    _CACHE_MAX = 64
+
+    def __init__(self) -> None:
+        self._cache: Dict[tuple, FusedFitEstimator] = {}
+
+    def _fused(self, members, est) -> FusedFitEstimator:
+        key = tuple(id(o) for o in members) + (id(est),)
+        hit = self._cache.get(key)
+        if hit is not None and hit.est is est and all(
+            a is b for a, b in zip(hit.members, members)
+        ):
+            return hit
+        fused = FusedFitEstimator(members, est)
+        if len(self._cache) >= self._CACHE_MAX:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = fused
+        return fused
+
+    def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        consumers = _consumers(plan)
+        for node in sorted(plan.nodes, key=lambda n: n.id):
+            if node not in plan.nodes:  # removed by an earlier rewrite
+                continue
+            op = plan.get_operator(node)
+            if getattr(op, "device_fit_fn", None) is None:
+                continue
+            try:
+                if op.device_fit_fn() is None:
+                    continue
+            except Exception:
+                continue
+            deps = plan.get_dependencies(node)
+            if len(deps) != 2:
+                continue
+            dnode = deps[0]
+            if not isinstance(dnode, NodeId) or dnode in prefixes:
+                continue
+            dop = plan.get_operator(dnode)
+            if not fusable(dop) or len(plan.get_dependencies(dnode)) != 1:
+                continue
+            if consumers.get(dnode, []) != [node]:
+                continue
+            members = (
+                dop.members
+                if isinstance(dop, FusedBatchTransformer)
+                else [dop]
+            )
+            fused = self._fused(members, op)
+            plan = plan.set_operator(node, fused)
+            plan = plan.set_dependencies(
+                node, [plan.get_dependencies(dnode)[0], deps[1]]
+            )
+            plan = plan.remove_node(dnode)
+            consumers = _consumers(plan)
         return plan, prefixes

@@ -109,6 +109,12 @@ def _make_stage_fusion():
     return StageFusionRule()
 
 
+def _make_tree_fit_fusion():
+    from .fusion import EstimatorFusionRule, GatherFusionRule
+
+    return [GatherFusionRule(), EstimatorFusionRule()]
+
+
 class DefaultOptimizer(Optimizer):
     """Standard batches: saved-state load, CSE to fixpoint, node-level optimization
     (reference: workflow/DefaultOptimizer.scala:8-14)."""
@@ -136,8 +142,11 @@ class DefaultOptimizer(Optimizer):
             Batch("Node Level Optimization", Once(), [NodeOptimizationRule()]),
             # TPU-specific: compile chains of row-local device transformers
             # into one XLA program (workflow/fusion.py). Runs last so CSE /
-            # prefix extraction see the original node granularity.
+            # prefix extraction see the original node granularity. Gather
+            # trees and trailing estimator fits fuse after the chains have
+            # collapsed.
             Batch("Stage Fusion", Once(), [_make_stage_fusion()]),
+            Batch("Tree & Fit Fusion", Once(), _make_tree_fit_fusion()),
         ]
 
 
@@ -170,4 +179,5 @@ class AutoCachingOptimizer(Optimizer):
             # After cache placement: cached/prefix nodes are excluded from
             # chains, so fusion never hides a materialization point.
             Batch("Stage Fusion", Once(), [_make_stage_fusion()]),
+            Batch("Tree & Fit Fusion", Once(), _make_tree_fit_fusion()),
         ]

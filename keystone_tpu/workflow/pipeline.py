@@ -461,6 +461,14 @@ class Estimator(EstimatorOperator, Generic[A, B]):
 class LabelEstimator(EstimatorOperator, Generic[A, B, L]):
     """Fits a Transformer from a dataset plus labels (LabelEstimator.scala:13-100)."""
 
+    def device_fit_fn(self):
+        """Fit-fusion contract: return a ``workflow.fusion.DeviceFit``
+        (traceable fit + host model builder + geometry gate) to let the
+        optimizer compile upstream featurization INTO this fit as one
+        program, or None (default) to keep the materialized-features
+        path."""
+        return None
+
     def fit(self, data: Dataset, labels: Dataset) -> Transformer[A, B]:
         raise NotImplementedError
 

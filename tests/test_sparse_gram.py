@@ -1,0 +1,178 @@
+"""Sparse gram-engine LBFGS vs the gather-path oracle.
+
+The gram engine folds G = AᵀA once over densified row chunks and runs the
+SAME L-BFGS iterates against G (hvp = GP/n + λP ≡ Aᵀ(AP)/n + λP), so the
+two solvers must agree to summation-order noise. Also pins the
+compressed-COO resident format (int16 indices + bf16 values — 4 bytes/nnz)
+through the same fit.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.lbfgs import (
+    SparseLBFGSwithL2,
+    run_lbfgs_gram_streamed,
+)
+from keystone_tpu.ops.sparse import gram_pad_dim, sparse_gram_stream
+
+N, D, W_NNZ, K = 3000, 200, 12, 3
+
+
+def _problem(seed=0, idx_dtype=np.int32, val_dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, D, size=(N, W_NNZ)).astype(idx_dtype)
+    vals = rng.normal(size=(N, W_NNZ)).astype(val_dtype)
+    labels = rng.integers(0, K, size=N)
+    Y = (2.0 * np.eye(K)[labels] - 1.0).astype(np.float32)
+    ds = Dataset(
+        {"indices": jnp.asarray(idx), "values": jnp.asarray(vals)}, n=N
+    )
+    return ds, Dataset.of(jnp.asarray(Y)), idx, vals, Y
+
+
+class TestSparseGramStream:
+    def test_gram_matches_dense_oracle(self):
+        _, _, idx, vals, Y = _problem()
+        dense = np.zeros((N, D), np.float64)
+        np.add.at(dense, (np.arange(N)[:, None], idx), vals)
+
+        c = 512
+        nchunks = -(-N // c)
+        pad = nchunks * c - N
+        idx_t = jnp.asarray(
+            np.pad(idx, ((0, pad), (0, 0)), constant_values=-1)
+        ).reshape(nchunks, c, W_NNZ)
+        val_t = jnp.asarray(np.pad(vals, ((0, pad), (0, 0)))).reshape(
+            nchunks, c, W_NNZ
+        )
+        Y_t = jnp.asarray(np.pad(Y, ((0, pad), (0, 0)))).reshape(
+            nchunks, c, K
+        )
+        import jax
+
+        G, AtY, yty = jax.jit(
+            lambda a, b, y: sparse_gram_stream(
+                lambda cid: (a[cid], b[cid], y[cid]), nchunks, D, K
+            )
+        )(idx_t, val_t, Y_t)
+        d_pad = gram_pad_dim(D, jnp.float32)
+        assert G.shape == (d_pad, d_pad)
+        np.testing.assert_allclose(
+            np.asarray(G)[:D, :D], dense.T @ dense, rtol=2e-4, atol=2e-3
+        )
+        # Padding rows/cols of G and AtY are exactly zero.
+        assert np.all(np.asarray(G)[D:, :] == 0)
+        assert np.all(np.asarray(AtY)[D:, :] == 0)
+        np.testing.assert_allclose(
+            np.asarray(AtY)[:D], dense.T @ Y, rtol=2e-4, atol=2e-3
+        )
+        np.testing.assert_allclose(float(yty), (Y * Y).sum(), rtol=1e-6)
+
+    def test_duplicate_indices_accumulate(self):
+        # COO rows may repeat a column; densify must add, not overwrite.
+        idx = jnp.asarray([[1, 1, 3]], dtype=jnp.int32)
+        vals = jnp.asarray([[2.0, 3.0, 4.0]], dtype=jnp.float32)
+        Y = jnp.asarray([[1.0]], dtype=jnp.float32)
+        import jax
+
+        G, AtY, _ = jax.jit(
+            lambda a, b, y: sparse_gram_stream(
+                lambda cid: (a, b, y), 1, 8, 1
+            )
+        )(idx, vals, Y)
+        dense = np.zeros(8)
+        dense[1], dense[3] = 5.0, 4.0
+        np.testing.assert_allclose(
+            np.asarray(G)[:8, :8], np.outer(dense, dense), atol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(AtY)[:8, 0], dense, atol=1e-5)
+
+
+class TestGramSolverMatchesGather:
+    def test_same_model_as_gather_path(self):
+        ds, ys, *_ = _problem()
+        m_gather = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=25, num_features=D
+        ).fit(ds, ys)
+        m_gram = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=25, num_features=D, solver="gram",
+            gram_chunk_rows=512,
+        ).fit(ds, ys)
+        np.testing.assert_allclose(
+            np.asarray(m_gram.x), np.asarray(m_gather.x), rtol=5e-3,
+            atol=5e-4,
+        )
+        np.testing.assert_allclose(
+            np.asarray(m_gram.b_opt), np.asarray(m_gather.b_opt),
+            rtol=5e-3, atol=5e-4,
+        )
+        # Predictions agree tightly (the model difference is fp noise).
+        ds2, *_ = _problem(seed=5)[:1], None
+        p1 = np.asarray(m_gather.batch_apply(ds).array)
+        p2 = np.asarray(m_gram.batch_apply(ds).array)
+        np.testing.assert_allclose(p2, p1, rtol=1e-2, atol=1e-3)
+
+    def test_compressed_int16_bf16_storage(self):
+        # 4-bytes-per-nnz resident format: int16 indices + bf16 values.
+        ds16, ys, idx, vals, Y = _problem(
+            idx_dtype=np.int16, val_dtype=np.float32
+        )
+        ds16 = Dataset(
+            {
+                "indices": jnp.asarray(idx.astype(np.int16)),
+                "values": jnp.asarray(vals).astype(jnp.bfloat16),
+            },
+            n=N,
+        )
+        m16 = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=25, num_features=D, solver="gram",
+            gram_chunk_rows=512,
+        ).fit(ds16, ys)
+        ds32, _, _, _, _ = _problem()
+        m32 = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=25, num_features=D
+        ).fit(ds32, ys)
+        # bf16 values quantize the data itself (~0.4% relative), so the
+        # tolerance is bf16-resolution, not fp32-noise.
+        np.testing.assert_allclose(
+            np.asarray(m16.x), np.asarray(m32.x), rtol=0.05, atol=0.02
+        )
+
+    def test_streamed_regenerated_chunks(self):
+        # Chunks produced by a generator (nothing resident) must equal the
+        # resident fit on the same data.
+        import jax
+
+        ds, ys, idx, vals, Y = _problem()
+        c = 500
+        nchunks = N // c
+
+        idx_t = jnp.asarray(idx).reshape(nchunks, c, W_NNZ)
+        val_t = jnp.asarray(vals).reshape(nchunks, c, W_NNZ)
+        Y_t = jnp.asarray(Y).reshape(nchunks, c, K)
+
+        W_s, loss = run_lbfgs_gram_streamed(
+            lambda cid, it, vt, yt: (it[cid], vt[cid], yt[cid]),
+            nchunks, D, K, lam=1e-3, num_iterations=25, n=N,
+            operands=(idx_t, val_t, Y_t),
+        )
+        m_gather = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=25, num_features=D
+        ).fit(ds, ys)
+        # No intercept lane in this direct call: compare to gather WITHOUT
+        # intercept by refitting through run_lbfgs on the raw COO.
+        from keystone_tpu.ops.learning.lbfgs import run_lbfgs
+
+        W_ref = run_lbfgs(
+            {"indices": jnp.asarray(idx), "values": jnp.asarray(vals)},
+            jnp.asarray(Y), lam=1e-3, num_iterations=25, n=N,
+            W_init=jnp.zeros((D, K), jnp.float32),
+        )
+        assert np.isfinite(float(loss))
+        np.testing.assert_allclose(
+            np.asarray(W_s), np.asarray(W_ref), rtol=5e-3, atol=5e-4
+        )
