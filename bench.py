@@ -21,9 +21,13 @@ Additional metrics ride in detail.additional_metrics:
   - amazon_fulln_streamed_gram: the REAL n=65e6 Amazon row, streamed
     (chunks never all resident), vs the literal 52.29 s — no n-scaling.
   - krr_cifar_kernel_geometry: RandomPatchCifarKernel's KRR solver shape
-    (no reference timing exists; absolute + MFU only).
-  - mnist_random_fft_end_to_end: the README example geometry end-to-end
-    (no reference timing exists; absolute + MFU only).
+    through the bf16x3 AND f32 kernel engines (no reference timing
+    exists; absolute + MFU + cross-engine quality delta).
+  - mnist_random_fft_end_to_end: the README example geometry end-to-end,
+    with a featurize/solve/executor phase split.
+  - autocache_on_chip: three measured wall-clocks (no-cache / greedy
+    under a 3 GB budget / aggressive) for a reused featurize chain.
+  - stupidbackoff_batch_scoring: vectorized LM serving vs the dict loop.
 
 Timing method: the tunneled dev TPU adds ~80-110 ms of per-dispatch
 overhead (HTTP round trip; a real TPU host dispatches in <1 ms), so each
@@ -32,9 +36,10 @@ conservative, used for vs_baseline) and the marginal device time from
 in-program repetition ((t_reps3 - t_reps1) / 2 — what the hardware actually
 spends; used for achieved TFLOP/s + MFU).
 
-Env knobs: BENCH_SCALE (row multiplier), BENCH_PRECISION=bf16|f32,
-BENCH_EPOCHS (BCD epochs, default 3), BENCH_ONLY=timit (skip the extra
-metrics).
+Env knobs: BENCH_N (headline rows, default the REAL 2.2e6),
+BENCH_AMAZON_N (default the REAL 65e6), BENCH_SCALE (resident-row
+multiplier), BENCH_PRECISION=bf16|f32, BENCH_EPOCHS (BCD epochs, default
+3), BENCH_ONLY=timit (headline only).
 
 Prints ONE JSON line:
   {"metric": ..., "value": <seconds>, "unit": "s", "vs_baseline": <speedup x>}
@@ -526,8 +531,9 @@ def amazon_sparse_metric():
     Capacity arithmetic (stated, not assumed): n=65e6 × 83 nnz at int32+f32
     is ~43 GB — it does NOT fit 16 GB HBM (round 3 claimed it did; that was
     false). The compressed int16+bf16 COO (4 B/nnz) is ~21.6 GB at n=65e6 —
-    still over; the measured resident ceiling is ~n=36e6 (12.3 GB, probed
-    in amazon_fulln_metric). The full-n row therefore STREAMS — see
+    still over; the measured resident point is n=30e6 (9.8 GB, probed with
+    fit-path folds in amazon_fulln_metric; n=36e6 is past the
+    fold-workspace ceiling). The full-n row therefore STREAMS — see
     amazon_fulln_streamed_gram, which runs the literal n=65e6.
     """
     from keystone_tpu.data import Dataset
@@ -614,7 +620,8 @@ def amazon_fulln_metric():
     overlappable with the ~2-min fold).
 
     Also probes the measured RESIDENT ceiling: allocates the compressed
-    COO at n=36e6 (12.3 GB) and folds two chunks from it in place.
+    COO at n=30e6 (9.8 GB) and folds two chunks from it in place (n=36e6
+    is past the fold-workspace ceiling — the measured cliff).
     """
     from keystone_tpu.ops.learning.lbfgs import run_lbfgs_gram_streamed
     from keystone_tpu.ops import pallas_ops
@@ -627,15 +634,25 @@ def amazon_fulln_metric():
     num_chunks = -(-n_full // c)
     use_pallas = pallas_ops.pallas_enabled()
 
-    def _hash_bits(cid, count, salt):
+    def _hash_bits(cid, shape, salt):
         """Counter-based u32 generator (SplitMix-style multiply-xor): the
         regen stand-in for host I/O must not dominate the fold, and the
         threefry PRNG measures ~1.1 s per 5.4M-element chunk on this chip
         — 10x the chunk's actual densify+syrk work. Synthetic CONTENT does
         not affect GEMM/scatter throughput, so statistical polish buys
         nothing here (tests use jax.random; this generator is bench-local).
+
+        The counter is built from 2-D iotas — a FLAT arange over the
+        element count would create a single dimension past 2^31 at the
+        n=36e6 capacity probe, which overflows TPU s32 indexing and
+        crashes the worker process (observed, round 4).
         """
-        x = jnp.arange(count, dtype=jnp.uint32)
+        rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+        cols = (
+            jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+            if len(shape) > 1 else jnp.zeros(shape, jnp.uint32)
+        )
+        x = rows * jnp.uint32(shape[-1] if len(shape) > 1 else 1) + cols
         x = x + jnp.uint32(2654435761) * jnp.uint32(cid * 2 + salt + 1)
         x = x ^ (x >> 16)
         x = x * jnp.uint32(0x7FEB352D)
@@ -644,11 +661,11 @@ def amazon_fulln_metric():
         return x ^ (x >> 16)
 
     def chunk_fn(cid):
-        bits = _hash_bits(cid, c * nnz, 0).reshape(c, nnz)
+        bits = _hash_bits(cid, (c, nnz), 0)
         idx = (bits % jnp.uint32(d)).astype(jnp.int16)
         # Centered ~unit-variance values from uniform bits (throughput is
         # value-independent; see _hash_bits).
-        u = _hash_bits(cid, c * nnz, 1).reshape(c, nnz)
+        u = _hash_bits(cid, (c, nnz), 1)
         vals = (
             (u >> 8).astype(jnp.float32) * (3.464 / (1 << 24)) - 1.732
         ).astype(jnp.bfloat16)
@@ -666,7 +683,7 @@ def amazon_fulln_metric():
             ],
             axis=1,
         )
-        y = (_hash_bits(cid, c, 2) % jnp.uint32(k)).astype(jnp.int32)
+        y = (_hash_bits(cid, (c,), 2) % jnp.uint32(k)).astype(jnp.int32)
         Y = jnp.where(
             valid[:, None],
             2.0 * jax.nn.one_hot(y, k, dtype=jnp.float32) - 1.0,
@@ -679,18 +696,24 @@ def amazon_fulln_metric():
             chunk_fn, num_chunks, d + 1, k, lam=1e-3,
             num_iterations=iters, n=n_full, use_pallas=use_pallas,
             val_dtype=jnp.bfloat16,
+            # ~1000 chunks is minutes of device time; one dispatch that
+            # long trips the worker watchdog (observed crash) — segment.
+            max_chunks_per_dispatch=128,
         )
         return float(loss)
 
-    loss = run_once()  # warm (compile)
-    assert np.isfinite(loss), f"bad streamed sparse solve: {loss}"
+    # ONE measured run — at ~9 min of device time for the full fold, a
+    # separate warm pass would double the bench's cost to shave the ~1 min
+    # one-time compile out of a row that is about capacity, not speed.
     t0 = time.perf_counter()
-    loss = run_once()  # timed: ONE run (the row costs minutes, not ms)
+    loss = run_once()
     elapsed = time.perf_counter() - t0
+    assert np.isfinite(loss), f"bad streamed sparse solve: {loss}"
 
-    # Resident-capacity probe: allocate the compressed COO at n=36e6
-    # (332 B/row -> 12.3 GB incl. labels) and fold two chunks IN PLACE.
-    n_res = 36_000_000
+    # Resident-capacity probe: allocate the compressed COO at n=30e6
+    # (9.8 GB) and fold two chunks IN PLACE. n=36e6 (11.8 GB) compiles
+    # past the fold workspace's budget and is the measured cliff.
+    n_res = 30_000_000
     resident_ok = False
     if n_full < 10_000_000:
         n_res = 0  # scaled-down smoke runs skip the 12.3 GB probe
@@ -700,8 +723,8 @@ def amazon_fulln_metric():
 
         @jax.jit
         def alloc():
-            bits = _hash_bits(7, n_res * nnz, 0).reshape(n_res, nnz)
-            vb = _hash_bits(7, n_res * nnz, 1).reshape(n_res, nnz)
+            bits = _hash_bits(7, (n_res, nnz), 0)
+            vb = _hash_bits(7, (n_res, nnz), 1)
             return (
                 (bits % jnp.uint32(d)).astype(jnp.int16),
                 ((vb >> 8).astype(jnp.float32) * (2.0 / (1 << 24)) - 1.0
@@ -741,7 +764,12 @@ def amazon_fulln_metric():
             "streamed": (
                 "chunks regenerated device-side per scan step (the I/O "
                 "stand-in; all bench rows exclude input I/O); working set "
-                "~2.3 GB regardless of n"
+                "~2.3 GB regardless of n; 128-chunk dispatch segments"
+            ),
+            "timing": (
+                "single measured run incl. the one-time compile (~1 min "
+                "of ~9); a warm+timed pair would double a row whose claim "
+                "is capacity, not speed"
             ),
             "engine": (
                 "densify-chunk + accumulating MXU syrk -> G, then 20 "
@@ -757,9 +785,10 @@ def amazon_fulln_metric():
                 "hbm_gb": 16,
                 "measured_resident_n": n_res if resident_ok else 0,
                 "measured_resident_note": (
-                    "compressed int16+bf16 COO at n=36e6 (12.3 GB) "
+                    "compressed int16+bf16 COO at n=30e6 (9.8 GB) "
                     "allocated on-chip and fit-path chunk folds run from "
-                    "it in place" if resident_ok else (
+                    "it in place (n=36e6 is past the fold-workspace "
+                    "ceiling - the measured cliff)" if resident_ok else (
                         "probe skipped at scaled-down BENCH_AMAZON_N"
                         if not n_res else "probe failed"
                     )

@@ -193,11 +193,15 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             valid = (
                 jnp.arange(F.shape[0]) < n_true
             ).astype(F.dtype)[:, None]
-            fmean = jnp.sum(F, axis=0) / n_true  # padding rows are zero
+            # Mask BEFORE the mean: inside the fused program the padding
+            # rows of F are featurize(0) — nonzero (cos(b), rectifier
+            # caps, ...) — so an unmasked sum would bias every scaler.
+            F = F * valid
+            fmean = jnp.sum(F, axis=0) / n_true
             # Centering un-zeroes padding rows (0 - mean); re-mask so the
             # solver's zero-padding contract holds.
             Fc = (F - fmean) * valid
-            ymean = jnp.sum(Y, axis=0) / n_true
+            ymean = jnp.sum(Y * valid.astype(Y.dtype), axis=0) / n_true
             Yc = (Y - ymean) * valid.astype(Y.dtype)
             W_stack = linalg.bcd_least_squares_fused_flat(
                 Fc, Yc, bs, lam=self.lam, num_iter=self.num_iter

@@ -307,27 +307,108 @@ def run_lbfgs_gram_streamed(
     use_pallas: bool = False,
     val_dtype=jnp.float32,
     operands=(),
+    max_chunks_per_dispatch: Optional[int] = None,
 ):
     """Streamed sparse ridge fit: fold G = AᵀA over COO chunks ONCE
     (``sparse.sparse_gram_stream`` — chunks may be regenerated/loaded per
     call, so the full dataset never exists on device), then run the SAME
     L-BFGS iterates as the gather path against G at one (d, d)×(d, k)
-    GEMM per iteration. One dispatch. Returns (W (d, k), final_loss).
+    GEMM per iteration. Returns (W (d, k), final_loss).
 
     ``operands``: arrays ``chunk_fn`` slices from, passed as
     ``chunk_fn(cid, *operands)``. Resident buffers MUST ride here — a
     chunk_fn that closes over concrete device arrays embeds them as
     program CONSTANTS (hundreds of MB of HLO at Amazon scale, which the
     remote-compile transport rejects outright).
+
+    ``max_chunks_per_dispatch``: bound the fold's program length. By
+    default the whole fit is ONE dispatch; very long streams (the full
+    n=65e6 Amazon fold is ~1000 chunks ≈ minutes of device time) must be
+    segmented or host-side dispatch watchdogs kill the worker (observed).
+    Segments reuse one compiled fold program (chunk id is a traced
+    operand); chunk ids past ``num_chunks`` in the final ragged segment
+    contribute exactly zero.
     """
     if n is None:
         raise ValueError("streamed fit needs the true row count n")
-    program = _gram_streamed_program(
-        chunk_fn, int(num_chunks), int(d), int(k), float(lam),
-        int(num_iterations), float(convergence_tol), int(n),
+    seg = max_chunks_per_dispatch
+    if seg is None or seg >= num_chunks:
+        program = _gram_streamed_program(
+            chunk_fn, int(num_chunks), int(d), int(k), float(lam),
+            int(num_iterations), float(convergence_tol), int(n),
+            bool(use_pallas), jnp.dtype(val_dtype),
+        )
+        return program(tuple(operands))
+
+    from keystone_tpu.ops.sparse import sparse_gram_init
+
+    fold = _gram_fold_program(
+        chunk_fn, int(num_chunks), int(d), int(k), int(seg),
         bool(use_pallas), jnp.dtype(val_dtype),
     )
-    return program(tuple(operands))
+    solve = _gram_solve_program(
+        int(d), int(k), float(lam), int(num_iterations),
+        float(convergence_tol), int(n), jnp.dtype(val_dtype),
+    )
+    carry = sparse_gram_init(d, k, val_dtype)
+    for cid0 in range(0, int(num_chunks), int(seg)):
+        carry = fold(carry, jnp.asarray(cid0, jnp.int32), tuple(operands))
+        # Drain each segment: queuing many multi-second dispatches
+        # asynchronously is exactly what the segmentation exists to avoid.
+        float(carry[2])
+    return solve(carry)
+
+
+@functools.lru_cache(maxsize=16)
+def _gram_fold_program(chunk_fn, num_chunks, d, k, seg, use_pallas,
+                       val_dtype):
+    """Compiled fold of ``seg`` consecutive chunks into the (G, AtY, yty)
+    carry; the starting chunk id is a traced operand so every segment —
+    including the phantom-padded final one — reuses this one executable.
+    The carry is donated (G is ~1.2 GB at Amazon geometry)."""
+    from keystone_tpu.ops.sparse import sparse_gram_fold
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def fold(carry, cid0, operands):
+        def cf(cid):
+            indices, values, Yc = chunk_fn(cid, *operands)
+            live = cid < num_chunks
+            return (
+                indices,
+                jnp.where(live, values, jnp.zeros_like(values)),
+                jnp.where(live, Yc, jnp.zeros_like(Yc)),
+            )
+
+        return sparse_gram_fold(
+            carry, cid0 + jnp.arange(seg), cf, d, k,
+            use_pallas=use_pallas, val_dtype=val_dtype,
+        )
+
+    return fold
+
+
+@functools.lru_cache(maxsize=16)
+def _gram_solve_program(d, k, lam, num_iterations, convergence_tol, n,
+                        val_dtype):
+    """Compiled finalize + L-BFGS-on-G tail of the segmented fold."""
+    from keystone_tpu.ops.sparse import gram_finalize, gram_pad_dim
+
+    d_pad = gram_pad_dim(d, val_dtype)
+
+    @jax.jit
+    def solve(carry):
+        G, AtY, yty = carry
+        W, loss = _lbfgs_gram_core(
+            gram_finalize(G), AtY, yty,
+            jnp.zeros((d_pad, k), jnp.float32),
+            jnp.asarray(lam, jnp.float32),
+            jnp.asarray(num_iterations),
+            jnp.asarray(convergence_tol, jnp.float32),
+            jnp.asarray(n, jnp.float32),
+        )
+        return W[:d], loss
+
+    return solve
 
 
 @functools.lru_cache(maxsize=16)
@@ -487,7 +568,12 @@ class SparseLBFGSwithL2(LabelEstimator):
 
         from keystone_tpu.ops import pallas_ops
 
-        if self.gram_dtype == "bf16" or val1.dtype == jnp.bfloat16:
+        if self.gram_dtype == "f32":
+            # Explicit f32 wins even over bf16-compressed values: the
+            # slabs upcast losslessly and the syrk runs the exact 6-pass
+            # recipe (the caller is paying for precision on purpose).
+            val_dtype = jnp.float32
+        elif self.gram_dtype == "bf16" or val1.dtype == jnp.bfloat16:
             val_dtype = jnp.bfloat16
         else:
             val_dtype = jnp.float32

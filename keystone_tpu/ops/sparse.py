@@ -251,13 +251,54 @@ def sparse_gram_stream(
     the per-partition kernel.
 
     Returns (G, AtY, yty) at d_pad = :func:`gram_pad_dim` (slice [:d] to
-    drop the padding). Traceable — call under jit.
+    drop the padding). Traceable — call under jit. For dispatch-bounded
+    SEGMENTED folding (long chunk streams must not run as one multi-minute
+    program on hosts with dispatch watchdogs), use :func:`sparse_gram_fold`
+    over cid ranges and :func:`gram_finalize` once at the end.
+    """
+    carry = sparse_gram_fold(
+        None, jnp.arange(num_chunks), chunk_fn, d, k,
+        use_pallas=use_pallas, val_dtype=val_dtype,
+    )
+    G, AtY, yty = carry
+    return gram_finalize(G), AtY, yty
+
+
+def sparse_gram_init(d: int, k: int, val_dtype=jnp.float32):
+    """Zero (G_raw, AtY, yty) carry for :func:`sparse_gram_fold`."""
+    d_pad = gram_pad_dim(d, val_dtype)
+    return (
+        jnp.zeros((d_pad, d_pad), jnp.float32),
+        jnp.zeros((d_pad, k), jnp.float32),
+        jnp.zeros((), jnp.float32),
+    )
+
+
+def gram_finalize(G):
+    """Mirror the accumulated upper triangle into a full symmetric G."""
+    return jnp.triu(G) + jnp.triu(G, 1).T
+
+
+def sparse_gram_fold(
+    carry,
+    cids,
+    chunk_fn,
+    d: int,
+    k: int,
+    use_pallas: bool = False,
+    val_dtype=jnp.float32,
+):
+    """Fold the chunk ids ``cids`` into the (G_raw, AtY, yty) carry.
+
+    ``carry=None`` starts fresh (:func:`sparse_gram_init`). G_raw carries
+    the accumulating-syrk upper-triangle contract — call
+    :func:`gram_finalize` after the LAST fold. Traceable.
     """
     from keystone_tpu.ops import pallas_ops
 
-    d_pad = gram_pad_dim(d, val_dtype)
-    G0 = jnp.zeros((d_pad, d_pad), jnp.float32)
-    AtY0 = jnp.zeros((d_pad, k), jnp.float32)
+    if carry is None:
+        carry = sparse_gram_init(d, k, val_dtype)
+    d_pad = carry[0].shape[0]
 
     def body(carry, cid):
         G, AtY, yty = carry
@@ -282,12 +323,8 @@ def sparse_gram_stream(
         Yf = Yc.astype(jnp.float32)
         return (G, AtY, yty + jnp.sum(Yf * Yf)), None
 
-    (G, AtY, yty), _ = jax.lax.scan(
-        body, (G0, AtY0, jnp.zeros((), jnp.float32)),
-        jnp.arange(num_chunks),
-    )
-    G = jnp.triu(G) + jnp.triu(G, 1).T
-    return G, AtY, yty
+    carry, _ = jax.lax.scan(body, carry, cids)
+    return carry
 
 
 @functools.partial(jax.jit, static_argnames=("d",))

@@ -295,6 +295,35 @@ def _compose(fns, X):
     return X
 
 
+
+class _IdentityMemo:
+    """Bounded memo keyed by the object identities of its constituents.
+
+    Shared by every fusion rule: re-optimizing a graph built from the same
+    node objects (the normal case — pipelines are re-applied with the same
+    operators) must return the SAME fused wrapper, so its jitted program
+    compiles once instead of once per apply (~4.5 s per miss at the
+    MnistRandomFFT geometry). id() keys alone are unsafe — an evicted
+    entry's ids can be recycled by the allocator — so hits re-verify every
+    constituent with `is` against the live objects the cached value holds.
+    """
+
+    def __init__(self, max_entries: int = 64):
+        self._cache: Dict[tuple, object] = {}
+        self._max = max_entries
+
+    def get(self, key_objs, verify, build):
+        key = tuple(id(o) for o in key_objs)
+        hit = self._cache.get(key)
+        if hit is not None and verify(hit):
+            return hit
+        value = build()
+        if len(self._cache) >= self._max:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = value
+        return value
+
+
 def _consumers(plan: Graph) -> Dict[NodeId, List]:
     out: Dict[NodeId, List] = {}
     for node, deps in plan.dependencies.items():
@@ -319,27 +348,16 @@ class StageFusionRule(Rule):
     a fresh closure every optimization pass.
     """
 
-    _CACHE_MAX = 64
-
     def __init__(self) -> None:
-        # key: tuple of member object ids; value keeps the members alive so
-        # the ids cannot be recycled while the entry exists. Bounded FIFO —
-        # sessions building many distinct pipelines (sweeps) must not pin
-        # executables forever.
-        self._cache: Dict[tuple, FusedBatchTransformer] = {}
+        self._memo = _IdentityMemo()
 
     def _fused(self, ops) -> FusedBatchTransformer:
-        key = tuple(id(o) for o in ops)
-        hit = self._cache.get(key)
-        if hit is not None and all(
-            a is b for a, b in zip(hit.members, ops)
-        ):
-            return hit
-        fused = FusedBatchTransformer(ops)
-        if len(self._cache) >= self._CACHE_MAX:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[key] = fused
-        return fused
+        return self._memo.get(
+            ops,
+            lambda hit: len(hit.members) == len(ops)
+            and all(a is b for a, b in zip(hit.members, ops)),
+            lambda: FusedBatchTransformer(ops),
+        )
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
         consumers = _consumers(plan)
@@ -409,7 +427,35 @@ class GatherFusionRule(Rule):
     device-fusable node consumed only by the gather; and all branches hang
     off ONE common dependency. Runs after :class:`StageFusionRule`, so
     multi-node branches have already collapsed to single fused nodes.
+
+    Fused gathers are memoized by (branch members, combiner) identity —
+    same policy as the other fusion rules. Without it every pipeline
+    apply() re-optimizes into a FRESH FusedGatherTransformer whose new
+    jit closure recompiles the whole tree (~4.5 s per apply at the
+    MnistRandomFFT bench geometry — observed as a 27x end-to-end
+    regression before this cache existed).
     """
+
+    def __init__(self) -> None:
+        self._memo = _IdentityMemo()
+
+    def _fused(self, branches, comb) -> FusedGatherTransformer:
+        flat = [m for br in branches for m in br] + [comb]
+
+        def verify(hit):
+            return (
+                hit.combiner is comb
+                and len(hit.branches) == len(branches)
+                and all(
+                    len(ha) == len(ba)
+                    and all(a is b for a, b in zip(ha, ba))
+                    for ha, ba in zip(hit.branches, branches)
+                )
+            )
+
+        return self._memo.get(
+            flat, verify, lambda: FusedGatherTransformer(branches, comb)
+        )
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
         consumers = _consumers(plan)
@@ -463,7 +509,7 @@ class GatherFusionRule(Rule):
                 branches.append(members)
             if not ok or common is None:
                 continue
-            fused = FusedGatherTransformer(branches, comb)
+            fused = self._fused(branches, comb)
             plan = plan.set_operator(comb_node, fused)
             plan = plan.set_dependencies(comb_node, [common])
             plan = plan.remove_node(node)
@@ -490,23 +536,17 @@ class EstimatorFusionRule(Rule):
     per-geometry compiled program cache then hits across fits.
     """
 
-    _CACHE_MAX = 64
-
     def __init__(self) -> None:
-        self._cache: Dict[tuple, FusedFitEstimator] = {}
+        self._memo = _IdentityMemo()
 
     def _fused(self, members, est) -> FusedFitEstimator:
-        key = tuple(id(o) for o in members) + (id(est),)
-        hit = self._cache.get(key)
-        if hit is not None and hit.est is est and all(
-            a is b for a, b in zip(hit.members, members)
-        ):
-            return hit
-        fused = FusedFitEstimator(members, est)
-        if len(self._cache) >= self._CACHE_MAX:
-            self._cache.pop(next(iter(self._cache)))
-        self._cache[key] = fused
-        return fused
+        return self._memo.get(
+            list(members) + [est],
+            lambda hit: hit.est is est
+            and len(hit.members) == len(members)
+            and all(a is b for a, b in zip(hit.members, members)),
+            lambda: FusedFitEstimator(members, est),
+        )
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
         consumers = _consumers(plan)

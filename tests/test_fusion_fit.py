@@ -142,12 +142,19 @@ class TestEstimatorFitFusion:
         np.testing.assert_allclose(got, ref, atol=2e-4, rtol=2e-4)
 
     def test_device_fit_fn_with_padding_rows(self):
-        # Padding rows (mesh zero-padding) must not perturb means or solve.
+        # Padding rows must not perturb means or solve. Inside a FUSED
+        # program the padding rows of F are featurize(0) — NONZERO — so
+        # the padded fixture uses garbage rows, not zeros (a zero-padded
+        # fixture would mask the unmasked-mean bias this test exists for).
         n, pad, d, bs, k = 90, 38, 64, 16, 3
         F = rng.normal(size=(n, d)).astype(np.float32)
         Y = rng.normal(size=(n, k)).astype(np.float32)
-        Fp = jnp.asarray(np.vstack([F, np.zeros((pad, d), np.float32)]))
-        Yp = jnp.asarray(np.vstack([Y, np.zeros((pad, k), np.float32)]))
+        Fp = jnp.asarray(
+            np.vstack([F, 7.0 + rng.normal(size=(pad, d)).astype(np.float32)])
+        )
+        Yp = jnp.asarray(
+            np.vstack([Y, rng.normal(size=(pad, k)).astype(np.float32)])
+        )
         est = BlockLeastSquaresEstimator(bs, 2, 1e-3)
         dev = est.device_fit_fn()
         import jax
