@@ -716,7 +716,7 @@ def amazon_fulln_metric():
     n_res = 30_000_000
     resident_ok = False
     if n_full < 10_000_000:
-        n_res = 0  # scaled-down smoke runs skip the 12.3 GB probe
+        n_res = 0  # scaled-down smoke runs skip the 9.8 GB probe
     try:
         if not n_res:
             raise RuntimeError("probe skipped")

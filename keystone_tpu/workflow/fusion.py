@@ -318,7 +318,9 @@ class _IdentityMemo:
         if hit is not None and verify(hit):
             return hit
         value = build()
-        if len(self._cache) >= self._max:
+        if key not in self._cache and len(self._cache) >= self._max:
+            # Only evict for genuinely NEW keys: a verify-failed overwrite
+            # replaces its own slot and must not drop an unrelated entry.
             self._cache.pop(next(iter(self._cache)))
         self._cache[key] = value
         return value
