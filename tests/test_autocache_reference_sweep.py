@@ -187,3 +187,52 @@ class TestGeneralizeProfiles:
         p = generalize_profiles(1000, samples)
         assert p.ns >= 0.0
         assert p.mem_bytes >= 0
+
+
+class TestProfileMemo:
+    def test_repeat_optimizations_profile_once(self, monkeypatch):
+        # A λ-sweep re-optimizes logically-identical graphs; the greedy
+        # rule must pay the sampled-profiling passes ONCE (memo keyed by
+        # Prefix), or every later fit trails aggressive by a full
+        # profiling pass on chip.
+        import numpy as np
+
+        from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+        from keystone_tpu.ops.stats import CosineRandomFeatures
+        from keystone_tpu.workflow import autocache
+        from keystone_tpu.workflow.optimizer import AutoCachingOptimizer
+
+        calls = []
+        real = autocache.profile_nodes
+
+        def counting(graph, nodes, *a, **k):
+            calls.append(len(nodes))
+            return real(graph, nodes, *a, **k)
+
+        monkeypatch.setattr(autocache, "profile_nodes", counting)
+
+        rng = np.random.default_rng(0)
+        import jax.numpy as jnp
+
+        X = jnp.asarray(rng.normal(size=(256, 16)).astype(np.float32))
+        Y = jnp.asarray(rng.normal(size=(256, 3)).astype(np.float32))
+        data, labels = Dataset.of(X), Dataset.of(Y)
+        crf = CosineRandomFeatures(16, 64, 0.1, seed=0)
+
+        env = PipelineEnv.get_or_create()
+        env.reset()
+        env.set_optimizer(AutoCachingOptimizer(GreedyCache(max_mem_bytes=1 << 24)))
+        try:
+            for lam in (1e-3, 1e-2, 1e-1):
+                pipe = crf.to_pipeline().and_then(
+                    BlockLeastSquaresEstimator(32, 1, lam), data, labels
+                ).fit()
+                pipe.apply(Dataset.of(X[:8])).to_numpy()
+        finally:
+            env.reset()
+
+        profiled_after_first = sum(calls[1:])
+        assert calls, "greedy never profiled"
+        assert profiled_after_first == 0, (
+            "repeat fits re-profiled logically identical nodes", calls
+        )
