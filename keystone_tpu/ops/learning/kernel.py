@@ -65,9 +65,12 @@ def _gaussian_block(X, Xb, x_norms, xb_norms, gamma: float, use_pallas: bool,
 
     cd = jnp.bfloat16 if kdtype == "bf16" else jnp.float32
     # bf16x3 takes the XLA path even when Pallas is available: Mosaic has
-    # no lowering for 3-pass dot precision, and the unfused norm+exp
-    # epilogue costs only ~5% extra HBM traffic here — the GEMM pass count
-    # is what dominates.
+    # no lowering for 3-pass dot precision, and a fused hi/lo-split
+    # Pallas variant MEASURED SLOWER than XLA's 3-pass dot at the bench
+    # geometry (0.265 s vs 0.204 s device — the per-operand hi/lo splits
+    # do not hoist out of the block scan) with worse fit-path noise, so
+    # it was removed; the unfused epilogue costs only ~5% extra HBM
+    # traffic here.
     if use_pallas and kdtype != "bf16x3":
         return pallas_ops.gaussian_kernel_block(
             X, Xb, x_norms, xb_norms, gamma, compute_dtype=cd
