@@ -176,6 +176,30 @@ class TestStreamingEstimatorAPI:
         one = np.asarray(model.apply(np.asarray(X)[0]))
         np.testing.assert_allclose(one, preds[0], atol=1e-4)
 
+    def test_estimator_mesh_branch_matches_single_device(self):
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.streaming_ls import (
+            StreamingFeaturizedLeastSquares,
+        )
+
+        featurize = _featurizer()
+        X, Y = _problem(512, seed=9)
+        mesh = mesh_lib.make_mesh()
+        est = StreamingFeaturizedLeastSquares(
+            featurize, d_feat=D_FEAT, block_size=BLOCK, num_iter=2,
+            lam=LAM, tile_rows=64,
+        )
+        m_one = est.fit(Dataset.of(X), Dataset.of(Y))
+        m_mesh = est.fit(
+            Dataset.of(X).shard(mesh), Dataset.of(Y).shard(mesh)
+        )
+        # Same tolerance as the sibling mesh-parity test: f32 psum/fold
+        # summation-order noise, BCD-amplified.
+        np.testing.assert_allclose(
+            np.asarray(m_mesh.W_stack), np.asarray(m_one.W_stack),
+            atol=2e-3, rtol=2e-3,
+        )
+
     def test_timit_pipeline_streaming_mode(self):
         from keystone_tpu.pipelines.timit import TimitConfig, run
 
