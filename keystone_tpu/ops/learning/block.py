@@ -184,25 +184,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         traceable function, so the optimizer can compile upstream
         featurization INTO it (featurize + solve = ONE program; the
         feature matrix never materializes between dispatches)."""
-        from keystone_tpu.workflow.fusion import DeviceFit
+        from keystone_tpu.workflow.fusion import DeviceFit, masked_center
         from keystone_tpu.ops.stats import StandardScalerModel
 
         bs = self.block_size
 
         def fit_fn(F, Y, n_true: int):
-            valid = (
-                jnp.arange(F.shape[0]) < n_true
-            ).astype(F.dtype)[:, None]
-            # Mask BEFORE the mean: inside the fused program the padding
-            # rows of F are featurize(0) — nonzero (cos(b), rectifier
-            # caps, ...) — so an unmasked sum would bias every scaler.
-            F = F * valid
-            fmean = jnp.sum(F, axis=0) / n_true
-            # Centering un-zeroes padding rows (0 - mean); re-mask so the
-            # solver's zero-padding contract holds.
-            Fc = (F - fmean) * valid
-            ymean = jnp.sum(Y * valid.astype(Y.dtype), axis=0) / n_true
-            Yc = (Y - ymean) * valid.astype(Y.dtype)
+            Fc, Yc, fmean, ymean = masked_center(F, Y, n_true)
             W_stack = linalg.bcd_least_squares_fused_flat(
                 Fc, Yc, bs, lam=self.lam, num_iter=self.num_iter
             )

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -37,6 +38,11 @@ class LinearMapper(Transformer):
 
     def batch_apply(self, data: Dataset) -> Dataset:
         return data.map_batch(self.apply)
+
+    def device_fn(self):
+        """Stage-fusion contract: center-scale + GEMM + intercept as one
+        row-local array function, so apply chains fuse through the model."""
+        return self.apply
 
 
 class SparseLinearMapper(Transformer):
@@ -89,6 +95,31 @@ class LinearMapEstimator(LabelEstimator):
 
     def __init__(self, lam: Optional[float] = None):
         self.lam = lam
+
+    def device_fit_fn(self):
+        """Fit-fusion contract (workflow/fusion.py): mean-centering + the
+        normal-equations solve as one traceable function, so upstream
+        featurization compiles INTO the fit (same pattern as
+        BlockLeastSquaresEstimator.device_fit_fn)."""
+        from keystone_tpu.parallel.linalg import _normal_equations_kernel
+        from keystone_tpu.workflow.fusion import DeviceFit, masked_center
+
+        lam = float(self.lam or 0.0)
+
+        def fit_fn(F, Y, n_true: int):
+            Fc, Yc, fmean, ymean = masked_center(F, Y, n_true)
+            # Same kernel as the materialized-features fit(), so both
+            # paths share one accumulation-precision story.
+            x = _normal_equations_kernel(Fc, Yc.astype(Fc.dtype), lam)
+            return x, fmean, ymean
+
+        def build(params):
+            x, fmean, ymean = params
+            return LinearMapper(
+                x, b_opt=ymean, feature_scaler=StandardScalerModel(fmean)
+            )
+
+        return DeviceFit(fit_fn, build)
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         feature_scaler = StandardScaler(normalize_std_dev=False).fit(data)

@@ -132,6 +132,26 @@ class DeviceFit:
         self.supports = supports
 
 
+def masked_center(F, Y, n_true: int):
+    """Mean-center (F, Y) over the first ``n_true`` rows, masking padding
+    BEFORE the means: inside a fused program padding rows hold
+    featurize(0), which is nonzero in general (cos(b), rectifier caps,
+    intercepts), so an unmasked sum would bias every scaler. Returns
+    (Fc, Yc, fmean, ymean) with padding rows re-zeroed — the solvers'
+    zero-padding contract. Shared by every ``device_fit_fn``.
+    """
+    import jax.numpy as jnp
+
+    valid = (jnp.arange(F.shape[0]) < n_true).astype(F.dtype)[:, None]
+    F = F * valid
+    fmean = jnp.sum(F, axis=0) / n_true
+    Fc = (F - fmean) * valid
+    yvalid = valid.astype(Y.dtype)
+    ymean = jnp.sum(Y * yvalid, axis=0) / n_true
+    Yc = (Y - ymean) * yvalid
+    return Fc, Yc, fmean, ymean
+
+
 class FusedGatherTransformer(Transformer):
     """A gather-of-branches + combiner compiled as one program.
 

@@ -188,3 +188,53 @@ class TestEstimatorFitFusion:
             np.asarray(ref.batch_apply(feats).array),
             atol=1e-5,
         )
+
+
+class TestLinearMapEstimatorDeviceFit:
+    def test_device_fit_matches_fit_with_garbage_padding(self):
+        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+
+        n, pad, d, k = 120, 40, 32, 3
+        F = rng.normal(size=(n, d)).astype(np.float32)
+        Y = rng.normal(size=(n, k)).astype(np.float32)
+        Fp = jnp.asarray(
+            np.vstack([F, 5.0 + rng.normal(size=(pad, d)).astype(np.float32)])
+        )
+        Yp = jnp.asarray(
+            np.vstack([Y, rng.normal(size=(pad, k)).astype(np.float32)])
+        )
+        est = LinearMapEstimator(lam=1e-3)
+        dev = est.device_fit_fn()
+        import jax
+
+        params = jax.jit(dev.fit, static_argnums=2)(Fp, Yp, n)
+        fused_model = dev.build(params)
+        ref_model = est.fit(
+            Dataset.of(jnp.asarray(F)), Dataset.of(jnp.asarray(Y))
+        )
+        probe = Dataset.of(jnp.asarray(F[:32]))
+        np.testing.assert_allclose(
+            np.asarray(fused_model.batch_apply(probe).array),
+            np.asarray(ref_model.batch_apply(probe).array),
+            atol=2e-4, rtol=2e-4,
+        )
+
+    def test_pipeline_fit_fuses_linear_estimator(self):
+        from keystone_tpu.ops.learning.linear import LinearMapEstimator
+        from keystone_tpu.ops.stats import NormalizeRows
+
+        n, d, k = 80, 24, 2
+        X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+        fitted = NormalizeRows().to_pipeline().and_then(
+            LinearMapEstimator(lam=1e-2), Dataset.of(X), Dataset.of(Y)
+        ).fit()
+        preds = np.asarray(fitted.apply(Dataset.of(X)).to_numpy())
+        feats = NormalizeRows().batch_apply(Dataset.of(X))
+        ref = np.asarray(
+            LinearMapEstimator(lam=1e-2)
+            .fit(feats, Dataset.of(Y))
+            .batch_apply(feats)
+            .array
+        )
+        np.testing.assert_allclose(preds, ref, atol=2e-4, rtol=2e-4)
