@@ -1286,7 +1286,28 @@ def main():
             except Exception as e:  # a broken extra must not kill the headline
                 extras.append({"metric": fn.__name__, "error": str(e)[:300]})
         headline["detail"]["additional_metrics"] = extras
+
+    # Full result: committed artifact (the driver's stdout capture keeps only
+    # the LAST ~2000 chars, which round 4's single giant line overflowed —
+    # the headline number physically missing from BENCH_r04.json).
+    full_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_FULL_r05.json")
+    with open(full_path, "w") as f:
+        json.dump(headline, f, indent=1)
     print(json.dumps(headline))
+
+    # Compact headline LAST, so a tail capture always contains
+    # metric/value/vs_baseline/MFU without re-running anything.
+    compact = {
+        "metric": headline["metric"],
+        "value": headline["value"],
+        "unit": headline["unit"],
+        "vs_baseline": headline["vs_baseline"],
+        "mfu": headline.get("detail", {}).get("mfu"),
+        "achieved_tflops": headline.get("detail", {}).get("achieved_tflops"),
+        "full_results": "BENCH_FULL_r05.json",
+    }
+    print(json.dumps(compact))
 
 
 if __name__ == "__main__":

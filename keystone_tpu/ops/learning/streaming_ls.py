@@ -10,9 +10,12 @@ headline path and on the reference's streaming-by-construction substrate
 (CsvDataLoader.scala:10-31 lazy rows; per-partition Gramian accumulation,
 BlockWeightedLeastSquares.scala:177-313).
 
-Semantics match the raw BCD solvers (``linalg.bcd_least_squares``): no
-mean-centering (use ``BlockLeastSquaresEstimator`` when features fit
-residently and centering is wanted).
+Default semantics match ``BlockLeastSquaresEstimator``
+(BlockLinearMapper.scala:224-243): features and labels are mean-centered
+(the column sums accumulate in the same tile pass as the Gramian — a
+rank-1 correction, not a second data pass) and the model carries the
+intercept. ``center=False`` gives the raw-BCD semantics of
+``linalg.bcd_least_squares`` instead.
 """
 
 from __future__ import annotations
@@ -30,23 +33,40 @@ from keystone_tpu.workflow import LabelEstimator, Transformer
 
 
 class StreamingFeaturizedLinearModel(Transformer):
-    """Apply featurize + block weights tile-wise (features never resident)."""
+    """Apply featurize + block weights tile-wise (features never resident).
 
-    def __init__(self, featurize, W_stack, tile_rows: int):
+    A centered fit supplies (fmean, ymean); predictions are then
+    (F − fmean) @ W + ymean, which folds into the single affine offset
+    ymean − fmean @ W_flat — BlockLinearMapper's model shape without a
+    second pass over the features.
+    """
+
+    def __init__(self, featurize, W_stack, tile_rows: int,
+                 fmean=None, ymean=None):
         self.featurize = featurize
         self.W_stack = jnp.asarray(W_stack)
         self.tile_rows = tile_rows
+        self.fmean = None if fmean is None else jnp.asarray(fmean)
+        self.ymean = None if ymean is None else jnp.asarray(ymean)
+        Wf = self.W_stack.reshape(-1, self.W_stack.shape[2])
+        self.offset = (
+            None if self.ymean is None
+            else self.ymean - self.fmean.astype(jnp.float32) @ Wf
+        )
 
     def apply(self, x):
         F = self.featurize(jnp.asarray(x)[None, :])
         Wf = self.W_stack.reshape(-1, self.W_stack.shape[2])
-        return (F.astype(jnp.float32) @ Wf)[0]
+        out = (F.astype(jnp.float32) @ Wf)[0]
+        return out if self.offset is None else out + self.offset
 
     def batch_apply(self, data: Dataset) -> Dataset:
         preds = streaming.streaming_predict(
             jnp.asarray(data.array), self.W_stack, self.featurize,
             self.tile_rows,
         )
+        if self.offset is not None:
+            preds = preds + self.offset
         return Dataset(preds, n=data.n, mesh=data.mesh)._rezero_padding()
 
 
@@ -69,6 +89,7 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
         lam: float = 0.0,
         tile_rows: Optional[int] = None,
         feat_itemsize: int = 4,
+        center: bool = True,
     ):
         self.featurize = featurize
         self.d_feat = d_feat
@@ -78,6 +99,7 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
         self.tile_rows = tile_rows or streaming.pick_tile_rows(
             d_feat, feat_itemsize
         )
+        self.center = center
 
     @property
     def weight(self) -> int:
@@ -89,24 +111,37 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
         multi = data.mesh is not None and any(
             s > 1 for s in dict(data.mesh.shape).values()
         )
+        fmean = ymean = None
         if multi:
-            W = streaming.streaming_bcd_fit_mesh(
-                X, Y, featurize=self.featurize, d_feat=self.d_feat,
+            kw = dict(
+                featurize=self.featurize, d_feat=self.d_feat,
                 tile_rows=min(self.tile_rows, max(X.shape[0] // mesh_lib.axis_size(
                     data.mesh, mesh_lib.DATA_AXIS), 1)),
                 block_size=self.block_size, lam=self.lam,
                 num_iter=self.num_iter, mesh=data.mesh, n_true=data.n,
             )
+            if self.center:
+                W, fmean, ymean = streaming.streaming_bcd_fit_mesh_centered(
+                    X, Y, **kw
+                )
+            else:
+                W = streaming.streaming_bcd_fit_mesh(X, Y, **kw)
         else:
-            W, _, _ = streaming.streaming_bcd_fit(
-                X, Y, featurize=self.featurize, d_feat=self.d_feat,
+            kw = dict(
+                featurize=self.featurize, d_feat=self.d_feat,
                 tile_rows=min(self.tile_rows, X.shape[0]),
                 block_size=self.block_size, lam=self.lam,
                 num_iter=self.num_iter,
                 valid=int(data.n) if data.n != X.shape[0] else None,
             )
+            if self.center:
+                W, fmean, ymean, _ = streaming.streaming_bcd_fit_centered(
+                    X, Y, **kw
+                )
+            else:
+                W, _, _ = streaming.streaming_bcd_fit(X, Y, **kw)
         return StreamingFeaturizedLinearModel(
-            self.featurize, W, self.tile_rows
+            self.featurize, W, self.tile_rows, fmean=fmean, ymean=ymean,
         )
 
 

@@ -755,9 +755,27 @@ class ShardedStupidBackoffModel(Transformer):
     the reference's ``ngramCounts.lookup`` on the partitioned RDD, where
     the partitioner routes each lookup (StupidBackoff.scala:96-125)."""
 
-    def __init__(self, shards: List["StupidBackoffModel"], indexer=None):
+    def __init__(self, shards: List["StupidBackoffModel"], indexer=None,
+                 validate: bool = True):
         self.shards = shards
         self.indexer = indexer or NGramIndexerImpl()
+        # batch_score_packed SUMS per-shard lookups, which is only equal to
+        # the routed lookup when no n-gram lives in two shards — guaranteed
+        # by partition_ngram_pairs but not by a hand-assembled model, where
+        # a duplicate would silently double its count. The check is one
+        # O(total n-grams) pass; shards built by the partitioner may pass
+        # ``validate=False`` to skip it at serving scale.
+        if validate:
+            total = sum(len(s.ngram_counts) for s in shards)
+            union: set = set()
+            for s in shards:
+                union.update(s.ngram_counts)
+            if len(union) != total:
+                raise ValueError(
+                    f"shards overlap: {total - len(union)} n-gram(s) present "
+                    "in more than one shard (partition with "
+                    "partition_ngram_pairs)"
+                )
 
     def _count(self, ngram: NGram) -> int:
         pid = initial_bigram_partition(ngram, len(self.shards), self.indexer)

@@ -467,19 +467,22 @@ class AutoCacheRule(Rule):
         if not to_profile:
             return set()
 
-        # Profile-memo lookup by the HASH of the logical prefix (all
-        # profiled nodes are source-free, so Prefix.find is defined for
-        # them). The hash, not the Prefix itself: a Prefix chain ends in
-        # DatasetOperator leaves that hold the full training arrays, and
-        # keeping those alive for up to _PROFILE_MEMO_MAX entries would be
-        # a multi-GB retention leak for a cache of two floats. Profiles
-        # are advisory (they steer cache placement, never numerics), so a
-        # rare hash collision costs at most a suboptimal plan.
+        # Profile-memo lookup by the HASH of the logical prefix plus a
+        # structural label fingerprint (all profiled nodes are source-free,
+        # so Prefix.find is defined for them). The hash, not the Prefix
+        # itself: a Prefix chain ends in DatasetOperator leaves that hold
+        # the full training arrays, and keeping those alive for up to
+        # _PROFILE_MEMO_MAX entries would be a multi-GB retention leak for
+        # a cache of two floats. The fingerprint (a label string — no
+        # array retention) guards the hash: a collision between chains
+        # with different structure misses instead of silently reusing
+        # another chain's timing profile for the optimizer's lifetime.
         scales_key = (tuple(strategy.partition_scales), strategy.num_trials)
         find_memo: Dict[NodeId, Prefix] = {}
         node_keys: Dict[NodeId, Tuple] = {}
         for n in to_profile:
-            node_keys[n] = (hash(Prefix.find(plan, n, find_memo)), scales_key)
+            p = Prefix.find(plan, n, find_memo)
+            node_keys[n] = (hash(p), _prefix_fingerprint(p), scales_key)
         profiles = {
             n: self._profile_memo[k]
             for n, k in node_keys.items()
@@ -507,6 +510,22 @@ class AutoCacheRule(Rule):
         if max_mem is None:
             max_mem = _default_mem_budget()
         return greedy_cache_set(plan, profiles, max_mem)
+
+
+def _prefix_fingerprint(prefix: Prefix) -> str:
+    """Structural label string of a Prefix chain — cheap to build, retains
+    no operators/arrays, and distinguishes chains whose hashes collide."""
+    memo: Dict[int, str] = {}
+
+    def fp(p: Prefix) -> str:
+        got = memo.get(id(p))
+        if got is None:
+            label = getattr(p.operator, "label", type(p.operator).__name__)
+            got = f"{label}({','.join(fp(d) for d in p.deps)})"
+            memo[id(p)] = got
+        return got
+
+    return fp(prefix)
 
 
 def _default_mem_budget() -> int:

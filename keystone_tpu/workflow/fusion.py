@@ -237,6 +237,11 @@ class FusedGatherTransformer(Transformer):
         return data.map_batch(self._composed)
 
 
+# A handful of entries covers the λ-sweep reuse case; FIFO keeps a refit
+# loop over many geometries from retaining one executable per geometry.
+_FIT_PROGRAM_CACHE_MAX = 8
+
+
 class FusedFitEstimator(LabelEstimator):
     """An estimator fit fused with its upstream featurize program.
 
@@ -258,6 +263,8 @@ class FusedFitEstimator(LabelEstimator):
         # the same geometry reuses ONE compiled program instead of paying
         # the multi-second featurize+solve compile per fit (the same trap
         # _gram_streamed_program documents in ops/learning/lbfgs.py).
+        # FIFO-bounded like _IdentityMemo: a long-lived estimator refit
+        # across many geometries must not retain one executable per key.
         self._programs: Dict[tuple, object] = {}
 
     def __getstate__(self):
@@ -303,6 +310,8 @@ class FusedFitEstimator(LabelEstimator):
             def fused(X, Y):
                 return dev.fit(_compose(fns, X), Y, n_true)
 
+            if len(self._programs) >= _FIT_PROGRAM_CACHE_MAX:
+                self._programs.pop(next(iter(self._programs)))
             self._programs[key] = fused
 
         params = fused(X, labels.array)

@@ -162,7 +162,7 @@ class TestStreamingEstimatorAPI:
         X, Y = _problem(500)
         est = StreamingFeaturizedLeastSquares(
             featurize, d_feat=D_FEAT, block_size=BLOCK, num_iter=2,
-            lam=LAM, tile_rows=128,
+            lam=LAM, tile_rows=128, center=False,  # raw-BCD reference below
         )
         model = est.fit(Dataset.of(X), Dataset.of(Y))
         preds = np.asarray(model.batch_apply(Dataset.of(X)).array)
@@ -210,6 +210,127 @@ class TestStreamingEstimatorAPI:
         _, train_eval, _ = run(cfg)
         # Synthetic TIMIT is learnable: the streamed fit must actually fit.
         assert train_eval.total_error < 0.5, train_eval.total_error
+
+
+class TestStreamingCentered:
+    """Centered streamed fits must match BlockLeastSquaresEstimator — the
+    solver whose semantics (per-block feature centering + label centering +
+    intercept, BlockLinearMapper.scala:224-243) the streaming tier claims
+    (VERDICT r4 Missing #2)."""
+
+    def test_matches_block_least_squares(self):
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+        from keystone_tpu.ops.learning.streaming_ls import (
+            StreamingFeaturizedLeastSquares,
+        )
+
+        featurize = _featurizer()
+        X, Y = _problem(500)
+        est = StreamingFeaturizedLeastSquares(
+            featurize, d_feat=D_FEAT, block_size=BLOCK, num_iter=2,
+            lam=LAM, tile_rows=128,  # center=True default
+        )
+        model = est.fit(Dataset.of(X), Dataset.of(Y))
+
+        F = featurize(X)
+        block = BlockLeastSquaresEstimator(BLOCK, 2, lam=LAM).fit(
+            Dataset.of(np.asarray(F)), Dataset.of(Y)
+        )
+        Xt, _ = _problem(100, seed=3)
+        preds = np.asarray(model.batch_apply(Dataset.of(Xt)).array)
+        ref = np.asarray(
+            block.batch_apply(Dataset.of(np.asarray(featurize(Xt)))).array
+        )
+        np.testing.assert_allclose(preds, ref, atol=5e-3, rtol=5e-3)
+
+    def test_centered_solver_matches_masked_center_reference(self):
+        # Rank-1 gram-space centering == explicit center-then-solve, with
+        # ragged padding rows holding GARBAGE (they must not leak into the
+        # means: a zero row featurizes to cos(b) != 0, a garbage row to
+        # anything).
+        featurize = _featurizer()
+        n_true = 437
+        X, Y = _problem(n_true)
+        rng = np.random.default_rng(21)
+        pad = 75
+        Xp = jnp.concatenate(
+            [X, jnp.asarray(rng.normal(size=(pad, D_IN)).astype(np.float32) * 50)]
+        )
+        Yp = jnp.concatenate(
+            [Y, jnp.asarray(rng.normal(size=(pad, K)).astype(np.float32) * 50)]
+        )
+        W, fmean, ymean, loss = streaming.streaming_bcd_fit_centered(
+            Xp, Yp, featurize=featurize, d_feat=D_FEAT, tile_rows=128,
+            block_size=BLOCK, lam=LAM, num_iter=2, valid=n_true,
+        )
+        F = np.asarray(featurize(X)).astype(np.float64)
+        Yd = np.asarray(Y, dtype=np.float64)
+        mu, ybar = F.mean(axis=0), Yd.mean(axis=0)
+        W_ref = bcd_least_squares_fused_flat(
+            jnp.asarray((F - mu).astype(np.float32)),
+            jnp.asarray((Yd - ybar).astype(np.float32)),
+            BLOCK, lam=LAM, num_iter=2, use_pallas=False,
+        )
+        np.testing.assert_allclose(np.asarray(fmean), mu, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(ymean), ybar, atol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(W), np.asarray(W_ref), atol=2e-3, rtol=2e-3
+        )
+        assert np.isfinite(float(loss)) and float(loss) >= 0
+
+    def test_centered_mesh_matches_single_device(self):
+        featurize = _featurizer()
+        n_true = 700
+        X, Y = _problem(n_true, seed=7)
+        mesh = mesh_lib.make_mesh()
+        num = mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS)
+        pad = (-n_true) % (num * 64)
+        rng = np.random.default_rng(11)
+        Xp = jnp.concatenate(
+            [X, jnp.asarray(rng.normal(size=(pad, D_IN)).astype(np.float32))]
+        )
+        Yp = jnp.concatenate(
+            [Y, jnp.asarray(rng.normal(size=(pad, K)).astype(np.float32))]
+        )
+        W_mesh, fm_m, ym_m = streaming.streaming_bcd_fit_mesh_centered(
+            mesh_lib.shard_rows(Xp, mesh), mesh_lib.shard_rows(Yp, mesh),
+            featurize=featurize, d_feat=D_FEAT, tile_rows=64,
+            block_size=BLOCK, lam=LAM, num_iter=2, mesh=mesh, n_true=n_true,
+        )
+        W_one, fm_1, ym_1, _ = streaming.streaming_bcd_fit_centered(
+            X, Y, featurize=featurize, d_feat=D_FEAT, tile_rows=64,
+            block_size=BLOCK, lam=LAM, num_iter=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fm_m), np.asarray(fm_1), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ym_m), np.asarray(ym_1), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_mesh), np.asarray(W_one), atol=2e-3, rtol=2e-3
+        )
+
+    def test_lambda_sweep_is_one_compile(self):
+        # λ is a traced operand (VERDICT r4 Weak #3): a 3-λ sweep over one
+        # geometry must add exactly ONE entry to the jit cache.
+        featurize = _featurizer(seed=33)
+        X, Y = _problem(320, seed=13)
+        kw = dict(
+            featurize=featurize, d_feat=D_FEAT, tile_rows=128,
+            block_size=BLOCK, num_iter=2,
+        )
+        fn = streaming.streaming_bcd_fit_centered
+        before = fn._cache_size()
+        sols = [
+            np.asarray(fn(X, Y, lam=lam, **kw)[0])
+            for lam in (1e-3, 1e-2, 1e-1)
+        ]
+        assert fn._cache_size() - before == 1
+        # λ actually took effect: heavier ridge shrinks the weights.
+        norms = [float(np.linalg.norm(s)) for s in sols]
+        assert norms[0] > norms[1] > norms[2]
 
 
 class TestStreamingPallasKernel:
