@@ -1,0 +1,161 @@
+"""Disk-backed chunk shards for the streamed solvers.
+
+The reference streams from storage by construction — ``CsvDataLoader`` is
+a lazy ``textFile`` (CsvDataLoader.scala:10-31) and image loaders decode
+per partition (ImageLoaderUtils.scala:21-94) — so its fits are bounded by
+disk, not RAM. The round-4 streamed folds here took their chunks from
+HOST-RESIDENT arrays, bounding n by host RAM instead. This module closes
+that gap: pre-tiled padded-COO shards live in ``.npy`` files, are opened
+memory-mapped, and feed the segmented Gramian folds one SEGMENT at a time
+(``run_lbfgs_gram_streamed(segment_source=...)``) — peak host residency
+is the mmap page cache (OS-evictable) plus ``seg`` chunks of copy buffer,
+regardless of dataset size.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Tuple
+
+import numpy as np
+
+_META = "shards.json"
+_FILES = {"indices": "indices.npy", "values": "values.npy", "labels": "labels.npy"}
+
+
+class DiskCOOShards:
+    """Pre-tiled padded-COO chunks on disk, mmap-read per segment.
+
+    Layout on disk (one directory):
+      indices.npy  (num_chunks, chunk_rows, w)  int16/int32  (-1 = inactive)
+      values.npy   (num_chunks, chunk_rows, w)  f32/bf16-as-u16 is NOT used;
+                   values keep their numpy dtype (float32 or float16-like)
+      labels.npy   (num_chunks, chunk_rows, k)
+      shards.json  {n_true, d, num_chunks, chunk_rows}
+
+    ``write`` builds the files with ``open_memmap`` so the full dataset
+    never needs to exist in RAM either at write time (callers may fill
+    chunk ranges incrementally via the returned memmaps).
+    """
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, _META)) as f:
+            meta = json.load(f)
+        self.n_true = int(meta["n_true"])
+        self.d = int(meta["d"])
+        self.num_chunks = int(meta["num_chunks"])
+        self.chunk_rows = int(meta["chunk_rows"])
+        self._idx = np.load(
+            os.path.join(directory, _FILES["indices"]), mmap_mode="r"
+        )
+        self._val = np.load(
+            os.path.join(directory, _FILES["values"]), mmap_mode="r"
+        )
+        self._y = np.load(
+            os.path.join(directory, _FILES["labels"]), mmap_mode="r"
+        )
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def write(
+        directory: str,
+        indices: np.ndarray,
+        values: np.ndarray,
+        labels: np.ndarray,
+        chunk_rows: int,
+        n_true: int = None,
+        d: int = None,
+    ) -> "DiskCOOShards":
+        """Tile row-major (n, w) COO + (n, k) labels into on-disk chunks.
+
+        Rows past the last full chunk are padded with inactive (-1)
+        lanes / zero labels. For datasets too big to hold even once,
+        build the memmaps with :meth:`create` and fill ranges instead.
+        """
+        n, w = indices.shape
+        k = labels.shape[1]
+        n_true = n if n_true is None else int(n_true)
+        d = int(indices.max()) + 1 if d is None else int(d)
+        num_chunks = -(-n // chunk_rows)
+        mm_i, mm_v, mm_y = DiskCOOShards.create(
+            directory, num_chunks, chunk_rows, w, k,
+            idx_dtype=indices.dtype, val_dtype=values.dtype,
+            y_dtype=labels.dtype, n_true=n_true, d=d,
+        )
+        for c in range(num_chunks):
+            lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
+            m = hi - lo
+            mm_i[c, :m] = indices[lo:hi]
+            mm_v[c, :m] = values[lo:hi]
+            mm_y[c, :m] = labels[lo:hi]
+        for mm in (mm_i, mm_v, mm_y):
+            mm.flush()
+        del mm_i, mm_v, mm_y
+        return DiskCOOShards(directory)
+
+    @staticmethod
+    def create(
+        directory: str,
+        num_chunks: int,
+        chunk_rows: int,
+        w: int,
+        k: int,
+        idx_dtype=np.int32,
+        val_dtype=np.float32,
+        y_dtype=np.float32,
+        n_true: int = 0,
+        d: int = 0,
+    ) -> Tuple[np.memmap, np.memmap, np.memmap]:
+        """Allocate the on-disk chunk files and return writable memmaps
+        (indices prefilled with -1, values/labels with 0)."""
+        os.makedirs(directory, exist_ok=True)
+        shape2 = (num_chunks, chunk_rows)
+        mm_i = np.lib.format.open_memmap(
+            os.path.join(directory, _FILES["indices"]), mode="w+",
+            dtype=idx_dtype, shape=shape2 + (w,),
+        )
+        mm_i[...] = -1
+        mm_v = np.lib.format.open_memmap(
+            os.path.join(directory, _FILES["values"]), mode="w+",
+            dtype=val_dtype, shape=shape2 + (w,),
+        )
+        mm_y = np.lib.format.open_memmap(
+            os.path.join(directory, _FILES["labels"]), mode="w+",
+            dtype=y_dtype, shape=shape2 + (k,),
+        )
+        with open(os.path.join(directory, _META), "w") as f:
+            json.dump(
+                {"n_true": int(n_true), "d": int(d),
+                 "num_chunks": int(num_chunks),
+                 "chunk_rows": int(chunk_rows)},
+                f,
+            )
+        return mm_i, mm_v, mm_y
+
+    # ------------------------------------------------------------------
+    def segment_source(self, cid0: int, seg: int):
+        """The ``segment_source`` contract of ``run_lbfgs_gram_streamed``:
+        materialize ONLY chunks [cid0, cid0+seg) as host arrays (phantom
+        chunks past the end are inactive/-1 padded — the fold masks them
+        by absolute id anyway)."""
+        hi = min(cid0 + seg, self.num_chunks)
+        idx = np.asarray(self._idx[cid0:hi])
+        val = np.asarray(self._val[cid0:hi])
+        y = np.asarray(self._y[cid0:hi])
+        pad = seg - (hi - cid0)
+        if pad:
+            idx = np.concatenate(
+                [idx, np.full((pad,) + idx.shape[1:], -1, idx.dtype)]
+            )
+            val = np.concatenate(
+                [val, np.zeros((pad,) + val.shape[1:], val.dtype)]
+            )
+            y = np.concatenate([y, np.zeros((pad,) + y.shape[1:], y.dtype)])
+        return idx, val, y
+
+    @property
+    def is_memory_mapped(self) -> bool:
+        return all(
+            isinstance(a, np.memmap) for a in (self._idx, self._val, self._y)
+        )

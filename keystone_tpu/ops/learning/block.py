@@ -277,3 +277,13 @@ class BlockLeastSquaresEstimator(LabelEstimator):
             max(cpu_weight * flops, mem_weight * bytes_scanned)
             + network_weight * network
         )
+
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """Capacity model for the selector's HBM feasibility cut: the fit
+        holds the feature blocks plus a scaled/stacked second copy (f32),
+        labels twice (raw + centered), and the multi-epoch Gramian stash."""
+        return (
+            8.0 * n * d / num_machines
+            + 8.0 * n * k / num_machines
+            + 4.0 * d * self.block_size
+        )

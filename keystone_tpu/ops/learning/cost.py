@@ -30,6 +30,26 @@ DEFAULT_CPU_WEIGHT = 3.8e-4
 DEFAULT_MEM_WEIGHT = 2.9e-1
 DEFAULT_NETWORK_WEIGHT = 1.32
 
+# Fallback device-memory budget when the backend reports no memory stats
+# (CPU test meshes); real chips report bytes_limit (v5e: ~15.75 GB).
+DEFAULT_HBM_BYTES = 16 << 30
+# Fraction of device memory a solver's resident operands may claim: the
+# rest covers XLA scratch, fusion temporaries and transfer buffers.
+DEFAULT_HBM_UTILIZATION = 0.85
+
+
+def device_memory_bytes() -> int:
+    """Per-device memory budget: the backend's reported limit, else the
+    conservative default."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit)
+    except Exception:  # backends without memory stats
+        pass
+    return DEFAULT_HBM_BYTES
+
 # TPU-measured weights from scripts/fit_cost_weights.py on a single v5e chip
 # (2026-07; grid up to n=131072, d=2048; median rel err ~0.6 — the measured
 # times at these scales are dominated by host transfer, so treat the cpu/mem
@@ -94,11 +114,18 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     """Auto-selecting least-squares solver (LeastSquaresEstimator.scala:26-87).
 
     Candidates: DenseLBFGS, Sparsify->SparseLBFGS, Densify->BlockLS(1000, 3),
-    Densify->Exact normal equations, and (only when ``allow_approximate``)
-    Densify->SketchedLeastSquares — a randomized solver whose answer is an
-    approximation of the exact ridge solution. ``optimize`` measures
-    (n, d, k, sparsity, num devices) from the sample and picks the
-    cost-model argmin.
+    Densify->Exact normal equations, the STREAMING tier
+    (StreamingLeastSquaresChoice — featurize-inside-the-fit, bound to the
+    upstream featurizer by the optimizer's StreamedFitFusionRule), and
+    (only when ``allow_approximate``) Densify->SketchedLeastSquares — a
+    randomized solver whose answer is an approximation of the exact ridge
+    solution. ``optimize`` measures (n, d, k, sparsity, num devices) from
+    the sample and picks the cost-model argmin among candidates whose
+    RESIDENT operands fit the device-memory budget — a capacity term the
+    reference's cluster cost model (CostModel.scala:6-16) folds into its
+    memory weight, and which on a fixed-HBM chip must instead be a hard
+    feasibility cut: past it, the streaming tier is the only candidate
+    that can run at all.
     """
 
     def __init__(
@@ -109,6 +136,10 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         mem_weight: float = DEFAULT_MEM_WEIGHT,
         network_weight: float = DEFAULT_NETWORK_WEIGHT,
         allow_approximate: bool = False,
+        hbm_bytes: Optional[float] = None,
+        hbm_utilization: float = DEFAULT_HBM_UTILIZATION,
+        block_size: int = 1000,
+        block_iters: int = 3,
     ):
         from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
         from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
@@ -116,23 +147,38 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             LinearMapEstimator,
             SketchedLeastSquaresEstimator,
         )
+        from keystone_tpu.ops.learning.streaming_ls import (
+            StreamingLeastSquaresChoice,
+        )
 
         self.lam = lam
         self.num_machines = num_machines
         self.cpu_weight = cpu_weight
         self.mem_weight = mem_weight
         self.network_weight = network_weight
+        self.hbm_bytes = hbm_bytes
+        self.hbm_utilization = hbm_utilization
 
         dense_lbfgs = DenseLBFGSwithL2(lam=lam, num_iterations=20)
         sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
-        block = BlockLeastSquaresEstimator(1000, 3, lam=lam)
+        block = BlockLeastSquaresEstimator(block_size, block_iters, lam=lam)
         exact = LinearMapEstimator(lam)
+        streaming = StreamingLeastSquaresChoice(
+            num_iter=block_iters, lam=lam,
+            block_size_hint=max(block_size, 1024),
+        )
+        self._streaming_choice = streaming
 
         self.options: Sequence[Tuple[object, LabelEstimator]] = [
             (dense_lbfgs, dense_lbfgs),
             (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
             (block, TransformerLabelEstimatorChain(Densify(), block)),
             (exact, TransformerLabelEstimatorChain(Densify(), exact)),
+            # The streaming choice is its own graph operator (no Densify
+            # chain): StreamedFitFusionRule must see it directly to bind
+            # the upstream featurizer; its fit densifies sparse input
+            # itself on the resident fallback path.
+            (streaming, streaming),
         ]
         if allow_approximate:
             # Beyond the reference's candidate set: randomized sketch-and-
@@ -172,15 +218,53 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         k = int(np.asarray(labels_sample.array).shape[-1])
         machines = self.num_machines or max(len(jax.devices()), 1)
 
-        logger.debug(
-            "LeastSquaresEstimator optimize: n=%d d=%d k=%d sparsity=%.4f machines=%d",
-            n, d, k, sparsity, machines,
+        # Raw-source row bytes (attached by the sample collector): the
+        # streaming tier keeps RAW rows resident, not features.
+        self._streaming_choice.raw_row_bytes = getattr(
+            sample, "source_row_bytes", None
         )
-        best = min(
-            self.options,
-            key=lambda opt: opt[0].cost(
+        budget = (
+            self.hbm_bytes if self.hbm_bytes is not None
+            else device_memory_bytes()
+        ) * self.hbm_utilization
+        # The streaming tier's feature slab scales down with the budget so
+        # its capacity model and its actual tile sizing agree.
+        self._streaming_choice.slab_bytes = int(min(2 << 30, budget // 4))
+
+        def resident(opt) -> float:
+            rb = getattr(opt[0], "resident_bytes", None)
+            if rb is None:
+                return 0.0
+            return rb(n, d, k, sparsity, machines)
+
+        def total_cost(opt) -> float:
+            # Infeasible candidates — resident operands past the device
+            # budget — cost infinity: they would OOM, whatever their model
+            # time says.
+            if resident(opt) > budget:
+                return float("inf")
+            return opt[0].cost(
                 n, d, k, sparsity, machines,
                 self.cpu_weight, self.mem_weight, self.network_weight,
-            ),
+            )
+
+        costs = [total_cost(opt) for opt in self.options]
+        logger.debug(
+            "LeastSquaresEstimator optimize: n=%d d=%d k=%d sparsity=%.4f "
+            "machines=%d budget=%.2e costs=%s",
+            n, d, k, sparsity, machines, budget,
+            [f"{type(o[0]).__name__}={c:.3g}" for o, c in
+             zip(self.options, costs)],
         )
-        return best[1]
+        if all(c == float("inf") for c in costs):
+            # Nothing fits the budget model: take the least-resident
+            # candidate (in practice the streaming tier) rather than a
+            # guaranteed OOM.
+            best = min(self.options, key=resident)
+            logger.warning(
+                "no solver candidate fits the %.2f GB budget at n=%d d=%d; "
+                "selecting least-resident %s",
+                budget / 2**30, n, d, type(best[0]).__name__,
+            )
+            return best[1]
+        return self.options[int(np.argmin(costs))][1]

@@ -140,6 +140,15 @@ class LinearMapEstimator(LabelEstimator):
         network = d * (d + k)
         return max(cpu_weight * flops, mem_weight * bytes_scanned) + network_weight * network
 
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """Capacity model: the matrix plus its centered copy (f32), labels,
+        and the Gramian with its Cholesky factor."""
+        return (
+            8.0 * n * d / num_machines
+            + 8.0 * n * k / num_machines
+            + 8.0 * d * d
+        )
+
     @staticmethod
     def compute_cost(data: Dataset, labels: Dataset, lam: float, x, b_opt=None) -> float:
         """Ridge loss ||Ax+b - y||²/(2n) + λ/2 ||x||²
@@ -267,3 +276,14 @@ class SketchedLeastSquaresEstimator(LabelEstimator):
         bytes_scanned = (1 + self.refine_iters) * n * d / num_machines
         network = d * (d + k) + self.refine_iters * d * k
         return max(cpu_weight * flops, mem_weight * bytes_scanned) + network_weight * network
+
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """Capacity model: the matrix, the (m, d) sketch, and the sketched
+        Gramian + factor."""
+        m = min(max(self.sketch_factor * d, d + 1), max(n, d + 1))
+        return (
+            4.0 * n * d / num_machines
+            + 4.0 * n * k / num_machines
+            + 4.0 * m * d
+            + 8.0 * d * d
+        )

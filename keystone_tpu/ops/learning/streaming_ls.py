@@ -39,13 +39,22 @@ class StreamingFeaturizedLinearModel(Transformer):
     (F − fmean) @ W + ymean, which folds into the single affine offset
     ymean − fmean @ W_flat — BlockLinearMapper's model shape without a
     second pass over the features.
+
+    ``d_in`` (when known) makes the model tolerant of graph position:
+    fed RAW rows (width d_in) it featurizes tile-wise; fed
+    ALREADY-FEATURIZED rows (width d_feat — e.g. a saved-state reuse in a
+    later pipeline whose featurize nodes are intact) it applies the
+    weights directly. The fused optimizer rewrite needs this because the
+    same fitted transformer serves both rewired (raw-input) and original
+    (featurized-input) apply sites.
     """
 
     def __init__(self, featurize, W_stack, tile_rows: int,
-                 fmean=None, ymean=None):
+                 fmean=None, ymean=None, d_in: Optional[int] = None):
         self.featurize = featurize
         self.W_stack = jnp.asarray(W_stack)
         self.tile_rows = tile_rows
+        self.d_in = d_in
         self.fmean = None if fmean is None else jnp.asarray(fmean)
         self.ymean = None if ymean is None else jnp.asarray(ymean)
         Wf = self.W_stack.reshape(-1, self.W_stack.shape[2])
@@ -54,15 +63,31 @@ class StreamingFeaturizedLinearModel(Transformer):
             else self.ymean - self.fmean.astype(jnp.float32) @ Wf
         )
 
+    @property
+    def d_feat(self) -> int:
+        return self.W_stack.shape[0] * self.W_stack.shape[1]
+
+    def _featurize_for(self, width: int):
+        if self.d_in is None or width == self.d_in:
+            return self.featurize
+        if width == self.d_feat:
+            return _identity_featurize
+        raise ValueError(
+            f"input width {width} matches neither raw d_in={self.d_in} "
+            f"nor d_feat={self.d_feat}"
+        )
+
     def apply(self, x):
-        F = self.featurize(jnp.asarray(x)[None, :])
+        x = jnp.asarray(x)
+        F = self._featurize_for(x.shape[-1])(x[None, :])
         Wf = self.W_stack.reshape(-1, self.W_stack.shape[2])
         out = (F.astype(jnp.float32) @ Wf)[0]
         return out if self.offset is None else out + self.offset
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        X = jnp.asarray(data.array)
         preds = streaming.streaming_predict(
-            jnp.asarray(data.array), self.W_stack, self.featurize,
+            X, self.W_stack, self._featurize_for(X.shape[-1]),
             self.tile_rows,
         )
         if self.offset is not None:
@@ -105,6 +130,47 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
     def weight(self) -> int:
         return self.num_iter + 1
 
+    def device_fit_fn(self):
+        """Fit-fusion contract (workflow/fusion.py): upstream transform +
+        the internal tile-scanned featurize/Gramian/BCD program compile as
+        ONE dispatch. F here is the estimator's INPUT (the upstream
+        program's output, typically narrow raw-ish rows) — the internal
+        cosine features still materialize only one tile slab at a time.
+        A BankFeaturize featurizer rides as TRACED DeviceFit operands so
+        its arrays never embed as HLO constants."""
+        from keystone_tpu.parallel.streaming import BankFeaturize, _fit_core
+        from keystone_tpu.workflow.fusion import DeviceFit
+
+        bank = self.featurize if isinstance(self.featurize, BankFeaturize) else None
+
+        def fit_fn(F, Y, n_true: int, *bank_params):
+            if bank is not None:
+                bank_type, bank_key = type(bank), bank.static_key()
+                featurize = lambda X_t: bank_type.apply_bank(  # noqa: E731
+                    bank_key, bank_params, X_t
+                )
+            else:
+                featurize = self.featurize
+            tile = min(self.tile_rows, F.shape[0])
+            W, _, _, fmean, ymean = _fit_core(
+                F, Y, featurize, self.d_feat, tile, self.block_size,
+                self.lam, self.num_iter, False,
+                n_true if n_true != F.shape[0] else None, None,
+                self.center,
+            )
+            return W, fmean, ymean
+
+        def build(params):
+            W, fmean, ymean = params
+            return StreamingFeaturizedLinearModel(
+                self.featurize, W, self.tile_rows, fmean=fmean, ymean=ymean,
+            )
+
+        return DeviceFit(
+            fit_fn, build,
+            operands=tuple(bank.params) if bank is not None else (),
+        )
+
     def fit(self, data: Dataset, labels: Dataset) -> StreamingFeaturizedLinearModel:
         X = jnp.asarray(data.array)
         Y = jnp.asarray(labels.array)
@@ -145,24 +211,330 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
         )
 
 
-def cosine_bank_featurize(Wrf_flat, brf_flat, feat_dtype=jnp.float32):
-    """Featurize closure over a flat cosine random-feature bank, using the
-    fused Pallas kernel when safely dispatchable (same recipe as the bench
-    headline)."""
-    from keystone_tpu.ops import pallas_ops
+class CosineBankFeaturize(streaming.BankFeaturize):
+    """Cosine random-feature bank as a :class:`BankFeaturize`: the bank
+    arrays ride as jit operands, so every streamed fit over any bank of
+    the same SHAPE shares one compiled program (λ-sweeps and pipeline
+    re-optimizations never recompile the tile scan), and a TIMIT-scale
+    bank never embeds as an HLO constant. Uses the fused Pallas cosine
+    kernel when safely dispatchable (same recipe as the bench headline).
+    """
 
-    Wrf_flat = jnp.asarray(Wrf_flat)
-    brf_flat = jnp.asarray(brf_flat)
-    use_pallas = pallas_ops.pallas_direct_ok(Wrf_flat)
+    def __init__(self, Wrf_flat, brf_flat, feat_dtype=jnp.float32):
+        from keystone_tpu.ops import pallas_ops
 
-    def featurize(X_t):
+        self.Wrf = jnp.asarray(Wrf_flat)
+        self.brf = jnp.asarray(brf_flat)
+        self.feat_dtype = jnp.dtype(feat_dtype)
+        self.use_pallas = bool(pallas_ops.pallas_direct_ok(self.Wrf))
+
+    @property
+    def params(self):
+        return (self.Wrf, self.brf)
+
+    def static_key(self) -> tuple:
+        return (str(self.feat_dtype), self.use_pallas)
+
+    @classmethod
+    def apply_bank(cls, static_key, params, X_t):
+        from keystone_tpu.ops import pallas_ops
+
+        feat_dtype, use_pallas = jnp.dtype(static_key[0]), static_key[1]
+        Wrf, brf = params
         if use_pallas:
             return pallas_ops.cosine_features(
-                X_t, Wrf_flat, brf_flat,
+                X_t, Wrf, brf,
                 compute_dtype=feat_dtype, out_dtype=feat_dtype,
             )
         return jnp.cos(
-            X_t.astype(jnp.float32) @ Wrf_flat.T + brf_flat
+            X_t.astype(jnp.float32) @ Wrf.T + brf
         ).astype(feat_dtype)
 
-    return featurize
+
+def cosine_bank_featurize(Wrf_flat, brf_flat, feat_dtype=jnp.float32):
+    """Build a :class:`CosineBankFeaturize` (kept as the public factory)."""
+    return CosineBankFeaturize(Wrf_flat, brf_flat, feat_dtype)
+
+
+def _identity_featurize(X_t):
+    """Module-level identity featurize: stable jit identity for the
+    already-featurized (resident) fallback of the streaming choice."""
+    return X_t
+
+
+def pick_block_size(d_feat: int, hint: int) -> int:
+    """Largest divisor of d_feat that is <= hint (BCD needs d % bs == 0)."""
+    for b in range(min(hint, d_feat), 0, -1):
+        if d_feat % b == 0:
+            return b
+    return 1
+
+
+class ComposedDeviceFeaturize:
+    """Composition of device-fusable transformers as a featurize callable.
+
+    Holds the member transformers (picklable — the save contract) and
+    rebuilds the composed function on unpickle; one instance per fused
+    estimator, so the closure-path jit cache keys stay stable across
+    fits.
+    """
+
+    def __init__(self, members):
+        self.members = list(members)
+        self._build()
+
+    def _build(self):
+        fns = [m.device_fn() for m in self.members]
+
+        def composed(X_t):
+            for f in fns:
+                X_t = f(X_t)
+            return X_t
+
+        self._fn = composed
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_fn", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._build()
+
+    def __call__(self, X_t):
+        return self._fn(X_t)
+
+
+def _extract_bank(members) -> Optional[CosineBankFeaturize]:
+    """Recognize the cosine-featurizer shapes the optimizer produces and
+    turn them into a :class:`CosineBankFeaturize` (bank-as-operand program
+    keys; the TIMIT composition — gather of CosineRandomFeatures branches
+    + VectorCombiner — is exactly this after GatherFusionRule)."""
+    from keystone_tpu.ops.stats import CosineRandomFeaturesModel
+    from keystone_tpu.ops.util import VectorCombiner
+    from keystone_tpu.workflow.fusion import FusedGatherTransformer
+
+    if len(members) != 1:
+        return None
+    m = members[0]
+    if isinstance(m, CosineRandomFeaturesModel):
+        return CosineBankFeaturize(m.W, m.b)
+    if isinstance(m, FusedGatherTransformer):
+        if not isinstance(m.combiner, VectorCombiner):
+            return None
+        rfs = []
+        for br in m.branches:
+            if len(br) != 1 or not isinstance(br[0], CosineRandomFeaturesModel):
+                return None
+            rfs.append(br[0])
+        return CosineBankFeaturize(
+            jnp.concatenate([rf.W for rf in rfs]),
+            jnp.concatenate([rf.b for rf in rfs]),
+        )
+    return None
+
+
+class StreamingLeastSquaresChoice(LabelEstimator):
+    """The cost model's streaming-tier selection for
+    :class:`~keystone_tpu.ops.learning.cost.LeastSquaresEstimator`.
+
+    When the resident solvers' operands exceed device memory, ``optimize``
+    returns this choice; the optimizer's StreamedFitFusionRule then binds
+    the upstream featurize program INTO the fit (``fuse_with_members``),
+    producing the out-of-core tier — featurize per row tile, Gramian
+    fold, centered BCD (BlockLeastSquaresEstimator semantics). Fitting it
+    DIRECTLY (no fusable upstream) tile-streams the already-resident
+    features through the same solver: correct, but without the memory
+    win, since the input had to materialize to reach it.
+
+    Cost model: one streamed data pass building the normal equations
+    (the Exact solver's n·d·(d+k) flops — LinearMapper.scala:100-115)
+    plus ``num_iter`` Gramian-space epochs, at a streaming overhead
+    factor, so resident solvers win whenever they fit.
+    """
+
+    streamed_fit_fusable = True
+    # Streamed fits pay the full normal-equations syrk plus per-tile
+    # featurize regeneration; measured on-chip (BENCH_r04) the resident
+    # residual-BCD solver is several times faster at the same geometry
+    # when its operands fit — bias selection toward resident solvers
+    # whenever the analytic models land close.
+    _STREAM_OVERHEAD = 2.0
+
+    def __init__(
+        self,
+        num_iter: int = 3,
+        lam: float = 0.0,
+        block_size_hint: int = 4096,
+        center: bool = True,
+    ):
+        self.num_iter = num_iter
+        self.lam = lam
+        self.block_size_hint = block_size_hint
+        self.center = center
+        # Set by the owning LeastSquaresEstimator before cost evaluation
+        # (bytes per RAW input row — the streamed fit keeps raw rows, not
+        # features, resident).
+        self.raw_row_bytes: Optional[float] = None
+        # Feature-slab budget for the tile scan; the owner shrinks it when
+        # the device budget is small so the capacity model and the actual
+        # fit agree on the working set.
+        self.slab_bytes: int = 2 << 30
+
+    @property
+    def label(self) -> str:
+        return f"StreamingLeastSquaresChoice({self.num_iter},{self.lam})"
+
+    @property
+    def weight(self) -> int:
+        return self.num_iter + 1
+
+    def build_estimator(self, featurize, d_feat: int) -> StreamingFeaturizedLeastSquares:
+        return StreamingFeaturizedLeastSquares(
+            featurize, d_feat=d_feat,
+            block_size=pick_block_size(d_feat, self.block_size_hint),
+            num_iter=self.num_iter, lam=self.lam, center=self.center,
+            tile_rows=streaming.pick_tile_rows(
+                d_feat, 4, slab_bytes=self.slab_bytes
+            ),
+        )
+
+    def fuse_with_members(self, members) -> "StreamedFitEstimator":
+        return StreamedFitEstimator(members, self)
+
+    def fit(self, data: Dataset, labels: Dataset):
+        from keystone_tpu.ops.sparse import Densify, is_sparse_dataset
+
+        if is_sparse_dataset(data):
+            data = Densify().batch_apply(data)
+        d_feat = int(jnp.asarray(data.array).shape[-1])
+        return self.build_estimator(_identity_featurize, d_feat).fit(
+            data, labels
+        )
+
+    def cost(
+        self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight,
+        network_weight,
+    ) -> float:
+        flops = (n * d * (d + k) + self.num_iter * d * d * k) / num_machines
+        bytes_scanned = n * d / num_machines + 2.0 * d * d
+        network = d * (d + k)  # the single (G, FY) psum
+        return (
+            self._STREAM_OVERHEAD
+            * max(cpu_weight * flops, mem_weight * bytes_scanned)
+            + network_weight * network
+        )
+
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """Raw rows + labels (sharded) + Gramian, factors and one feature
+        slab (replicated) — the feature matrix itself never exists."""
+        raw = self.raw_row_bytes if self.raw_row_bytes else 4.0 * min(d, 512)
+        bs = min(self.block_size_hint, d)
+        slab = min(
+            streaming.pick_tile_rows(d, 4, slab_bytes=self.slab_bytes)
+            * d * 4.0,
+            float(self.slab_bytes),
+        )
+        return (
+            n * raw / num_machines
+            + 4.0 * n * k / num_machines
+            + 8.0 * d * d          # G + diagonal-block Cholesky stash
+            + 8.0 * d * bs         # diag/chol block stacks in the solve
+            + slab
+        )
+
+
+class StreamedFitEstimator(LabelEstimator):
+    """A capacity-selected streaming fit bound to its upstream featurize
+    program (the rewrite StreamedFitFusionRule performs).
+
+    The members' composed ``device_fn`` becomes the tile featurizer of a
+    :class:`StreamingFeaturizedLeastSquares` — featurize + Gramian fold +
+    centered BCD compile as one scanned program and the feature matrix
+    never materializes (the cost-model-driven form of the ``--streaming``
+    flag this replaces; reference analog: LeastSquaresEstimator.scala:
+    59-84 picking BlockLeastSquares, whose per-partition featurize+solve
+    never materializes the global matrix either). Cosine featurizer
+    shapes lower to the bank-as-operand program (stable compile keys).
+    """
+
+    def __init__(self, members, choice: StreamingLeastSquaresChoice):
+        self.members = list(members)
+        self.choice = choice
+        self._featurize = _extract_bank(self.members) or ComposedDeviceFeaturize(
+            self.members
+        )
+
+    @property
+    def can_serve_raw_input(self) -> bool:
+        """True when the fitted model can PROVABLY disambiguate raw vs
+        featurized input by width — the gate StreamedFitFusionRule checks
+        before rewiring apply sites to feed raw rows. Requires a bank
+        featurizer (widths known statically) with d_in != d_feat."""
+        Wrf = getattr(self._featurize, "Wrf", None)
+        return Wrf is not None and Wrf.shape[0] != Wrf.shape[1]
+
+    @property
+    def label(self) -> str:
+        inner = " > ".join(m.label for m in self.members)
+        return f"StreamedFit[{inner} -> {self.choice.label}]"
+
+    @property
+    def weight(self) -> int:
+        return self.choice.weight
+
+    def _fallback(self, data: Dataset, labels: Dataset):
+        raw_width = self._raw_width(data)
+        for m in self.members:
+            data = m.batch_apply(data)
+        model = self.choice.fit(data, labels)
+        # Apply sites may have been rewired to feed RAW rows (the rule
+        # rewires only when can_serve_raw_input): make the fallback model
+        # width-adaptive too, or those sites would crash on a raw batch.
+        if (
+            self.can_serve_raw_input
+            and isinstance(model, StreamingFeaturizedLinearModel)
+            and raw_width is not None
+        ):
+            model.featurize = self._featurize
+            model.d_in = raw_width
+        return model
+
+    @staticmethod
+    def _raw_width(data: Dataset):
+        try:
+            if data.is_host:
+                items = data.to_list()
+                return int(np.asarray(items[0]).shape[-1]) if items else None
+            return int(jnp.asarray(data.array).shape[-1])
+        except Exception:
+            return None
+
+    def fit(self, data: Dataset, labels: Dataset):
+        if data.is_host or labels.is_host:
+            return self._fallback(data, labels)
+        X = jnp.asarray(data.array)
+        d_feat = int(
+            jax.eval_shape(
+                self._featurize,
+                jax.ShapeDtypeStruct((1,) + X.shape[1:], X.dtype),
+            ).shape[-1]
+        )
+        d_in = int(X.shape[-1])
+        est = self.choice.build_estimator(self._featurize, d_feat)
+        model = est.fit(data, labels)
+        if d_in == d_feat:
+            # Width cannot disambiguate raw vs featurized input. The rule
+            # never rewires apply sites in this case (can_serve_raw_input
+            # is False), so every apply site featurizes upstream: the
+            # model must always take the identity path.
+            model.featurize = _identity_featurize
+            model.d_in = None
+        else:
+            # d_in makes the model adaptive: rewired apply sites feed raw
+            # rows (featurize-inside, tile-wise); saved-state reuse in
+            # later pipelines with intact featurize nodes feeds
+            # featurized rows.
+            model.d_in = d_in
+        return model

@@ -97,6 +97,18 @@ class CosineRandomFeaturesModel(Transformer):
     def apply(self, x):
         return jnp.cos(jnp.asarray(x) @ self.W.T + self.b)
 
+    def _batch_fn(self, X):
+        return jnp.cos(X @ self.W.T + self.b)
+
+    def device_fn(self):
+        """Stage-fusion contract (workflow/fusion.py): row-local cos-GEMM.
+
+        The XLA form — inside a fused program XLA fuses the cosine into
+        the matmul epilogue; the standalone batch path below still
+        prefers the Pallas kernel, and fused STREAMED fits recover it via
+        the bank extraction (streaming_ls._extract_bank)."""
+        return self._batch_fn
+
     def batch_apply(self, data: Dataset) -> Dataset:
         import jax.tree_util as jtu
         from jax.sharding import PartitionSpec as P

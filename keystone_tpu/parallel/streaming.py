@@ -312,16 +312,121 @@ def bcd_from_gram(
     return W
 
 
-# ``lam`` is a TRACED operand (not static): a λ-sweep over one geometry
-# reuses one compiled program instead of recompiling the whole tile scan
-# per λ (VERDICT r4 Weak #3).
+class BankFeaturize:
+    """Featurize whose array parameters ride as jit OPERANDS, not trace
+    constants.
+
+    The closure-based fit programs key their compile cache on the
+    featurize CALLABLE's identity and embed any captured arrays as HLO
+    constants — so rebuilding a logically-equal bank (λ-sweeps, pipeline
+    re-optimization) recompiles the whole tile scan, and a TIMIT-scale
+    bank (~360 MB) becomes a constant the remote-compile transport
+    rejects. Subclasses instead expose
+
+      - ``params``: pytree of arrays (passed as traced operands),
+      - ``static_key()``: hashable non-array config,
+      - classmethod ``apply_bank(static_key, params, X_t)``: the traceable
+        featurize, resolved through the CLASS (stable identity),
+
+    and the fit dispatchers key the program on (class, static_key, operand
+    shapes) — one executable per geometry, shared across bank instances.
+    ``__call__`` keeps instances usable as plain featurize callables
+    (predict path, gram_stats, tests).
+    """
+
+    @property
+    def params(self):
+        raise NotImplementedError
+
+    def static_key(self) -> tuple:
+        return ()
+
+    @classmethod
+    def apply_bank(cls, static_key, params, X_t):
+        raise NotImplementedError
+
+    def __call__(self, X_t):
+        return type(self).apply_bank(self.static_key(), self.params, X_t)
+
+
+def _fit_core(X, Y, featurize, d_feat, tile_rows, block_size, lam,
+              num_iter, use_pallas, valid, labelize, center):
+    """Shared traceable fit body: tile folds → (optional rank-1 centering)
+    → BCD on the normal equations. Returns (W, loss, yty, fmean, ymean);
+    fmean/ymean are None when ``center`` is False (static branch)."""
+    n_true = valid if valid is not None else (
+        X.shape[0] if X.ndim == 2 else X.shape[0] * X.shape[1]
+    )
+    if center:
+        G, FY, yty, fsum, ysum = gram_stats(
+            X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
+            valid=valid, labelize=labelize, moments=True,
+        )
+        G, FY, ytyc, fmean, ymean = center_gram_stats(
+            G, FY, yty, fsum, ysum, n_true
+        )
+        loss_yty = ytyc
+    else:
+        G, FY, yty = gram_stats(
+            X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
+            valid=valid, labelize=labelize,
+        )
+        fmean = ymean = None
+        loss_yty = yty
+    W = bcd_from_gram(G, FY, block_size, lam, num_iter)
+    # W blocks are laid out [b*block : (b+1)*block] along d — reshape keeps
+    # that order, so Wf rows align with G/FY rows.
+    Wf = W.reshape(d_feat, W.shape[2])
+    loss = (
+        loss_yty - 2.0 * jnp.vdot(Wf, FY) + jnp.vdot(Wf, G @ Wf)
+    ) / n_true
+    return W, loss, yty, fmean, ymean
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
         "featurize", "d_feat", "tile_rows", "block_size", "num_iter",
-        "use_pallas", "valid", "labelize",
+        "use_pallas", "valid", "labelize", "center",
     ),
 )
+def _streaming_fit_closure(X, Y, *, featurize, d_feat, tile_rows,
+                           block_size, lam, num_iter, use_pallas, valid,
+                           labelize, center):
+    return _fit_core(X, Y, featurize, d_feat, tile_rows, block_size, lam,
+                     num_iter, use_pallas, valid, labelize, center)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "bank_type", "bank_key", "d_feat", "tile_rows", "block_size",
+        "num_iter", "use_pallas", "valid", "labelize", "center",
+    ),
+)
+def _streaming_fit_bank(X, Y, bank_params, *, bank_type, bank_key, d_feat,
+                        tile_rows, block_size, lam, num_iter, use_pallas,
+                        valid, labelize, center):
+    featurize = lambda X_t: bank_type.apply_bank(bank_key, bank_params, X_t)  # noqa: E731
+    return _fit_core(X, Y, featurize, d_feat, tile_rows, block_size, lam,
+                     num_iter, use_pallas, valid, labelize, center)
+
+
+def _dispatch_fit(X, Y, featurize, center, kw):
+    if isinstance(featurize, BankFeaturize):
+        return _streaming_fit_bank(
+            X, Y, featurize.params, bank_type=type(featurize),
+            bank_key=featurize.static_key(), center=center, **kw,
+        )
+    return _streaming_fit_closure(
+        X, Y, featurize=featurize, center=center, **kw,
+    )
+
+
+# ``lam`` is a TRACED operand (not static): a λ-sweep over one geometry
+# reuses one compiled program instead of recompiling the whole tile scan
+# per λ (VERDICT r4 Weak #3). A :class:`BankFeaturize` featurize further
+# keys the program on bank SHAPES rather than callable identity.
 def streaming_bcd_fit(
     X: Array,
     Y: Array,
@@ -345,18 +450,12 @@ def streaming_bcd_fit(
     loss ||Y − FW||²/n comes algebraically from the accumulated stats —
     (yty − 2·tr(Wᵀ FY) + tr(Wᵀ G W))/n — two small GEMMs, no data pass.
     """
-    G, FY, yty = gram_stats(
-        X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
-        valid=valid, labelize=labelize,
+    W, loss, yty, _, _ = _dispatch_fit(
+        X, Y, featurize, False,
+        dict(d_feat=d_feat, tile_rows=tile_rows, block_size=block_size,
+             lam=lam, num_iter=num_iter, use_pallas=use_pallas,
+             valid=valid, labelize=labelize),
     )
-    W = bcd_from_gram(G, FY, block_size, lam, num_iter)
-    # W blocks are laid out [b*block : (b+1)*block] along d — reshape keeps
-    # that order, so Wf rows align with G/FY rows.
-    Wf = W.reshape(d_feat, W.shape[2])
-    n_true = valid if valid is not None else (
-        X.shape[0] if X.ndim == 2 else X.shape[0] * X.shape[1]
-    )
-    loss = (yty - 2.0 * jnp.vdot(Wf, FY) + jnp.vdot(Wf, G @ Wf)) / n_true
     return W, loss, yty
 
 
@@ -382,13 +481,6 @@ def center_gram_stats(G, FY, yty, fsum, ysum, n):
     return Gc, FYc, ytyc, fmean, ymean
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "featurize", "d_feat", "tile_rows", "block_size", "num_iter",
-        "use_pallas", "valid", "labelize",
-    ),
-)
 def streaming_bcd_fit_centered(
     X: Array,
     Y: Array,
@@ -413,19 +505,12 @@ def streaming_bcd_fit_centered(
     (F − fmean) @ W_flat + ymean — the same affine model BlockLinearMapper
     applies. ``lam`` is traced (λ-sweeps share one executable).
     """
-    G, FY, yty, fsum, ysum = gram_stats(
-        X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
-        valid=valid, labelize=labelize, moments=True,
+    W, loss, _, fmean, ymean = _dispatch_fit(
+        X, Y, featurize, True,
+        dict(d_feat=d_feat, tile_rows=tile_rows, block_size=block_size,
+             lam=lam, num_iter=num_iter, use_pallas=use_pallas,
+             valid=valid, labelize=labelize),
     )
-    n_true = valid if valid is not None else (
-        X.shape[0] if X.ndim == 2 else X.shape[0] * X.shape[1]
-    )
-    Gc, FYc, ytyc, fmean, ymean = center_gram_stats(
-        G, FY, yty, fsum, ysum, n_true
-    )
-    W = bcd_from_gram(Gc, FYc, block_size, lam, num_iter)
-    Wf = W.reshape(d_feat, W.shape[2])
-    loss = (ytyc - 2.0 * jnp.vdot(Wf, FYc) + jnp.vdot(Wf, Gc @ Wf)) / n_true
     return W, fmean, ymean, loss
 
 
@@ -606,6 +691,201 @@ def streaming_block_bcd_mesh(
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P()),
         out_specs=P(),
+        check_vma=False,
+    )(X, Y, Wrf, brf)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "block_size", "lam", "num_iter", "mesh", "n_true", "feat_dtype",
+    ),
+)
+def streaming_block_bcd_mesh_2d(
+    X: Array,
+    Y: Array,
+    Wrf: Array,
+    brf: Array,
+    *,
+    block_size: int,
+    lam: float,
+    num_iter: int,
+    mesh,
+    n_true: Optional[int] = None,
+    feat_dtype=jnp.float32,
+) -> Array:
+    """2-D (data × model) form of the north-star program: the Gramian/
+    factor stash, the block weights AND the feature bank shard over the
+    ``model`` axis (reference analog: VectorSplitter.scala:10-36 feature
+    blocks over workers), while rows shard over BOTH axes so every device
+    computes on every block step.
+
+    Per-device stash drops from nb·bs² to (nb/model_size)·bs² — the lever
+    NORTHSTAR.md §3 names for d ≫ 200k: at d_feat = 409,600 (100 blocks
+    of 4096) the replicated stash would be 13.4 GB (Gramian + factor);
+    over model=4 it is 3.4 GB.
+
+    Block b's owner is model index b // (nb/model_size) (contiguous
+    assignment matches the bank's natural sharding). Per block step:
+
+      bank slice  psum over model (bs·d_in floats — owner broadcasts)
+      F           local cos slab over the device's rows, freed per step
+      gram/corr   psum over BOTH axes (epoch 1) / corr only (later)
+      solve       epoch 1: replicated (gram is replicated post-psum);
+                  later: the OWNER computes gram@w_old and the Cholesky
+                  solve from its stash, then broadcasts w_new/w_old
+                  (2·bs·k floats) — the stash itself never crosses the
+                  interconnect
+      R update    local rows
+
+    Returns (nb, bs, k) block weights sharded over ``model`` on axis 0.
+    X/Y rows must be sharded over (data, model) flattened (data-major).
+    """
+    data_ax = mesh_lib.DATA_AXIS
+    model_ax = mesh_lib.MODEL_AXIS
+    d_feat = Wrf.shape[0]
+    d_in = X.shape[1]
+    k = Y.shape[1]
+    if d_feat % block_size:
+        raise ValueError(f"d_feat {d_feat} not divisible by {block_size}")
+    nb = d_feat // block_size
+    mc = mesh_lib.axis_size(mesh, model_ax)
+    dr = mesh_lib.axis_size(mesh, data_ax)
+    if nb % mc:
+        raise ValueError(f"nb {nb} not divisible by model axis {mc}")
+    nb_local = nb // mc
+    n_pad = X.shape[0]
+    ln = n_pad // (dr * mc)
+    bs = block_size
+
+    def body(x_local, y_local, wrf_local, brf_local):
+        lam_t = jnp.asarray(lam, jnp.float32)
+        mi = jax.lax.axis_index(model_ax)
+        if n_true is not None and n_true != n_pad:
+            # P((data, model)) splits rows data-major.
+            start = (jax.lax.axis_index(data_ax) * mc + mi) * ln
+            valid = (
+                (start + jnp.arange(ln)) < n_true
+            ).astype(jnp.float32)[:, None]
+        else:
+            valid = None
+
+        def bank_block(b):
+            slot = jnp.mod(b, nb_local)
+            owner = b // nb_local
+            is_owner = (mi == owner)
+            sl = jax.lax.dynamic_slice(
+                wrf_local, (slot * bs, 0), (bs, d_in)
+            )
+            bb = jax.lax.dynamic_slice(brf_local, (slot * bs,), (bs,))
+            own_f = is_owner.astype(sl.dtype)
+            Wb = jax.lax.psum(sl * own_f, model_ax)
+            bv = jax.lax.psum(bb * own_f, model_ax)
+            return Wb, bv, is_owner, slot
+
+        def featurize(x, Wb, bv):
+            F = jnp.cos(x @ Wb.T + bv).astype(feat_dtype)
+            if valid is not None:
+                F = F * valid.astype(F.dtype)
+            return F
+
+        acc = jnp.promote_types(feat_dtype, jnp.float32)
+
+        def corr_of(F, R):
+            return jax.lax.psum(
+                jax.lax.psum(
+                    jax.lax.dot_general(
+                        F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
+                        preferred_element_type=acc,
+                    ),
+                    data_ax,
+                ),
+                model_ax,
+            )
+
+        def apply_delta(R, F, w_new, w_old):
+            delta = jax.lax.dot_general(
+                F, (w_new - w_old).astype(F.dtype),
+                (((1,), (0,)), ((), ())), preferred_element_type=acc,
+            )
+            return R - delta.astype(R.dtype)
+
+        def mask_store(stash, slot, value, is_owner):
+            old = jax.lax.dynamic_index_in_dim(stash, slot, 0, keepdims=False)
+            new = jnp.where(is_owner, value, old)
+            return jax.lax.dynamic_update_index_in_dim(stash, new, slot, 0)
+
+        def first_step(carry, b):
+            R, Wst, G, C = carry
+            Wb, bv, is_owner, slot = bank_block(b)
+            F = featurize(x_local, Wb, bv)
+            gram = jax.lax.psum(
+                jax.lax.psum(
+                    jax.lax.dot_general(
+                        F, F, (((0,), (0,)), ((), ())),
+                        preferred_element_type=acc,
+                    ),
+                    data_ax,
+                ),
+                model_ax,
+            )
+            chol = _psd_factor(gram, lam_t)
+            corr = corr_of(F, R)
+            # w_old is zero in epoch 1 (fresh W) — rhs is just corr.
+            w_new = _solve_psd(gram, corr, lam_t, chol=chol)
+            R = apply_delta(R, F, w_new, jnp.zeros_like(w_new))
+            G = mask_store(G, slot, gram, is_owner)
+            C = mask_store(C, slot, chol, is_owner)
+            Wst = mask_store(Wst, slot, w_new, is_owner)
+            return (R, Wst, G, C), None
+
+        def later_step(carry, b):
+            R, Wst, G, C = carry
+            Wb, bv, is_owner, slot = bank_block(b)
+            F = featurize(x_local, Wb, bv)
+            corr = corr_of(F, R)
+            own_f = is_owner.astype(jnp.float32)
+            gram_l = jax.lax.dynamic_index_in_dim(G, slot, 0, keepdims=False)
+            chol_l = jax.lax.dynamic_index_in_dim(C, slot, 0, keepdims=False)
+            w_old_l = jax.lax.dynamic_index_in_dim(
+                Wst, slot, 0, keepdims=False
+            )
+            # Non-owners hold garbage stash slots; guard the factor with I
+            # so their (masked-out) solves stay finite — NaN·0 would leak.
+            chol_safe = jnp.where(
+                is_owner, chol_l, jnp.eye(bs, dtype=chol_l.dtype)
+            )
+            rhs = corr + gram_l @ w_old_l
+            w_new_l = _solve_psd(gram_l, rhs, lam_t, chol=chol_safe)
+            w_new = jax.lax.psum(w_new_l * own_f, model_ax)
+            w_old = jax.lax.psum(w_old_l * own_f, model_ax)
+            R = apply_delta(R, F, w_new, w_old)
+            Wst = mask_store(Wst, slot, w_new, is_owner)
+            return (R, Wst, G, C), None
+
+        R0 = y_local.astype(jnp.float32)
+        if valid is not None:
+            R0 = R0 * valid
+        Wst0 = jnp.zeros((nb_local, bs, k), jnp.float32)
+        G0 = jnp.zeros((nb_local, bs, bs), jnp.float32)
+        C0 = jnp.zeros((nb_local, bs, bs), jnp.float32)
+        order = jnp.arange(nb)
+        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0), order)
+        if num_iter > 1:
+            def epoch(carry, _):
+                carry, _ = jax.lax.scan(later_step, carry, order)
+                return carry, None
+            carry, _ = jax.lax.scan(epoch, carry, None, length=num_iter - 1)
+        return carry[1]
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(
+            P((data_ax, model_ax)), P((data_ax, model_ax)),
+            P(model_ax), P(model_ax),
+        ),
+        out_specs=P(model_ax),
         check_vma=False,
     )(X, Y, Wrf, brf)
 

@@ -45,11 +45,20 @@ class TimitConfig:
     lam: float = 0.0
     seed: int = 123
     synthetic_n: int = 4096
-    # Out-of-core mode: featurize INSIDE the fit, per row tile — the
-    # feature matrix never materializes, so feature counts past HBM
-    # (the reference's 204,800-dim default at cluster row counts) run on
-    # one chip (ops/learning/streaming_ls.py; the BENCH_r04 headline
-    # path). Solver semantics = raw BCD (no mean-centering).
+    # Solver selection:
+    #   "auto"      — cost-model-driven (LeastSquaresEstimator): the
+    #                 optimizer picks among resident solvers and the
+    #                 out-of-core streaming tier by analytic cost under an
+    #                 HBM feasibility cut; past the memory wall the
+    #                 StreamedFitFusionRule binds the cosine featurizer
+    #                 into the fit with NO flag (the reference's defining
+    #                 behavior, LeastSquaresEstimator.scala:59-84).
+    #   "block"     — force BlockLeastSquares(block_size, epochs, λ), the
+    #                 reference TimitPipeline's literal composition.
+    #   "streaming" — force the out-of-core tier (the old --streaming).
+    # All three fit the same centered model (streaming_ls centering).
+    solver: str = "auto"
+    # Back-compat alias: streaming=True == solver="streaming".
     streaming: bool = False
 
 
@@ -100,7 +109,8 @@ def run(config: TimitConfig):
 
     labels = ClassLabelIndicatorsFromIntLabels(NUM_CLASSES)(train.labels)
 
-    if config.streaming:
+    solver = "streaming" if config.streaming else config.solver
+    if solver == "streaming":
         import jax.numpy as jnp
 
         from keystone_tpu.ops.learning.streaming_ls import (
@@ -125,6 +135,21 @@ def run(config: TimitConfig):
             lam=config.lam,
         )
         pipeline = est.with_data(train.data, labels).and_then(MaxClassifier())
+    elif solver == "auto":
+        # Cost-model-driven selection: at resident-friendly geometry this
+        # picks a resident solver (BlockLS at the reference's shape); past
+        # the HBM wall the streaming choice wins and the optimizer fuses
+        # the cosine featurizer into the fit — no flag.
+        from keystone_tpu.ops.learning.cost import LeastSquaresEstimator
+
+        est = LeastSquaresEstimator(
+            lam=config.lam,
+            block_size=config.block_size,
+            block_iters=config.num_epochs,
+        )
+        pipeline = build_featurizer(config).and_then(
+            est, train.data, labels,
+        ).and_then(MaxClassifier())
     else:
         pipeline = build_featurizer(config).and_then(
             BlockLeastSquaresEstimator(config.block_size, config.num_epochs, config.lam),
@@ -157,7 +182,12 @@ def main(argv=None):
     parser.add_argument("--seed", type=int, default=123)
     parser.add_argument(
         "--streaming", action="store_true",
-        help="out-of-core fit: featurize per row tile inside the solver",
+        help="force the out-of-core fit (equivalent to --solver streaming)",
+    )
+    parser.add_argument(
+        "--solver", default="auto", choices=["auto", "block", "streaming"],
+        help="auto = cost-model selection with HBM feasibility (default); "
+        "block = reference-literal BlockLeastSquares",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
@@ -174,6 +204,7 @@ def main(argv=None):
         num_epochs=args.numEpochs,
         lam=args.lam,
         seed=args.seed,
+        solver=args.solver,
         streaming=args.streaming,
     )
     _, train_eval, test_eval = run(config)

@@ -35,7 +35,7 @@ import jax
 
 from .env import Prefix
 from .graph import Graph, NodeId, SinkId
-from .operators import GatherTransformerOperator
+from .operators import DelegatingOperator, GatherTransformerOperator
 from .optimizer import Plan, Rule
 from .pipeline import LabelEstimator, Transformer
 
@@ -46,6 +46,7 @@ __all__ = [
     "StageFusionRule",
     "GatherFusionRule",
     "EstimatorFusionRule",
+    "StreamedFitFusionRule",
     "fusable",
 ]
 
@@ -120,16 +121,21 @@ class FusedBatchTransformer(Transformer):
 class DeviceFit:
     """The traceable-fit contract estimators opt into for fit fusion.
 
-    ``fit(F, Y, n_true) -> params`` must be traceable (jittable) on the
-    featurized array; ``build(params) -> Transformer`` runs on host with
-    the concrete params; ``supports(d_feat)`` gates geometry (e.g. block
-    divisibility) before any tracing happens.
+    ``fit(F, Y, n_true, *operands) -> params`` must be traceable
+    (jittable) on the featurized array; ``build(params) -> Transformer``
+    runs on host with the concrete params; ``supports(d_feat)`` gates
+    geometry (e.g. block divisibility) before any tracing happens.
+    ``operands``: arrays the fit needs as TRACED inputs (e.g. a random-
+    feature bank) — a fit that closes over concrete arrays embeds them as
+    HLO constants, which recompiles per instance and breaks the
+    remote-compile transport at TIMIT bank sizes.
     """
 
-    def __init__(self, fit, build, supports=lambda d: True):
+    def __init__(self, fit, build, supports=lambda d: True, operands=()):
         self.fit = fit
         self.build = build
         self.supports = supports
+        self.operands = tuple(operands)
 
 
 def masked_center(F, Y, n_true: int):
@@ -307,14 +313,14 @@ class FusedFitEstimator(LabelEstimator):
         if fused is None:
 
             @jax.jit
-            def fused(X, Y):
-                return dev.fit(_compose(fns, X), Y, n_true)
+            def fused(X, Y, operands):
+                return dev.fit(_compose(fns, X), Y, n_true, *operands)
 
             if len(self._programs) >= _FIT_PROGRAM_CACHE_MAX:
                 self._programs.pop(next(iter(self._programs)))
             self._programs[key] = fused
 
-        params = fused(X, labels.array)
+        params = fused(X, labels.array, dev.operands)
         return dev.build(params)
 
 
@@ -549,6 +555,150 @@ class GatherFusionRule(Rule):
                     plan = plan.remove_node(t)
             consumers = _consumers(plan)
         return plan, prefixes
+
+
+class StreamedFitFusionRule(Rule):
+    """Bind the upstream featurize program INTO a capacity-selected
+    streaming estimator.
+
+    Applies when a node's operator declares ``streamed_fit_fusable``
+    (the cost model's StreamingLeastSquaresChoice) and its DATA input is
+    a fusable transformer consumed only by it. The rewrite calls the
+    choice's ``fuse_with_members(members)``, whose fit generates features
+    per row tile inside the solver — the feature matrix never
+    materializes, which is the entire point of the selection: the cost
+    model picked this tier BECAUSE the featurized operand cannot fit.
+    Runs after Stage/Gather fusion (upstream is one node) and after
+    NodeOptimizationRule (the choice has been swapped in).
+    """
+
+    def __init__(self) -> None:
+        self._memo = _IdentityMemo()
+
+    def _fused(self, members, choice):
+        return self._memo.get(
+            list(members) + [choice],
+            lambda hit: hit.choice is choice
+            and len(hit.members) == len(members)
+            and all(a is b for a, b in zip(hit.members, members)),
+            lambda: choice.fuse_with_members(members),
+        )
+
+    def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        consumers = _consumers(plan)
+        for node in sorted(plan.nodes, key=lambda n: n.id):
+            if node not in plan.nodes:
+                continue
+            op = plan.get_operator(node)
+            if not getattr(op, "streamed_fit_fusable", False):
+                continue
+            deps = plan.get_dependencies(node)
+            if len(deps) != 2:
+                continue
+            dnode = deps[0]
+            unbindable = None
+            dop = None
+            if not isinstance(dnode, NodeId) or dnode in prefixes:
+                unbindable = "its data input is a source/prefix-published node"
+            else:
+                dop = plan.get_operator(dnode)
+                if not fusable(dop) or len(plan.get_dependencies(dnode)) != 1:
+                    unbindable = "its upstream transformer is not device-fusable"
+            if unbindable:
+                _logger().warning(
+                    "capacity-selected streaming fit at %s cannot bind its "
+                    "featurizer (%s): the fit will tile-stream MATERIALIZED "
+                    "features — the memory-wall selection may not hold",
+                    getattr(op, "label", op), unbindable,
+                )
+                continue
+            # The featurize node may have other consumers ONLY when they
+            # are this estimator's own apply sites (delegating nodes fed
+            # by the same featurizer — CSE merges the train and apply
+            # chains when the pipeline is applied to its training data).
+            # Those get rewired to RAW input below; any other consumer
+            # means the featurized result is genuinely needed elsewhere
+            # and fusing would force recomputation — bail.
+            def _is_own_delegate(c):
+                return (
+                    isinstance(c, NodeId)
+                    and isinstance(plan.get_operator(c), DelegatingOperator)
+                    and list(plan.get_dependencies(c)) == [node, dnode]
+                )
+
+            shared_delegates = [
+                c for c in consumers.get(dnode, []) if c != node
+            ]
+            if not all(_is_own_delegate(c) for c in shared_delegates):
+                _logger().warning(
+                    "capacity-selected streaming fit at %s cannot bind its "
+                    "featurizer (featurized result has other consumers): "
+                    "the fit will tile-stream MATERIALIZED features — the "
+                    "memory-wall selection may not hold",
+                    getattr(op, "label", op),
+                )
+                continue
+            members = (
+                dop.members
+                if isinstance(dop, FusedBatchTransformer)
+                else [dop]
+            )
+            fused = self._fused(members, op)
+            # Rewiring apply sites to feed RAW rows requires the fitted
+            # model to disambiguate raw vs featurized input by width —
+            # only provable for bank featurizers with d_in != d_feat.
+            can_rewire = getattr(fused, "can_serve_raw_input", False)
+            raw_in = plan.get_dependencies(dnode)[0]
+            plan = plan.set_operator(node, fused)
+            plan = plan.set_dependencies(node, [raw_in, deps[1]])
+            if can_rewire:
+                for c in shared_delegates:
+                    plan = plan.set_dependencies(c, [node, raw_in])
+            if can_rewire or not shared_delegates:
+                plan = plan.remove_node(dnode)
+            # else: dnode stays — the shared delegates keep featurizing
+            # upstream and the width-adaptive model takes the identity
+            # path on their featurized input.
+
+            # Remaining apply sites (delegating nodes) may featurize via a
+            # TWIN node holding the SAME operator (the fusion memos
+            # guarantee object identity for train/apply twins — the
+            # non-merged case, e.g. applying to held-out data). Rewire
+            # them to feed RAW input too: the fitted model then carries
+            # the featurizer and applies it tile-wise, so inference never
+            # materializes the feature matrix either. Sites that keep
+            # their featurizer still work — the fitted model is
+            # width-adaptive (StreamingFeaturizedLinearModel.d_in).
+            consumers = _consumers(plan)
+            if can_rewire:
+                delegates = [
+                    c for c in consumers.get(node, [])
+                    if isinstance(c, NodeId)
+                    and isinstance(plan.get_operator(c), DelegatingOperator)
+                ]
+                for c in delegates:
+                    cdeps = plan.get_dependencies(c)
+                    ain = cdeps[1] if len(cdeps) == 2 else None
+                    if ain == raw_in:
+                        continue  # rewired above (merged case)
+                    if (
+                        isinstance(ain, NodeId)
+                        and plan.get_operator(ain) is dop
+                        and len(plan.get_dependencies(ain)) == 1
+                    ):
+                        plan = plan.set_dependencies(
+                            c, [cdeps[0], plan.get_dependencies(ain)[0]]
+                        )
+                        if consumers.get(ain, []) == [c]:
+                            plan = plan.remove_node(ain)
+                consumers = _consumers(plan)
+        return plan, prefixes
+
+
+def _logger():
+    import logging
+
+    return logging.getLogger("keystone_tpu.fusion")
 
 
 class EstimatorFusionRule(Rule):

@@ -110,9 +110,13 @@ def _make_stage_fusion():
 
 
 def _make_tree_fit_fusion():
-    from .fusion import EstimatorFusionRule, GatherFusionRule
+    from .fusion import (
+        EstimatorFusionRule,
+        GatherFusionRule,
+        StreamedFitFusionRule,
+    )
 
-    return [GatherFusionRule(), EstimatorFusionRule()]
+    return [GatherFusionRule(), EstimatorFusionRule(), StreamedFitFusionRule()]
 
 
 class DefaultOptimizer(Optimizer):

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 from typing import Dict, Set
 
+import numpy as np
+
 from . import analysis
 from .env import PipelineEnv, Prefix
 from .graph import Graph, NodeId, SourceId
@@ -177,6 +179,24 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
     from keystone_tpu.data import Dataset
     from .operators import DatasetOperator
 
+    def _row_bytes(ds: Dataset):
+        """Approximate bytes per row of the raw source (streaming-tier
+        capacity models keep RAW rows resident, not features)."""
+        try:
+            if ds.is_host:
+                items = ds.to_list()
+                return float(np.asarray(items[0]).nbytes) if items else None
+            import jax.tree_util as jtu
+
+            return float(
+                sum(
+                    int(np.prod(x.shape[1:])) * x.dtype.itemsize
+                    for x in jtu.tree_leaves(ds.data)
+                )
+            )
+        except Exception:
+            return None
+
     def sample_dataset(ds: Dataset) -> Dataset:
         num_shards = 1
         if ds.mesh is not None:
@@ -195,6 +215,7 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
         # numPerPartition, LeastSquaresEstimator.scala:60-64); the sample only
         # supplies d, k, and sparsity.
         out.total_n = ds.n
+        out.source_row_bytes = _row_bytes(ds)
         return out
 
     # Execute with a private memo table, sampling at every DatasetOperator.
@@ -210,6 +231,25 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
         else:
             exprs = [_wrap(d) for d in deps]
             value = op.execute(exprs).get()
+            # Operators derive NEW Datasets, losing the sample metadata —
+            # without re-attaching it here a chained optimizable node would
+            # see n = the handful of sampled rows and cost-select for a
+            # tiny problem (the reference's numPerPartition reaches its
+            # estimators whole, LeastSquaresEstimator.scala:60-64).
+            if isinstance(value, Dataset):
+                dep_ds = [v for v in deps if isinstance(v, Dataset)]
+                totals = [
+                    v.total_n for v in dep_ds
+                    if getattr(v, "total_n", None) is not None
+                ]
+                if totals:
+                    value.total_n = max(totals)
+                raws = [
+                    v.source_row_bytes for v in dep_ds
+                    if getattr(v, "source_row_bytes", None) is not None
+                ]
+                if raws:
+                    value.source_row_bytes = max(raws)
         memo[gid] = value
         return value
 

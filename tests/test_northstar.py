@@ -143,3 +143,164 @@ class TestNorthstarProgram:
 
         f1, f3 = flops(1), flops(3)
         assert f3 < 2.0 * f1, (f1, f3)
+
+
+class TestNorthstar2D:
+    """The 2-D (data x model) variant (VERDICT r4 directive #3): stash,
+    bank and block weights shard over `model`; rows shard over both axes.
+    Per-device stash = nb/model_size Gramians+factors — the d >> 200k
+    lever NORTHSTAR.md §3 names."""
+
+    def _mesh42(self):
+        return mesh_lib.make_mesh(
+            (4, 2), (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+        )
+
+    def _shard(self, mesh, Xp, Yp, Wrf, brf):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        rows = P((mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
+        return (
+            jax.device_put(jnp.asarray(Xp), NamedSharding(mesh, rows)),
+            jax.device_put(jnp.asarray(Yp), NamedSharding(mesh, rows)),
+            jax.device_put(Wrf, NamedSharding(mesh, P(mesh_lib.MODEL_AXIS))),
+            jax.device_put(brf, NamedSharding(mesh, P(mesh_lib.MODEL_AXIS))),
+        )
+
+    def test_2d_mesh_matches_resident(self):
+        d_feat = 4 * BS  # nb=4 over model=2 -> 2 blocks/group
+        Wrf, brf = _bank(d_feat)
+        mesh = self._mesh42()
+        n_true, n_pad = 700, 704  # 88 rows/device over 8 devices
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(n_true, D_IN)).astype(np.float32)
+        Y = rng.normal(size=(n_true, K)).astype(np.float32)
+        Xp = np.vstack(
+            [X, rng.normal(size=(n_pad - n_true, D_IN)).astype(np.float32)]
+        )
+        Yp = np.vstack([Y, np.zeros((n_pad - n_true, K), np.float32)])
+        Xs, Ys, Ws, bs_ = self._shard(mesh, Xp, Yp, Wrf, brf)
+        W_2d = streaming.streaming_block_bcd_mesh_2d(
+            Xs, Ys, Ws, bs_, block_size=BS, lam=LAM, num_iter=3, mesh=mesh,
+            n_true=n_true,
+        )
+        F = jnp.cos(jnp.asarray(X) @ Wrf.T + brf)
+        W_ref = bcd_least_squares_fused_flat(
+            F, jnp.asarray(Y), BS, lam=LAM, num_iter=3, use_pallas=False
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_2d), np.asarray(W_ref), atol=2e-3, rtol=2e-3
+        )
+
+    def _lowered_2d(self, d_feat=8 * BS, n_pad=1024):
+        Wrf, brf = _bank(d_feat)
+        mesh = self._mesh42()
+        Xs, Ys, Ws, bs_ = self._shard(
+            mesh,
+            np.zeros((n_pad, D_IN), np.float32),
+            np.zeros((n_pad, K), np.float32),
+            Wrf, brf,
+        )
+        return jax.jit(
+            lambda a, b, w, c: streaming.streaming_block_bcd_mesh_2d(
+                a, b, w, c, block_size=BS, lam=LAM, num_iter=3, mesh=mesh
+            )
+        ).lower(Xs, Ys, Ws, bs_)
+
+    def test_2d_hlo_no_feature_width_gather(self):
+        hlo = self._lowered_2d().compile().as_text()
+        assert "all-reduce" in hlo
+        d_feat = 8 * BS
+        for m in re.finditer(r"all-gather[^=\n]*=\s*\S*f32\[([0-9,]+)\]", hlo):
+            dims = [int(x) for x in m.group(1).split(",")]
+            assert d_feat not in dims, f"feature-width all-gather: {m.group(0)}"
+
+    def test_2d_live_buffer_shards_stash(self):
+        d_feat, n_pad = 8 * BS, 1024
+        compiled = self._lowered_2d(d_feat, n_pad).compile()
+        mem = compiled.memory_analysis()
+        if mem is None:
+            pytest.skip("backend exposes no memory analysis")
+        peak = getattr(mem, "temp_size_in_bytes", None)
+        if peak is None:
+            pytest.skip("no temp_size_in_bytes on this backend")
+        ln = n_pad // 8
+        nb, mc = d_feat // BS, 2
+        # Per-device model: raw rows + residual + one slab + SHARDED stash
+        # (nb/mc Gramians + factors) + sharded weights + sharded bank.
+        stash = 2 * (nb // mc) * BS * BS
+        model = (
+            ln * D_IN + ln * K + ln * BS + stash
+            + (nb // mc) * BS * K + (d_feat // mc) * (D_IN + 1)
+        ) * 4
+        assert peak <= 4 * model, (peak, model)
+        # And the stash sharding is visible: the replicated-stash model of
+        # the 1-D program would be ~2x larger at this geometry.
+        replicated_stash_model = model + 2 * (nb - nb // mc) * BS * BS * 4
+        assert model < replicated_stash_model
+
+
+@pytest.mark.slow
+class TestNorthstarRealisticShape:
+    """VERDICT r4 directive #5: one mesh case at realistic per-device
+    shapes — bs >= 1024, d_feat >= 8192, rows/device >= 8192, ragged
+    n_true — the shape class where padding/raggedness/layout bugs live."""
+
+    def test_realistic_shape_parity_and_structure(self):
+        bs, d_feat, d_in, k = 1024, 8192, 64, 8
+        mesh = mesh_lib.make_mesh()
+        num = mesh_lib.axis_size(mesh, mesh_lib.DATA_AXIS)
+        n_pad = 8192 * num
+        n_true = n_pad - 1237  # ragged: boundary shard partially valid
+        rng = np.random.default_rng(7)
+        Wrf = jnp.asarray(
+            rng.normal(size=(d_feat, d_in)).astype(np.float32) * 0.3
+        )
+        brf = jnp.asarray(
+            rng.uniform(0, 2 * np.pi, size=(d_feat,)).astype(np.float32)
+        )
+        X = rng.normal(size=(n_pad, d_in)).astype(np.float32)
+        Y = np.zeros((n_pad, k), np.float32)
+        Y[:n_true] = rng.normal(size=(n_true, k)).astype(np.float32)
+
+        fit = jax.jit(
+            lambda a, b, w, c: streaming.streaming_block_bcd_mesh(
+                a, b, w, c, block_size=bs, lam=LAM, num_iter=2, mesh=mesh,
+                n_true=n_true,
+            )
+        )
+        Xs = mesh_lib.shard_rows(jnp.asarray(X), mesh)
+        Ys = mesh_lib.shard_rows(jnp.asarray(Y), mesh)
+
+        # Structural assertions at THIS shape, not just the miniature one.
+        lowered = fit.lower(Xs, Ys, Wrf, brf)
+        compiled = lowered.compile()
+        hlo = compiled.as_text()
+        assert "all-reduce" in hlo
+        for m in re.finditer(r"all-gather[^=\n]*=\s*\S*f32\[([0-9,]+)\]", hlo):
+            dims = [int(x) for x in m.group(1).split(",")]
+            assert d_feat not in dims, f"feature-width all-gather: {m.group(0)}"
+        mem = compiled.memory_analysis()
+        if mem is not None and getattr(mem, "temp_size_in_bytes", None):
+            ln = n_pad // num
+            nb = d_feat // bs
+            model = (
+                ln * d_in + ln * k + ln * bs + 2 * nb * bs * bs
+                + nb * bs * k + d_feat * (d_in + 1)
+            ) * 4
+            materialized = ln * d_feat * 4
+            assert mem.temp_size_in_bytes <= 4 * model, (
+                mem.temp_size_in_bytes, model, materialized,
+            )
+
+        W_mesh = fit(Xs, Ys, Wrf, brf)
+
+        # Parity against the resident solver on the same features.
+        F = jnp.cos(jnp.asarray(X[:n_true]) @ Wrf.T + brf)
+        W_ref = bcd_least_squares_fused_flat(
+            F, jnp.asarray(Y[:n_true]), bs, lam=LAM, num_iter=2,
+            use_pallas=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_mesh), np.asarray(W_ref), atol=5e-3, rtol=5e-3
+        )

@@ -321,13 +321,14 @@ class TestStreamingCentered:
             featurize=featurize, d_feat=D_FEAT, tile_rows=128,
             block_size=BLOCK, num_iter=2,
         )
-        fn = streaming.streaming_bcd_fit_centered
-        before = fn._cache_size()
+        before = streaming._streaming_fit_closure._cache_size()
         sols = [
-            np.asarray(fn(X, Y, lam=lam, **kw)[0])
+            np.asarray(
+                streaming.streaming_bcd_fit_centered(X, Y, lam=lam, **kw)[0]
+            )
             for lam in (1e-3, 1e-2, 1e-1)
         ]
-        assert fn._cache_size() - before == 1
+        assert streaming._streaming_fit_closure._cache_size() - before == 1
         # λ actually took effect: heavier ridge shrinks the weights.
         norms = [float(np.linalg.norm(s)) for s in sols]
         assert norms[0] > norms[1] > norms[2]
