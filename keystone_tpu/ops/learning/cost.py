@@ -161,6 +161,12 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
 
         dense_lbfgs = DenseLBFGSwithL2(lam=lam, num_iterations=20)
         sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
+        # The gram engine: fold G once on the MXU, iterate data-free —
+        # cheaper than gather past ~5 iterations whenever its (d_pad)^2
+        # Gramian fits the budget (its resident_bytes carries that term).
+        sparse_gram = SparseLBFGSwithL2(
+            lam=lam, num_iterations=20, solver="gram"
+        )
         block = BlockLeastSquaresEstimator(block_size, block_iters, lam=lam)
         exact = LinearMapEstimator(lam)
         streaming = StreamingLeastSquaresChoice(
@@ -172,6 +178,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self.options: Sequence[Tuple[object, LabelEstimator]] = [
             (dense_lbfgs, dense_lbfgs),
             (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
+            (sparse_gram, TransformerLabelEstimatorChain(Sparsify(), sparse_gram)),
             (block, TransformerLabelEstimatorChain(Densify(), block)),
             (exact, TransformerLabelEstimatorChain(Densify(), exact)),
             # The streaming choice is its own graph operator (no Densify
@@ -206,7 +213,13 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         if is_sparse_dataset(sample):
             indices = np.asarray(sample.data["indices"])
             d = int(indices.max()) + 1
-            sparsity = float((indices >= 0).sum() / (max(n, 1) * d))
+            # Active fraction measured over the SAMPLE's valid rows
+            # (dividing by the full n would collapse sparsity toward zero
+            # whenever the collector attaches total_n; padded-COO rows
+            # hold -1 lanes, which the >= 0 mask already excludes).
+            sparsity = float(
+                (indices >= 0).sum() / (max(sample.n, 1) * d)
+            )
         elif sample.is_host:
             first = sample.to_list()[0]
             d = int(np.asarray(first).shape[-1])

@@ -696,20 +696,33 @@ class SparseLBFGSwithL2(LabelEstimator):
         logger.info("LBFGS(gram) final loss: %s", float(final_loss))
         return W
 
+    # Measured on-chip calibration (BENCH_r04 amazon row): the gram
+    # engine's one-time densify+syrk fold plus 20 G-space iterations cost
+    # ~4.5 gather-engine iterations end-to-end at the Amazon geometry —
+    # the MXU-vs-random-access gap the reference's CPU-fitted weights
+    # cannot express analytically.
+    _GRAM_FOLD_ITER_EQUIV = 4.5
+
     def cost(
         self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight,
         sparse_overhead: float = 8.0,
     ) -> float:
-        """Analytic cost model (LBFGS.scala:264-280)."""
+        """Analytic cost model (LBFGS.scala:264-280). The ``gram`` engine
+        is priced as a measured iteration-equivalent of the gather engine
+        (fold once, then data-free iterations) — see _GRAM_FOLD_ITER_EQUIV."""
         import math
 
         flops = n * sparsity * d * k / num_machines
         bytes_scanned = n * d * sparsity / num_machines
         network = 2.0 * d * k * math.log2(max(num_machines, 2))
-        return self.num_iterations * (
+        per_iter = (
             sparse_overhead * max(cpu_weight * flops, mem_weight * bytes_scanned)
             + network_weight * network
         )
+        if self.solver == "gram":
+            iters_equiv = min(self._GRAM_FOLD_ITER_EQUIV, self.num_iterations)
+            return iters_equiv * per_iter + mem_weight * d * d / num_machines
+        return self.num_iterations * per_iter
 
     def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
         """Capacity model: padded-COO operand (int32 index + f32 value per

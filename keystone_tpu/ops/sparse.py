@@ -363,11 +363,18 @@ class Sparsify(Transformer):
     """Dense batch -> padded-COO sparse batch (reference: Sparsify.scala:10-20)."""
 
     def apply(self, x):
+        if isinstance(x, dict) and "indices" in x and "values" in x:
+            return x  # already a sparse item: identity (mirrors Densify)
         x = np.asarray(x)
         idx = np.nonzero(x)[0]
         return {"indices": idx.astype(np.int32), "values": x[idx].astype(np.float32)}
 
     def batch_apply(self, data: Dataset) -> Dataset:
+        if is_sparse_dataset(data):
+            # Already padded-COO (e.g. the cost-model selector's
+            # Sparsify->SparseLBFGS chain fitted on genuinely sparse
+            # input): sparsifying is the identity.
+            return data
         X = np.asarray(data.array)
         nnz_per_row = (X != 0).sum(axis=1)
         width = max(int(nnz_per_row.max()), 1)

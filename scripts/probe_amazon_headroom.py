@@ -1,0 +1,161 @@
+"""On-chip headroom decomposition for the Amazon full-n streamed fold.
+
+Measures (warm, synced) the per-chunk cost of each stage of the streamed
+sparse Gramian fold at the production geometry (c=65536 rows/chunk,
+d=16384 -> d_pad=17408 bf16):
+
+  - chunk regen (the I/O stand-in the bench uses in place of host I/O)
+  - the accumulating Pallas syrk on the densified slab (the floor)
+  - the whole fold per chunk (24-chunk warm run, extrapolated to the
+    993-chunk full row)
+
+These are the numbers behind the bench's ``headroom_decomposition_r5``
+note: the syrk alone runs at its measured ceiling (~149 TF/s ->
+~0.132 s/chunk, i.e. a ~131 s floor for the full fold), so wall-clock
+targets below that are structural, not implementation slack. Prints one
+JSON line.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(_here, ".."))
+
+from bench import NUM_FEATURES  # noqa: E402
+from keystone_tpu.ops import pallas_ops  # noqa: E402
+from keystone_tpu.ops.learning.lbfgs import run_lbfgs_gram_streamed  # noqa: E402
+from keystone_tpu.ops.sparse import gram_pad_dim  # noqa: E402
+
+d, nnz, k = NUM_FEATURES, 82, 2
+c, w = 65536, 83
+REPS = 8
+
+
+def _hash_bits(cid, shape, salt):
+    """The bench's counter-based u32 generator (see bench.py
+    amazon_fulln_metric for why threefry is not used here)."""
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = (
+        jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+        if len(shape) > 1 else jnp.zeros(shape, jnp.uint32)
+    )
+    x = rows * jnp.uint32(shape[-1] if len(shape) > 1 else 1) + cols
+    x = x + jnp.uint32(2654435761) * jnp.uint32(cid * 2 + salt + 1)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    return x ^ (x >> 16)
+
+
+def make_chunk_fn(n_full):
+    """The bench's chunk generator, verbatim geometry."""
+
+    def chunk_fn(cid):
+        bits = _hash_bits(cid, (c, nnz), 0)
+        idx = (bits % jnp.uint32(d)).astype(jnp.int16)
+        u = _hash_bits(cid, (c, nnz), 1)
+        vals = (
+            (u >> 8).astype(jnp.float32) * (3.464 / (1 << 24)) - 1.732
+        ).astype(jnp.bfloat16)
+        row = cid * c + jnp.arange(c)
+        valid = row < n_full
+        idx1 = jnp.concatenate(
+            [idx.astype(jnp.int32), jnp.where(valid, d, -1)[:, None]],
+            axis=1,
+        )
+        val1 = jnp.concatenate(
+            [
+                jnp.where(valid[:, None], vals, 0),
+                valid.astype(jnp.bfloat16)[:, None],
+            ],
+            axis=1,
+        )
+        y = (_hash_bits(cid, (c,), 2) % jnp.uint32(k)).astype(jnp.int32)
+        Y = jnp.where(
+            valid[:, None],
+            2.0 * jax.nn.one_hot(y, k, dtype=jnp.float32) - 1.0,
+            0.0,
+        )
+        return idx1, val1, Y
+
+    return chunk_fn
+
+
+def main():
+    out = {"c": c, "reps": REPS}
+    cf = make_chunk_fn(65_000_000)
+
+    # (a) regen only.
+    @jax.jit
+    def regen_only(_):
+        def body(i, acc):
+            idx1, val1, Y = cf(i)
+            return (
+                acc
+                + jnp.sum(idx1[:, 0].astype(jnp.float32))
+                + jnp.sum(val1.astype(jnp.float32))
+                + jnp.sum(Y)
+            )
+        return jax.lax.fori_loop(0, REPS, body, jnp.zeros((), jnp.float32))
+
+    float(regen_only(0))
+    t0 = time.perf_counter()
+    float(regen_only(0))
+    out["regen_s_per_chunk"] = round((time.perf_counter() - t0) / REPS, 4)
+
+    # (b) accumulating syrk ceiling on a full-width resident slab
+    # (constant content: MXU throughput is value-independent, and a
+    # generated slab's u32 intermediates would OOM beside the fit).
+    d_pad = gram_pad_dim(d + 1, jnp.bfloat16)
+    out["d_pad"] = d_pad
+    F = jnp.full((c, d_pad), 0.01, jnp.bfloat16)
+
+    @jax.jit
+    def syrk_only(F):
+        return jax.lax.fori_loop(
+            0, REPS, lambda i, G: pallas_ops.gram_sym_acc(G, F),
+            jnp.zeros((d_pad, d_pad), jnp.float32),
+        )
+
+    float(jnp.sum(syrk_only(F)))
+    t0 = time.perf_counter()
+    float(jnp.sum(syrk_only(F)))
+    dt = time.perf_counter() - t0
+    out["syrk_s_per_chunk"] = round(dt / REPS, 4)
+    macs = REPS * c * d_pad * d_pad / 2  # upper-triangle syrk
+    out["syrk_ceiling_tflops"] = round(2 * macs / dt / 1e12, 1)
+    out["fold_floor_s_fulln"] = round(65e6 / c * (dt / REPS), 1)
+
+    # (c) whole fold, 24 chunks, warm (the fit dispatch is async: block
+    # on the loss before stopping the clock).
+    chunks = 24
+    n = chunks * c
+    cf24 = make_chunk_fn(n)
+
+    def fold_once():
+        t0 = time.perf_counter()
+        _, loss = run_lbfgs_gram_streamed(
+            cf24, chunks, d + 1, k, lam=1e-3, num_iterations=2, n=n,
+            use_pallas=pallas_ops.pallas_enabled(),
+            val_dtype=jnp.bfloat16,
+        )
+        assert np.isfinite(float(loss))
+        return time.perf_counter() - t0
+
+    fold_once()  # compile
+    per_chunk = fold_once() / chunks
+    out["fold_s_per_chunk_warm"] = round(per_chunk, 4)
+    out["fulln_warm_est_s"] = round(per_chunk * 993, 1)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

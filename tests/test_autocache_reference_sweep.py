@@ -190,6 +190,7 @@ class TestGeneralizeProfiles:
 
 
 class TestProfileMemo:
+    @pytest.mark.slow
     def test_repeat_optimizations_profile_once(self, monkeypatch):
         # A λ-sweep re-optimizes logically-identical graphs; the greedy
         # rule must pay the sampled-profiling passes ONCE (memo keyed by

@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 import jax
+import jax.numpy as jnp
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.cost import (
@@ -68,6 +69,66 @@ class TestSelection:
                 assert rb(n, d, k, 1.0, 8) > budget, type(model).__name__
             else:
                 assert rb(n, d, k, 1.0, 8) < budget
+
+    def _sparse_sample(self, n_total, d, k, nnz=8):
+        rng = np.random.default_rng(4)
+        idx = rng.integers(0, d, size=(24, nnz)).astype(np.int32)
+        idx[0, 0] = d - 1  # pin the measured feature width
+        s = Dataset(
+            {"indices": jnp.asarray(idx),
+             "values": jnp.asarray(rng.normal(size=(24, nnz)).astype(np.float32))},
+            n=24,
+        )
+        s.total_n = n_total
+        s.source_row_bytes = nnz * 8.0
+        ls = Dataset.of(rng.normal(size=(24, k)).astype(np.float32))
+        return s, ls
+
+    def test_sparse_gram_engine_selected_when_gramian_fits(self):
+        # Fold-once + data-free iterations beats 20 gather passes when
+        # the (d_pad)^2 Gramian fits the budget (BENCH_r04 calibration).
+        from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+
+        est = LeastSquaresEstimator(lam=0.1, hbm_bytes=8 << 30)
+        s, ls = self._sparse_sample(50_000_000, 16384, 2)
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain)
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2) and inner.solver == "gram"
+
+    def test_sparse_gather_selected_when_gramian_does_not_fit(self):
+        from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+
+        # d = 600k: G would be ~1.4 TB — only the gather engine fits.
+        est = LeastSquaresEstimator(lam=0.1, hbm_bytes=8 << 30)
+        s, ls = self._sparse_sample(50_000_000, 600_000, 2)
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain)
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2) and inner.solver == "gather"
+
+    def test_selected_sparse_chain_fits_sparse_input(self):
+        # The Sparsify->SparseLBFGS chain must accept ALREADY-sparse input
+        # (Sparsify is then the identity) — the selector returns it for
+        # genuinely sparse datasets.
+        rng = np.random.default_rng(6)
+        n, d, nnz, k = 800, 128, 5, 2
+        idx = rng.integers(0, d, size=(n, nnz)).astype(np.int32)
+        idx[0, 0] = d - 1
+        val = rng.normal(size=(n, nnz)).astype(np.float32)
+        dense = np.zeros((n, d), np.float32)
+        np.add.at(dense, (np.arange(n)[:, None], idx), val)
+        W_true = rng.normal(size=(d, k)).astype(np.float32)
+        Y = dense @ W_true
+        sp = Dataset(
+            {"indices": jnp.asarray(idx), "values": jnp.asarray(val)}, n=n
+        )
+        est = LeastSquaresEstimator(lam=1e-4)
+        chosen = est.optimize(sp, Dataset.of(Y))
+        model = chosen.fit(sp, Dataset.of(Y))
+        preds = np.asarray(model.batch_apply(sp).array)
+        r2 = 1 - ((preds - Y) ** 2).sum() / ((Y - Y.mean(0)) ** 2).sum()
+        assert r2 > 0.95, r2
 
     def test_streaming_choice_direct_fit_matches_block_semantics(self):
         # The choice fit DIRECTLY on featurized data (no fusable upstream):

@@ -64,6 +64,7 @@ class _PreemptAfter:
 
 
 class TestCheckpointResume:
+    @pytest.mark.slow
     def test_segmented_fit_matches_unsegmented(self, tmp_path):
         data, labels = _problem()
         ref = _weights(_est().fit(data, labels))

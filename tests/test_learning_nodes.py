@@ -199,6 +199,7 @@ class TestBWLS:
         est = BlockWeightedLeastSquaresEstimator(4, 3, 0.1, 0.5)
         assert est.weight == 10
 
+    @pytest.mark.slow
     def test_sharded_matches_unsharded(self, mesh8):
         """Rows stay on the mesh: a sharded fit must equal the local fit
         (round 2 removed the host-f64 round trip; stats are device segment
@@ -213,6 +214,7 @@ class TestBWLS:
         p_sharded = m_sharded.batch_apply(train.data).to_numpy()
         np.testing.assert_allclose(p_sharded, p_local, atol=1e-8)
 
+    @pytest.mark.slow
     def test_mw_zero_close_to_unweighted(self):
         """mixture_weight→0 should approach the population (unweighted) solve."""
         train = synthetic_classification(300, 8, 3, seed=7)

@@ -135,6 +135,7 @@ class TestLogisticRegressionReference:
         acc = (preds.reshape(-1) == yv).mean()
         assert acc > 0.65, acc
 
+    @pytest.mark.slow
     def test_multinomial_matches_r_golden_weights(self):
         # "multinomial logistic regression with LBFGS": data drawn from the
         # iris-fitted model (intercept layout, stride d+1 — the Spark

@@ -156,6 +156,7 @@ class TestFusedBCD:
         )
         np.testing.assert_allclose(np.asarray(W2), np.asarray(W4), atol=1e-4)
 
+    @pytest.mark.slow
     def test_fused_with_pallas_interpret(self):
         with force_interpret():
             n, db, nb, k = 32, 8, 2, 3

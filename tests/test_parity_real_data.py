@@ -8,9 +8,13 @@ scripts/solver-comparisons-final.csv).
 """
 
 import numpy as np
+import pytest
 
 
 class TestDigitsRealDataParity:
+    # Fast-tier triage (round 5): real-data parity is the full tier's and
+    # parity.py's job; the fast tier keeps the synthetic parity tests.
+    @pytest.mark.slow
     def test_block_ls_matches_exact_on_real_digits(self):
         from keystone_tpu.pipelines import mnist_random_fft as mp
         from keystone_tpu.data.loaders import load_digits_real

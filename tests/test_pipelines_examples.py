@@ -48,6 +48,7 @@ class TestCifarFamily:
         _, train_eval, test_eval = run_linear_pixels(self.CFG)
         assert 0.0 <= test_eval.total_error <= 1.0
 
+    @pytest.mark.slow
     def test_random_patch_cifar_learns(self):
         _, train_eval, test_eval = run_random_patch_cifar(self.CFG)
         assert train_eval.total_error < 0.1
@@ -57,6 +58,7 @@ class TestCifarFamily:
         _, train_eval, test_eval = run_random_patch_cifar_kernel(self.CFG)
         assert test_eval.total_error < 0.5
 
+    @pytest.mark.slow
     def test_random_patch_cifar_kernel_checkpoint_flag(self, tmp_path,
                                                        monkeypatch):
         # The CLI-exposed checkpoint knobs plumb through to the KRR solver:
@@ -88,6 +90,7 @@ class TestCifarFamily:
         _, _, ref_eval = run_random_patch_cifar_kernel(ref_cfg)
         assert test_eval.total_error == ref_eval.total_error
 
+    @pytest.mark.slow
     def test_augmented_votes_over_crops(self):
         _, test_eval = run_random_patch_cifar_augmented(self.CFG)
         assert test_eval.total_error < 0.6
@@ -119,6 +122,7 @@ class TestVocImageNet:
 
 
 class TestTextPipelines:
+    @pytest.mark.slow
     def test_amazon_reviews(self):
         cfg = AmazonReviewsConfig(synthetic_n=200, common_features=400,
                                   num_iters=15)
@@ -181,6 +185,7 @@ class TestCLI:
 
 
 class TestFittedPipelineSerialization:
+    @pytest.mark.slow
     def test_cifar_fitted_pipeline_roundtrip(self, tmp_path):
         """fit() the full conv featurizer + solver pipeline, save, load in a
         fresh object, and check prediction parity (the reference's

@@ -161,6 +161,7 @@ class TestDatasetPaddingProperties:
         st.sampled_from([0.0, 1.5, -2.0]),
     )
     @settings(max_examples=40, deadline=None)
+    @pytest.mark.slow
     def test_map_batch_restores_zero_padding(self, n, d, shift):
         from keystone_tpu.parallel import mesh as mesh_lib
 
@@ -243,6 +244,7 @@ class TestSolverProperties:
         st.sampled_from([0.0, 1e-3, 0.5]),
     )
     @settings(max_examples=25, deadline=None)
+    @pytest.mark.slow
     def test_normal_equations_solution_is_stationary(self, extra, d, k, lam):
         # KKT: the ridge optimum satisfies (AᵀA + λI) W = AᵀB exactly.
         # Overdetermined draws only (n > d): underdetermined + lam=0 makes
@@ -464,6 +466,7 @@ class TestSparseProperties:
         st.integers(min_value=1, max_value=10),
     )
     @settings(max_examples=25, deadline=None)
+    @pytest.mark.slow
     def test_sparsify_densify_round_trip(self, n, d):
         from keystone_tpu.ops.sparse import Densify, Sparsify
 

@@ -65,6 +65,7 @@ class TestBWLSOnReferenceFixtures:
         # Reference: Stats.aboutEq(norm(gradient), 0, 1e-2).
         assert np.linalg.norm(grad) < 1e-2
 
+    @pytest.mark.slow
     def test_per_class_matches_block_weighted(self):
         A, B = _load("aMat.csv"), _load("bMat.csv")
         wsq = BlockWeightedLeastSquaresEstimator(4, 5, 0.1, 0.3).fit(

@@ -133,6 +133,7 @@ class TestPerClassWeightedLS:
         preds = np.asarray(model.batch_apply(Dataset.of(X)).array)
         assert np.isfinite(preds).all()
 
+    @pytest.mark.slow
     def test_classifies_separable_data(self):
         rng = np.random.default_rng(4)
         n, d, k = 120, 10, 3

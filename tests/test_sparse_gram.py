@@ -93,6 +93,7 @@ class TestSparseGramStream:
 
 
 class TestGramSolverMatchesGather:
+    @pytest.mark.slow
     def test_same_model_as_gather_path(self):
         ds, ys, *_ = _problem()
         m_gather = SparseLBFGSwithL2(
