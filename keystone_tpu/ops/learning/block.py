@@ -189,10 +189,10 @@ class BlockLeastSquaresEstimator(LabelEstimator):
 
         bs = self.block_size
 
-        def fit_fn(F, Y, n_true: int):
+        def fit_fn(F, Y, n_true: int, lam):
             Fc, Yc, fmean, ymean = masked_center(F, Y, n_true)
             W_stack = linalg.bcd_least_squares_fused_flat(
-                Fc, Yc, bs, lam=self.lam, num_iter=self.num_iter
+                Fc, Yc, bs, lam=lam, num_iter=self.num_iter
             )
             return W_stack, fmean, ymean
 
@@ -211,7 +211,14 @@ class BlockLeastSquaresEstimator(LabelEstimator):
         def supports(d_feat: int) -> bool:
             return d_feat % bs == 0 and self.num_features in (None, d_feat)
 
-        return DeviceFit(fit_fn, build, supports)
+        # λ rides as a traced operand and the program is shared by logical
+        # identity: a λ-sweep building a fresh estimator per λ compiles
+        # the fused featurize+fit ONCE (workflow/fusion.py DeviceFit).
+        return DeviceFit(
+            fit_fn, build, supports,
+            operands=(jnp.asarray(self.lam, jnp.float32),),
+            program_key=("BlockLS", bs, self.num_iter, self.num_features),
+        )
 
     def fit(self, data: Dataset, labels: Dataset) -> BlockLinearMapper:
         splitter = VectorSplitter(self.block_size, self.num_features)

@@ -241,8 +241,10 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             else device_memory_bytes()
         ) * self.hbm_utilization
         # The streaming tier's feature slab scales down with the budget so
-        # its capacity model and its actual tile sizing agree.
+        # its capacity model and its actual tile sizing agree; the budget
+        # itself drives its gram-vs-block tier decision.
         self._streaming_choice.slab_bytes = int(min(2 << 30, budget // 4))
+        self._streaming_choice.budget_bytes = budget
 
         def resident(opt) -> float:
             rb = getattr(opt[0], "resident_bytes", None)

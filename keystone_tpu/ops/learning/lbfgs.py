@@ -276,13 +276,13 @@ class DenseLBFGSwithL2(LabelEstimator):
         from keystone_tpu.ops.stats import StandardScalerModel
         from keystone_tpu.workflow.fusion import DeviceFit, masked_center
 
-        def fit_fn(F, Y, n_true: int):
+        def fit_fn(F, Y, n_true: int, lam):
             Fc, Yc, fmean, ymean = masked_center(F, Y, n_true)
             dtype = jnp.result_type(Fc.dtype, Yc.dtype)
             W0 = jnp.zeros((Fc.shape[1], Yc.shape[1]), dtype=dtype)
             W, _ = _lbfgs_body(
                 Fc.astype(dtype), Yc.astype(dtype), W0,
-                jnp.asarray(self.lam, dtype),
+                lam.astype(dtype),
                 jnp.asarray(self.num_iterations),
                 jnp.asarray(self.convergence_tol, dtype),
                 jnp.asarray(n_true, dtype),
@@ -295,7 +295,13 @@ class DenseLBFGSwithL2(LabelEstimator):
                 W, b_opt=ymean, feature_scaler=StandardScalerModel(fmean)
             )
 
-        return DeviceFit(fit_fn, build)
+        return DeviceFit(
+            fit_fn, build,
+            operands=(jnp.asarray(self.lam, jnp.float32),),
+            program_key=(
+                "DenseLBFGS", self.num_iterations, self.convergence_tol,
+            ),
+        )
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         feature_scaler = StandardScaler(normalize_std_dev=False).fit(data)

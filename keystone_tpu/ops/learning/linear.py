@@ -101,16 +101,18 @@ class LinearMapEstimator(LabelEstimator):
         normal-equations solve as one traceable function, so upstream
         featurization compiles INTO the fit (same pattern as
         BlockLeastSquaresEstimator.device_fit_fn)."""
-        from keystone_tpu.parallel.linalg import _normal_equations_kernel
+        from keystone_tpu.parallel.linalg import _solve_psd
         from keystone_tpu.workflow.fusion import DeviceFit, masked_center
 
-        lam = float(self.lam or 0.0)
-
-        def fit_fn(F, Y, n_true: int):
+        def fit_fn(F, Y, n_true: int, lam):
             Fc, Yc, fmean, ymean = masked_center(F, Y, n_true)
-            # Same kernel as the materialized-features fit(), so both
-            # paths share one accumulation-precision story.
-            x = _normal_equations_kernel(Fc, Yc.astype(Fc.dtype), lam)
+            Yc = Yc.astype(Fc.dtype)
+            # Same normal-equations kernel body as the materialized-
+            # features fit(), with λ as a traced operand (λ-sweeps share
+            # one compiled program).
+            gram = Fc.T @ Fc
+            corr = Fc.T @ Yc
+            x = _solve_psd(gram, corr, jnp.asarray(lam, Fc.dtype))
             return x, fmean, ymean
 
         def build(params):
@@ -119,7 +121,11 @@ class LinearMapEstimator(LabelEstimator):
                 x, b_opt=ymean, feature_scaler=StandardScalerModel(fmean)
             )
 
-        return DeviceFit(fit_fn, build)
+        return DeviceFit(
+            fit_fn, build,
+            operands=(jnp.asarray(float(self.lam or 0.0), jnp.float32),),
+            program_key=("LinearMap",),
+        )
 
     def fit(self, data: Dataset, labels: Dataset) -> LinearMapper:
         feature_scaler = StandardScaler(normalize_std_dev=False).fit(data)

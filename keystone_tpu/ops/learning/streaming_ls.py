@@ -143,7 +143,7 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
 
         bank = self.featurize if isinstance(self.featurize, BankFeaturize) else None
 
-        def fit_fn(F, Y, n_true: int, *bank_params):
+        def fit_fn(F, Y, n_true: int, lam, *bank_params):
             if bank is not None:
                 bank_type, bank_key = type(bank), bank.static_key()
                 featurize = lambda X_t: bank_type.apply_bank(  # noqa: E731
@@ -154,7 +154,7 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
             tile = min(self.tile_rows, F.shape[0])
             W, _, _, fmean, ymean = _fit_core(
                 F, Y, featurize, self.d_feat, tile, self.block_size,
-                self.lam, self.num_iter, False,
+                lam, self.num_iter, False,
                 n_true if n_true != F.shape[0] else None, None,
                 self.center,
             )
@@ -166,10 +166,22 @@ class StreamingFeaturizedLeastSquares(LabelEstimator):
                 self.featurize, W, self.tile_rows, fmean=fmean, ymean=ymean,
             )
 
-        return DeviceFit(
-            fit_fn, build,
-            operands=tuple(bank.params) if bank is not None else (),
-        )
+        lam_op = jnp.asarray(self.lam, jnp.float32)
+        if bank is not None:
+            # Logical program identity: λ-sweeps over same-shape banks
+            # share one fused executable (bank values ride as operands).
+            program_key = (
+                "StreamingFLS", self.d_feat, self.block_size,
+                self.num_iter, self.tile_rows, self.center,
+                type(bank).__name__, bank.static_key(),
+            )
+            return DeviceFit(
+                fit_fn, build, operands=(lam_op,) + tuple(bank.params),
+                program_key=program_key,
+            )
+        # Generic featurize closures have no shareable identity: keep the
+        # per-instance program cache (λ still traced).
+        return DeviceFit(fit_fn, build, operands=(lam_op,))
 
     def fit(self, data: Dataset, labels: Dataset) -> StreamingFeaturizedLinearModel:
         X = jnp.asarray(data.array)
@@ -335,6 +347,81 @@ def _extract_bank(members) -> Optional[CosineBankFeaturize]:
     return None
 
 
+class BlockStreamedLeastSquares(LabelEstimator):
+    """The north-star tier as a pipeline estimator: per-block featurize →
+    psum → solve → residual update (``streaming_block_bcd_mesh``), for
+    geometries where even the (d, d) Gramian of the gram-streamed tier
+    exceeds device memory (d ≳ 60k on a 16 GB chip). Requires a
+    :class:`CosineBankFeaturize` (the residual sweep needs per-block bank
+    slices). Centered by default — same BlockLeastSquares semantics as
+    the other tiers (means fold into the block steps; NORTHSTAR.md).
+    """
+
+    def __init__(
+        self,
+        bank: CosineBankFeaturize,
+        d_feat: int,
+        block_size: int,
+        num_iter: int = 3,
+        lam: float = 0.0,
+        center: bool = True,
+    ):
+        if not isinstance(bank, CosineBankFeaturize):
+            raise TypeError(
+                "BlockStreamedLeastSquares needs a CosineBankFeaturize "
+                "(per-block bank slices drive the residual sweep)"
+            )
+        if bank.Wrf.shape[0] != d_feat:
+            raise ValueError(
+                f"bank rows {bank.Wrf.shape[0]} != d_feat {d_feat}"
+            )
+        self.bank = bank
+        self.d_feat = d_feat
+        self.block_size = block_size
+        self.num_iter = num_iter
+        self.lam = lam
+        self.center = center
+
+    @property
+    def label(self) -> str:
+        return f"BlockStreamedLeastSquares({self.d_feat},{self.block_size})"
+
+    @property
+    def weight(self) -> int:
+        return self.num_iter + 1
+
+    def fit(self, data: Dataset, labels: Dataset) -> StreamingFeaturizedLinearModel:
+        import jax as _jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        X = jnp.asarray(data.array)
+        Y = jnp.asarray(labels.array)
+        mesh = data.mesh
+        if mesh is None or not any(
+            s > 1 for s in dict(mesh.shape).values()
+        ):
+            # Single-device form: a 1-device mesh (psums are identities).
+            mesh = mesh_lib.make_mesh(devices=_jax.devices()[:1])
+            X = _jax.device_put(X, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+            Y = _jax.device_put(Y, NamedSharding(mesh, P(mesh_lib.DATA_AXIS)))
+        n_true = int(data.n) if data.n != X.shape[0] else None
+        out = streaming.streaming_block_bcd_mesh(
+            X, Y, self.bank.Wrf, self.bank.brf,
+            block_size=self.block_size, lam=self.lam,
+            num_iter=self.num_iter, mesh=mesh, n_true=n_true,
+            center=self.center, feat_dtype=self.bank.feat_dtype,
+        )
+        if self.center:
+            W, fmean, ymean = out
+        else:
+            W, fmean, ymean = out, None, None
+        return StreamingFeaturizedLinearModel(
+            self.bank, W,
+            streaming.pick_tile_rows(self.d_feat, 4),
+            fmean=fmean, ymean=ymean,
+        )
+
+
 class StreamingLeastSquaresChoice(LabelEstimator):
     """The cost model's streaming-tier selection for
     :class:`~keystone_tpu.ops.learning.cost.LeastSquaresEstimator`.
@@ -381,6 +468,12 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         # the device budget is small so the capacity model and the actual
         # fit agree on the working set.
         self.slab_bytes: int = 2 << 30
+        # Device-memory budget (set by the owner): decides the TIER —
+        # gram-streamed (one data pass, needs an 8d² Gramian+factor
+        # stash) vs block-streamed (the north-star program: per-block
+        # Gramians only, num_iter featurize passes) for d where 8d²
+        # itself exceeds the budget (~60k dims on a 16 GB chip).
+        self.budget_bytes: Optional[float] = None
 
     @property
     def label(self) -> str:
@@ -390,14 +483,63 @@ class StreamingLeastSquaresChoice(LabelEstimator):
     def weight(self) -> int:
         return self.num_iter + 1
 
-    def build_estimator(self, featurize, d_feat: int) -> StreamingFeaturizedLeastSquares:
-        return StreamingFeaturizedLeastSquares(
-            featurize, d_feat=d_feat,
-            block_size=pick_block_size(d_feat, self.block_size_hint),
+    def _gram_tier_ok(self, d_feat: int) -> bool:
+        """The d-only discriminator shared by the capacity model and
+        build_estimator: the gram tier needs its (d, d) Gramian + factor
+        stash resident."""
+        if self.budget_bytes is None:
+            return True
+        slab = min(
+            streaming.pick_tile_rows(d_feat, 4, slab_bytes=self.slab_bytes)
+            * d_feat * 4.0,
+            float(self.slab_bytes),
+        )
+        return 8.0 * d_feat * d_feat + slab <= self.budget_bytes
+
+    def _block_tier_bs(self, d_feat: int) -> int:
+        """Block size for the block-streamed tier: the hint, shrunk until
+        the per-block Gramian/factor stash (8·d·bs bytes) fits a quarter
+        of the budget."""
+        hint = self.block_size_hint
+        if self.budget_bytes is not None:
+            cap = max(int(self.budget_bytes / (32.0 * d_feat)), 1)
+            hint = min(hint, cap)
+        return pick_block_size(d_feat, hint)
+
+    def build_estimator(self, featurize, d_feat: int):
+        if self._gram_tier_ok(d_feat):
+            bs = pick_block_size(d_feat, self.block_size_hint)
+            return StreamingFeaturizedLeastSquares(
+                featurize, d_feat=d_feat, block_size=bs,
+                num_iter=self.num_iter, lam=self.lam, center=self.center,
+                tile_rows=streaming.pick_tile_rows(
+                    d_feat, 4, slab_bytes=self.slab_bytes
+                ),
+            )
+        if not isinstance(featurize, CosineBankFeaturize):
+            # The capacity model assumed the block tier (no d² term), but
+            # only bank featurizers can drive per-block slices. Best
+            # effort: run the gram tier anyway (it may exceed the budget)
+            # rather than crash a fit the selector already committed to.
+            import logging
+
+            logging.getLogger("keystone_tpu.streaming").warning(
+                "d_feat=%d: (d, d) Gramian exceeds the device budget and "
+                "the block-streamed tier needs a cosine bank featurizer "
+                "(got %s); falling back to the gram tier — the fit may "
+                "not fit device memory", d_feat, type(featurize).__name__,
+            )
+            return StreamingFeaturizedLeastSquares(
+                featurize, d_feat=d_feat,
+                block_size=pick_block_size(d_feat, self.block_size_hint),
+                num_iter=self.num_iter, lam=self.lam, center=self.center,
+                tile_rows=streaming.pick_tile_rows(
+                    d_feat, 4, slab_bytes=self.slab_bytes
+                ),
+            )
+        return BlockStreamedLeastSquares(
+            featurize, d_feat=d_feat, block_size=self._block_tier_bs(d_feat),
             num_iter=self.num_iter, lam=self.lam, center=self.center,
-            tile_rows=streaming.pick_tile_rows(
-                d_feat, 4, slab_bytes=self.slab_bytes
-            ),
         )
 
     def fuse_with_members(self, members) -> "StreamedFitEstimator":
@@ -427,8 +569,12 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         )
 
     def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
-        """Raw rows + labels (sharded) + Gramian, factors and one feature
-        slab (replicated) — the feature matrix itself never exists."""
+        """Capacity model of whichever TIER ``build_estimator`` would pick
+        at this d (the shared ``_gram_tier_ok`` discriminator keeps the
+        two consistent). Gram tier: raw rows + labels (sharded) + the
+        (d, d) Gramian/factor stash + one feature slab. Block tier (the
+        north-star program): raw rows + labels + residual + per-BLOCK
+        Gramian/factor stash + one block slab + the bank — no d² term."""
         raw = self.raw_row_bytes if self.raw_row_bytes else 4.0 * min(d, 512)
         bs = min(self.block_size_hint, d)
         slab = min(
@@ -436,12 +582,21 @@ class StreamingLeastSquaresChoice(LabelEstimator):
             * d * 4.0,
             float(self.slab_bytes),
         )
+        common = n * raw / num_machines + 4.0 * n * k / num_machines
+        if self._gram_tier_ok(d):
+            return (
+                common
+                + 8.0 * d * d      # G + diagonal-block Cholesky stash
+                + 8.0 * d * bs     # diag/chol block stacks in the solve
+                + slab
+            )
+        bs_b = self._block_tier_bs(d)
         return (
-            n * raw / num_machines
-            + 4.0 * n * k / num_machines
-            + 8.0 * d * d          # G + diagonal-block Cholesky stash
-            + 8.0 * d * bs         # diag/chol block stacks in the solve
-            + slab
+            common
+            + 4.0 * n * k / num_machines  # residual R alongside Y
+            + 8.0 * d * bs_b              # per-block Gramian + factor stash
+            + 4.0 * (n / num_machines) * bs_b  # one block slab
+            + d * raw                     # bank rows ~ raw row width
         )
 
 

@@ -302,11 +302,13 @@ def bcd_least_squares(
 # ---------------------------------------------------------------------------
 
 
+# ``lam`` is a TRACED operand: λ-sweeps over one geometry reuse one
+# compiled sweep (it reaches the solves as a numeric jitter only).
 @functools.partial(
     jax.jit,
-    static_argnames=("lam", "num_iter", "use_pallas", "sym", "cache_stash"),
+    static_argnames=("num_iter", "use_pallas", "sym", "cache_stash"),
 )
-def _bcd_fused_kernel(A_stack, B, W0, lam: float, num_iter: int,
+def _bcd_fused_kernel(A_stack, B, W0, lam, num_iter: int,
                       use_pallas: bool, sym: bool, cache_stash: bool = True):
     def first_epoch_step(R, xs):
         """First sweep: compute (and, when caching, stash) each block's
@@ -415,10 +417,10 @@ def _bcd_block_update(Ab, R, Wb, lam: float, use_pallas: bool, sym: bool,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("block", "lam", "num_iter", "use_pallas", "sym",
+    static_argnames=("block", "num_iter", "use_pallas", "sym",
                      "cache_grams", "strided"),
 )
-def _bcd_fused_flat_kernel(F, B, W0, block: int, lam: float, num_iter: int,
+def _bcd_fused_flat_kernel(F, B, W0, block: int, lam, num_iter: int,
                            use_pallas: bool, sym: bool,
                            cache_grams: bool = False, strided: bool = False):
     nb = F.shape[1] // block
@@ -559,7 +561,7 @@ def bcd_least_squares_fused_flat(
         B = jnp.pad(B, ((0, 0), (0, tr - k_orig)))
         W0 = jnp.zeros((nb, block_size, tr), dtype=B.dtype)
     W, R = _bcd_fused_flat_kernel(
-        F, B, W0, int(block_size), float(lam), max(int(num_iter), 1),
+        F, B, W0, int(block_size), lam, max(int(num_iter), 1),
         bool(use_pallas), True, cache_grams, strided,
     )
     if W.shape[2] != k_orig:
@@ -628,7 +630,7 @@ def bcd_least_squares_fused(
         int(num_iter), 2 * nb * db * db * acc_itemsize
     )
     W, R = _bcd_fused_kernel(
-        A_stack, B, W0, float(lam), max(int(num_iter), 1),
+        A_stack, B, W0, lam, max(int(num_iter), 1),
         bool(use_pallas), True, cache_stash,
     )
     return (W, R) if return_residual else W

@@ -552,10 +552,12 @@ def streaming_predict(
     return jnp.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
 
+# ``lam`` is a TRACED operand (λ-sweeps share one compiled sweep).
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "block_size", "lam", "num_iter", "mesh", "n_true", "feat_dtype",
+        "block_size", "num_iter", "mesh", "n_true", "feat_dtype",
+        "center",
     ),
 )
 def streaming_block_bcd_mesh(
@@ -570,6 +572,7 @@ def streaming_block_bcd_mesh(
     mesh,
     n_true: Optional[int] = None,
     feat_dtype=jnp.float32,
+    center: bool = False,
 ) -> Array:
     """The north-star program: cosine-featurize + block coordinate descent
     where feature BLOCKS are generated per step and discarded — the plan
@@ -594,7 +597,13 @@ def streaming_block_bcd_mesh(
 
     Padding rows (``n_true``) are masked AFTER featurization (a zero row
     featurizes to cos(b) ≠ 0). Returns the (nb, bs, k) block weights,
-    replicated.
+    replicated — or, with ``center=True``, (W, fmean, ymean):
+    per-block feature means and the label mean accumulate in the same
+    block steps (one extra bs-vector in the epoch-1 psum and a k-vector
+    per correlation psum), the per-block systems solve on their CENTERED
+    Gramians, and the model is the BlockLeastSquares affine form
+    (F − fmean) @ W + ymean — full semantics parity with the resident
+    Block solver at geometries where only this tier runs.
     """
     axis = mesh_lib.DATA_AXIS
     d_feat = Wrf.shape[0]
@@ -606,6 +615,7 @@ def streaming_block_bcd_mesh(
     n_pad = X.shape[0]
     num = mesh_lib.axis_size(mesh, axis)
     ln = n_pad // num
+    n_eff = n_true if n_true is not None else n_pad
 
     def body(x_local, y_local, Wrf, brf):
         lam_t = jnp.asarray(lam, jnp.float32)
@@ -627,7 +637,10 @@ def streaming_block_bcd_mesh(
                 F = F * valid.astype(F.dtype)
             return F
 
-        def update(b, R, Wst, gram, chol):
+        def update(b, R, Wst, gram, chol, mu):
+            """One block solve + residual update. ``gram``/``chol`` are the
+            (centered, when ``center``) block system; ``mu`` is the block's
+            feature mean (None when not centering)."""
             acc = jnp.promote_types(feat_dtype, jnp.float32)
             F = featurize_block(b)
             corr = jax.lax.psum(
@@ -637,18 +650,32 @@ def streaming_block_bcd_mesh(
                 ),
                 axis,
             )
+            if mu is not None:
+                # Centered correlation: FcᵀR = FᵀR − μ·(Σᵢ Rᵢ)ᵀ.
+                rsum = jax.lax.psum(jnp.sum(R, axis=0), axis)
+                corr = corr - jnp.outer(mu, rsum)
             w_old = jax.lax.dynamic_index_in_dim(Wst, b, 0, keepdims=False)
             rhs = corr + gram @ w_old
             w_new = _solve_psd(gram, rhs, lam_t, chol=chol)
+            dw = w_new - w_old
             delta = jax.lax.dot_general(
-                F, (w_new - w_old).astype(F.dtype), (((1,), (0,)), ((), ())),
+                F, dw.astype(F.dtype), (((1,), (0,)), ((), ())),
                 preferred_element_type=acc,
-            )
-            R = R - delta.astype(R.dtype)
+            ).astype(R.dtype)
+            if mu is not None:
+                # R ← R − Fc·Δw = R − F·Δw + 1·(μᵀΔw); the constant term
+                # must not leak into padding rows.
+                const = (mu @ dw).astype(R.dtype)
+                corr_term = (
+                    const[None, :] if valid is None
+                    else const[None, :] * valid.astype(R.dtype)
+                )
+                delta = delta - corr_term
+            R = R - delta
             return R, jax.lax.dynamic_update_index_in_dim(Wst, w_new, b, 0)
 
         def first_step(carry, b):
-            R, Wst, G, C = carry
+            R, Wst, G, C, M = carry
             acc = jnp.promote_types(feat_dtype, jnp.float32)
             F = featurize_block(b)
             gram = jax.lax.psum(
@@ -658,39 +685,63 @@ def streaming_block_bcd_mesh(
                 ),
                 axis,
             )
+            if center:
+                fsum = jax.lax.psum(
+                    jnp.sum(F, axis=0, dtype=jnp.float32), axis
+                )
+                mu = fsum / n_eff
+                gram = gram - jnp.outer(fsum, mu)  # = G − n μμᵀ, exact
+                M = jax.lax.dynamic_update_index_in_dim(M, mu, b, 0)
+            else:
+                mu = None
             chol = _psd_factor(gram, lam_t)
-            R, Wst = update(b, R, Wst, gram, chol)
+            R, Wst = update(b, R, Wst, gram, chol, mu)
             G = jax.lax.dynamic_update_index_in_dim(G, gram, b, 0)
             C = jax.lax.dynamic_update_index_in_dim(C, chol, b, 0)
-            return (R, Wst, G, C), None
+            return (R, Wst, G, C, M), None
 
         def later_step(carry, b):
-            R, Wst, G, C = carry
+            R, Wst, G, C, M = carry
             gram = jax.lax.dynamic_index_in_dim(G, b, 0, keepdims=False)
             chol = jax.lax.dynamic_index_in_dim(C, b, 0, keepdims=False)
-            R, Wst = update(b, R, Wst, gram, chol)
-            return (R, Wst, G, C), None
+            mu = (
+                jax.lax.dynamic_index_in_dim(M, b, 0, keepdims=False)
+                if center else None
+            )
+            R, Wst = update(b, R, Wst, gram, chol, mu)
+            return (R, Wst, G, C, M), None
 
         R0 = y_local.astype(jnp.float32)
         if valid is not None:
             R0 = R0 * valid
+        if center:
+            ysum = jax.lax.psum(jnp.sum(R0, axis=0), axis)
+            ymean = ysum / n_eff
+            R0 = R0 - (
+                ymean[None, :] if valid is None
+                else ymean[None, :] * valid
+            )
         Wst0 = jnp.zeros((nb, block_size, k), jnp.float32)
         G0 = jnp.zeros((nb, block_size, block_size), jnp.float32)
         C0 = jnp.zeros((nb, block_size, block_size), jnp.float32)
+        M0 = jnp.zeros((nb, block_size), jnp.float32)
         order = jnp.arange(nb)
-        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0), order)
+        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0, M0), order)
         if num_iter > 1:
             def epoch(carry, _):
                 carry, _ = jax.lax.scan(later_step, carry, order)
                 return carry, None
             carry, _ = jax.lax.scan(epoch, carry, None, length=num_iter - 1)
+        if center:
+            return carry[1], carry[4].reshape(d_feat), ymean
         return carry[1]
 
+    out_specs = (P(), P(), P()) if center else P()
     return jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P()),
-        out_specs=P(),
+        out_specs=out_specs,
         check_vma=False,
     )(X, Y, Wrf, brf)
 
@@ -698,7 +749,7 @@ def streaming_block_bcd_mesh(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "block_size", "lam", "num_iter", "mesh", "n_true", "feat_dtype",
+        "block_size", "num_iter", "mesh", "n_true", "feat_dtype",
     ),
 )
 def streaming_block_bcd_mesh_2d(

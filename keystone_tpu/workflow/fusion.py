@@ -126,16 +126,27 @@ class DeviceFit:
     runs on host with the concrete params; ``supports(d_feat)`` gates
     geometry (e.g. block divisibility) before any tracing happens.
     ``operands``: arrays the fit needs as TRACED inputs (e.g. a random-
-    feature bank) — a fit that closes over concrete arrays embeds them as
-    HLO constants, which recompiles per instance and breaks the
-    remote-compile transport at TIMIT bank sizes.
+    feature bank, the ridge λ) — a fit that closes over concrete arrays
+    embeds them as HLO constants, which recompiles per instance and
+    breaks the remote-compile transport at TIMIT bank sizes.
+
+    ``program_key``: hashable logical identity of the TRACE (estimator
+    family + every static config the fit function closes over). When
+    set, fused programs are shared ACROSS FusedFitEstimator instances
+    with identical members and key — a λ-sweep building a fresh
+    estimator per λ then compiles ONE program (λ rides in ``operands``).
+    The contract: two DeviceFits with equal program_key and identical
+    member objects must trace identically; anything value-affecting that
+    is not in the key MUST be an operand.
     """
 
-    def __init__(self, fit, build, supports=lambda d: True, operands=()):
+    def __init__(self, fit, build, supports=lambda d: True, operands=(),
+                 program_key=None):
         self.fit = fit
         self.build = build
         self.supports = supports
         self.operands = tuple(operands)
+        self.program_key = program_key
 
 
 def masked_center(F, Y, n_true: int):
@@ -247,6 +258,47 @@ class FusedGatherTransformer(Transformer):
 # loop over many geometries from retaining one executable per geometry.
 _FIT_PROGRAM_CACHE_MAX = 8
 
+# Programs shared ACROSS FusedFitEstimator instances by (member identity,
+# DeviceFit.program_key, geometry): a λ-sweep whose driver builds a fresh
+# estimator object per λ (so the rule's identity memo misses) still
+# compiles the featurize+fit program ONCE — λ rides as a traced operand.
+# Values hold strong member refs so recycled id()s cannot alias; FIFO.
+_SHARED_FIT_PROGRAMS: Dict[tuple, tuple] = {}
+_SHARED_FIT_MAX = 16
+
+
+def _shared_fit_program(members, program_key, geom_key, build):
+    # Members are held through WEAK refs: the cached program's closure
+    # pins the estimator's device operands (a TIMIT-scale bank is 100s of
+    # MB of HBM), so once the owning pipeline is garbage-collected the
+    # entry must die with it — dead entries are purged on every insert,
+    # and a hit re-verifies identity against the dereferenced members (a
+    # recycled id() cannot alias a live weakref).
+    import weakref
+
+    key = (tuple(id(m) for m in members), program_key, geom_key)
+    hit = _SHARED_FIT_PROGRAMS.get(key)
+    if hit is not None:
+        live = [r() for r in hit[0]]
+        if len(live) == len(members) and all(
+            a is not None and a is b for a, b in zip(live, members)
+        ):
+            return hit[1]
+    for k in [
+        k for k, (refs, _) in _SHARED_FIT_PROGRAMS.items()
+        if any(r() is None for r in refs)
+    ]:
+        del _SHARED_FIT_PROGRAMS[k]
+    program = build()
+    if key not in _SHARED_FIT_PROGRAMS and (
+        len(_SHARED_FIT_PROGRAMS) >= _SHARED_FIT_MAX
+    ):
+        _SHARED_FIT_PROGRAMS.pop(next(iter(_SHARED_FIT_PROGRAMS)))
+    _SHARED_FIT_PROGRAMS[key] = (
+        tuple(weakref.ref(m) for m in members), program,
+    )
+    return program
+
 
 class FusedFitEstimator(LabelEstimator):
     """An estimator fit fused with its upstream featurize program.
@@ -309,16 +361,25 @@ class FusedFitEstimator(LabelEstimator):
         n_true = int(data.n)
 
         key = (n_true, X.shape, str(X.dtype))
-        fused = self._programs.get(key)
-        if fused is None:
 
+        def build_program():
             @jax.jit
             def fused(X, Y, operands):
                 return dev.fit(_compose(fns, X), Y, n_true, *operands)
 
-            if len(self._programs) >= _FIT_PROGRAM_CACHE_MAX:
-                self._programs.pop(next(iter(self._programs)))
-            self._programs[key] = fused
+            return fused
+
+        if dev.program_key is not None:
+            fused = _shared_fit_program(
+                self.members, dev.program_key, key, build_program
+            )
+        else:
+            fused = self._programs.get(key)
+            if fused is None:
+                fused = build_program()
+                if len(self._programs) >= _FIT_PROGRAM_CACHE_MAX:
+                    self._programs.pop(next(iter(self._programs)))
+                self._programs[key] = fused
 
         params = fused(X, labels.array, dev.operands)
         return dev.build(params)

@@ -38,55 +38,12 @@ c, w = 65536, 83
 REPS = 8
 
 
-def _hash_bits(cid, shape, salt):
-    """The bench's counter-based u32 generator (see bench.py
-    amazon_fulln_metric for why threefry is not used here)."""
-    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
-    cols = (
-        jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
-        if len(shape) > 1 else jnp.zeros(shape, jnp.uint32)
-    )
-    x = rows * jnp.uint32(shape[-1] if len(shape) > 1 else 1) + cols
-    x = x + jnp.uint32(2654435761) * jnp.uint32(cid * 2 + salt + 1)
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    return x ^ (x >> 16)
-
-
 def make_chunk_fn(n_full):
-    """The bench's chunk generator, verbatim geometry."""
+    """The bench's chunk generator, imported — the probe measures the
+    EXACT fold the bench runs."""
+    from bench import amazon_chunk_fn_factory
 
-    def chunk_fn(cid):
-        bits = _hash_bits(cid, (c, nnz), 0)
-        idx = (bits % jnp.uint32(d)).astype(jnp.int16)
-        u = _hash_bits(cid, (c, nnz), 1)
-        vals = (
-            (u >> 8).astype(jnp.float32) * (3.464 / (1 << 24)) - 1.732
-        ).astype(jnp.bfloat16)
-        row = cid * c + jnp.arange(c)
-        valid = row < n_full
-        idx1 = jnp.concatenate(
-            [idx.astype(jnp.int32), jnp.where(valid, d, -1)[:, None]],
-            axis=1,
-        )
-        val1 = jnp.concatenate(
-            [
-                jnp.where(valid[:, None], vals, 0),
-                valid.astype(jnp.bfloat16)[:, None],
-            ],
-            axis=1,
-        )
-        y = (_hash_bits(cid, (c,), 2) % jnp.uint32(k)).astype(jnp.int32)
-        Y = jnp.where(
-            valid[:, None],
-            2.0 * jax.nn.one_hot(y, k, dtype=jnp.float32) - 1.0,
-            0.0,
-        )
-        return idx1, val1, Y
-
-    return chunk_fn
+    return amazon_chunk_fn_factory(c, nnz, d, k, n_full)
 
 
 def main():

@@ -134,7 +134,7 @@ class TestEstimatorFitFusion:
         assert dev.supports(d) and not dev.supports(d + 1)
         import jax
 
-        params = jax.jit(dev.fit, static_argnums=2)(F, Y, n)
+        params = jax.jit(dev.fit, static_argnums=2)(F, Y, n, *dev.operands)
         fused_model = dev.build(params)
         ref_model = est.fit(Dataset.of(F), Dataset.of(Y))
         got = np.asarray(fused_model.batch_apply(Dataset.of(F)).array)
@@ -159,9 +159,9 @@ class TestEstimatorFitFusion:
         dev = est.device_fit_fn()
         import jax
 
-        params_p = jax.jit(dev.fit, static_argnums=2)(Fp, Yp, n)
+        params_p = jax.jit(dev.fit, static_argnums=2)(Fp, Yp, n, *dev.operands)
         params = jax.jit(dev.fit, static_argnums=2)(
-            jnp.asarray(F), jnp.asarray(Y), n
+            jnp.asarray(F), jnp.asarray(Y), n, *dev.operands
         )
         for a, b in zip(params_p, params):
             np.testing.assert_allclose(
@@ -207,7 +207,7 @@ class TestLinearMapEstimatorDeviceFit:
         dev = est.device_fit_fn()
         import jax
 
-        params = jax.jit(dev.fit, static_argnums=2)(Fp, Yp, n)
+        params = jax.jit(dev.fit, static_argnums=2)(Fp, Yp, n, *dev.operands)
         fused_model = dev.build(params)
         ref_model = est.fit(
             Dataset.of(jnp.asarray(F)), Dataset.of(jnp.asarray(Y))
@@ -255,7 +255,7 @@ class TestMoreFamilyFitFusion:
         Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
         est = DenseLBFGSwithL2(lam=1e-2, num_iterations=30)
         dev = est.device_fit_fn()
-        params = jax.jit(dev.fit, static_argnums=2)(F, Y, n)
+        params = jax.jit(dev.fit, static_argnums=2)(F, Y, n, *dev.operands)
         fused_model = dev.build(params)
         ref_model = est.fit(Dataset.of(F), Dataset.of(Y))
         got = np.asarray(fused_model.batch_apply(Dataset.of(F)).array)
@@ -321,10 +321,52 @@ class TestMoreFamilyFitFusion:
         dev = est.device_fit_fn()
         # The bank rides as TRACED operands (DeviceFit.operands) so it
         # never embeds as an HLO constant in the fused program.
-        assert len(dev.operands) == 2
+        assert len(dev.operands) == 3  # lam + Wrf + brf as traced operands
         params = jax.jit(dev.fit, static_argnums=2)(X, Y, n, *dev.operands)
         fused_model = dev.build(params)
         ref_model = est.fit(Dataset.of(X), Dataset.of(Y))
         got = np.asarray(fused_model.batch_apply(Dataset.of(X)).array)
         ref = np.asarray(ref_model.batch_apply(Dataset.of(X)).array)
         np.testing.assert_allclose(got, ref, atol=2e-3, rtol=2e-3)
+
+
+class TestSharedFitPrograms:
+    def test_lambda_sweep_with_fresh_estimators_compiles_once(self):
+        """A λ-sweep whose driver builds a NEW estimator object per λ (the
+        autocache bench pattern) must share ONE fused featurize+fit
+        program: λ is a DeviceFit operand and the program cache keys on
+        (members, program_key), not estimator identity. Regression test
+        for the round-5 recompile-per-λ slowdown the CRF device_fn
+        introduced."""
+        from keystone_tpu.workflow import fusion
+        from keystone_tpu.workflow.env import PipelineEnv
+
+        PipelineEnv.get_or_create().reset()
+        pipe, cfg = _featurizer(num_ffts=2, block=32)
+        n = 64
+        X = rng.normal(size=(n, D_IN)).astype(np.float32)
+        Y = rng.normal(size=(n, 3)).astype(np.float32)
+        data = Dataset.of(jnp.asarray(X))
+        labels = Dataset.of(jnp.asarray(Y))
+
+        before_keys = set(fusion._SHARED_FIT_PROGRAMS)
+        preds = []
+        for lam in (1e-4, 1e-3, 1e-2):
+            # One optimizer across the sweep (the bench pattern): the
+            # fusion memos then hand every λ the SAME fused members, and
+            # the shared-program cache must collapse the sweep to one
+            # compile. (Estimator prefix state would make later fits
+            # no-ops, so clear just the state table, not the optimizer.)
+            PipelineEnv.get_or_create().state.clear()
+            est = BlockLeastSquaresEstimator(cfg.block_size, 2, lam)
+            p = pipe.and_then(est, data, labels)
+            X2 = Dataset.of(jnp.asarray(X[:16]))
+            preds.append(np.asarray(p.apply(X2).get().array))
+        # One shared program for the whole sweep (same members + same
+        # BlockLS program_key; λ rides as an operand). Key-set delta, not
+        # length delta: the insert-time purge may drop entries whose
+        # owners died in earlier tests.
+        new_keys = set(fusion._SHARED_FIT_PROGRAMS) - before_keys
+        assert len(new_keys) == 1, new_keys
+        # And λ genuinely differed: heavier ridge shrinks predictions.
+        assert not np.allclose(preds[0], preds[2])
