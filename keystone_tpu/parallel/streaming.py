@@ -573,7 +573,7 @@ def streaming_block_bcd_mesh(
     n_true: Optional[int] = None,
     feat_dtype=jnp.float32,
     center: bool = False,
-) -> Array:
+):
     """The north-star program: cosine-featurize + block coordinate descent
     where feature BLOCKS are generated per step and discarded — the plan
     that runs TIMIT at ~200k feature dims on a v5e-16 (NORTHSTAR.md).
@@ -643,17 +643,24 @@ def streaming_block_bcd_mesh(
             feature mean (None when not centering)."""
             acc = jnp.promote_types(feat_dtype, jnp.float32)
             F = featurize_block(b)
-            corr = jax.lax.psum(
-                jax.lax.dot_general(
-                    F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
-                    preferred_element_type=acc,
-                ),
-                axis,
-            )
+            local = jax.lax.dot_general(
+                F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=acc,
+            ).astype(jnp.float32)
             if mu is not None:
-                # Centered correlation: FcᵀR = FᵀR − μ·(Σᵢ Rᵢ)ᵀ.
-                rsum = jax.lax.psum(jnp.sum(R, axis=0), axis)
-                corr = corr - jnp.outer(mu, rsum)
+                # Centered correlation: FcᵀR = FᵀR − μ·(Σᵢ Rᵢ)ᵀ. The row
+                # sum rides the SAME psum as the correlation (stacked as
+                # one extra row) — one collective per block step, as the
+                # dossier's cost model states.
+                stacked = jax.lax.psum(
+                    jnp.concatenate(
+                        [local, jnp.sum(R, axis=0)[None, :]], axis=0
+                    ),
+                    axis,
+                )
+                corr = stacked[:-1] - jnp.outer(mu, stacked[-1])
+            else:
+                corr = jax.lax.psum(local, axis)
             w_old = jax.lax.dynamic_index_in_dim(Wst, b, 0, keepdims=False)
             rhs = corr + gram @ w_old
             w_new = _solve_psd(gram, rhs, lam_t, chol=chol)
@@ -750,6 +757,7 @@ def streaming_block_bcd_mesh(
     jax.jit,
     static_argnames=(
         "block_size", "num_iter", "mesh", "n_true", "feat_dtype",
+        "center",
     ),
 )
 def streaming_block_bcd_mesh_2d(
@@ -764,7 +772,8 @@ def streaming_block_bcd_mesh_2d(
     mesh,
     n_true: Optional[int] = None,
     feat_dtype=jnp.float32,
-) -> Array:
+    center: bool = False,
+):
     """2-D (data × model) form of the north-star program: the Gramian/
     factor stash, the block weights AND the feature bank shard over the
     ``model`` axis (reference analog: VectorSplitter.scala:10-36 feature
@@ -791,6 +800,10 @@ def streaming_block_bcd_mesh_2d(
 
     Returns (nb, bs, k) block weights sharded over ``model`` on axis 0.
     X/Y rows must be sharded over (data, model) flattened (data-major).
+    With ``center=True`` (same semantics as the 1-D form): returns
+    (W, fmean (nb, bs) sharded over model, ymean replicated); per-block
+    means live in the owner's stash and are owner-broadcast (bs floats)
+    in later epochs alongside w_new/w_old.
     """
     data_ax = mesh_lib.DATA_AXIS
     model_ax = mesh_lib.MODEL_AXIS
@@ -808,6 +821,7 @@ def streaming_block_bcd_mesh_2d(
     n_pad = X.shape[0]
     ln = n_pad // (dr * mc)
     bs = block_size
+    n_eff = n_true if n_true is not None else n_pad
 
     def body(x_local, y_local, wrf_local, brf_local):
         lam_t = jnp.asarray(lam, jnp.float32)
@@ -842,24 +856,39 @@ def streaming_block_bcd_mesh_2d(
 
         acc = jnp.promote_types(feat_dtype, jnp.float32)
 
-        def corr_of(F, R):
-            return jax.lax.psum(
-                jax.lax.psum(
-                    jax.lax.dot_general(
-                        F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
-                        preferred_element_type=acc,
-                    ),
-                    data_ax,
-                ),
-                model_ax,
-            )
+        def psum2(v):
+            return jax.lax.psum(jax.lax.psum(v, data_ax), model_ax)
 
-        def apply_delta(R, F, w_new, w_old):
-            delta = jax.lax.dot_general(
-                F, (w_new - w_old).astype(F.dtype),
-                (((1,), (0,)), ((), ())), preferred_element_type=acc,
+        def corr_of(F, R, mu):
+            local = jax.lax.dot_general(
+                F, R.astype(F.dtype), (((0,), (0,)), ((), ())),
+                preferred_element_type=acc,
+            ).astype(jnp.float32)
+            if mu is None:
+                return psum2(local)
+            # Centered correlation: FcᵀR = FᵀR − μ·(Σᵢ Rᵢ)ᵀ. The row sum
+            # rides the SAME psum2 as the correlation (one extra stacked
+            # row) — the per-step collective count stays at one pair.
+            stacked = psum2(
+                jnp.concatenate([local, jnp.sum(R, axis=0)[None, :]], axis=0)
             )
-            return R - delta.astype(R.dtype)
+            return stacked[:-1] - jnp.outer(mu, stacked[-1])
+
+        def apply_delta(R, F, w_new, w_old, mu):
+            dw = w_new - w_old
+            delta = jax.lax.dot_general(
+                F, dw.astype(F.dtype),
+                (((1,), (0,)), ((), ())), preferred_element_type=acc,
+            ).astype(R.dtype)
+            if mu is not None:
+                # R ← R − Fc·Δw = R − F·Δw + 1·(μᵀΔw), padding-masked.
+                const = (mu @ dw).astype(R.dtype)
+                term = (
+                    const[None, :] if valid is None
+                    else const[None, :] * valid.astype(R.dtype)
+                )
+                delta = delta - term
+            return R - delta
 
         def mask_store(stash, slot, value, is_owner):
             old = jax.lax.dynamic_index_in_dim(stash, slot, 0, keepdims=False)
@@ -867,35 +896,46 @@ def streaming_block_bcd_mesh_2d(
             return jax.lax.dynamic_update_index_in_dim(stash, new, slot, 0)
 
         def first_step(carry, b):
-            R, Wst, G, C = carry
+            R, Wst, G, C, M = carry
             Wb, bv, is_owner, slot = bank_block(b)
             F = featurize(x_local, Wb, bv)
-            gram = jax.lax.psum(
-                jax.lax.psum(
-                    jax.lax.dot_general(
-                        F, F, (((0,), (0,)), ((), ())),
-                        preferred_element_type=acc,
-                    ),
-                    data_ax,
-                ),
-                model_ax,
+            gram = psum2(
+                jax.lax.dot_general(
+                    F, F, (((0,), (0,)), ((), ())),
+                    preferred_element_type=acc,
+                )
             )
+            if center:
+                fsum = psum2(jnp.sum(F, axis=0, dtype=jnp.float32))
+                mu = fsum / n_eff
+                gram = gram - jnp.outer(fsum, mu)  # = G − n μμᵀ, exact
+                M = mask_store(M, slot, mu, is_owner)
+            else:
+                mu = None
             chol = _psd_factor(gram, lam_t)
-            corr = corr_of(F, R)
+            corr = corr_of(F, R, mu)
             # w_old is zero in epoch 1 (fresh W) — rhs is just corr.
             w_new = _solve_psd(gram, corr, lam_t, chol=chol)
-            R = apply_delta(R, F, w_new, jnp.zeros_like(w_new))
+            R = apply_delta(R, F, w_new, jnp.zeros_like(w_new), mu)
             G = mask_store(G, slot, gram, is_owner)
             C = mask_store(C, slot, chol, is_owner)
             Wst = mask_store(Wst, slot, w_new, is_owner)
-            return (R, Wst, G, C), None
+            return (R, Wst, G, C, M), None
 
         def later_step(carry, b):
-            R, Wst, G, C = carry
+            R, Wst, G, C, M = carry
             Wb, bv, is_owner, slot = bank_block(b)
             F = featurize(x_local, Wb, bv)
-            corr = corr_of(F, R)
             own_f = is_owner.astype(jnp.float32)
+            if center:
+                # Owner broadcasts the block's mean (bs floats).
+                mu_l = jax.lax.dynamic_index_in_dim(
+                    M, slot, 0, keepdims=False
+                )
+                mu = jax.lax.psum(mu_l * own_f, model_ax)
+            else:
+                mu = None
+            corr = corr_of(F, R, mu)
             gram_l = jax.lax.dynamic_index_in_dim(G, slot, 0, keepdims=False)
             chol_l = jax.lax.dynamic_index_in_dim(C, slot, 0, keepdims=False)
             w_old_l = jax.lax.dynamic_index_in_dim(
@@ -910,25 +950,37 @@ def streaming_block_bcd_mesh_2d(
             w_new_l = _solve_psd(gram_l, rhs, lam_t, chol=chol_safe)
             w_new = jax.lax.psum(w_new_l * own_f, model_ax)
             w_old = jax.lax.psum(w_old_l * own_f, model_ax)
-            R = apply_delta(R, F, w_new, w_old)
+            R = apply_delta(R, F, w_new, w_old, mu)
             Wst = mask_store(Wst, slot, w_new, is_owner)
-            return (R, Wst, G, C), None
+            return (R, Wst, G, C, M), None
 
         R0 = y_local.astype(jnp.float32)
         if valid is not None:
             R0 = R0 * valid
+        if center:
+            ymean = psum2(jnp.sum(R0, axis=0)) / n_eff
+            R0 = R0 - (
+                ymean[None, :] if valid is None
+                else ymean[None, :] * valid
+            )
         Wst0 = jnp.zeros((nb_local, bs, k), jnp.float32)
         G0 = jnp.zeros((nb_local, bs, bs), jnp.float32)
         C0 = jnp.zeros((nb_local, bs, bs), jnp.float32)
+        M0 = jnp.zeros((nb_local, bs), jnp.float32)
         order = jnp.arange(nb)
-        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0), order)
+        carry, _ = jax.lax.scan(first_step, (R0, Wst0, G0, C0, M0), order)
         if num_iter > 1:
             def epoch(carry, _):
                 carry, _ = jax.lax.scan(later_step, carry, order)
                 return carry, None
             carry, _ = jax.lax.scan(epoch, carry, None, length=num_iter - 1)
+        if center:
+            return carry[1], carry[4], ymean
         return carry[1]
 
+    out_specs = (
+        (P(model_ax), P(model_ax), P()) if center else P(model_ax)
+    )
     return jax.shard_map(
         body,
         mesh=mesh,
@@ -936,7 +988,7 @@ def streaming_block_bcd_mesh_2d(
             P((data_ax, model_ax)), P((data_ax, model_ax)),
             P(model_ax), P(model_ax),
         ),
-        out_specs=P(model_ax),
+        out_specs=out_specs,
         check_vma=False,
     )(X, Y, Wrf, brf)
 

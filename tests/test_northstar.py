@@ -396,3 +396,45 @@ class TestNorthstarCentered:
             choice.build_estimator(bank, d_feat),
             StreamingFeaturizedLeastSquares,
         )
+
+
+class TestNorthstar2DCentered:
+    def test_2d_centered_matches_1d_centered(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        d_feat = 4 * BS
+        Wrf, brf = _bank(d_feat, seed=9)
+        mesh2 = mesh_lib.make_mesh(
+            (4, 2), (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS)
+        )
+        mesh1 = mesh_lib.make_mesh()
+        n_true, n_pad = 700, 704
+        rng = np.random.default_rng(12)
+        X = rng.normal(size=(n_true, D_IN)).astype(np.float32)
+        Y = rng.normal(size=(n_true, K)).astype(np.float32) + 0.5
+        Xp = np.vstack(
+            [X, 5.0 + rng.normal(size=(n_pad - n_true, D_IN)).astype(np.float32)]
+        )
+        Yp = np.vstack([Y, 5.0 * np.ones((n_pad - n_true, K), np.float32)])
+        rows = P((mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS))
+        W2, fm2, ym2 = streaming.streaming_block_bcd_mesh_2d(
+            jax.device_put(jnp.asarray(Xp), NamedSharding(mesh2, rows)),
+            jax.device_put(jnp.asarray(Yp), NamedSharding(mesh2, rows)),
+            jax.device_put(Wrf, NamedSharding(mesh2, P(mesh_lib.MODEL_AXIS))),
+            jax.device_put(brf, NamedSharding(mesh2, P(mesh_lib.MODEL_AXIS))),
+            block_size=BS, lam=LAM, num_iter=3, mesh=mesh2, n_true=n_true,
+            center=True,
+        )
+        W1, fm1, ym1 = streaming.streaming_block_bcd_mesh(
+            mesh_lib.shard_rows(jnp.asarray(Xp), mesh1),
+            mesh_lib.shard_rows(jnp.asarray(Yp), mesh1),
+            Wrf, brf, block_size=BS, lam=LAM, num_iter=3, mesh=mesh1,
+            n_true=n_true, center=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fm2).reshape(-1), np.asarray(fm1), atol=1e-5
+        )
+        np.testing.assert_allclose(np.asarray(ym2), np.asarray(ym1), atol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(W2), np.asarray(W1), atol=2e-3, rtol=2e-3
+        )
