@@ -159,3 +159,96 @@ class DiskCOOShards:
         return all(
             isinstance(a, np.memmap) for a in (self._idx, self._val, self._y)
         )
+
+
+class DiskDenseShards:
+    """Pre-tiled DENSE rows on disk, mmap-read per segment — the dense
+    analog of :class:`DiskCOOShards`, feeding
+    ``parallel.streaming.streaming_bcd_fit_segments``.
+
+    Layout: ``x.npy`` (num_tiles, tile_rows, d_in), ``y.npy``
+    (num_tiles, tile_rows, k), ``dense_shards.json``
+    {n_true, tile_rows, num_tiles, tiles_per_segment}.
+    """
+
+    _META = "dense_shards.json"
+
+    def __init__(self, directory: str):
+        with open(os.path.join(directory, self._META)) as f:
+            meta = json.load(f)
+        self.n_true = int(meta["n_true"])
+        self.tile_rows = int(meta["tile_rows"])
+        self.num_tiles = int(meta["num_tiles"])
+        self.tiles_per_segment = int(meta["tiles_per_segment"])
+        self._x = np.load(os.path.join(directory, "x.npy"), mmap_mode="r")
+        self._y = np.load(os.path.join(directory, "y.npy"), mmap_mode="r")
+
+    @property
+    def num_segments(self) -> int:
+        return -(-self.num_tiles // self.tiles_per_segment)
+
+    @staticmethod
+    def write(
+        directory: str,
+        X: np.ndarray,
+        Y: np.ndarray,
+        tile_rows: int,
+        tiles_per_segment: int,
+    ) -> "DiskDenseShards":
+        """Tile (n, d_in) rows + (n, k) labels into on-disk tiles (the
+        ragged tail is zero-padded; n_true masks it at fold time)."""
+        n, d_in = X.shape
+        k = Y.shape[1]
+        num_tiles = -(-n // tile_rows)
+        os.makedirs(directory, exist_ok=True)
+        mm_x = np.lib.format.open_memmap(
+            os.path.join(directory, "x.npy"), mode="w+", dtype=X.dtype,
+            shape=(num_tiles, tile_rows, d_in),
+        )
+        mm_y = np.lib.format.open_memmap(
+            os.path.join(directory, "y.npy"), mode="w+", dtype=Y.dtype,
+            shape=(num_tiles, tile_rows, k),
+        )
+        # open_memmap('w+') creates the file zero-filled via ftruncate
+        # (sparse allocation) — the ragged tail needs no explicit pass.
+        for t in range(num_tiles):
+            lo, hi = t * tile_rows, min((t + 1) * tile_rows, n)
+            mm_x[t, : hi - lo] = X[lo:hi]
+            mm_y[t, : hi - lo] = Y[lo:hi]
+        mm_x.flush(); mm_y.flush()
+        del mm_x, mm_y
+        with open(os.path.join(directory, DiskDenseShards._META), "w") as f:
+            json.dump(
+                {"n_true": int(n), "tile_rows": int(tile_rows),
+                 "num_tiles": int(num_tiles),
+                 "tiles_per_segment": int(tiles_per_segment)},
+                f,
+            )
+        return DiskDenseShards(directory)
+
+    def segment_source(self, s: int):
+        """``streaming_bcd_fit_segments`` contract: materialize ONLY this
+        segment's tiles (phantom tiles past the end are zero-padded and
+        masked by valid_rows=0)."""
+        tps = self.tiles_per_segment
+        lo, hi = s * tps, min((s + 1) * tps, self.num_tiles)
+        X_seg = np.asarray(self._x[lo:hi])
+        Y_seg = np.asarray(self._y[lo:hi])
+        pad = tps - (hi - lo)
+        if pad:
+            X_seg = np.concatenate(
+                [X_seg, np.zeros((pad,) + X_seg.shape[1:], X_seg.dtype)]
+            )
+            Y_seg = np.concatenate(
+                [Y_seg, np.zeros((pad,) + Y_seg.shape[1:], Y_seg.dtype)]
+            )
+        valid_rows = max(
+            min(self.n_true - lo * self.tile_rows, tps * self.tile_rows), 0
+        )
+        return X_seg, Y_seg, valid_rows
+
+    @property
+    def is_memory_mapped(self) -> bool:
+        return isinstance(self._x, np.memmap) and isinstance(
+            self._y, np.memmap
+        )

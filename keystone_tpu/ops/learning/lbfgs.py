@@ -405,9 +405,8 @@ def run_lbfgs_gram_streamed(
         )
         return program(tuple(operands))
 
-    from collections import deque
-
     from keystone_tpu.ops.sparse import sparse_gram_init
+    from keystone_tpu.parallel.streaming import BoundedInflight
 
     if segment_source is not None:
         if seg is None:
@@ -426,10 +425,7 @@ def run_lbfgs_gram_streamed(
         float(convergence_tol), int(n), jnp.dtype(val_dtype),
     )
     carry = sparse_gram_init(d, k, val_dtype)
-    # Probes are tiny NON-donated scalars derived from each segment's
-    # carry: blocking on probe i-inflight bounds the queue without
-    # touching donated buffers.
-    probes = deque()
+    throttle = BoundedInflight(inflight)
     for cid0 in range(0, int(num_chunks), int(seg)):
         if segment_source is not None:
             ops = tuple(
@@ -438,9 +434,7 @@ def run_lbfgs_gram_streamed(
         else:
             ops = tuple(operands)
         carry = fold(carry, jnp.asarray(cid0, jnp.int32), ops)
-        probes.append(carry[2] + 0.0)
-        while len(probes) > max(int(inflight), 1):
-            float(probes.popleft())
+        throttle.admit(carry[2])
     return solve(carry)
 
 

@@ -63,6 +63,29 @@ def pick_tile_rows(
     return max((rows // _ROW_ALIGN) * _ROW_ALIGN, _ROW_ALIGN)
 
 
+class BoundedInflight:
+    """Bound the device dispatch queue of a host-driven segment loop.
+
+    ``admit(x)`` enqueues a tiny NON-donated probe derived from the
+    segment's carry (the ``+ 0.0`` keeps it off the donated buffers) and
+    blocks on the oldest once more than ``limit`` are in flight — the
+    next segment's host load/transfer overlaps device compute while the
+    queue (and the tunnel watchdog's view of it) stays bounded. Shared
+    by the dense and sparse segmented folds.
+    """
+
+    def __init__(self, limit: int):
+        from collections import deque
+
+        self._limit = max(int(limit), 1)
+        self._probes = deque()
+
+    def admit(self, scalar) -> None:
+        self._probes.append(scalar + 0.0)
+        while len(self._probes) > self._limit:
+            float(self._probes.popleft())
+
+
 def _row_mask(M, valid):
     """Zero rows at index >= valid (padding rows must not touch G/FY)."""
     idx = jax.lax.broadcasted_iota(jnp.int32, (M.shape[0], 1), 0)
@@ -362,24 +385,17 @@ def _fit_core(X, Y, featurize, d_feat, tile_rows, block_size, lam,
             X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
             valid=valid, labelize=labelize, moments=True,
         )
-        G, FY, ytyc, fmean, ymean = center_gram_stats(
-            G, FY, yty, fsum, ysum, n_true
-        )
-        loss_yty = ytyc
     else:
         G, FY, yty = gram_stats(
             X, Y, featurize, d_feat, tile_rows, use_pallas=use_pallas,
             valid=valid, labelize=labelize,
         )
-        fmean = ymean = None
-        loss_yty = yty
-    W = bcd_from_gram(G, FY, block_size, lam, num_iter)
-    # W blocks are laid out [b*block : (b+1)*block] along d — reshape keeps
-    # that order, so Wf rows align with G/FY rows.
-    Wf = W.reshape(d_feat, W.shape[2])
-    loss = (
-        loss_yty - 2.0 * jnp.vdot(Wf, FY) + jnp.vdot(Wf, G @ Wf)
-    ) / n_true
+        fsum = ysum = None
+    # W blocks are laid out [b*block : (b+1)*block] along d, so Wf rows
+    # align with G/FY rows (shared solve tail).
+    W, loss, fmean, ymean = _solve_from_stats_core(
+        G, FY, yty, fsum, ysum, n_true, lam, block_size, num_iter, center
+    )
     return W, loss, yty, fmean, ymean
 
 
@@ -457,6 +473,124 @@ def streaming_bcd_fit(
              valid=valid, labelize=labelize),
     )
     return W, loss, yty
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
+    "bank_type", "bank_key", "tile_rows", "use_pallas",
+))
+def _dense_segment_fold(carry, X_seg, Y_seg, valid_rows, bank_params, *,
+                        bank_type, bank_key, tile_rows, use_pallas):
+    """Fold one SEGMENT of pre-tiled rows into the (G, FY, yty, fsum,
+    ysum) carry — the dense analog of the sparse segmented fold: segments
+    may be loaded from disk one at a time, so neither HBM nor host RAM
+    ever holds the dataset. The carry is donated (G dominates);
+    ``valid_rows`` (traced) masks the ragged tail of the LAST segment.
+    The featurize bank rides as traced operands (BankFeaturize contract:
+    one compiled fold for every segment and every logically-equal bank).
+    """
+    featurize = lambda X_t: bank_type.apply_bank(bank_key, bank_params, X_t)  # noqa: E731
+    G, FY, yty, fsum, ysum = carry
+
+    def body(c, xs):
+        X_t, Y_t, t0 = xs
+        tile_valid = jnp.clip(valid_rows - t0, 0, tile_rows).astype(jnp.int32)
+        return _tile_update(
+            *c, X_t, Y_t, featurize, use_pallas, tile_valid
+        ), None
+
+    starts = jnp.arange(X_seg.shape[0]) * tile_rows
+    (G, FY, yty, fsum, ysum), _ = jax.lax.scan(
+        body, (G, FY, yty, fsum, ysum), (X_seg, Y_seg, starts)
+    )
+    return G, FY, yty, fsum, ysum
+
+
+def streaming_bcd_fit_segments(
+    segment_source,
+    num_segments: int,
+    n_true: int,
+    bank,
+    d_feat: int,
+    tile_rows: int,
+    block_size: int,
+    lam,
+    num_iter: int,
+    use_pallas: bool = False,
+    center: bool = True,
+    inflight: int = 2,
+):
+    """Disk-bounded dense streamed fit: fold (G, FY, moments) over
+    segments delivered one at a time (e.g.
+    :class:`keystone_tpu.data.shards.DiskDenseShards.segment_source` over
+    memory-mapped tiles), then solve with (optionally centered) BCD on
+    the normal equations. The dense analog of
+    ``run_lbfgs_gram_streamed(segment_source=...)``: n is bounded by
+    DISK, not host RAM or HBM.
+
+    ``segment_source(s) -> (X_seg (T, tile_rows, d_in), Y_seg (T,
+    tile_rows, k), valid_rows)`` — valid_rows counts the segment's true
+    rows (phantom/padding tiles past it are masked). Returns
+    (W, fmean, ymean, loss) when centered, else (W, None, None, loss).
+    """
+    bank_type, bank_key = type(bank), bank.static_key()
+    bank_params = bank.params  # raw pytree — the BankFeaturize contract
+    first = segment_source(0)
+    k = int(first[1].shape[-1])
+    carry = (
+        jnp.zeros((d_feat, d_feat), jnp.float32),
+        jnp.zeros((d_feat, k), jnp.float32),
+        jnp.zeros((), jnp.float32),
+        jnp.zeros((d_feat,), jnp.float32),
+        jnp.zeros((k,), jnp.float32),
+    )
+    throttle = BoundedInflight(inflight)
+    for s in range(num_segments):
+        X_seg, Y_seg, valid_rows = first if s == 0 else segment_source(s)
+        first = None
+        carry = _dense_segment_fold(
+            carry, jnp.asarray(X_seg), jnp.asarray(Y_seg),
+            jnp.asarray(int(valid_rows), jnp.int32), bank_params,
+            bank_type=bank_type, bank_key=bank_key, tile_rows=tile_rows,
+            use_pallas=use_pallas,
+        )
+        throttle.admit(carry[2])
+    G, FY, yty, fsum, ysum = carry
+    G = jnp.triu(G) + jnp.triu(G, 1).T
+    # The accumulated moments ride into the shared jitted solve either
+    # way; the static ``center`` branch simply ignores them when False.
+    W, loss, fmean, ymean = _solve_from_stats(
+        G, FY, yty, fsum, ysum,
+        jnp.asarray(n_true, jnp.float32), jnp.asarray(lam, jnp.float32),
+        block_size=block_size, num_iter=num_iter, center=center,
+    )
+    return W, fmean, ymean, loss
+
+
+def _solve_from_stats_core(G, FY, yty, fsum, ysum, n_true, lam,
+                           block_size, num_iter, center):
+    """Traceable solve tail shared by every gram-stats fit entry point:
+    (optional rank-1 centering) -> BCD on the normal equations -> loss.
+    ``G`` must have BOTH triangles valid. Returns
+    (W, loss, fmean, ymean) — fmean/ymean None when not centering."""
+    fmean = ymean = None
+    if center:
+        G, FY, yty, fmean, ymean = center_gram_stats(
+            G, FY, yty, fsum, ysum, n_true
+        )
+    W = bcd_from_gram(G, FY, block_size, lam, num_iter)
+    Wf = W.reshape(G.shape[0], W.shape[2])
+    loss = (yty - 2.0 * jnp.vdot(Wf, FY) + jnp.vdot(Wf, G @ Wf)) / n_true
+    return W, loss, fmean, ymean
+
+
+@functools.partial(
+    jax.jit, static_argnames=("block_size", "num_iter", "center")
+)
+def _solve_from_stats(G, FY, yty, fsum, ysum, n_true, lam, *,
+                      block_size, num_iter, center):
+    return _solve_from_stats_core(
+        G, FY, yty, fsum, ysum, n_true, lam, block_size, num_iter, center
+    )
 
 
 def center_gram_stats(G, FY, yty, fsum, ysum, n):

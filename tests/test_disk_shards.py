@@ -100,3 +100,66 @@ class TestDiskShards:
         shards = DiskCOOShards(d)
         got = shards.segment_source(1, 1)
         np.testing.assert_array_equal(got[0][0], idx[CHUNK : 2 * CHUNK])
+
+
+class TestDiskDenseShards:
+    def test_dense_disk_fit_matches_resident_streamed(self, tmp_path):
+        from keystone_tpu.data.shards import DiskDenseShards
+        from keystone_tpu.ops.learning.streaming_ls import CosineBankFeaturize
+        from keystone_tpu.parallel import streaming
+
+        rng = np.random.default_rng(7)
+        d_in, d_feat, bs, k = 16, 256, 64, 3
+        tile, tps = 128, 2
+        n = 5 * tile + 77  # ragged tail inside the last segment
+        X = rng.normal(size=(n, d_in)).astype(np.float32)
+        Y = rng.normal(size=(n, k)).astype(np.float32) + 0.4
+        bank = CosineBankFeaturize(
+            rng.normal(size=(d_feat, d_in)).astype(np.float32) * 0.3,
+            rng.uniform(0, 6, d_feat).astype(np.float32),
+        )
+        shards = DiskDenseShards.write(
+            str(tmp_path / "dense"), X, Y, tile_rows=tile,
+            tiles_per_segment=tps,
+        )
+        assert shards.is_memory_mapped and shards.num_segments == 3
+
+        W_d, fm_d, ym_d, loss_d = streaming.streaming_bcd_fit_segments(
+            shards.segment_source, shards.num_segments, n, bank,
+            d_feat=d_feat, tile_rows=tile, block_size=bs, lam=1e-2,
+            num_iter=2, center=True,
+        )
+        W_r, fm_r, ym_r, loss_r = streaming.streaming_bcd_fit_centered(
+            jnp.asarray(X), jnp.asarray(Y), featurize=bank, d_feat=d_feat,
+            tile_rows=tile, block_size=bs, lam=1e-2, num_iter=2,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fm_d), np.asarray(fm_r), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(ym_d), np.asarray(ym_r), atol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(W_d), np.asarray(W_r), atol=2e-3, rtol=2e-3
+        )
+        np.testing.assert_allclose(
+            float(loss_d), float(loss_r), rtol=1e-4
+        )
+
+    def test_dense_segment_residency_bounded(self, tmp_path):
+        from keystone_tpu.data.shards import DiskDenseShards
+
+        rng = np.random.default_rng(8)
+        n, d_in, k, tile, tps = 1024, 8, 2, 128, 2
+        X = rng.normal(size=(n, d_in)).astype(np.float32)
+        Y = rng.normal(size=(n, k)).astype(np.float32)
+        shards = DiskDenseShards.write(
+            str(tmp_path / "d2"), X, Y, tile_rows=tile, tiles_per_segment=tps
+        )
+        seg = shards.segment_source(0)
+        seg_bytes = seg[0].nbytes + seg[1].nbytes
+        assert seg_bytes <= (X.nbytes + Y.nbytes) * tps / shards.num_tiles + 4096
+        # Ragged final segment: phantom tiles padded, valid_rows clipped.
+        last = shards.segment_source(shards.num_segments - 1)
+        assert last[0].shape[0] == tps
+        assert 0 <= last[2] <= tps * tile
