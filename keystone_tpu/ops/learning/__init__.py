@@ -33,6 +33,14 @@ from .kernel import (
     NystromKernelRidge,
 )
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, run_lbfgs
+from .streaming_ls import (
+    BlockStreamedLeastSquares,
+    CosineBankFeaturize,
+    StreamingFeaturizedLeastSquares,
+    StreamingFeaturizedLinearModel,
+    StreamingLeastSquaresChoice,
+    cosine_bank_featurize,
+)
 from .linear import (
     LinearMapEstimator,
     LinearMapper,
