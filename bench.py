@@ -25,8 +25,11 @@ Additional metrics ride in detail.additional_metrics:
     exists; absolute + MFU + cross-engine quality delta).
   - mnist_random_fft_end_to_end: the README example geometry end-to-end,
     with a featurize/solve/executor phase split.
-  - autocache_on_chip: three measured wall-clocks (no-cache / greedy
-    under a 3 GB budget / aggressive) for a reused featurize chain.
+  - autocache_on_chip: measured warm-sweep wall-clocks (no-cache /
+    greedy post-fusion / greedy pre-fusion / aggressive, 3 GB budget)
+    for a reused fully-fusable featurize chain — greedy must TIE no-cache.
+  - autocache_host_boundary: same sweep convention with a fusion-breaking
+    host decode stage in the chain — greedy must BEAT no-cache.
   - stupidbackoff_batch_scoring: vectorized LM serving vs the dict loop.
 
 Timing method: the tunneled dev TPU adds ~80-110 ms of per-dispatch
@@ -1084,44 +1087,116 @@ def mnist_fft_metric():
     }
 
 
-def autocache_metric():
-    """Autocache vs whole-chain fusion ON CHIP: one scenario, three
-    measured wall-clocks under a stated HBM budget.
-
-    Workload: a 3-stage featurize chain (512→8192 cosine features →
-    rectify → 8192→2048 cosine features) reused by THREE ridge fits (a λ
-    sweep — the reference's canonical re-use pattern). Intermediates:
-    stage-1/2 outputs 4.3 GB each, stage-3 output 1.1 GB (n=131072, f32).
-
-      - no-cache (DefaultOptimizer): every fit re-executes the chain.
-      - GreedyCache(max_mem_bytes=3 GB): must pick ≤3 GB of intermediates.
-      - AggressiveCache: caches all three reused intermediates (9.7 GB).
-
-    ROUND-5 READING — this row's meaning flipped, honestly: cosine
-    featurizers became device-fusable, so the no-cache optimizer now
-    compiles the WHOLE chain + centered BCD fit into one shared program
-    (λ rides as a traced operand — DeviceFit.program_key), and a full
-    re-execution costs ~0.5 s at this geometry — LESS than the cached
-    configs' steady-state fits, whose Cacher nodes break the fusion
-    chain into per-stage dispatches. Caching a device-pure chain is now
-    strictly dominated by fusing it; autocache's remaining value is for
-    stages fusion cannot collapse (host-side loaders/image decode,
-    multi-consumer intermediates, cross-process prefix reuse). The row
-    reports the measured walls as they are — vs_baseline < 1 here is
-    the FUSION feature winning, not the cache feature regressing; the
-    r4 numbers (no-cache 69.4 s vs greedy 20.2 s) are what this chip
-    did before chains fused.
-    """
-    from keystone_tpu.data import Dataset
-    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
-    from keystone_tpu.ops.stats import CosineRandomFeatures, LinearRectifier
-    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
-    from keystone_tpu.workflow.autocache import AggressiveCache, GreedyCache
+def _run_cache_sweeps(make_optimizer, make_chain, fit_sweep, num_warm=3):
+    """Shared harness for the autocache rows: one COLD 3-fit λ-sweep
+    (compiles + greedy's profiling passes), then ``num_warm`` further
+    3-fit sweeps with FRESH λ values each (so every fit genuinely solves
+    — an identical λ would load the published fit from the state table),
+    taking the MIN warm sweep wall (the TIMIT headline's min-of-N warm
+    convention). The env is NOT reset between sweeps of one config:
+    steady-state cross-fit prefix reuse is exactly what the cache plan is
+    being priced on."""
+    from keystone_tpu.workflow import autocache
     from keystone_tpu.workflow.env import PipelineEnv
+
+    env = PipelineEnv.get_or_create()
+    env.reset()
+    autocache.clear_observed_profiles()  # fair A/B across configs
+    optimizer = make_optimizer()
+    env.set_optimizer(optimizer)
+    lams = np.logspace(-5, -2, 3 * (num_warm + 1))
+    sweeps = []
+    for s in range(num_warm + 1):
+        t0 = time.perf_counter()
+        fit_sweep(make_chain(), lams[3 * s: 3 * s + 3])
+        sweeps.append(round(time.perf_counter() - t0, 3))
+    # The PLAN: how many cache placements the strategy chose on a fresh
+    # fit graph (read off the rule itself — in steady state the inserted
+    # Cachers are immediately replaced by state-table splices, so counting
+    # Cacher nodes in the final plan would report 0). Untimed.
+    fit_sweep(make_chain(), None)
+    num_cachers = 0
+    for batch in getattr(optimizer, "batches", []):
+        for rule in batch.rules:
+            sel = getattr(rule, "last_selection", None)
+            if sel is not None:
+                num_cachers = len(sel)
+    env.reset()
+    return {
+        "cold_sweep_s": sweeps[0],
+        "warm_sweeps_s": sweeps[1:],
+        "wall_s": min(sweeps[1:]),
+        "cache_insertions": num_cachers,
+    }
+
+
+def _cache_configs(budget):
+    from keystone_tpu.workflow.autocache import AggressiveCache, GreedyCache
     from keystone_tpu.workflow.optimizer import (
         AutoCachingOptimizer,
         DefaultOptimizer,
     )
+
+    return (
+        ("no_cache", DefaultOptimizer),
+        ("greedy_postfusion", lambda: AutoCachingOptimizer(
+            GreedyCache(max_mem_bytes=budget)
+        )),
+        ("greedy_prefusion", lambda: AutoCachingOptimizer(
+            GreedyCache(max_mem_bytes=budget), cache_before_fusion=True
+        )),
+        ("aggressive_unbounded", lambda: AutoCachingOptimizer(
+            AggressiveCache()
+        )),
+    )
+
+
+def _make_fit_sweep(data, labels, X_probe):
+    """The sweep body shared by both autocache rows (identical timing
+    semantics by construction): fit BlockLS(512, 1, λ) per λ and sync a
+    256-row probe; with lams=None, just trigger one fresh optimization
+    (the plan probe _run_cache_sweeps reads off the rule)."""
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.data import Dataset
+
+    def fit_sweep(chain, lams):
+        if lams is None:
+            plan_pipe = chain.and_then(
+                BlockLeastSquaresEstimator(512, 1, 3e-3), data, labels
+            )
+            plan_pipe.executor.optimized_graph
+            return
+        for lam in lams:
+            fitted = chain.and_then(
+                BlockLeastSquaresEstimator(512, 1, float(lam)), data, labels
+            ).fit()
+            probe = fitted.apply(Dataset.of(X_probe))
+            _sync_scalar(jnp.sum(jnp.abs(jnp.asarray(probe.to_numpy()))))
+
+    return fit_sweep
+
+
+def autocache_metric():
+    """Autocache vs whole-chain fusion ON CHIP under a stated HBM budget,
+    min-of-N warm sweeps (the TIMIT headline convention).
+
+    Workload: a 3-stage featurize chain (512→8192 cosine features →
+    rectify → 8192→2048 cosine features) reused by 3-fit ridge λ-sweeps
+    (the reference's canonical re-use pattern). Intermediates: stage-1/2
+    outputs 4.3 GB each, stage-3 output 1.1 GB (n=131072, f32).
+
+    ROUND-6 READING. Cache placement now runs on the POST-fusion plan:
+    on this fully device-fusable chain the fused program absorbs every
+    stage, so greedy_postfusion finds no profitable interior cut, inserts
+    nothing that splits the program, and must tie no-cache (round 5's
+    greedy lost 101.6 s vs 99.0 s because pre-fusion placement broke the
+    fused chain into per-stage dispatches — kept measurable here as
+    greedy_prefusion). The host-boundary row (autocache_host_boundary)
+    carries the case caching must WIN.
+    """
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.stats import CosineRandomFeatures, LinearRectifier
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
 
     n, d_in, d_mid, d_out = 131_072, 512, 8192, 2048
     budget = 3 << 30
@@ -1142,55 +1217,19 @@ def autocache_metric():
     rect = LinearRectifier(0.0)
     crf2 = CosineRandomFeatures(d_mid, d_out, 1e-2, seed=1)
 
-    def run_config(make_optimizer):
-        env = PipelineEnv.get_or_create()
-        env.reset()
-        env.set_optimizer(make_optimizer())
-        chain = crf1.to_pipeline().and_then(rect).and_then(crf2)
-        per_fit = []
-        for lam in (1e-4, 1e-3, 1e-2):
-            t0 = time.perf_counter()
-            fitted = chain.and_then(
-                BlockLeastSquaresEstimator(512, 1, lam), data, labels
-            ).fit()
-            probe = fitted.apply(Dataset.of(X[:256]))
-            _sync_scalar(jnp.sum(jnp.abs(jnp.asarray(probe.to_numpy()))))
-            per_fit.append(round(time.perf_counter() - t0, 3))
-        # The PLAN: how many Cacher insertions the strategy chose on this
-        # fit graph (the optimizer runs on graph construction; profiling
-        # for greedy re-runs here and is excluded from the timed fits).
-        plan_pipe = chain.and_then(
-            BlockLeastSquaresEstimator(512, 1, 1e-4), data, labels
-        )
-        g = plan_pipe.executor.optimized_graph
-        num_cachers = sum(
-            1 for node in g.nodes
-            if "Cacher" in getattr(g.get_operator(node), "label", "")
-        )
-        env.reset()
-        return per_fit, num_cachers
+    def make_chain():
+        return crf1.to_pipeline().and_then(rect).and_then(crf2)
+
+    fit_sweep = _make_fit_sweep(data, labels, X[:256])
 
     results = {}
-    for name, mk in (
-        ("no_cache", DefaultOptimizer),
-        ("greedy_3gb", lambda: AutoCachingOptimizer(
-            GreedyCache(max_mem_bytes=budget)
-        )),
-        ("aggressive_unbounded", lambda: AutoCachingOptimizer(
-            AggressiveCache()
-        )),
-    ):
+    for name, mk in _cache_configs(budget):
         try:
-            per_fit, num_cachers = run_config(mk)
-            results[name] = {
-                "wall_s": round(sum(per_fit), 3),
-                "per_fit_s": per_fit,
-                "cache_insertions": num_cachers,
-            }
+            results[name] = _run_cache_sweeps(mk, make_chain, fit_sweep)
         except Exception as e:
             results[name] = {"wall_s": None, "error": str(e)[:160]}
 
-    greedy = results.get("greedy_3gb", {}).get("wall_s")
+    greedy = results.get("greedy_postfusion", {}).get("wall_s")
     base = results.get("no_cache", {}).get("wall_s")
     return {
         "metric": "autocache_on_chip",
@@ -1201,7 +1240,11 @@ def autocache_metric():
         ),
         "detail": {
             "n": n, "dims": [d_in, d_mid, d_out],
-            "reuse": "3-fit lambda sweep over one featurize chain",
+            "reuse": "3-fit lambda sweeps over one featurize chain",
+            "timing": (
+                "min of 3 warm 3-fit sweeps after one cold sweep; fresh "
+                "lambdas per sweep so every fit genuinely solves"
+            ),
             "budget_bytes": budget,
             "intermediate_gb": [
                 round(n * d_mid * 4 / 1e9, 1),
@@ -1210,23 +1253,110 @@ def autocache_metric():
             ],
             "configs": results,
             "reading": (
-                "round 5: the no-cache optimizer fuses the WHOLE chain + "
-                "fit into one shared program (lambda is a traced operand), "
-                "so a full re-execution (~0.5 s warm) now undercuts the "
-                "cached configs, whose Cacher nodes break the fusion "
-                "chain; vs_baseline < 1 is the fusion feature winning, "
-                "not the cache feature regressing (r4, pre-fusion: "
-                "no-cache 69.4 s vs greedy 20.2 s). Fit 1 in every "
-                "config is dominated by the one-time compile; greedy's "
-                "additionally carries its on-chip profiling passes. "
-                "Autocache remains the tool for stages fusion cannot "
-                "collapse (host loaders/decodes, multi-consumer "
-                "intermediates, cross-process prefix reuse)"
+                "round 6: AutoCacheRule runs on the POST-fusion plan and "
+                "declines any cut inside a fusable region, so on this "
+                "fully device-fusable chain greedy_postfusion must TIE "
+                "no_cache (acceptance: wall <= no_cache wall); "
+                "greedy_prefusion keeps the round-5 phase order for A/B "
+                "(its placement granularity predates fusion, though the "
+                "rule-level boundary guard now applies there too). The "
+                "win case for caching lives in autocache_host_boundary"
             ),
             "vs_baseline_note": (
-                "vs_baseline here = no-cache wall / greedy wall (the "
-                "cache plan's measured on-chip speedup, profiling "
-                "included)"
+                "vs_baseline = no-cache warm wall / greedy_postfusion "
+                "warm wall; >= 1.0 means the cache plan no longer "
+                "degrades the fused program"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    }
+
+
+def autocache_host_boundary_metric():
+    """The case cache placement must WIN: a host decode stage feeds a
+    device-fusable featurize+fit chain reused by λ-sweeps. Fusion cannot
+    collapse the host stage; greedy caches its output at the fused-stage
+    boundary and later fits load it from the prefix state table instead
+    of re-paying transfer+decode. Same min-of-N warm sweep convention as
+    autocache_on_chip. Acceptance: greedy_postfusion warm wall strictly
+    below no-cache."""
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.workflow import Transformer
+
+    n, d_in, d_mid = 65_536, 512, 4096
+    budget = 3 << 30
+    rng = np.random.default_rng(6)
+    X = jnp.asarray(rng.normal(size=(n, d_in)).astype(np.float32))
+    y = rng.integers(0, 10, size=n)
+    labels = Dataset.of(
+        jnp.asarray(
+            np.asarray(
+                ClassLabelIndicatorsFromIntLabels(10)(Dataset.of(y)).array
+            )
+        )
+    )
+    data = Dataset.of(X)
+    jax.block_until_ready(X)
+
+    class HostDecode(Transformer):
+        """Not device-fusable: device->host, host decode math, host->device
+        — the loader/decode stage class fusion cannot collapse."""
+
+        def apply(self, x):
+            v = np.asarray(x)
+            return np.sign(v) * np.sqrt(np.abs(v)).astype(np.float32)
+
+        def batch_apply(self, ds):
+            V = np.asarray(ds.array)  # device -> host
+            out = np.sign(V) * np.sqrt(np.abs(V)).astype(np.float32)
+            return Dataset(jnp.asarray(out), n=ds.n)  # host -> device
+
+    host = HostDecode()
+    crf = CosineRandomFeatures(d_in, d_mid, 1e-2, seed=2)
+
+    def make_chain():
+        return host.to_pipeline().and_then(crf)
+
+    fit_sweep = _make_fit_sweep(data, labels, X[:256])
+
+    results = {}
+    for name, mk in _cache_configs(budget):
+        if name == "aggressive_unbounded":
+            continue  # the greedy-vs-none contrast is the claim here
+        try:
+            results[name] = _run_cache_sweeps(mk, make_chain, fit_sweep)
+        except Exception as e:
+            results[name] = {"wall_s": None, "error": str(e)[:160]}
+
+    greedy = results.get("greedy_postfusion", {}).get("wall_s")
+    base = results.get("no_cache", {}).get("wall_s")
+    return {
+        "metric": "autocache_host_boundary",
+        "value": greedy if greedy is not None else -1.0,
+        "unit": "s",
+        "vs_baseline": (
+            round(base / greedy, 2) if greedy and base else None
+        ),
+        "detail": {
+            "n": n, "dims": [d_in, d_mid],
+            "host_stage_gb_per_pass": round(n * d_in * 4 * 2 / 1e9, 2),
+            "reuse": "3-fit lambda sweeps over host decode + fused chain",
+            "timing": (
+                "min of 3 warm 3-fit sweeps after one cold sweep; fresh "
+                "lambdas per sweep"
+            ),
+            "budget_bytes": budget,
+            "configs": results,
+            "reading": (
+                "the host decode stage is the fusion-breaking boundary "
+                "autocache exists for post round-6: greedy caches its "
+                "output and warm sweeps load it from the prefix state "
+                "table, skipping the device->host->device roundtrip "
+                "no_cache re-pays every fit; vs_baseline > 1.0 is the "
+                "cache feature earning its keep on the plan fusion "
+                "actually runs"
             ),
             "device": str(jax.devices()[0]),
         },
@@ -1325,6 +1455,7 @@ def main():
             krr_metric,
             mnist_fft_metric,
             autocache_metric,
+            autocache_host_boundary_metric,
             stupidbackoff_metric,
         ):
             try:

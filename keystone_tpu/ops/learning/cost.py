@@ -212,7 +212,13 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         n = getattr(sample, "total_n", sample.n)
         if is_sparse_dataset(sample):
             indices = np.asarray(sample.data["indices"])
-            d = int(indices.max()) + 1
+            # Feature width: prefer the TRUE width threaded through by the
+            # sample collector (``total_d`` — declared by the vectorizer or
+            # measured over the full index array); ``indices.max()+1`` over
+            # a 24-row sample undershoots whenever the sample misses the
+            # top ids, mis-pricing every sparse candidate's resident_bytes.
+            measured_d = int(indices.max()) + 1
+            d = max(int(getattr(sample, "total_d", 0) or 0), measured_d)
             # Active fraction measured over the SAMPLE's valid rows
             # (dividing by the full n would collapse sparsity toward zero
             # whenever the collector attaches total_n; padded-COO rows
@@ -227,15 +233,23 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             sparsity = float((X != 0).mean())
         else:
             d = int(np.asarray(sample.array).shape[-1])
-            sparsity = float(np.mean(np.asarray(sample.array[: n]) != 0))
+            # Slice by the sample's VALID rows, matching the sparse branch:
+            # n here is the full-dataset size, so ``[: n]`` would keep any
+            # zero-padded tail rows and deflate the measured sparsity.
+            sparsity = float(
+                np.mean(np.asarray(sample.array[: sample.n]) != 0)
+            )
         k = int(np.asarray(labels_sample.array).shape[-1])
         machines = self.num_machines or max(len(jax.devices()), 1)
 
         # Raw-source row bytes (attached by the sample collector): the
-        # streaming tier keeps RAW rows resident, not features.
+        # streaming tier keeps RAW rows resident, not features. The
+        # density flag lets its capacity model default an UNSET raw width
+        # honestly — a dense row is the full 4d bytes, not a capped guess.
         self._streaming_choice.raw_row_bytes = getattr(
             sample, "source_row_bytes", None
         )
+        self._streaming_choice.input_is_sparse = is_sparse_dataset(sample)
         budget = (
             self.hbm_bytes if self.hbm_bytes is not None
             else device_memory_bytes()

@@ -464,6 +464,11 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         # (bytes per RAW input row — the streamed fit keeps raw rows, not
         # features, resident).
         self.raw_row_bytes: Optional[float] = None
+        # Density of the raw input (set by the owner): decides how an
+        # UNSET raw_row_bytes defaults in resident_bytes. None (no owner)
+        # is treated as dense — the conservative direction for a
+        # feasibility cut.
+        self.input_is_sparse: Optional[bool] = None
         # Feature-slab budget for the tile scan; the owner shrinks it when
         # the device budget is small so the capacity model and the actual
         # fit agree on the working set.
@@ -575,7 +580,14 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         (d, d) Gramian/factor stash + one feature slab. Block tier (the
         north-star program): raw rows + labels + residual + per-BLOCK
         Gramian/factor stash + one block slab + the bank — no d² term."""
-        raw = self.raw_row_bytes if self.raw_row_bytes else 4.0 * min(d, 512)
+        raw = self.raw_row_bytes
+        if not raw:
+            # Unknown raw width. Dense input: the raw operand IS the full
+            # f32 row — 4d bytes (the old min(d, 512) cap underestimated
+            # wide-dense rows ~32x at d=16384, letting this tier look
+            # feasible when the raw operand alone exceeds HBM). Sparse
+            # input: rows are padded COO, bounded by the old cap.
+            raw = 4.0 * min(d, 512) if self.input_is_sparse else 4.0 * d
         bs = min(self.block_size_hint, d)
         slab = min(
             streaming.pick_tile_rows(d, 4, slab_bytes=self.slab_bytes)

@@ -755,17 +755,29 @@ class ShardedStupidBackoffModel(Transformer):
     the reference's ``ngramCounts.lookup`` on the partitioned RDD, where
     the partitioner routes each lookup (StupidBackoff.scala:96-125)."""
 
+    # Keys probed per shard by the default disjointness check.
+    _VALIDATE_PROBES = 32
+
     def __init__(self, shards: List["StupidBackoffModel"], indexer=None,
-                 validate: bool = True):
+                 validate=True):
         self.shards = shards
         self.indexer = indexer or NGramIndexerImpl()
         # batch_score_packed SUMS per-shard lookups, which is only equal to
         # the routed lookup when no n-gram lives in two shards — guaranteed
         # by partition_ngram_pairs but not by a hand-assembled model, where
-        # a duplicate would silently double its count. The check is one
-        # O(total n-grams) pass; shards built by the partitioner may pass
-        # ``validate=False`` to skip it at serving scale.
-        if validate:
+        # a duplicate would silently double its count.
+        #
+        # The DEFAULT check is a sampled-key probe: O(shards² × probes)
+        # dict lookups instead of materializing a set union of every
+        # shard's n-grams (O(total n-grams) time AND memory — at serving
+        # scale that doubled construction's footprint for a check that, in
+        # the realistic failure mode of the same pair list fed to two
+        # shards, any single probed key already catches). Probabilistic:
+        # it cannot prove disjointness. Pass ``validate="full"`` for the
+        # exhaustive union check, or ``validate=False`` to skip — the
+        # partitioner's own construction path (:meth:`from_partitioned`)
+        # does, since its shards are disjoint by construction.
+        if validate == "full":
             total = sum(len(s.ngram_counts) for s in shards)
             union: set = set()
             for s in shards:
@@ -776,6 +788,48 @@ class ShardedStupidBackoffModel(Transformer):
                     "in more than one shard (partition with "
                     "partition_ngram_pairs)"
                 )
+        elif validate:
+            self._probe_disjoint()
+
+    def _probe_disjoint(self) -> None:
+        """Sampled disjointness check: probe evenly-spaced keys from each
+        shard against every other shard's table. Probabilistic — it cannot
+        prove disjointness, but catches the systematic overlaps
+        mis-assembly actually produces (duplicated or mis-partitioned pair
+        lists) at O(probes) memory (the keys are stepped off the dict
+        iterator, never materialized as a full list)."""
+        from itertools import islice
+
+        for i, s in enumerate(self.shards):
+            count = len(s.ngram_counts)
+            if not count:
+                continue
+            step = max(count // self._VALIDATE_PROBES, 1)
+            probes = list(islice(
+                iter(s.ngram_counts), 0, step * self._VALIDATE_PROBES, step
+            ))
+            for j, other in enumerate(self.shards):
+                if j == i:
+                    continue
+                for key in probes:
+                    if key in other.ngram_counts:
+                        raise ValueError(
+                            f"shards overlap: n-gram {key} present in "
+                            f"shards {i} and {j} (partition with "
+                            "partition_ngram_pairs; probabilistic probe — "
+                            'pass validate="full" for the exhaustive check)'
+                        )
+
+    @classmethod
+    def from_partitioned(
+        cls, shards: List["StupidBackoffModel"], indexer=None
+    ) -> "ShardedStupidBackoffModel":
+        """Construction path for shards fitted from
+        :func:`partition_ngram_pairs` output: the partitioner assigns each
+        n-gram to exactly one part, so the overlap check is skipped
+        entirely (validate=False) — no O(total n-grams) pass at serving
+        scale."""
+        return cls(shards, indexer=indexer, validate=False)
 
     def _count(self, ngram: NGram) -> int:
         pid = initial_bigram_partition(ngram, len(self.shards), self.indexer)

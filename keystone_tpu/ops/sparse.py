@@ -397,6 +397,15 @@ class SparseFeatureVectorizer(Transformer):
         self.num_features = len(feature_space)
         self.max_nnz = max_nnz
 
+    @property
+    def sparse_output_dim(self) -> int:
+        """Declared output width — the cost-model sample collector threads
+        this through as ``total_d`` so solver selection prices the true
+        feature width instead of ``indices.max()+1`` over a tiny sample
+        (which undershoots whenever the sample misses the top ids)."""
+        space = self.feature_space.values()
+        return (max(space) + 1) if space else 0
+
     def apply(self, item):
         pairs = sorted(
             (self.feature_space[f], v)

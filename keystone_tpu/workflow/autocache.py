@@ -19,6 +19,31 @@ Two strategies, as in the reference:
 Node weights come from the ``weight`` attribute of operators (the
 WeightedOperator contract, reference: workflow/WeightedOperator.scala): the
 number of passes the operator makes over its inputs.
+
+POST-FUSION WORLD MODEL (round 6). The reference profiles the plan it will
+actually run; our port used to profile the PRE-fusion execution model, so
+whole-chain fusion made recompute nearly free while inserted ``Cacher``
+nodes broke the fused program (round 5's autocache_on_chip row: greedy
+LOST to no-cache). The rule is therefore fusion-aware on two axes:
+
+  1. In :class:`~.optimizer.AutoCachingOptimizer` it runs AFTER the fusion
+     batches, so profiles are taken per POST-fusion node: a stage absorbed
+     into a fused program no longer exists as a candidate (its marginal
+     recompute cost is ~0 by construction), and ``estimate_cached_runtime``
+     on the fused graph prices a candidate by the delta between the fused
+     plan with and without the cut.
+  2. Whatever the phase order, selection excludes nodes where a spliced
+     Cacher would sever an edge the fusion rules would otherwise compile
+     into one program (:func:`~.fusion.cache_would_split_fusion`), so
+     insertion only ever lands on fused-stage boundaries: host loaders /
+     decodes, multi-consumer intermediates, gather points, and inputs of
+     non-traceable fits.
+
+Profiles come from real executions when available: the executor records
+each node's first-force wall time and bytes into the observed-profile
+table (:func:`record_observed_profile`), keyed by logical Prefix like the
+sampling memo, and greedy consults it before paying sampled profiling
+passes — the cross-fit "re-profile on the fused plan" hook.
 """
 
 from __future__ import annotations
@@ -234,8 +259,12 @@ def greedy_cache_set(
     graph: Graph,
     profiles: Dict[NodeId, Profile],
     max_mem: int,
+    excluded: Optional[Set[NodeId]] = None,
 ) -> Set[NodeId]:
     """The greedy selection loop (greedyCache, AutoCacheRule.scala:559-602).
+
+    ``excluded`` bars extra nodes from selection (AutoCacheRule passes the
+    fusion-splitting set so a Cacher never lands inside a fusable region).
 
     Divergence from the reference: source descendants are excluded from
     *selection*, not just subtracted from the result afterwards. The
@@ -246,19 +275,19 @@ def greedy_cache_set(
     profiled candidates always dominate strictly).
     """
     cached = init_cache_set(graph)
-    source_desc = descendants_of_sources(graph)
+    barred = descendants_of_sources(graph) | (excluded or set())
     runs = compute_runs(graph, cached)
     to_cache: Set[NodeId] = set()
     used = cached_mem(cached, profiles)
     while used < max_mem and _still_room(
-        cached | to_cache | source_desc, runs, profiles, max_mem - used
+        cached | to_cache | barred, runs, profiles, max_mem - used
     ):
         to_cache.add(
             _select_next(
                 graph,
                 profiles,
                 cached | to_cache,
-                cached | to_cache | source_desc,
+                cached | to_cache | barred,
                 runs,
                 max_mem - used,
             )
@@ -407,8 +436,69 @@ def _estimate_bytes(value) -> int:
     return 64
 
 
+# ---------------------------------------------------------------------------
+# Observed profiles: real full-scale measurements collected by the executor
+# ---------------------------------------------------------------------------
+
+# Keyed like the sampling memo — (hash(Prefix), structural fingerprint) —
+# holding only floats, never operators or arrays. The executor records each
+# source-free node's first-force wall time + result bytes here as pipelines
+# actually run; AutoCacheRule consults it before paying sampled profiling
+# passes, so cache placement prices POST-FUSION nodes by what the fused
+# program measurably cost, not by a toy-scale extrapolation.
+_OBSERVED_PROFILES: Dict[Tuple, Profile] = {}
+_OBSERVED_MAX = 512
+
+
+def observed_profile_key(
+    graph: Graph, node: NodeId, _memo: Optional[dict] = None
+) -> Optional[Tuple]:
+    """Stable cross-graph identity of a node's computation, or None for
+    source-dependent nodes (whose Prefix is undefined)."""
+    try:
+        p = Prefix.find(graph, node, _memo)
+    except (ValueError, TypeError):
+        return None
+    return (hash(p), _prefix_fingerprint(p))
+
+
+def record_observed_profile(key: Tuple, ns: float, mem_bytes: int) -> None:
+    """Record a real execution of the node behind ``key``. Keeps the MIN
+    observed time (the warm recompute cost — first runs carry compiles)
+    and the latest size."""
+    if ns <= 0:
+        return
+    prev = _OBSERVED_PROFILES.pop(key, None)
+    if prev is not None:
+        ns = min(ns, prev.ns)
+    elif len(_OBSERVED_PROFILES) >= _OBSERVED_MAX:
+        _OBSERVED_PROFILES.pop(next(iter(_OBSERVED_PROFILES)))
+    _OBSERVED_PROFILES[key] = Profile(ns=ns, mem_bytes=int(mem_bytes))
+
+
+def get_observed_profile(key: Optional[Tuple]) -> Optional[Profile]:
+    return _OBSERVED_PROFILES.get(key) if key is not None else None
+
+
+def clear_observed_profiles() -> None:
+    """Reset hook — called by PipelineEnv.reset(): keys hash
+    DatasetOperators by dataset id(), so entries must not outlive the env
+    generation (a recycled id could alias a stale profile onto different
+    data)."""
+    _OBSERVED_PROFILES.clear()
+
+
 class AutoCacheRule(Rule):
     """Insert Cacher nodes per the configured strategy.
+
+    Fusion-preserving placement: candidates where a spliced Cacher would
+    sever an edge the fusion rules would otherwise compile into one
+    program (:func:`~.fusion.cache_would_split_fusion`) are excluded from
+    BOTH strategies, so a cache only ever lands on a fused-stage boundary.
+    Run after the fusion batches (AutoCachingOptimizer's order), the
+    surviving candidates are whole post-fusion programs and
+    ``estimate_cached_runtime`` prices each cut against the plan that will
+    actually execute.
 
     GreedyCache profiling is memoized across optimizer invocations by
     logical :class:`Prefix`: a λ-sweep refitting the same featurize chain
@@ -417,7 +507,9 @@ class AutoCacheRule(Rule):
     pass costs real compiles of the sampled shapes, so the memo is the
     difference between greedy's steady-state fits matching aggressive's
     and trailing them by a full profiling pass — measured on the
-    autocache bench row.)
+    autocache bench row.) Real executions observed by the executor
+    (:func:`record_observed_profile`) take precedence over both: they are
+    full-scale measurements of the fused programs themselves.
     """
 
     _PROFILE_MEMO_MAX = 512
@@ -425,23 +517,33 @@ class AutoCacheRule(Rule):
     def __init__(self, strategy=None):
         self.strategy = strategy or GreedyCache()
         self._profile_memo: Dict[Tuple, Profile] = {}
+        # The most recent apply()'s selected nodes — observable by benches
+        # and tests even after SavedStateLoadRule replaces the inserted
+        # Cachers with state splices.
+        self.last_selection: Set[NodeId] = set()
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        from .fusion import fusion_splitting_nodes
+
+        splitting = fusion_splitting_nodes(plan, prefixes)
         if isinstance(self.strategy, AggressiveCache):
-            to_cache = self._aggressive(plan)
+            to_cache = self._aggressive(plan, splitting)
         else:
-            to_cache = self._greedy(plan, self.strategy)
+            to_cache = self._greedy(plan, self.strategy, splitting)
+        self.last_selection = set(to_cache)
         return _insert_cachers(plan, to_cache), prefixes
 
-    def _aggressive(self, plan: Graph) -> Set[NodeId]:
+    def _aggressive(
+        self, plan: Graph, splitting: Optional[Set[NodeId]] = None
+    ) -> Set[NodeId]:
         """Cache every node with >1 weighted direct successor access that is
-        not already cached and not source-dependent
-        (aggressiveCache, AutoCacheRule.scala:503-518)."""
+        not already cached, not source-dependent, and not inside a fusable
+        region (aggressiveCache, AutoCacheRule.scala:503-518)."""
         cached = init_cache_set(plan)
-        source_desc = descendants_of_sources(plan)
+        excluded = descendants_of_sources(plan) | (splitting or set())
         out = set()
         for node in plan.nodes:
-            if node in cached or node in source_desc:
+            if node in cached or node in excluded:
                 continue
             accesses = 0
             for child in analysis.get_children(plan, node):
@@ -453,16 +555,25 @@ class AutoCacheRule(Rule):
                 out.add(node)
         return out
 
-    def _greedy(self, plan: Graph, strategy: GreedyCache) -> Set[NodeId]:
+    def _greedy(
+        self,
+        plan: Graph,
+        strategy: GreedyCache,
+        splitting: Optional[Set[NodeId]] = None,
+    ) -> Set[NodeId]:
         cached = init_cache_set(plan)
         runs = compute_runs(plan, cached)
-        source_desc = descendants_of_sources(plan)
+        splitting = splitting or set()
+        excluded = descendants_of_sources(plan) | splitting
         # Profile every uncached node accessed more than once that doesn't
-        # depend on the sources (AutoCacheRule.scala:612-618).
+        # depend on the sources (AutoCacheRule.scala:612-618) and whose
+        # caching wouldn't split a fusable region (those nodes' marginal
+        # recompute cost is absorbed by the fused program — profiling them
+        # would price the cut against a plan that never runs).
         to_profile = {
             n
             for n in plan.nodes
-            if n not in cached and runs[n] > 1 and n not in source_desc
+            if n not in cached and runs[n] > 1 and n not in excluded
         }
         if not to_profile:
             return set()
@@ -480,14 +591,19 @@ class AutoCacheRule(Rule):
         scales_key = (tuple(strategy.partition_scales), strategy.num_trials)
         find_memo: Dict[NodeId, Prefix] = {}
         node_keys: Dict[NodeId, Tuple] = {}
+        profiles: Dict[NodeId, Profile] = {}
         for n in to_profile:
             p = Prefix.find(plan, n, find_memo)
-            node_keys[n] = (hash(p), _prefix_fingerprint(p), scales_key)
-        profiles = {
-            n: self._profile_memo[k]
-            for n, k in node_keys.items()
-            if k in self._profile_memo
-        }
+            base = (hash(p), _prefix_fingerprint(p))
+            node_keys[n] = base + (scales_key,)
+            # Full-scale measurement from a real prior execution of this
+            # computation (post-fusion, warm) beats any sampled model.
+            observed = get_observed_profile(base)
+            if observed is not None:
+                profiles[n] = observed
+        for n, k in node_keys.items():
+            if n not in profiles and k in self._profile_memo:
+                profiles[n] = self._profile_memo[k]
         misses = to_profile - set(profiles)
         if misses:
             fresh = profile_nodes(
@@ -509,7 +625,7 @@ class AutoCacheRule(Rule):
         max_mem = strategy.max_mem_bytes
         if max_mem is None:
             max_mem = _default_mem_budget()
-        return greedy_cache_set(plan, profiles, max_mem)
+        return greedy_cache_set(plan, profiles, max_mem, excluded=splitting)
 
 
 def _prefix_fingerprint(prefix: Prefix) -> str:

@@ -96,6 +96,15 @@ class PipelineEnv:
         self._optimizer = optimizer
 
     def reset(self) -> None:
-        """Clear prefix state and optimizer (test fixture hook, PipelineContext.scala:9-42)."""
+        """Clear prefix state and optimizer (test fixture hook, PipelineContext.scala:9-42).
+
+        Also clears the autocache observed-profile table: its keys hash
+        DatasetOperators by dataset id(), and letting entries outlive the
+        env generation would widen the window for a recycled id to alias a
+        stale profile onto different data (the hazard _SHARED_FIT_PROGRAMS
+        guards with weakref re-verification)."""
         self.state.clear()
         self._optimizer = None
+        from . import autocache
+
+        autocache.clear_observed_profiles()

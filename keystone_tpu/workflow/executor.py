@@ -4,16 +4,23 @@ On first demand the executor (optionally) runs the global whole-pipeline
 optimizer, then recursively evaluates the requested id's dependency chain,
 memoizing each node's Expression and publishing results for nodes whose prefix
 was marked by the optimizer into the global PipelineEnv state table.
+
+Profile collection: every source-free node's first force is timed and its
+result size estimated, feeding the autocache observed-profile table. The
+executor runs the OPTIMIZED graph, so what gets measured is the cost of the
+post-fusion programs themselves — the full-scale ground truth AutoCacheRule
+prefers over its sampled extrapolations when placing caches.
 """
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Mapping, Optional
 
 from . import analysis
 from .env import PipelineEnv, Prefix
 from .graph import Graph, GraphId, NodeId, SinkId, SourceId
-from .operators import Expression
+from .operators import Expression, ExpressionOperator
 
 
 class GraphExecutor:
@@ -30,6 +37,7 @@ class GraphExecutor:
         self._optimized_graph: Optional[Graph] = graph if not optimize else None
         self._prefixes: Optional[Mapping[NodeId, Prefix]] = prefixes
         self._execution_state: Dict[GraphId, Expression] = {}
+        self._profile_key_memo: Dict[NodeId, Prefix] = {}
 
     def _ensure_optimized(self) -> Graph:
         if self._optimized_graph is None:
@@ -70,9 +78,73 @@ class GraphExecutor:
             dep_exprs = [self._execute(graph, dep) for dep in graph.get_dependencies(graph_id)]
             operator = graph.get_operator(graph_id)
             expression = operator.execute(dep_exprs)
+            self._observe(graph, graph_id, operator, dep_exprs, expression)
             # Publish results the optimizer marked for prefix-state reuse.
             if self._prefixes and graph_id in self._prefixes:
                 PipelineEnv.get_or_create().state[self._prefixes[graph_id]] = expression
 
         self._execution_state[graph_id] = expression
         return expression
+
+    def _observe(self, graph, graph_id, operator, dep_exprs, expression) -> None:
+        """Arrange for the node's first force to record an observed profile.
+
+        The expression's thunk is wrapped so that when (and only when) the
+        value is actually demanded, the node's own wall time — deps forced
+        first, which every core operator's thunk does anyway — and result
+        bytes land in the autocache observed-profile table under the node's
+        logical Prefix. ExpressionOperator nodes are skipped (their value
+        was computed elsewhere; timing the splice says nothing about the
+        operator's cost), as are source-dependent nodes (no Prefix).
+        """
+        if isinstance(operator, ExpressionOperator):
+            return
+        orig = getattr(expression, "_thunk", None)
+        if orig is None:  # already computed (shared expression)
+            return
+        from . import autocache
+
+        key = autocache.observed_profile_key(
+            graph, graph_id, self._profile_key_memo
+        )
+        if key is None:
+            return
+
+        def drain(value):
+            """Wait out async JAX dispatch on a value's device arrays."""
+            try:
+                import jax
+
+                jax.block_until_ready(
+                    [x for x in jax.tree_util.tree_leaves(
+                        getattr(value, "data", value)
+                    ) if hasattr(x, "block_until_ready")]
+                )
+            except Exception:
+                pass
+
+        def timed():
+            # Force AND drain deps BEFORE the clock starts: an upstream
+            # fused program's in-flight device compute would otherwise
+            # block inside this node's timed region and be double-counted
+            # against it.
+            for d in dep_exprs:
+                drain(d.get())
+            t0 = time.perf_counter()
+            value = orig()
+            # Drain the node's own dispatch INSIDE the timed region (the
+            # same guard the sampled profiler applies): a jitted program
+            # returns un-materialized arrays, and without the sync its
+            # compute would be mis-attributed to whichever downstream
+            # stage first blocks.
+            drain(value)
+            ns = (time.perf_counter() - t0) * 1e9
+            try:
+                autocache.record_observed_profile(
+                    key, ns, autocache._estimate_bytes(value)
+                )
+            except Exception:
+                pass
+            return value
+
+        expression._thunk = timed

@@ -29,13 +29,23 @@ def _is_saveable(op) -> bool:
 
 class ExtractSaveablePrefixes(Rule):
     """Mark nodes whose results should be published to / loaded from the global
-    prefix state table: Cacher nodes and estimator fits."""
+    prefix state table: Cacher nodes and estimator fits.
+
+    Re-extraction MERGES: marks carried in from an earlier batch win, and
+    only unmarked saveable nodes gain fresh prefixes. AutoCachingOptimizer
+    runs this rule a second time after post-fusion cache placement, so the
+    Cachers AutoCacheRule just inserted get published for cross-fit reuse
+    without re-keying estimator marks the first extraction computed on the
+    pre-fusion graph (whose keys earlier fits already published under).
+    Marks for nodes no longer in the plan are dropped."""
 
     def apply(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
-        new_prefixes: Dict[NodeId, Prefix] = {}
+        new_prefixes: Dict[NodeId, Prefix] = {
+            n: p for n, p in prefixes.items() if n in plan.operators
+        }
         memo: Dict[NodeId, Prefix] = {}
         for node, op in plan.operators.items():
-            if not _is_saveable(op):
+            if node in new_prefixes or not _is_saveable(op):
                 continue
             # Prefixes are undefined for source-dependent nodes: skip them.
             ancestors = analysis.get_ancestors(plan, node)
@@ -171,6 +181,55 @@ class NodeOptimizationRule(Rule):
         return graph, prefixes
 
 
+def _attach_sparse_width(op, value, dep_values) -> None:
+    """Thread the TRUE feature width onto a derived sparse sample.
+
+    ``optimize()`` measures d as ``indices.max()+1`` over the sampled rows,
+    which undershoots whenever the handful of samples misses the top
+    feature ids. The width is knowable without sampling in every real
+    producer: a vectorizer declares it (``sparse_output_dim``) — whether
+    chained directly or applied through a DelegatingOperator as a fitted
+    transformer riding in the dep values — a Sparsify-style node's dense
+    input carries it as the dense shape, and a width-preserving transform
+    inherits its sparse input's. Attach it as ``total_d`` so the cost
+    model prices resident_bytes at the true width.
+    """
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.sparse import is_sparse_dataset
+
+    if not is_sparse_dataset(value):
+        return
+    # The declaring operator is the node's own op, or (the fit-then-apply
+    # route) a fitted transformer among the dep values.
+    for declarer in [op] + [v for v in dep_values if not isinstance(v, Dataset)]:
+        declared = getattr(declarer, "sparse_output_dim", None)
+        if callable(declared):
+            try:
+                declared = declared()
+            except Exception:
+                declared = None
+        if declared:
+            value.total_d = int(declared)
+            return
+    dep_ds = [v for v in dep_values if isinstance(v, Dataset)]
+    for v in dep_ds:
+        if is_sparse_dataset(v):
+            inherited = getattr(v, "total_d", None)
+            if inherited:
+                value.total_d = int(inherited)
+                return
+        else:
+            try:
+                import jax.tree_util as jtu
+
+                leaves = jtu.tree_leaves(v.data)
+                if len(leaves) == 1 and getattr(leaves[0], "ndim", 0) >= 2:
+                    value.total_d = int(leaves[0].shape[-1])
+                    return
+            except Exception:
+                pass
+
+
 def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
     """Execute ancestor chains of the target nodes with row-sampled datasets.
 
@@ -198,6 +257,8 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
             return None
 
     def sample_dataset(ds: Dataset) -> Dataset:
+        from keystone_tpu.ops.sparse import is_sparse_dataset
+
         num_shards = 1
         if ds.mesh is not None:
             from keystone_tpu.parallel import mesh as mesh_lib
@@ -216,6 +277,15 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
         # supplies d, k, and sparsity.
         out.total_n = ds.n
         out.source_row_bytes = _row_bytes(ds)
+        if is_sparse_dataset(ds):
+            # The TRUE feature width, measured over the FULL index array —
+            # ``indices.max()+1`` over a handful of sampled rows can
+            # undershoot it by orders of magnitude, mis-pricing every
+            # sparse candidate's resident_bytes downstream (cost.py).
+            try:
+                out.total_d = int(np.asarray(ds.data["indices"]).max()) + 1
+            except Exception:
+                pass
         return out
 
     # Execute with a private memo table, sampling at every DatasetOperator.
@@ -250,6 +320,7 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
                 ]
                 if raws:
                     value.source_row_bytes = max(raws)
+                _attach_sparse_width(op, value, deps)
         memo[gid] = value
         return value
 

@@ -150,6 +150,126 @@ class TestSelection:
         np.testing.assert_allclose(p_s, p_b, atol=5e-3, rtol=5e-3)
 
 
+class TestSparseWidthUndershoot:
+    """The measurement corner VERDICT r5 flagged: ``optimize()`` derives d
+    from ``indices.max()+1`` over a 24-row sample, which undershoots the
+    true feature width whenever the sample misses the top ids — mis-pricing
+    every sparse candidate's resident_bytes. Mitigation: the sample
+    collector threads the TRUE width through as ``total_d`` (declared by
+    the vectorizer, or measured over the full index array), and
+    ``optimize()`` prices max(total_d, measured)."""
+
+    def _undershooting_sample(self, n_total, d_true, d_seen, k, nnz=8):
+        rng = np.random.default_rng(11)
+        # All sampled indices land in [0, d_seen): measured width
+        # undershoots d_true by d_true/d_seen.
+        idx = rng.integers(0, d_seen, size=(24, nnz)).astype(np.int32)
+        s = Dataset(
+            {"indices": jnp.asarray(idx),
+             "values": jnp.asarray(
+                 rng.normal(size=(24, nnz)).astype(np.float32)
+             )},
+            n=24,
+        )
+        s.total_n = n_total
+        s.source_row_bytes = nnz * 8.0
+        ls = Dataset.of(rng.normal(size=(24, k)).astype(np.float32))
+        return s, ls
+
+    def test_total_d_restores_true_width_pricing(self):
+        # At the TRUE width (600k) the (d, d) Gramian is ~TBs: only the
+        # gather engine fits. The undershot measured width (16k) would
+        # wrongly admit the gram engine.
+        from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+
+        est = LeastSquaresEstimator(lam=0.1, hbm_bytes=8 << 30)
+        s, ls = self._undershooting_sample(50_000_000, 600_000, 16_384, 2)
+
+        # The failing shape, undodged: WITHOUT the threaded width the
+        # sample alone mis-prices, selecting the engine whose Gramian
+        # cannot exist at the true width.
+        chosen_blind = est.optimize(s, ls)
+        inner_blind = chosen_blind.estimator
+        assert inner_blind.solver == "gram"
+
+        s.total_d = 600_000  # what the collector attaches
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain)
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2) and inner.solver == "gather"
+
+    def test_collector_measures_full_width_for_sparse_source(self):
+        # The true-width row sits BEYOND the sampled prefix: the collector
+        # must measure total_d over the FULL index array.
+        from keystone_tpu.workflow.graph import Graph
+        from keystone_tpu.workflow.operators import DatasetOperator
+        from keystone_tpu.workflow.rules import _collect_samples
+
+        rng = np.random.default_rng(3)
+        n, d_true, nnz, k = 64, 4096, 4, 2
+        idx = rng.integers(0, 32, size=(n, nnz)).astype(np.int32)
+        idx[-1, 0] = d_true - 1  # top id only in the last row
+        ds = Dataset(
+            {"indices": jnp.asarray(idx),
+             "values": jnp.asarray(
+                 rng.normal(size=(n, nnz)).astype(np.float32)
+             )},
+            n=n,
+        )
+        labels = Dataset.of(rng.normal(size=(n, k)).astype(np.float32))
+        est = LeastSquaresEstimator(lam=0.1)
+        g = Graph()
+        g, dnode = g.add_node(DatasetOperator(ds), [])
+        g, lnode = g.add_node(DatasetOperator(labels), [])
+        g, enode = g.add_node(est, [dnode, lnode])
+        g, _ = g.add_sink(enode)
+        samples = _collect_samples(g, [enode], samples_per_shard=3)
+        sample = samples[enode][0]
+        assert sample.n < n  # genuinely subsampled
+        assert int(np.asarray(sample.data["indices"]).max()) + 1 < d_true
+        assert getattr(sample, "total_d", None) == d_true
+
+    def test_vectorizer_declares_output_width(self):
+        from keystone_tpu.ops.sparse import SparseFeatureVectorizer
+
+        vec = SparseFeatureVectorizer({"a": 0, "b": 7, "c": 3})
+        assert vec.sparse_output_dim == 8
+
+    def test_width_threads_through_delegating_apply(self):
+        # The fit-then-apply route: the vectorizer rides in the
+        # DelegatingOperator's dep values as a fitted transformer, not as
+        # the node's own operator — the declared width must still thread.
+        from keystone_tpu.ops.sparse import SparseFeatureVectorizer
+        from keystone_tpu.workflow.operators import DelegatingOperator
+        from keystone_tpu.workflow.rules import _attach_sparse_width
+
+        vec = SparseFeatureVectorizer({"a": 0, "b": 4095})
+        out = Dataset(
+            {"indices": jnp.asarray(np.zeros((4, 2), np.int32)),
+             "values": jnp.asarray(np.ones((4, 2), np.float32))},
+            n=4,
+        )
+        _attach_sparse_width(
+            DelegatingOperator(), out, [vec, Dataset.of(["a b", "b"])]
+        )
+        assert out.total_d == 4096
+
+
+class TestUnsetRawBytesDenseDefault:
+    def test_dense_default_is_full_row_width(self):
+        # raw_row_bytes unset + dense input: resident raw rows are the
+        # full 4d f32 row — the old min(d, 512) cap underestimated a
+        # d=8192 dense operand 16x, admitting the streaming tier when the
+        # raw operand alone exceeds HBM.
+        n, d, k = 1_000_000, 8192, 4
+        choice = StreamingLeastSquaresChoice(num_iter=2, lam=1e-2)
+        rb_dense = choice.resident_bytes(n, d, k, 1.0, 1)
+        assert rb_dense >= 4.0 * n * d  # raw operand priced at full width
+        choice.input_is_sparse = True
+        rb_sparse = choice.resident_bytes(n, d, k, 0.01, 1)
+        assert rb_sparse < rb_dense  # COO rows keep the bounded default
+
+
 class TestStreamedFitFusion:
     def test_pipeline_over_hbm_fuses_and_matches_explicit_bank(self):
         """optimize() picks streaming with no flag; the optimizer binds the

@@ -134,3 +134,39 @@ class TestBatchScoring:
             model.score(g)
         t_dict = (time.perf_counter() - t0) * (len(queries) / 1000)
         assert t_vec < t_dict / 3, (t_vec, t_dict)
+
+
+class TestShardValidation:
+    """Default construction runs the cheap sampled-key probe (O(shards² ×
+    probes), O(1) memory; probabilistic), not a full set union; the
+    partitioner's own path skips it — shards disjoint by construction."""
+
+    def _shards(self, num=3):
+        model, unigrams, pairs = _fit(_int_corpus(seed=9))
+        parts = partition_ngram_pairs(pairs, num)
+        est = StupidBackoffEstimator(unigrams)
+        return [est.fit(Dataset.of(p)) for p in parts]
+
+    def test_probe_catches_duplicated_shard(self):
+        shards = self._shards()
+        with pytest.raises(ValueError, match="overlap"):
+            ShardedStupidBackoffModel([shards[0], shards[0]])
+
+    def test_full_validation_still_available(self):
+        shards = self._shards()
+        with pytest.raises(ValueError, match="overlap"):
+            ShardedStupidBackoffModel(
+                [shards[0], shards[0]], validate="full"
+            )
+        ShardedStupidBackoffModel(shards, validate="full")  # disjoint: ok
+
+    def test_from_partitioned_skips_validation(self):
+        shards = self._shards()
+        # Even a (mis)use with overlapping shards constructs — the
+        # partitioner path vouches for disjointness by construction.
+        ShardedStupidBackoffModel.from_partitioned(shards)
+        ShardedStupidBackoffModel.from_partitioned([shards[0], shards[0]])
+
+    def test_default_probe_passes_disjoint_shards(self):
+        sharded = ShardedStupidBackoffModel(self._shards())
+        assert len(sharded.shards) == 3
