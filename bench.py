@@ -19,7 +19,11 @@ Additional metrics ride in detail.additional_metrics:
     resident, through BOTH sparse engines (gather data passes vs the
     fold-G-once gram engine).
   - amazon_fulln_streamed_gram: the REAL n=65e6 Amazon row, streamed
-    (chunks never all resident), vs the literal 52.29 s — no n-scaling.
+    (chunks never all resident), vs the literal 52.29 s — no n-scaling;
+    min-of-N warm (compile reported separately) like the headline.
+  - outofcore_prefetch: fit at the TIMIT geometry FROM DISK SHARDS
+    through the double-buffered prefetcher (data/prefetch.py), prefetch-on
+    vs serial read-then-fold, with the achieved overlap fraction.
   - krr_cifar_kernel_geometry: RandomPatchCifarKernel's KRR solver shape
     through the bf16x3 AND f32 kernel engines (no reference timing
     exists; absolute + MFU + cross-engine quality delta).
@@ -37,7 +41,9 @@ overhead (HTTP round trip; a real TPU host dispatches in <1 ms), so each
 metric reports BOTH the single-dispatch wall-clock (value / wallclock_s —
 conservative, used for vs_baseline) and the marginal device time from
 in-program repetition ((t_reps3 - t_reps1) / 2 — what the hardware actually
-spends; used for achieved TFLOP/s + MFU).
+spends; used for achieved TFLOP/s + MFU). Every row declares its
+convention machine-readably in ``detail.timing`` (one of VALID_TIMING,
+enforced by make_row and tests/test_bench_conventions.py).
 
 Env knobs: BENCH_N (headline rows, default the REAL 2.2e6),
 BENCH_AMAZON_N (default the REAL 65e6), BENCH_SCALE (resident-row
@@ -77,6 +83,55 @@ NUM_EPOCHS = int(os.environ.get("BENCH_EPOCHS", "3"))
 # dominant GEMMs use.
 PEAK_TFLOPS_BF16 = 197.0
 PEAK_TFLOPS_F32 = 49.0
+# v5e per-chip HBM bandwidth, for roofline attribution of memory-bound
+# phases (the FFT featurize stage).
+PEAK_HBM_GBPS = 819.0
+
+# Timing conventions a row may declare. EVERY emitted row carries
+# ``detail.timing`` as one of these (enforced by make_row + the fast test
+# tests/test_bench_conventions.py), so conventions can't silently diverge
+# across rows again (VERDICT r5 Weak #1):
+#   min_of_N_warm   — compile/warm pass first, min over N timed runs
+#   single_run_cold — one measured run INCLUDING compile (capacity rows
+#                     whose second run would double the bench's cost)
+#   single_run_warm — compile/warm pass first, ONE timed run
+#   host_only       — no device dispatch in the timed region
+VALID_TIMING = frozenset(
+    {"min_of_N_warm", "single_run_cold", "single_run_warm", "host_only"}
+)
+
+
+def make_row(metric, value, unit, vs_baseline, timing, detail):
+    """The ONLY way a bench row is built: the timing convention is a
+    required, validated field riding in detail."""
+    if timing not in VALID_TIMING:
+        raise ValueError(
+            f"row {metric!r}: timing {timing!r} not in {sorted(VALID_TIMING)}"
+        )
+    detail = dict(detail)
+    detail["timing"] = timing
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+        "detail": detail,
+    }
+
+
+def min_wall(fn, reps: int = 3):
+    """Min-of-N warm wall-clock: ``fn`` once untimed (compile + warm),
+    then the min over ``reps`` timed runs. Returns (min_wall_s, last
+    result, cold_wall_s) — cold includes the compile."""
+    t0 = time.perf_counter()
+    result = fn()
+    cold = time.perf_counter() - t0
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = fn()
+        best = min(best, time.perf_counter() - t0)
+    return best, result, cold
 
 
 def _sync_scalar(x) -> float:
@@ -261,12 +316,13 @@ def timit_streaming_metric():
     peak = PEAK_TFLOPS_BF16 if bf16 else PEAK_TFLOPS_F32
 
     baseline_s = BASELINE_MS / 1000.0
-    return {
-        "metric": "timit_full_n_streaming_d16384_wallclock",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(baseline_s / elapsed, 2),
-        "detail": {
+    return make_row(
+        "timit_full_n_streaming_d16384_wallclock",
+        round(elapsed, 3),
+        "s",
+        round(baseline_s / elapsed, 2),
+        "min_of_N_warm",
+        {
             "n": n,
             "d": d,
             "k": k,
@@ -279,7 +335,7 @@ def timit_streaming_metric():
                 "compiled scan; the feature matrix (72 GB bf16 at this "
                 "geometry) is never materialized (parallel/streaming.py)"
             ),
-            "timing": "wallclock = min of 3 timed single-dispatch runs",
+            "timing_note": "wallclock = min of 3 timed single-dispatch runs",
             "device_time_s": round(device_s, 3),
             "dispatch_overhead_s": round(dispatch_s, 3),
             "flop_model_executed_tflops": round(executed / 1e12, 2),
@@ -314,7 +370,7 @@ def timit_streaming_metric():
             "baseline_s": round(baseline_s, 3),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def timit_metric():
@@ -476,19 +532,20 @@ def timit_metric():
     )
     speedup = baseline_scaled_s / elapsed
 
-    return {
-        "metric": "timit_resident_262k",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(speedup, 2),
-        "detail": {
+    return make_row(
+        "timit_resident_262k",
+        round(elapsed, 3),
+        "s",
+        round(speedup, 2),
+        "min_of_N_warm",
+        {
             "n": n,
             "d": NUM_FEATURES,
             "k": TIMIT_NUM_CLASSES,
             "block_size": BLOCK_SIZE,
             "epochs": NUM_EPOCHS,
             "precision": "bf16" if bf16 else "f32",
-            "timing": (
+            "timing_note": (
                 "wallclock = min of 3 timed runs (steady state; the dev "
                 "tunnel adds ~±13% run jitter a production host lacks; "
                 "rounds 1-2 recorded a single run)"
@@ -519,7 +576,7 @@ def timit_metric():
             "vs_baseline_if_1_epoch": round(speedup * 3.0, 2),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def amazon_sparse_metric():
@@ -549,17 +606,20 @@ def amazon_sparse_metric():
     idx.sort(axis=1)
     vals = rng.normal(size=(n, nnz)).astype(np.float32)
     labels = rng.integers(0, k, size=n)
-    Y = (2.0 * np.eye(k)[labels] - 1.0).astype(np.float32)
+    from keystone_tpu.data import one_hot_pm1
+
+    Y = one_hot_pm1(labels, k)
     ds = Dataset({"indices": jnp.asarray(idx), "values": jnp.asarray(vals)}, n=n)
     Yd = Dataset.of(jnp.asarray(Y))
 
     def timed_fit(est):
-        model = est.fit(ds, Yd)  # warm (compile)
-        _sync_scalar(jnp.sum(jnp.abs(model.x)))
-        t0 = time.perf_counter()
-        model = est.fit(ds, Yd)
-        _sync_scalar(jnp.sum(jnp.abs(model.x)))
-        return model, time.perf_counter() - t0
+        def run():
+            model = est.fit(ds, Yd)
+            _sync_scalar(jnp.sum(jnp.abs(model.x)))
+            return model
+
+        elapsed, model, _ = min_wall(run, reps=2)
+        return model, elapsed
 
     model, elapsed = timed_fit(
         SparseLBFGSwithL2(lam=1e-3, num_iterations=iters, num_features=d)
@@ -579,13 +639,15 @@ def amazon_sparse_metric():
     gathers_per_s = iters * 2 * nnz_total / elapsed
     baseline_scaled_s = 52.290 * (n / 65e6)  # csv:13, n-scaled, same iters
     best = min(elapsed, elapsed_gram)
-    return {
-        "metric": "amazon_sparse_lbfgs_d16384",
-        "value": round(best, 3),
-        "unit": "s",
-        "vs_baseline": round(baseline_scaled_s / best, 4),
-        "detail": {
+    return make_row(
+        "amazon_sparse_lbfgs_d16384",
+        round(best, 3),
+        "s",
+        round(baseline_scaled_s / best, 4),
+        "min_of_N_warm",
+        {
             "n": n, "d": d, "nnz_per_row": nnz, "k": k, "iters": iters,
+            "timing_note": "each engine: warm fit, then min of 2 timed fits",
             "gather_engine_s": round(elapsed, 3),
             "gram_engine_s": round(elapsed_gram, 3),
             "engines_max_abs_model_delta": round(engine_err, 6),
@@ -605,7 +667,7 @@ def amazon_sparse_metric():
             "baseline_scaled_s": round(baseline_scaled_s, 3),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 
@@ -719,13 +781,16 @@ def amazon_fulln_metric():
         )
         return float(loss)
 
-    # ONE measured run — at ~9 min of device time for the full fold, a
-    # separate warm pass would double the bench's cost to shave the ~1 min
-    # one-time compile out of a row that is about capacity, not speed.
-    t0 = time.perf_counter()
-    loss = run_once()
-    elapsed = time.perf_counter() - t0
+    # Min-of-N warm, the TIMIT headline convention (VERDICT r5 Weak #1 —
+    # the old single cold run folded ~1 min of compile into a ~9 min wall,
+    # leaving the two headline rows on different conventions). The cold
+    # run is timed too: compile cost is REPORTED as its own field instead
+    # of vanishing or polluting the wall. BENCH_AMAZON_REPS trims the warm
+    # count for smoke runs (each warm rep is the full fold).
+    reps = max(int(os.environ.get("BENCH_AMAZON_REPS", "2")), 1)
+    elapsed, loss, cold_wall_s = min_wall(run_once, reps=reps)
     assert np.isfinite(loss), f"bad streamed sparse solve: {loss}"
+    compile_s_est = max(cold_wall_s - elapsed, 0.0)
 
     # Resident-capacity probe: allocate the compressed COO at n=30e6
     # (9.8 GB) and fold two chunks IN PLACE. n=36e6 (11.8 GB) compiles
@@ -771,23 +836,28 @@ def amazon_fulln_metric():
 
     flop_syrk = 1.0 * n_full * (d + 1024) ** 2  # executed MACs x2, padded d
     baseline_s = 52.290
-    return {
-        "metric": "amazon_fulln_streamed_gram",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": round(baseline_s / elapsed, 4),
-        "detail": {
+    return make_row(
+        "amazon_fulln_streamed_gram",
+        round(elapsed, 3),
+        "s",
+        round(baseline_s / elapsed, 4),
+        "min_of_N_warm",
+        {
             "n": n_full, "d": d, "nnz_per_row": nnz, "k": k, "iters": iters,
             "streamed": (
                 "chunks regenerated device-side per scan step (the I/O "
                 "stand-in; all bench rows exclude input I/O); working set "
                 "~2.3 GB regardless of n; 128-chunk dispatch segments"
             ),
-            "timing": (
-                "single measured run incl. the one-time compile (~1 min "
-                "of ~9); a warm+timed pair would double a row whose claim "
-                "is capacity, not speed"
+            "timing_note": (
+                f"cold run timed (compile included, reported separately), "
+                f"then min of {reps} warm full folds — the TIMIT headline "
+                f"convention; BENCH_AMAZON_REPS trims warm reps for smoke "
+                f"runs"
             ),
+            "cold_wall_s": round(cold_wall_s, 3),
+            "compile_s_est": round(compile_s_est, 3),
+            "warm_reps": reps,
             "engine": (
                 "densify-chunk + accumulating MXU syrk -> G, then 20 "
                 "L-BFGS iterations on G (same iterates as per-pass LBFGS; "
@@ -843,7 +913,7 @@ def amazon_fulln_metric():
             },
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def krr_metric():
@@ -877,12 +947,14 @@ def krr_metric():
             GaussianKernelGenerator(gamma=gamma, kernel_dtype=kdtype),
             lam=lam, block_size=bs, num_epochs=epochs,
         )
-        m = krr.fit(ds, ys)  # warm (compile + one-time program load)
-        _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
-        t0 = time.perf_counter()
-        m = krr.fit(ds, ys)
-        _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
-        return m, time.perf_counter() - t0
+
+        def run():
+            m = krr.fit(ds, ys)
+            _sync_scalar(jnp.sum(jnp.abs(m.w_locals[0])))
+            return m
+
+        elapsed, m, _ = min_wall(run, reps=2)
+        return m, elapsed
 
     m32, elapsed_f32 = timed_fit("f32")
     m3, elapsed = timed_fit("bf16x3")
@@ -923,6 +995,58 @@ def krr_metric():
     device_s, _, dispatch_s = marginal_device_time(make_repeated_for("bf16x3"))
     device_s_f32, _, _ = marginal_device_time(make_repeated_for("f32"))
 
+    # Phase decomposition (VERDICT r5 Weak #2): attribute the fused
+    # sweep's device time to its constituent phases so the 62%-vs-78%
+    # MFU gap against the BCD headline is EXPLAINED, not just reported.
+    # Phases re-run the same in-loop code paths on the same shapes:
+    #   kernel_gen — the column-block GEMM + exp (gram build; the exp
+    #     runs on the VPU, so its time is invisible to a GEMM-only MFU),
+    #   chol_solve — the per-block-step (K_bb + λI) Cholesky factor +
+    #     triangular solves (_krr_fit_fused re-factors every step; the
+    #     λI regularizer add rides inside, orders below measurement),
+    #   residual_update — the remainder (K_blockᵀW GEMM + updates).
+    from keystone_tpu.ops.learning.kernel import _column_block
+    from keystone_tpu.parallel.linalg import _solve_psd
+
+    x_norms_ph = jnp.sum(X * X, axis=1)
+
+    def make_kernel_only(reps):
+        @jax.jit
+        def run(X, x_norms):
+            def body(i, acc):
+                def step(carry, block):
+                    K = _column_block(
+                        X + 0.0 * acc, x_norms, block * bs, bs, gamma,
+                        use_pallas, "bf16x3",
+                    )
+                    return carry + jnp.sum(K[0]), None
+                out, _ = jax.lax.scan(step, 0.0, order)
+                return acc + out
+            return jax.lax.fori_loop(0, reps, body, 0.0)
+        return lambda: run(X, x_norms_ph)
+
+    rng_ph = np.random.default_rng(9)
+    A_ph = jnp.asarray(rng_ph.normal(size=(bs, bs)).astype(np.float32))
+    gram_ph = A_ph @ A_ph.T + bs * jnp.eye(bs)
+    rhs_ph = jnp.asarray(rng_ph.normal(size=(bs, k)).astype(np.float32))
+
+    def make_solve_only(reps):
+        steps = epochs * nb
+
+        @jax.jit
+        def run(gram, rhs):
+            def body(i, acc):
+                w = _solve_psd(
+                    gram + 0.0 * acc, rhs, jnp.asarray(lam, jnp.float32)
+                )
+                return acc + jnp.sum(w)
+            return jax.lax.fori_loop(0, reps * steps, body, 0.0)
+        return lambda: run(gram_ph, rhs_ph)
+
+    kernel_gen_s, _, _ = marginal_device_time(make_kernel_only)
+    chol_solve_s, _, _ = marginal_device_time(make_solve_only)
+    residual_update_s = max(device_s - kernel_gen_s - chol_solve_s, 0.0)
+
     # FLOP model per block: kernel column block 2·n·bs·d (the diag block is
     # a slice of it, not a second GEMM), residual K_blockᵀW 2·n·bs·k +
     # K_bbᵀw_old 2·bs²·k, Cholesky bs³/3, triangular+check solves ~6·bs²·k.
@@ -933,14 +1057,33 @@ def krr_metric():
     # bf16x3 runs the dominant GEMM as 3 bf16 passes: the algorithmic-f32
     # ceiling is peak_bf16/3.
     peak_x3 = PEAK_TFLOPS_BF16 / 3.0
-    return {
-        "metric": "krr_cifar_kernel_geometry",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": None,
-        "detail": {
+    return make_row(
+        "krr_cifar_kernel_geometry",
+        round(elapsed, 3),
+        "s",
+        None,
+        "min_of_N_warm",
+        {
             "n": n, "d": d, "k": k, "block_size": bs, "epochs": epochs,
+            "timing_note": "each engine: warm fit, then min of 2 timed fits",
             "device_time_s": round(device_s, 3),
+            "phases": {
+                "kernel_gen_s": round(kernel_gen_s, 3),
+                "chol_solve_s": round(chol_solve_s, 3),
+                "residual_update_s": round(residual_update_s, 3),
+                "note": (
+                    "gram build / solve / regularizer attribution of the "
+                    "fused sweep's marginal device time: kernel_gen is "
+                    "the column-block GEMM + VPU exp (exp time counts in "
+                    "the wall but contributes zero GEMM FLOPs — the "
+                    "structural piece of the MFU gap vs the BCD "
+                    "headline); chol_solve is the per-step (K_bb + "
+                    "lam*I) factor + triangular solves, re-run every "
+                    "block step (the lam*I add rides inside, orders "
+                    "below measurement); residual_update is the "
+                    "remainder (K_block^T W GEMM + model updates)"
+                ),
+            },
             "device_time_s_f32_engine": round(device_s_f32, 3),
             "wallclock_f32_engine_s": round(elapsed_f32, 3),
             "dispatch_overhead_s": round(dispatch_s, 3),
@@ -964,7 +1107,7 @@ def krr_metric():
             ),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def mnist_fft_metric():
@@ -1005,10 +1148,7 @@ def mnist_fft_metric():
         out = pipe.apply(data).get()
         return _sync_scalar(jnp.sum(jnp.abs(jnp.asarray(out.array))))
 
-    fit_once()  # warm (compile)
-    t0 = time.perf_counter()
-    fit_once()
-    elapsed = time.perf_counter() - t0
+    elapsed, _, _ = min_wall(fit_once, reps=2)
 
     # Phase attribution (VERDICT r3 Weak #3): time the featurize program
     # and the solver separately on the same shapes, so the end-to-end MFU
@@ -1052,13 +1192,33 @@ def mnist_fft_metric():
         + nb * bs**3 / 3.0
     )
     achieved = flops / 1e12 / elapsed
-    return {
-        "metric": "mnist_random_fft_end_to_end",
-        "value": round(elapsed, 3),
-        "unit": "s",
-        "vs_baseline": None,
-        "detail": {
+
+    # Roofline arithmetic for the featurize phase (VERDICT r5 Weak #3):
+    # "FFT is HBM-bound" stated as BOUNDED numbers, not an assertion.
+    # Traffic floor: X read once + the concat output written once —
+    # no fused program can move less. Traffic model: per-branch X read,
+    # per-branch complex intermediate written+read around the FFT
+    # (n×1024 c64), output written once.
+    fft_flops = num_ffts * 5.0 * n * p * np.log2(p)
+    bytes_floor = n * d_in * 4.0 + n * d_feat * 4.0
+    bytes_model = (
+        num_ffts * n * d_in * 4.0          # per-branch input read
+        + 2.0 * num_ffts * n * p * 8.0     # c64 intermediate write + read
+        + n * d_feat * 4.0                 # rectified concat output write
+    )
+    feat_gbps_floor = bytes_floor / t_featurize / 1e9
+    feat_gbps_model = bytes_model / t_featurize / 1e9
+    feat_tflops = fft_flops / t_featurize / 1e12
+
+    return make_row(
+        "mnist_random_fft_end_to_end",
+        round(elapsed, 3),
+        "s",
+        None,
+        "min_of_N_warm",
+        {
             "n": n, "num_ffts": num_ffts, "block_size": bs,
+            "timing_note": "warm fit, then min of 2 timed end-to-end fits",
             "flop_model_tflops": round(flops / 1e12, 3),
             "achieved_tflops": round(achieved, 1),
             "mfu": round(achieved / PEAK_TFLOPS_F32, 3),
@@ -1068,12 +1228,33 @@ def mnist_fft_metric():
                 "executor_and_apply_s": round(executor_overhead, 3),
                 "note": (
                     "featurize = the ONE fused gather program (sign+FFT+"
-                    "rectify x4 branches + concat: FFT is low arithmetic "
-                    "intensity, so this phase runs HBM-bound, which is "
-                    "where the end-to-end MFU goes); solve = the fused "
+                    "rectify x4 branches + concat; see featurize_roofline "
+                    "for the HBM-bound claim, bounded); solve = the fused "
                     "BCD on materialized features; remainder = executor "
                     "dispatch + the fused apply pass"
                 ),
+                "featurize_roofline": {
+                    "traffic_floor_gb": round(bytes_floor / 1e9, 2),
+                    "traffic_model_gb": round(bytes_model / 1e9, 2),
+                    "achieved_gbps_floor": round(feat_gbps_floor, 1),
+                    "achieved_gbps_model": round(feat_gbps_model, 1),
+                    "peak_hbm_gbps": PEAK_HBM_GBPS,
+                    "hbm_fraction_model": round(
+                        feat_gbps_model / PEAK_HBM_GBPS, 3
+                    ),
+                    "fft_achieved_tflops": round(feat_tflops, 2),
+                    "fft_compute_fraction_f32_peak": round(
+                        feat_tflops / PEAK_TFLOPS_F32, 3
+                    ),
+                    "note": (
+                        "floor = X read once + output written once; "
+                        "model adds per-branch reads and the c64 FFT "
+                        "intermediate round trip. HBM-bound holds iff "
+                        "achieved GB/s sits near peak while the FFT's "
+                        "achieved TFLOP/s sits far below the f32 "
+                        "compute peak — both fractions reported"
+                    ),
+                },
             },
             "precision": "f32 end-to-end (pipeline default)",
             "peak_tflops": PEAK_TFLOPS_F32,
@@ -1084,7 +1265,7 @@ def mnist_fft_metric():
             ),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def _run_cache_sweeps(make_optimizer, make_chain, fit_sweep, num_warm=3):
@@ -1231,17 +1412,16 @@ def autocache_metric():
 
     greedy = results.get("greedy_postfusion", {}).get("wall_s")
     base = results.get("no_cache", {}).get("wall_s")
-    return {
-        "metric": "autocache_on_chip",
-        "value": greedy if greedy is not None else -1.0,
-        "unit": "s",
-        "vs_baseline": (
-            round(base / greedy, 2) if greedy and base else None
-        ),
-        "detail": {
+    return make_row(
+        "autocache_on_chip",
+        greedy if greedy is not None else -1.0,
+        "s",
+        round(base / greedy, 2) if greedy and base else None,
+        "min_of_N_warm",
+        {
             "n": n, "dims": [d_in, d_mid, d_out],
             "reuse": "3-fit lambda sweeps over one featurize chain",
-            "timing": (
+            "timing_note": (
                 "min of 3 warm 3-fit sweeps after one cold sweep; fresh "
                 "lambdas per sweep so every fit genuinely solves"
             ),
@@ -1269,7 +1449,7 @@ def autocache_metric():
             ),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def autocache_host_boundary_metric():
@@ -1332,18 +1512,17 @@ def autocache_host_boundary_metric():
 
     greedy = results.get("greedy_postfusion", {}).get("wall_s")
     base = results.get("no_cache", {}).get("wall_s")
-    return {
-        "metric": "autocache_host_boundary",
-        "value": greedy if greedy is not None else -1.0,
-        "unit": "s",
-        "vs_baseline": (
-            round(base / greedy, 2) if greedy and base else None
-        ),
-        "detail": {
+    return make_row(
+        "autocache_host_boundary",
+        greedy if greedy is not None else -1.0,
+        "s",
+        round(base / greedy, 2) if greedy and base else None,
+        "min_of_N_warm",
+        {
             "n": n, "dims": [d_in, d_mid],
             "host_stage_gb_per_pass": round(n * d_in * 4 * 2 / 1e9, 2),
             "reuse": "3-fit lambda sweeps over host decode + fused chain",
-            "timing": (
+            "timing_note": (
                 "min of 3 warm 3-fit sweeps after one cold sweep; fresh "
                 "lambdas per sweep"
             ),
@@ -1360,7 +1539,7 @@ def autocache_host_boundary_metric():
             ),
             "device": str(jax.devices()[0]),
         },
-    }
+    )
 
 
 def stupidbackoff_metric():
@@ -1421,12 +1600,13 @@ def stupidbackoff_metric():
     dict_rate = n_dict / t_dict
 
     assert np.isfinite(scores).all()
-    return {
-        "metric": "stupidbackoff_batch_scoring",
-        "value": round(vec_rate, 0),
-        "unit": "ngrams/s",
-        "vs_baseline": round(vec_rate / dict_rate, 1),
-        "detail": {
+    return make_row(
+        "stupidbackoff_batch_scoring",
+        round(vec_rate, 0),
+        "ngrams/s",
+        round(vec_rate / dict_rate, 1),
+        "host_only",
+        {
             "num_queries": len(packed),
             "table_ngrams": len(counts),
             "dict_loop_ngrams_per_s": round(dict_rate, 0),
@@ -1441,7 +1621,162 @@ def stupidbackoff_metric():
                 "wall-clock exists for scoring throughput"
             ),
         },
-    }
+    )
+
+
+def outofcore_prefetch_metric():
+    """Out-of-core ingestion at the TIMIT geometry (ISSUE 2 tentpole):
+    fit from DISK SHARDS — raw 440-dim rows in memory-mapped tile files,
+    never resident as one array — through the double-buffered prefetcher
+    (data/prefetch.py), A/B against the serial read-then-fold path.
+
+    prefetch-on: a background reader stages segment k+1's host buffers
+    (disk read + mmap copy) while segment k's H2D transfer and tile fold
+    run; prefetch-off loads each segment on the consumer thread before
+    dispatching its fold. Identical fold programs and order — the walls
+    differ only by the ingestion overlap, and results are bit-identical
+    (tests/test_prefetch.py).
+
+    The achieved overlap fraction = (wall_off − wall_on) / measured load
+    time: the share of disk→host latency the prefetcher hid behind
+    device compute. Page-cache-warm reads make the load side a host
+    memcpy + decode cost — the conservative case for this row, since
+    cold reads would only widen the hidden latency.
+
+    Env knobs: BENCH_OOC_N (rows, default 262144 ≈ 0.5 GB of shards;
+    the full 2.2e6 is ~3.9 GB of disk), BENCH_OOC_DIR (shard directory,
+    default a temp dir; pre-existing shards of the right geometry are
+    reused so repeat runs skip the spill).
+    """
+    import tempfile
+
+    from keystone_tpu.data import one_hot_pm1
+    from keystone_tpu.data.prefetch import PrefetchStats
+    from keystone_tpu.data.shards import DiskDenseShards
+    from keystone_tpu.ops.stats import CosineRandomFeatures
+    from keystone_tpu.ops.learning.streaming_ls import CosineBankFeaturize
+    from keystone_tpu.parallel import streaming
+
+    n = int(os.environ.get("BENCH_OOC_N", str(262_144)))
+    d_in, d_feat, k = TIMIT_INPUT_DIMS, NUM_FEATURES, TIMIT_NUM_CLASSES
+    tile_rows, tiles_per_segment = 8_192, 2
+    epochs = NUM_EPOCHS
+
+    num_blocks = d_feat // BLOCK_SIZE
+    rfs = [
+        CosineRandomFeatures(d_in, BLOCK_SIZE, gamma=0.05, seed=i)
+        for i in range(num_blocks)
+    ]
+    bank = CosineBankFeaturize(
+        jnp.stack([rf.W for rf in rfs]).reshape(d_feat, d_in),
+        jnp.stack([rf.b for rf in rfs]).reshape(d_feat),
+    )
+
+    # Spill (untimed): synthetic TIMIT-shaped rows written tile-by-tile —
+    # host residency during the spill is one tile block, matching the
+    # loaders' to_disk_shards path.
+    shard_dir = os.environ.get("BENCH_OOC_DIR") or os.path.join(
+        tempfile.gettempdir(), f"keystone_ooc_{n}"
+    )
+    meta = os.path.join(shard_dir, "dense_shards.json")
+    shards = None
+    if os.path.exists(meta):
+        existing = DiskDenseShards(shard_dir)
+        # Reuse ONLY on full geometry match — a stale tiles_per_segment
+        # or width would silently measure a different configuration than
+        # the row reports (or crash mid-fit on a shape mismatch).
+        if (
+            existing.n_true == n
+            and existing.tile_rows == tile_rows
+            and existing.tiles_per_segment == tiles_per_segment
+            and existing._x.shape[-1] == d_in
+            and existing._y.shape[-1] == k
+        ):
+            shards = existing
+    if shards is None:
+        from keystone_tpu.data.shards import DiskDenseShardWriter
+
+        writer = DiskDenseShardWriter(
+            shard_dir, n, d_in, k, tile_rows=tile_rows,
+            tiles_per_segment=tiles_per_segment,
+        )
+        rng = np.random.default_rng(0)
+        for lo in range(0, n, tile_rows):
+            m = min(tile_rows, n - lo)
+            Xb = rng.normal(size=(m, d_in)).astype(np.float32)
+            yb = rng.integers(0, k, size=m)
+            writer.append(
+                Xb, one_hot_pm1(yb, k)
+            )
+        shards = writer.close()
+    source = shards.as_source()
+    disk_gb = (
+        shards._x.dtype.itemsize * shards._x.size
+        + shards._y.dtype.itemsize * shards._y.size
+    ) / 1e9
+
+    # Fresh PrefetchStats per run; the dict keeps the LAST (warm) run's
+    # stats so the reported load/wait figures are per-run, not sums over
+    # min_wall's warm + timed passes.
+    last_stats = {}
+
+    def fit(depth):
+        stats = PrefetchStats()
+        W, fmean, ymean, loss = streaming.streaming_bcd_fit_segments(
+            source, bank=bank, d_feat=d_feat, block_size=BLOCK_SIZE,
+            lam=1e-4, num_iter=epochs, center=False,
+            prefetch_depth=depth, prefetch_stats=stats,
+        )
+        loss = float(loss)
+        assert np.isfinite(loss), f"bad out-of-core solve: loss={loss}"
+        last_stats[depth] = stats
+        return loss
+
+    wall_off, _, _ = min_wall(lambda: fit(0), reps=3)
+    wall_on, loss, _ = min_wall(lambda: fit(2), reps=3)
+    load_s = last_stats[0].load_s  # serial load time of one warm run
+    wait_s = last_stats[2].wait_s  # consumer queue-wait of one warm run
+    hidden_s = max(wall_off - wall_on, 0.0)
+    overlap_fraction = min(hidden_s / load_s, 1.0) if load_s > 0 else 0.0
+
+    return make_row(
+        "outofcore_prefetch",
+        round(wall_on, 3),
+        "s",
+        round(wall_off / wall_on, 2),
+        "min_of_N_warm",
+        {
+            "n": n, "d_in": d_in, "d_feat": d_feat, "k": k,
+            "tile_rows": tile_rows,
+            "tiles_per_segment": tiles_per_segment,
+            "num_segments": source.num_segments,
+            "epochs": epochs,
+            "disk_shards_gb": round(disk_gb, 2),
+            "prefetch_on_wall_s": round(wall_on, 3),
+            "prefetch_off_wall_s": round(wall_off, 3),
+            "segment_load_s_per_run": round(load_s, 3),
+            "consumer_wait_s_per_run": round(wait_s, 3),
+            "overlap_fraction": round(overlap_fraction, 3),
+            "overlap_note": (
+                "overlap_fraction = (off_wall - on_wall) / serial "
+                "segment-load time: the share of disk->host ingestion "
+                "latency hidden behind the device folds; page-cache-warm "
+                "reads are the conservative case (cold reads widen it)"
+            ),
+            "timing_note": (
+                "each leg: warm fit (compile), then min of 3 timed fits; "
+                "identical fold programs, bit-identical results "
+                "(tests/test_prefetch.py)"
+            ),
+            "vs_baseline_note": (
+                "vs_baseline = prefetch-off wall / prefetch-on wall "
+                "(serial read-then-fold is the baseline); > 1.0 means "
+                "the prefetcher hides ingestion latency"
+            ),
+            "final_loss": round(loss, 4),
+            "device": str(jax.devices()[0]),
+        },
+    )
 
 
 def main():
@@ -1452,6 +1787,7 @@ def main():
             timit_metric,  # the rounds-1..3 resident-feature geometry
             amazon_sparse_metric,
             amazon_fulln_metric,
+            outofcore_prefetch_metric,
             krr_metric,
             mnist_fft_metric,
             autocache_metric,

@@ -1,5 +1,34 @@
-"""Data plane: the Dataset abstraction and data loaders."""
+"""Data plane: the Dataset abstraction, data loaders, and the out-of-core
+shard/prefetch tier (disk-backed Datasets streamed through the solvers)."""
 
-from .dataset import Dataset, LabeledData
+from .dataset import Dataset, LabeledData, one_hot_pm1
+from .prefetch import (
+    COOShardSource,
+    DenseShardSource,
+    DenseShardView,
+    PairedDenseSource,
+    Prefetcher,
+    PrefetchStats,
+    ResidentDenseSource,
+    ShardSource,
+    iter_segments,
+)
+from .shards import DiskCOOShards, DiskDenseShards, DiskDenseShardWriter
 
-__all__ = ["Dataset", "LabeledData"]
+__all__ = [
+    "Dataset",
+    "LabeledData",
+    "one_hot_pm1",
+    "ShardSource",
+    "DenseShardSource",
+    "DenseShardView",
+    "PairedDenseSource",
+    "ResidentDenseSource",
+    "COOShardSource",
+    "Prefetcher",
+    "PrefetchStats",
+    "iter_segments",
+    "DiskCOOShards",
+    "DiskDenseShards",
+    "DiskDenseShardWriter",
+]

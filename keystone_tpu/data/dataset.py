@@ -12,6 +12,12 @@ The TPU-native analog is batch-major arrays:
     true example count.
   - **Host form**: a Python list of arbitrary objects (images before decode,
     token sequences) for stages that must run host-side.
+  - **Shard form**: ``data`` is a :class:`~keystone_tpu.data.prefetch.
+    ShardSource` — ordered disk/host segments delivered one at a time, for
+    datasets whose resident size exceeds the host-RAM budget. Streamed
+    solvers consume the source directly (prefetched, never resident);
+    anything else triggers ``materialize()``, which only small sources
+    should ever hit.
 
 Transformers consume and produce Datasets; solvers read ``.array`` +
 ``.n`` directly and run jit-compiled sharded computations on them.
@@ -26,6 +32,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from keystone_tpu.parallel import mesh as mesh_lib
+
+from .prefetch import ShardSource
 
 
 def _is_arraylike(x: Any) -> bool:
@@ -44,6 +52,8 @@ class Dataset:
         self.mesh = mesh
         if isinstance(data, list):
             self.n = len(data) if n is None else n
+        elif isinstance(data, ShardSource):
+            self.n = data.n_true if n is None else n
         else:
             leaves = jax.tree_util.tree_leaves(data)
             if not leaves:
@@ -80,6 +90,11 @@ class Dataset:
         items = [b.to_list() for b in branches]
         return Dataset([tuple(vals) for vals in zip(*items)])
 
+    @staticmethod
+    def from_shards(source: ShardSource, n: Optional[int] = None) -> "Dataset":
+        """A Dataset backed by an out-of-core :class:`ShardSource`."""
+        return Dataset(source, n=n)
+
     # -- properties ---------------------------------------------------------
 
     @property
@@ -87,8 +102,31 @@ class Dataset:
         return isinstance(self.data, list)
 
     @property
+    def is_shard_backed(self) -> bool:
+        return isinstance(self.data, ShardSource)
+
+    @property
+    def shard_source(self) -> ShardSource:
+        if not self.is_shard_backed:
+            raise ValueError("Dataset is not shard-backed")
+        return self.data
+
+    def materialize(self) -> "Dataset":
+        """Shard form -> array form (concatenates every segment; only
+        sources that fit host RAM should ever reach this — the streamed
+        solvers consume the source directly instead)."""
+        if not self.is_shard_backed:
+            return self
+        mat = self.data.materialize()
+        if isinstance(mat, tuple):
+            mat = mat[0]  # a paired (X, Y) source read as a data Dataset
+        return Dataset(np.asarray(mat), n=self.n, mesh=self.mesh)
+
+    @property
     def array(self):
         """The single underlying array (errors for host/tuple datasets)."""
+        if self.is_shard_backed:
+            return self.materialize().array
         if self.is_host:
             arr = np.stack([np.asarray(x) for x in self.data])
             return arr
@@ -101,6 +139,8 @@ class Dataset:
     def num_padded(self) -> int:
         if self.is_host:
             return len(self.data)
+        if self.is_shard_backed:
+            return self.n
         return int(jax.tree_util.tree_leaves(self.data)[0].shape[0])
 
     def __len__(self) -> int:
@@ -111,6 +151,8 @@ class Dataset:
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
         """Apply `fn` per example. Host form: Python map. Array form: vmap,
         falling back to a host loop if `fn` is not traceable."""
+        if self.is_shard_backed:
+            return self.materialize().map(fn)
         if self.is_host:
             out = [fn(x) for x in self.data]
             return Dataset.of(out)
@@ -123,6 +165,8 @@ class Dataset:
 
     def map_batch(self, fn: Callable[[Any], Any]) -> "Dataset":
         """Apply a whole-batch (vectorized) function to the array form."""
+        if self.is_shard_backed:
+            return self.materialize().map_batch(fn)
         out = fn(self.data)
         return Dataset(out, n=self.n, mesh=self.mesh)._rezero_padding()
 
@@ -142,6 +186,8 @@ class Dataset:
 
     def to_list(self) -> List[Any]:
         """Materialize as a host list of per-example values (padding dropped)."""
+        if self.is_shard_backed:
+            return self.materialize().to_list()
         if self.is_host:
             return list(self.data)
         if isinstance(self.data, tuple):
@@ -157,6 +203,8 @@ class Dataset:
 
     def shard(self, mesh=None, axis: str = mesh_lib.DATA_AXIS) -> "Dataset":
         """Pad to divisibility and shard the leading axis over the mesh."""
+        if self.is_shard_backed:
+            return self.materialize().shard(mesh, axis)
         if self.is_host:
             raise ValueError("Host datasets cannot be device-sharded; vectorize first")
         mesh = mesh or mesh_lib.default_mesh()
@@ -172,7 +220,7 @@ class Dataset:
     def cache(self) -> "Dataset":
         """Force materialization now (the Cacher analog). Device arrays are
         already materialized eagerly by JAX; this just blocks until ready."""
-        if not self.is_host:
+        if not self.is_host and not self.is_shard_backed:
             jax.block_until_ready(jax.tree_util.tree_leaves(self.data))
         return self
 
@@ -182,10 +230,27 @@ class Dataset:
         return (jnp.arange(npad) < self.n).astype(jnp.float32)
 
     def __repr__(self) -> str:
+        if self.is_shard_backed:
+            return (
+                f"Dataset(shards, n={self.n}, "
+                f"segments={self.data.num_segments})"
+            )
         if self.is_host:
             return f"Dataset(host, n={self.n})"
         shapes = jax.tree_util.tree_map(lambda x: tuple(x.shape), self.data)
         return f"Dataset(array, n={self.n}, shapes={shapes})"
+
+
+def one_hot_pm1(labels: np.ndarray, num_classes: int) -> np.ndarray:
+    """Integer class labels -> the ±1 one-hot regression targets every LS
+    pipeline here fits against (the host-side twin of
+    ``ClassLabelIndicatorsFromIntLabels``): one shared encoding for every
+    spill/bench site instead of hand-rolled copies."""
+    return (
+        2.0 * np.eye(num_classes, dtype=np.float32)[
+            np.asarray(labels, dtype=np.int64).reshape(-1)
+        ] - 1.0
+    )
 
 
 class LabeledData:
@@ -198,3 +263,30 @@ class LabeledData:
             raise ValueError(
                 f"data ({self.data.n}) and labels ({self.labels.n}) must align"
             )
+
+    def to_disk_shards(
+        self,
+        path: str,
+        shard_rows: int,
+        tiles_per_segment: int = 4,
+        num_classes: Optional[int] = None,
+    ) -> "LabeledData":
+        """Spill this (data, labels) pair to pre-tiled disk shards and
+        return a SHARD-BACKED LabeledData over the files — the loaders'
+        materialize-to-disk-instead-of-RAM path. Integer class labels
+        become ±1 one-hot regression targets when ``num_classes`` is
+        given (the convention every LS pipeline here uses); otherwise
+        labels are stored as-is, reshaped to (n, k)."""
+        from .shards import DiskDenseShards
+
+        X = np.asarray(self.data.array)[: self.data.n]
+        Y = np.asarray(self.labels.array)[: self.labels.n]
+        if num_classes is not None:
+            Y = one_hot_pm1(Y, num_classes)
+        elif Y.ndim == 1:
+            Y = Y[:, None]
+        shards = DiskDenseShards.write(
+            path, X, Y.astype(np.float32, copy=False),
+            tile_rows=int(shard_rows), tiles_per_segment=tiles_per_segment,
+        )
+        return shards.as_labeled_data()

@@ -94,6 +94,108 @@ def load_labeled_csv(path: str, label_offset: int = 0) -> LabeledData:
     return LabeledData(rows[:, 1:], labels)
 
 
+def csv_to_disk_shards(
+    path: str,
+    out_dir: str,
+    shard_rows: int,
+    tiles_per_segment: int = 4,
+    label_col: Optional[int] = 0,
+    label_offset: int = 0,
+    num_classes: Optional[int] = None,
+) -> LabeledData:
+    """The loaders' out-of-core spill path: CSV file(s) -> pre-tiled disk
+    shards, ONE FILE RESIDENT AT A TIME, returning a shard-backed
+    LabeledData (reference analog: CsvDataLoader's lazy ``textFile`` never
+    collects either — the dataset goes storage-to-storage).
+
+    ``path`` may be a directory (files parsed in sorted order, matching
+    ``csv_data_loader``); host residency is bounded by the largest single
+    file plus the shard memmap pages being filled. ``label_col`` selects
+    the label column (None: all columns are features and labels must come
+    from elsewhere — unsupported here); integer class labels become ±1
+    one-hot targets when ``num_classes`` is given, else a (n, 1) float
+    column. ``shard_rows`` need not divide the row count — the ragged
+    final shard is zero-padded and masked by ``n_true`` at fold time.
+    """
+    if label_col is None:
+        raise ValueError("csv_to_disk_shards needs a label column")
+    from .shards import DiskDenseShardWriter
+
+    if os.path.isdir(path):
+        files = sorted(
+            os.path.join(path, f)
+            for f in os.listdir(path)
+            if os.path.isfile(os.path.join(path, f)) and not f.startswith(".")
+        )
+        if not files:
+            raise ValueError(f"{path}: directory contains no files")
+    else:
+        files = [path]
+
+    # Capacity pass: a newline count upper-bounds the row count per file
+    # (blank lines overcount; the +1 covers a missing trailing newline).
+    # The writer tolerates overshoot — unwritten tail tiles stay sparse
+    # zero-fill and close() records only the rows actually appended.
+    # Counted in fixed-size chunks: this path exists for files too big
+    # to hold, so the counting pass must not become the residency peak.
+    capacity = 0
+    for p in files:
+        last = b""
+        with open(p, "rb") as f:
+            while True:
+                buf = f.read(16 << 20)
+                if not buf:
+                    break
+                capacity += buf.count(b"\n")
+                last = buf[-1:]
+        if last and last != b"\n":
+            capacity += 1
+    if capacity == 0:
+        raise ValueError(f"{path}: no data rows in any file")
+
+    writer = None
+    width = None
+    for p in files:
+        if os.path.getsize(p) == 0:
+            continue  # sc.textFile semantics: empty files contribute nothing
+        rows = _read_csv_matrix(p)
+        if rows.shape[0] == 0:
+            continue
+        if width is None:
+            width = rows.shape[1]
+        elif rows.shape[1] != width:
+            raise ValueError(
+                f"{path}: files disagree on column count "
+                f"{{{width}, {rows.shape[1]}}}"
+            )
+        feats = np.delete(rows, label_col, axis=1).astype(
+            np.float32, copy=False
+        )
+        if num_classes is not None:
+            from .dataset import one_hot_pm1
+
+            Y = one_hot_pm1(
+                rows[:, label_col].astype(np.int64) + label_offset,
+                num_classes,
+            )
+        else:
+            # Continuous targets: keep the float column exactly as read
+            # (label_offset still applies — it is additive either way).
+            Y = (rows[:, label_col] + label_offset).astype(
+                np.float32
+            )[:, None]
+        if writer is None:
+            writer = DiskDenseShardWriter(
+                out_dir, capacity, feats.shape[1], Y.shape[1],
+                tile_rows=int(shard_rows),
+                tiles_per_segment=tiles_per_segment,
+            )
+        writer.append(feats, Y)
+    if writer is None:
+        raise ValueError(f"{path}: no data rows in any file")
+    return writer.close().as_labeled_data()
+
+
 CIFAR_LABEL_SIZE = 1
 CIFAR_IMAGE_BYTES = 3072  # 32*32*3
 CIFAR_RECORD_BYTES = CIFAR_LABEL_SIZE + CIFAR_IMAGE_BYTES

@@ -1,0 +1,511 @@
+"""Out-of-core ingestion: ShardSource protocol + double-buffered prefetch.
+
+The reference streams from storage *by construction* (``CsvDataLoader``
+is a lazy ``textFile``, CsvDataLoader.scala:10-31; image archives decode
+per partition, ImageLoaderUtils.scala:21-94), so its fits are bounded by
+disk. This module makes the disk tier a first-class, *pipelined* data
+path here:
+
+  - :class:`ShardSource` — the protocol unifying in-RAM segment sources
+    and the memory-mapped :class:`~keystone_tpu.data.shards.DiskCOOShards`
+    / :class:`~keystone_tpu.data.shards.DiskDenseShards` files: ordered
+    segments of READY host buffers, delivered one at a time.
+  - :class:`Prefetcher` — a background reader thread that loads segment
+    k+1 (disk read + mmap-page copy into a contiguous host staging
+    buffer) while the consumer's ``jax.device_put`` + device fold for
+    segment k are in flight. Double-buffered with bounded depth and
+    backpressure: the reader owns its own queue (the graph executor is
+    documented non-thread-safe, so NOTHING JAX-side runs on the reader
+    thread — it hands finished numpy buffers across, and the consumer
+    thread does every device interaction).
+
+The producer/consumer overlap is the same discipline as tf.data-style
+input pipelines and the async-dispatch throttling the streamed folds
+already use device-side (``BoundedInflight``): with depth d, at most d
+segments of host staging memory exist at once, and the disk→host latency
+of segment k+1 hides behind the fold of segment k.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterator, Optional, Tuple
+
+import numpy as np
+
+
+class ShardSource:
+    """Ordered segments of ready host buffers feeding a streamed fold.
+
+    The contract every streamed consumer (``streaming_bcd_fit_segments``,
+    ``run_lbfgs_gram_streamed``, the shard-backed ``Dataset``) reads:
+
+      - ``num_segments``: how many segments exist,
+      - ``n_true``: the true (unpadded) example count across all segments,
+      - ``load(s)``: materialize ONLY segment ``s`` as host numpy buffers
+        (same shape for every s — ragged tails are padded by the source).
+
+    ``load`` must be safe to call from a background thread: it may touch
+    the filesystem and numpy, never JAX (the executor and the dispatch
+    queue are single-consumer).
+    """
+
+    num_segments: int
+    n_true: int
+
+    def load(self, s: int):
+        raise NotImplementedError
+
+    # -- capacity metadata (the cost model prices the disk tier on these) --
+
+    @property
+    def row_bytes(self) -> Optional[float]:
+        """Approximate host bytes per example row (None when unknown)."""
+        return None
+
+    @property
+    def segment_bytes(self) -> Optional[float]:
+        """Approximate host bytes one staged segment occupies."""
+        return None
+
+    def materialize(self) -> Any:
+        """Concatenate every segment into resident arrays (small sources
+        only — the escape hatch that keeps shard-backed Datasets usable
+        by resident solvers when they DO fit)."""
+        raise NotImplementedError
+
+
+class DenseShardSource(ShardSource):
+    """:class:`~keystone_tpu.data.shards.DiskDenseShards` as a ShardSource:
+    ``load(s) -> (X_seg (T, tile_rows, d_in), Y_seg (T, tile_rows, k),
+    valid_rows)`` — exactly the ``segment_source`` contract of
+    ``streaming_bcd_fit_segments``."""
+
+    def __init__(self, shards):
+        self.shards = shards
+
+    @property
+    def num_segments(self) -> int:
+        return self.shards.num_segments
+
+    @property
+    def n_true(self) -> int:
+        return self.shards.n_true
+
+    @property
+    def tile_rows(self) -> int:
+        return self.shards.tile_rows
+
+    @property
+    def d_in(self) -> int:
+        return int(self.shards._x.shape[-1])
+
+    @property
+    def k(self) -> int:
+        return int(self.shards._y.shape[-1])
+
+    @property
+    def row_bytes(self) -> Optional[float]:
+        return float(
+            self.d_in * self.shards._x.dtype.itemsize
+            + self.k * self.shards._y.dtype.itemsize
+        )
+
+    @property
+    def segment_bytes(self) -> Optional[float]:
+        rb = self.row_bytes
+        return rb * self.shards.tiles_per_segment * self.tile_rows
+
+    def load(self, s: int):
+        return self.shards.segment_source(s)
+
+    def materialize(self):
+        """(X (n_true, d_in), Y (n_true, k)) resident."""
+        xs, ys = [], []
+        for s in range(self.num_segments):
+            X_seg, Y_seg, _ = self.load(s)
+            xs.append(X_seg.reshape(-1, X_seg.shape[-1]))
+            ys.append(Y_seg.reshape(-1, Y_seg.shape[-1]))
+        X = np.concatenate(xs)[: self.n_true]
+        Y = np.concatenate(ys)[: self.n_true]
+        return X, Y
+
+
+class DenseShardView(ShardSource):
+    """One FIELD (rows or labels) of a :class:`DenseShardSource`, flattened
+    to per-row form — what a shard-backed ``Dataset`` wraps, so the typed
+    Pipeline API can carry (data, labels) as two Datasets that share one
+    set of disk files. ``load(s)`` returns the (seg_rows, width) slice of
+    the field; the paired (X, Y, valid) form the solvers fold lives on
+    ``.paired`` (the underlying :class:`DenseShardSource`)."""
+
+    def __init__(self, paired: DenseShardSource, field: str):
+        if field not in ("x", "y"):
+            raise ValueError(f"field must be 'x' or 'y', got {field!r}")
+        self.paired = paired
+        self.field = field
+
+    @property
+    def num_segments(self) -> int:
+        return self.paired.num_segments
+
+    @property
+    def n_true(self) -> int:
+        return self.paired.n_true
+
+    @property
+    def width(self) -> int:
+        return self.paired.d_in if self.field == "x" else self.paired.k
+
+    @property
+    def row_bytes(self) -> Optional[float]:
+        sh = self.paired.shards
+        arr = sh._x if self.field == "x" else sh._y
+        return float(self.width * arr.dtype.itemsize)
+
+    @property
+    def segment_bytes(self) -> Optional[float]:
+        sh = self.paired.shards
+        return self.row_bytes * sh.tiles_per_segment * sh.tile_rows
+
+    def load(self, s: int):
+        """Field-only segment read: the row view never pays the label
+        read and — the big one — the label view never pays the much
+        wider row read (the cost-model sampler loads label segments)."""
+        sh = self.paired.shards
+        seg, _ = (
+            sh.segment_source_x(s) if self.field == "x"
+            else sh.segment_source_y(s)
+        )
+        return seg.reshape(-1, seg.shape[-1])
+
+    def materialize(self):
+        segs = [self.load(s) for s in range(self.num_segments)]
+        return np.concatenate(segs)[: self.n_true]
+
+
+class ResidentDenseSource(ShardSource):
+    """In-RAM (X, Y) presented through the ShardSource protocol — the
+    resident end of the unification: the same fold/prefetch machinery runs
+    whether segments come from memory-mapped disk files or live arrays
+    (used by parity tests and the prefetch-off bench leg)."""
+
+    def __init__(self, X, Y, tile_rows: int, tiles_per_segment: int):
+        self.X = np.asarray(X)
+        self.Y = np.asarray(Y)
+        self.tile_rows = int(tile_rows)
+        self.tiles_per_segment = int(tiles_per_segment)
+        self.n_true = int(self.X.shape[0])
+        self.num_tiles = -(-self.n_true // self.tile_rows)
+
+    @property
+    def num_segments(self) -> int:
+        return -(-self.num_tiles // self.tiles_per_segment)
+
+    @property
+    def d_in(self) -> int:
+        return int(self.X.shape[-1])
+
+    @property
+    def k(self) -> int:
+        return int(self.Y.shape[-1])
+
+    @property
+    def row_bytes(self) -> Optional[float]:
+        return float(
+            self.X.shape[-1] * self.X.dtype.itemsize
+            + self.Y.shape[-1] * self.Y.dtype.itemsize
+        )
+
+    def load(self, s: int):
+        tps, tr = self.tiles_per_segment, self.tile_rows
+        lo_row = s * tps * tr
+        hi_row = min(lo_row + tps * tr, self.n_true)
+        m = hi_row - lo_row
+        X_seg = np.zeros((tps * tr, self.X.shape[-1]), self.X.dtype)
+        Y_seg = np.zeros((tps * tr, self.Y.shape[-1]), self.Y.dtype)
+        X_seg[:m] = self.X[lo_row:hi_row]
+        Y_seg[:m] = self.Y[lo_row:hi_row]
+        valid = max(m, 0)
+        return (
+            X_seg.reshape(tps, tr, -1),
+            Y_seg.reshape(tps, tr, -1),
+            valid,
+        )
+
+    def materialize(self):
+        return self.X, self.Y
+
+
+class PairedDenseSource(ShardSource):
+    """(X_seg, Y_seg, valid_rows) segments assembled from a shard-backed
+    data view plus labels that live EITHER in the same disk shards (the
+    common spill-path case — zero extra reads) or as a small resident
+    array sliced per segment (labels usually fit host RAM even when rows
+    don't)."""
+
+    def __init__(self, data_view: DenseShardView, labels=None):
+        if data_view.field != "x":
+            # A y-view as "data" would silently fit labels against labels.
+            raise ValueError(
+                "PairedDenseSource needs the rows ('x') view as data, "
+                f"got the {data_view.field!r} view"
+            )
+        self.paired = data_view.paired
+        if labels is None:
+            self._labels = None
+        else:
+            Y = np.asarray(labels)
+            if Y.ndim == 1:
+                Y = Y[:, None]
+            if Y.shape[0] != self.paired.n_true:
+                raise ValueError(
+                    f"labels rows {Y.shape[0]} != shard rows "
+                    f"{self.paired.n_true}"
+                )
+            self._labels = Y
+
+    @property
+    def num_segments(self) -> int:
+        return self.paired.num_segments
+
+    @property
+    def n_true(self) -> int:
+        return self.paired.n_true
+
+    @property
+    def tile_rows(self) -> int:
+        return self.paired.tile_rows
+
+    @property
+    def d_in(self) -> int:
+        return self.paired.d_in
+
+    @property
+    def k(self) -> int:
+        if self._labels is not None:
+            return int(self._labels.shape[-1])
+        return self.paired.k
+
+    def load(self, s: int):
+        if self._labels is None:
+            return self.paired.load(s)
+        # Resident labels: read ONLY the X tiles from disk (the shard
+        # labels would be discarded) and slice the label rows host-side.
+        sh = self.paired.shards
+        X_seg, valid = sh.segment_source_x(s)
+        tps, tr = sh.tiles_per_segment, sh.tile_rows
+        lo = s * tps * tr
+        hi = min(lo + tps * tr, self.n_true)
+        Yp = np.zeros((tps * tr, self._labels.shape[-1]),
+                      self._labels.dtype)
+        Yp[: hi - lo] = self._labels[lo:hi]
+        return X_seg, Yp.reshape(tps, tr, -1), valid
+
+
+class COOShardSource(ShardSource):
+    """:class:`~keystone_tpu.data.shards.DiskCOOShards` grouped into
+    fixed-width segments: ``load(s) -> (idx, val, y)`` for chunks
+    [s·cps, (s+1)·cps) — the per-segment operand contract of
+    ``run_lbfgs_gram_streamed(segment_source=...)``."""
+
+    def __init__(self, shards, chunks_per_segment: int):
+        self.shards = shards
+        self.chunks_per_segment = int(chunks_per_segment)
+
+    @property
+    def num_segments(self) -> int:
+        return -(-self.shards.num_chunks // self.chunks_per_segment)
+
+    @property
+    def n_true(self) -> int:
+        return self.shards.n_true
+
+    @property
+    def num_chunks(self) -> int:
+        return self.shards.num_chunks
+
+    @property
+    def d(self) -> int:
+        return self.shards.d
+
+    def load(self, s: int):
+        return self.shards.segment_source(
+            s * self.chunks_per_segment, self.chunks_per_segment
+        )
+
+
+class FunctionSource(ShardSource):
+    """Wrap a plain ``load_fn(s)`` (plus counts) as a ShardSource — lets
+    the prefetcher drive legacy callable segment sources unchanged."""
+
+    def __init__(self, load_fn: Callable[[int], Any], num_segments: int,
+                 n_true: int = 0):
+        self._fn = load_fn
+        self.num_segments = int(num_segments)
+        self.n_true = int(n_true)
+
+    def load(self, s: int):
+        return self._fn(s)
+
+
+def is_shard_source(obj: Any) -> bool:
+    return isinstance(obj, ShardSource)
+
+
+class PrefetchStats:
+    """Where the ingestion time went, for the bench's overlap accounting:
+    ``load_s`` sums time spent inside ``source.load`` (reader thread —
+    disk + staging copies), ``wait_s`` sums time the CONSUMER blocked
+    waiting on the queue (latency the prefetch failed to hide)."""
+
+    def __init__(self):
+        self.load_s = 0.0
+        self.wait_s = 0.0
+        self.segments = 0
+
+
+class _ReaderDone:
+    pass
+
+
+class Prefetcher:
+    """Double-buffered background segment reader with bounded depth.
+
+    Iterating yields ``(s, payload)`` in strict segment order. The reader
+    thread runs ``source.load`` only (numpy/disk — never JAX) and blocks
+    once ``depth`` loaded segments sit unconsumed (backpressure: host
+    staging memory is bounded by depth × segment size). Clean shutdown is
+    part of the contract: closing (or breaking out of / raising inside
+    the consuming loop, via the context manager or generator finalizer)
+    stops the reader before it loads further segments. Reader exceptions
+    re-raise in the consumer at the segment that failed.
+    """
+
+    def __init__(self, source: ShardSource, depth: int = 2,
+                 stats: Optional[PrefetchStats] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        self.source = source
+        self.depth = int(depth)
+        self.stats = stats if stats is not None else PrefetchStats()
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started = False
+
+    # -- reader side -------------------------------------------------------
+
+    def _reader(self):
+        try:
+            for s in range(self.source.num_segments):
+                if self._stop.is_set():
+                    return
+                t0 = time.perf_counter()
+                payload = self.source.load(s)
+                self.stats.load_s += time.perf_counter() - t0
+                self._put((s, payload))
+            self._put(_ReaderDone())
+        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
+            self._put(e)
+
+    def _put(self, item):
+        """Queue.put with shutdown polling — a plain blocking put would
+        deadlock the reader if the consumer died without draining."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self) -> Iterator[Tuple[int, Any]]:
+        # Single-use by contract: after close() the stop flag is set and a
+        # fresh reader would exit without ever queueing the done sentinel,
+        # hanging the consumer on get() — fail loud instead.
+        if self._started:
+            raise RuntimeError(
+                "Prefetcher is single-use; create a new one per pass"
+            )
+        self._started = True
+        self._thread = threading.Thread(
+            target=self._reader, name="keystone-prefetch", daemon=True
+        )
+        self._thread.start()
+        expected = 0
+        try:
+            while True:
+                t0 = time.perf_counter()
+                item = self._queue.get()
+                self.stats.wait_s += time.perf_counter() - t0
+                if isinstance(item, _ReaderDone):
+                    return
+                if isinstance(item, BaseException):
+                    raise item
+                s, payload = item
+                assert s == expected, (
+                    f"prefetch order violated: got segment {s}, "
+                    f"expected {expected}"
+                )
+                expected += 1
+                self.stats.segments += 1
+                yield s, payload
+        finally:
+            self.close()
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    def close(self) -> None:
+        """Stop the reader and join it. Idempotent; called automatically
+        when the consuming loop exits for ANY reason (completion, break,
+        or a consumer-side exception)."""
+        self._stop.set()
+        if self._thread is not None:
+            # Drain so a put blocked on a full queue observes the stop.
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+
+def iter_segments(
+    source,
+    num_segments: Optional[int] = None,
+    prefetch_depth: int = 2,
+    stats: Optional[PrefetchStats] = None,
+) -> Iterator[Tuple[int, Any]]:
+    """Uniform segment iteration for the streamed folds: ``source`` is a
+    :class:`ShardSource` or a plain ``load_fn(s)`` callable (then
+    ``num_segments`` is required). ``prefetch_depth >= 1`` runs the
+    double-buffered background reader; ``0`` loads serially on the
+    consumer thread (the prefetch-off A/B leg — identical order and
+    payloads by construction)."""
+    if not is_shard_source(source):
+        if num_segments is None:
+            raise ValueError("callable segment sources need num_segments")
+        source = FunctionSource(source, num_segments)
+    elif num_segments is not None and num_segments < source.num_segments:
+        # An explicit cap folds a PREFIX of the source (partial-fold
+        # callers); the wrapped loads stay thread-safe for prefetch.
+        source = FunctionSource(source.load, num_segments, source.n_true)
+    if prefetch_depth and source.num_segments > 1:
+        yield from Prefetcher(source, depth=prefetch_depth, stats=stats)
+        return
+    for s in range(source.num_segments):
+        t0 = time.perf_counter()
+        payload = source.load(s)
+        if stats is not None:
+            stats.load_s += time.perf_counter() - t0
+            stats.segments += 1
+        yield s, payload

@@ -160,6 +160,14 @@ class DiskCOOShards:
             isinstance(a, np.memmap) for a in (self._idx, self._val, self._y)
         )
 
+    def as_source(self, chunks_per_segment: int):
+        """This shard set as a prefetchable ShardSource of
+        ``chunks_per_segment``-chunk segments (the
+        ``run_lbfgs_gram_streamed`` operand contract)."""
+        from .prefetch import COOShardSource
+
+        return COOShardSource(self, chunks_per_segment)
+
 
 class DiskDenseShards:
     """Pre-tiled DENSE rows on disk, mmap-read per segment — the dense
@@ -174,6 +182,7 @@ class DiskDenseShards:
     _META = "dense_shards.json"
 
     def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
         with open(os.path.join(directory, self._META)) as f:
             meta = json.load(f)
         self.n_true = int(meta["n_true"])
@@ -230,25 +239,140 @@ class DiskDenseShards:
         """``streaming_bcd_fit_segments`` contract: materialize ONLY this
         segment's tiles (phantom tiles past the end are zero-padded and
         masked by valid_rows=0)."""
+        X_seg, valid_rows = self.segment_source_x(s)
+        Y_seg, _ = self.segment_source_y(s)
+        return X_seg, Y_seg, valid_rows
+
+    def _segment_field(self, arr, s: int):
         tps = self.tiles_per_segment
         lo, hi = s * tps, min((s + 1) * tps, self.num_tiles)
-        X_seg = np.asarray(self._x[lo:hi])
-        Y_seg = np.asarray(self._y[lo:hi])
+        seg = np.asarray(arr[lo:hi])
         pad = tps - (hi - lo)
         if pad:
-            X_seg = np.concatenate(
-                [X_seg, np.zeros((pad,) + X_seg.shape[1:], X_seg.dtype)]
-            )
-            Y_seg = np.concatenate(
-                [Y_seg, np.zeros((pad,) + Y_seg.shape[1:], Y_seg.dtype)]
+            seg = np.concatenate(
+                [seg, np.zeros((pad,) + seg.shape[1:], seg.dtype)]
             )
         valid_rows = max(
             min(self.n_true - lo * self.tile_rows, tps * self.tile_rows), 0
         )
-        return X_seg, Y_seg, valid_rows
+        return seg, valid_rows
+
+    def segment_source_x(self, s: int):
+        """(X_seg, valid_rows) only — pairings that bring their own
+        resident labels skip the on-disk label read entirely."""
+        return self._segment_field(self._x, s)
+
+    def segment_source_y(self, s: int):
+        """(Y_seg, valid_rows) only — label views (e.g. the cost-model
+        sample collector) skip the much wider row read."""
+        return self._segment_field(self._y, s)
 
     @property
     def is_memory_mapped(self) -> bool:
         return isinstance(self._x, np.memmap) and isinstance(
             self._y, np.memmap
         )
+
+    def as_source(self):
+        """This shard set as a prefetchable ShardSource delivering the
+        (X_seg, Y_seg, valid_rows) segments
+        ``streaming_bcd_fit_segments`` folds."""
+        from .prefetch import DenseShardSource
+
+        return DenseShardSource(self)
+
+    def as_labeled_data(self):
+        """(data, labels) shard-backed Datasets over these files — the
+        typed-pipeline entry point: both Datasets view ONE set of disk
+        shards, so ``Pipeline.fit`` can route the pair through the
+        capacity selector with no resident copy ever existing."""
+        from .dataset import Dataset, LabeledData
+        from .prefetch import DenseShardView
+
+        paired = self.as_source()
+        return LabeledData(
+            Dataset(DenseShardView(paired, "x")),
+            Dataset(DenseShardView(paired, "y")),
+        )
+
+
+class DiskDenseShardWriter:
+    """Incremental row-appending writer for :class:`DiskDenseShards`.
+
+    Loaders stream rows in (one CSV file / archive member batch at a
+    time) and the writer fills on-disk tiles in place — host residency is
+    the incoming block, never the dataset. ``capacity_rows`` may OVERSHOOT
+    the true count (e.g. a newline-count upper bound): unwritten tail
+    tiles stay sparse zero-fill on disk and the metadata written at
+    ``close`` records only the rows actually appended.
+    """
+
+    def __init__(
+        self,
+        directory: str,
+        capacity_rows: int,
+        d_in: int,
+        k: int,
+        tile_rows: int,
+        tiles_per_segment: int = 4,
+        x_dtype=np.float32,
+        y_dtype=np.float32,
+    ):
+        if capacity_rows <= 0:
+            raise ValueError("capacity_rows must be positive")
+        self.directory = directory
+        self.tile_rows = int(tile_rows)
+        self.tiles_per_segment = int(tiles_per_segment)
+        cap_tiles = -(-int(capacity_rows) // self.tile_rows)
+        os.makedirs(directory, exist_ok=True)
+        self._mm_x = np.lib.format.open_memmap(
+            os.path.join(directory, "x.npy"), mode="w+", dtype=x_dtype,
+            shape=(cap_tiles, self.tile_rows, int(d_in)),
+        )
+        self._mm_y = np.lib.format.open_memmap(
+            os.path.join(directory, "y.npy"), mode="w+", dtype=y_dtype,
+            shape=(cap_tiles, self.tile_rows, int(k)),
+        )
+        self._rows = 0
+        self._closed = False
+
+    def append(self, X_block: np.ndarray, Y_block: np.ndarray) -> None:
+        X_block = np.asarray(X_block)
+        Y_block = np.asarray(Y_block)
+        if Y_block.ndim == 1:
+            Y_block = Y_block[:, None]
+        m = X_block.shape[0]
+        if Y_block.shape[0] != m:
+            raise ValueError(
+                f"rows disagree: X {m} vs Y {Y_block.shape[0]}"
+            )
+        if self._rows + m > self._mm_x.shape[0] * self.tile_rows:
+            raise ValueError(
+                f"writer capacity {self._mm_x.shape[0] * self.tile_rows} "
+                f"rows exceeded at {self._rows + m}"
+            )
+        flat_x = self._mm_x.reshape(-1, self._mm_x.shape[-1])
+        flat_y = self._mm_y.reshape(-1, self._mm_y.shape[-1])
+        flat_x[self._rows : self._rows + m] = X_block
+        flat_y[self._rows : self._rows + m] = Y_block
+        self._rows += m
+
+    def close(self) -> "DiskDenseShards":
+        """Flush, write metadata for the rows actually appended, and
+        reopen read-only as :class:`DiskDenseShards`."""
+        if self._closed:
+            raise RuntimeError("writer already closed")
+        self._closed = True
+        if self._rows == 0:
+            raise ValueError("no rows were appended")
+        self._mm_x.flush(); self._mm_y.flush()
+        del self._mm_x, self._mm_y
+        num_tiles = -(-self._rows // self.tile_rows)
+        with open(os.path.join(self.directory, DiskDenseShards._META), "w") as f:
+            json.dump(
+                {"n_true": int(self._rows), "tile_rows": int(self.tile_rows),
+                 "num_tiles": int(num_tiles),
+                 "tiles_per_segment": int(self.tiles_per_segment)},
+                f,
+            )
+        return DiskDenseShards(self.directory)

@@ -36,6 +36,14 @@ DEFAULT_HBM_BYTES = 16 << 30
 # Fraction of device memory a solver's resident operands may claim: the
 # rest covers XLA scratch, fusion temporaries and transfer buffers.
 DEFAULT_HBM_UTILIZATION = 0.85
+# Fallback HOST-memory budget when the OS reports nothing. The host tier
+# sits between HBM and disk: candidates needing the dataset host-resident
+# are infeasible past it, and the shard-backed streaming (disk) tier —
+# which stages only prefetch-depth segments — becomes the only door.
+DEFAULT_HOST_BYTES = 64 << 30
+# Fraction of host RAM the dataset may claim (the rest covers the
+# process, staging buffers, page cache churn).
+DEFAULT_HOST_UTILIZATION = 0.8
 
 
 def device_memory_bytes() -> int:
@@ -49,6 +57,26 @@ def device_memory_bytes() -> int:
     except Exception:  # backends without memory stats
         pass
     return DEFAULT_HBM_BYTES
+
+
+def host_memory_bytes() -> int:
+    """Host-RAM budget for resident datasets: the
+    ``KEYSTONE_HOST_BUDGET_BYTES`` env override (the ops knob — and the
+    test hook forcing the disk tier), else the OS-reported physical
+    memory, else the conservative default."""
+    import os
+
+    env = os.environ.get("KEYSTONE_HOST_BUDGET_BYTES")
+    if env:
+        return int(float(env))
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page = os.sysconf("SC_PAGE_SIZE")
+        if pages > 0 and page > 0:
+            return int(pages * page)
+    except (ValueError, OSError, AttributeError):
+        pass
+    return DEFAULT_HOST_BYTES
 
 # TPU-measured weights from scripts/fit_cost_weights.py on a single v5e chip
 # (2026-07; grid up to n=131072, d=2048; median rel err ~0.6 — the measured
@@ -126,6 +154,14 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     memory weight, and which on a fixed-HBM chip must instead be a hard
     feasibility cut: past it, the streaming tier is the only candidate
     that can run at all.
+
+    The cut prices THREE tiers separately: HBM (per-candidate
+    resident_bytes vs the device budget), host RAM (the raw dataset +
+    labels vs ``host_budget_bytes`` — every candidate except the disk
+    tier needs the dataset host-resident to begin), and DISK (a
+    shard-backed input lets the streaming choice stage only
+    prefetch-depth segments, so datasets past the host budget route
+    through disk shards with no flag — docs/data.md).
     """
 
     def __init__(
@@ -138,6 +174,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         allow_approximate: bool = False,
         hbm_bytes: Optional[float] = None,
         hbm_utilization: float = DEFAULT_HBM_UTILIZATION,
+        host_budget_bytes: Optional[float] = None,
+        host_utilization: float = DEFAULT_HOST_UTILIZATION,
         block_size: int = 1000,
         block_iters: int = 3,
     ):
@@ -158,6 +196,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         self.network_weight = network_weight
         self.hbm_bytes = hbm_bytes
         self.hbm_utilization = hbm_utilization
+        self.host_budget_bytes = host_budget_bytes
+        self.host_utilization = host_utilization
 
         dense_lbfgs = DenseLBFGSwithL2(lam=lam, num_iterations=20)
         sparse_lbfgs = SparseLBFGSwithL2(lam=lam, num_iterations=20)
@@ -246,19 +286,46 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         # streaming tier keeps RAW rows resident, not features. The
         # density flag lets its capacity model default an UNSET raw width
         # honestly — a dense row is the full 4d bytes, not a capped guess.
-        self._streaming_choice.raw_row_bytes = getattr(
-            sample, "source_row_bytes", None
-        )
+        raw_row_bytes = getattr(sample, "source_row_bytes", None)
+        self._streaming_choice.raw_row_bytes = raw_row_bytes
         self._streaming_choice.input_is_sparse = is_sparse_dataset(sample)
+        # DISK tier: a shard-backed source streams raw rows from disk
+        # segments — the streaming choice's resident operand stops
+        # scaling with n, and host-RAM feasibility is priced per
+        # candidate below.
+        shard_backed = bool(getattr(sample, "shard_backed", False))
+        self._streaming_choice.data_is_shard_backed = shard_backed
+        self._streaming_choice.shard_segment_bytes = getattr(
+            sample, "shard_segment_bytes", None
+        )
+        import os as _os
+
         budget = (
             self.hbm_bytes if self.hbm_bytes is not None
             else device_memory_bytes()
         ) * self.hbm_utilization
+        # An EXPLICIT host budget (constructor knob or env flag) is the
+        # operator's chosen cap and is honored as-is; the utilization
+        # derate applies only to autodetected physical RAM, where the
+        # process/staging/page-cache headroom is unaccounted.
+        env_budget = _os.environ.get("KEYSTONE_HOST_BUDGET_BYTES")
+        if self.host_budget_bytes is not None:
+            host_budget = float(self.host_budget_bytes)
+        elif env_budget:
+            host_budget = float(env_budget)
+        else:
+            host_budget = host_memory_bytes() * self.host_utilization
         # The streaming tier's feature slab scales down with the budget so
         # its capacity model and its actual tile sizing agree; the budget
         # itself drives its gram-vs-block tier decision.
         self._streaming_choice.slab_bytes = int(min(2 << 30, budget // 4))
         self._streaming_choice.budget_bytes = budget
+
+        # What every NON-disk candidate needs host-side before any device
+        # placement: the raw dataset plus labels, resident once.
+        host_resident = (
+            n * (raw_row_bytes if raw_row_bytes else 4.0 * d) + 4.0 * n * k
+        )
 
         def resident(opt) -> float:
             rb = getattr(opt[0], "resident_bytes", None)
@@ -266,11 +333,20 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 return 0.0
             return rb(n, d, k, sparsity, machines)
 
+        def host_ok(opt) -> bool:
+            # The disk tier (shard-backed streaming choice) stages only
+            # prefetch-depth segments host-side; everything else needs
+            # the full dataset in host RAM to even begin.
+            if shard_backed and opt[0] is self._streaming_choice:
+                return True
+            return host_resident <= host_budget
+
         def total_cost(opt) -> float:
             # Infeasible candidates — resident operands past the device
-            # budget — cost infinity: they would OOM, whatever their model
+            # budget, or a dataset past the host-RAM budget with no disk
+            # path — cost infinity: they would OOM, whatever their model
             # time says.
-            if resident(opt) > budget:
+            if not host_ok(opt) or resident(opt) > budget:
                 return float("inf")
             return opt[0].cost(
                 n, d, k, sparsity, machines,

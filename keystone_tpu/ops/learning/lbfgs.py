@@ -361,6 +361,7 @@ def run_lbfgs_gram_streamed(
     max_chunks_per_dispatch: Optional[int] = None,
     segment_source=None,
     inflight: int = 2,
+    prefetch_depth: int = 2,
 ):
     """Streamed sparse ridge fit: fold G = AᵀA over COO chunks ONCE
     (``sparse.sparse_gram_stream`` — chunks may be regenerated/loaded per
@@ -382,12 +383,22 @@ def run_lbfgs_gram_streamed(
     operand); chunk ids past ``num_chunks`` in the final ragged segment
     contribute exactly zero.
 
-    ``segment_source(cid0, seg) -> (idx_t, val_t, Y_t)``: per-SEGMENT
-    operand loader (e.g. :class:`keystone_tpu.data.shards.DiskCOOShards`
-    slicing memory-mapped files) — the disk-bounded tier: neither device
-    HBM nor host RAM ever holds the dataset, only ``seg`` chunks at a
-    time. ``chunk_fn`` then receives SEGMENT-RELATIVE ids. Requires
-    ``max_chunks_per_dispatch``.
+    ``segment_source``: per-SEGMENT operand loader — the disk-bounded
+    tier: neither device HBM nor host RAM ever holds the dataset, only
+    ``seg`` chunks at a time. Accepts
+
+      - a :class:`keystone_tpu.data.shards.DiskCOOShards` or its
+        prefetchable ``as_source(chunks_per_segment)`` form: segment k+1
+        is read from disk on a background thread while segment k's
+        transfer + fold are in flight (``prefetch_depth`` bounds staged
+        host buffers; 0 reads serially — byte-identical results), or
+      - the legacy callable ``segment_source(cid0, seg) -> (idx_t,
+        val_t, Y_t)`` (loaded serially: a callable makes no
+        thread-safety promise).
+
+    ``chunk_fn`` then receives SEGMENT-RELATIVE ids. Requires
+    ``max_chunks_per_dispatch`` (defaulted from a source's
+    ``chunks_per_segment``).
 
     ``inflight``: segments allowed in the device queue before the host
     blocks — keeps dispatch bounded (the tunnel-watchdog constraint the
@@ -397,6 +408,29 @@ def run_lbfgs_gram_streamed(
     if n is None:
         raise ValueError("streamed fit needs the true row count n")
     seg = max_chunks_per_dispatch
+    source = None
+    if segment_source is not None and not callable(segment_source):
+        from keystone_tpu.data.prefetch import COOShardSource, is_shard_source
+
+        if is_shard_source(segment_source):
+            source = segment_source
+        elif hasattr(segment_source, "segment_source"):
+            # A DiskCOOShards-like object: group chunks into segments.
+            source = COOShardSource(
+                segment_source, seg if seg else min(int(num_chunks), 8)
+            )
+        else:
+            raise TypeError(
+                f"segment_source must be callable, a ShardSource, or "
+                f"have .segment_source; got {type(segment_source).__name__}"
+            )
+        if seg is None:
+            seg = source.chunks_per_segment
+        elif seg != source.chunks_per_segment:
+            raise ValueError(
+                f"max_chunks_per_dispatch {seg} != the source's "
+                f"chunks_per_segment {source.chunks_per_segment}"
+            )
     if segment_source is None and (seg is None or seg >= num_chunks):
         program = _gram_streamed_program(
             chunk_fn, int(num_chunks), int(d), int(k), float(lam),
@@ -426,15 +460,27 @@ def run_lbfgs_gram_streamed(
     )
     carry = sparse_gram_init(d, k, val_dtype)
     throttle = BoundedInflight(inflight)
+
+    def folded(cid0, ops):
+        nonlocal carry
+        carry = fold(
+            carry, jnp.asarray(cid0, jnp.int32),
+            tuple(jnp.asarray(o) for o in ops),
+        )
+        throttle.admit(carry[2])
+
+    if source is not None:
+        from keystone_tpu.data.prefetch import iter_segments
+
+        for s, ops in iter_segments(source, prefetch_depth=prefetch_depth):
+            folded(s * int(seg), ops)
+        return solve(carry)
     for cid0 in range(0, int(num_chunks), int(seg)):
         if segment_source is not None:
-            ops = tuple(
-                jnp.asarray(o) for o in segment_source(int(cid0), int(seg))
-            )
+            ops = segment_source(int(cid0), int(seg))
         else:
-            ops = tuple(operands)
-        carry = fold(carry, jnp.asarray(cid0, jnp.int32), ops)
-        throttle.admit(carry[2])
+            ops = operands
+        folded(cid0, ops)
     return solve(carry)
 
 

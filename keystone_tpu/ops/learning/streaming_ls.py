@@ -274,6 +274,123 @@ def _identity_featurize(X_t):
     return X_t
 
 
+def _source_d_in(src) -> int:
+    """Row width of a shard source's DATA field (view or paired form) —
+    cheap metadata, no segment load or pairing construction. Raises the
+    same TypeError as ``_paired_source`` for non-dense sources (e.g. a
+    COOShardSource), so the deliberate guard is what callers hit."""
+    width = getattr(src, "width", None)
+    if width is None:
+        width = getattr(src, "d_in", None)
+    if width is None:
+        raise TypeError(
+            f"cannot stream a dense fit from shard source "
+            f"{type(src).__name__}"
+        )
+    return int(width)
+
+
+def _paired_source(data: Dataset, labels: Dataset):
+    """Assemble the (X_seg, Y_seg, valid_rows) segment source a
+    shard-backed fit folds over. The common spill-path case — data and
+    labels are views over ONE set of disk shards — costs zero extra
+    reads; resident labels (they usually fit host RAM even when rows
+    don't) are sliced per segment."""
+    from keystone_tpu.data.prefetch import (
+        DenseShardSource,
+        DenseShardView,
+        PairedDenseSource,
+        ResidentDenseSource,
+    )
+
+    def _same_provider(a, b):
+        """Same segment provider: identical object, or disk-shard sources
+        over the same directory (distinct DiskDenseShards handles on one
+        shard set are equivalent)."""
+        if a is b:
+            return True
+        sa, sb = getattr(a, "shards", None), getattr(b, "shards", None)
+        if sa is None or sb is None:
+            return False
+        if sa is sb:
+            return True
+        da, db = getattr(sa, "directory", None), getattr(sb, "directory", None)
+        return da is not None and da == db
+
+    src = data.shard_source
+    if isinstance(src, DenseShardView):
+        if (
+            labels is not None
+            and labels.is_shard_backed
+            and isinstance(labels.shard_source, DenseShardView)
+            and labels.shard_source.field == "y"
+            and _same_provider(labels.shard_source.paired, src.paired)
+        ):
+            # Field check rides in PairedDenseSource too: a swapped
+            # (data, labels) pair must raise, never silently fit the
+            # shards' stored labels against themselves.
+            return PairedDenseSource(src)
+        if (
+            labels is not None
+            and labels.is_shard_backed
+            and isinstance(labels.shard_source, DenseShardView)
+            and labels.shard_source.field == "x"
+        ):
+            raise ValueError(
+                "labels is a rows ('x') shard view — pass the labels "
+                "('y') view (a duplicated/swapped pair would silently "
+                "fit rows against rows)"
+            )
+        if labels is None:
+            raise ValueError("shard-backed fit needs labels")
+        return PairedDenseSource(src, np.asarray(labels.array)[: labels.n])
+    if isinstance(src, (DenseShardSource, PairedDenseSource,
+                        ResidentDenseSource)):
+        # The source already delivers (X_seg, Y_seg, valid) triples with
+        # its own embedded labels. Silently fitting against those while
+        # the caller passed DIFFERENT labels would train the wrong model
+        # with no error — accept only labels that view the same source.
+        if labels is not None:
+            lsrc = (
+                labels.shard_source if labels.is_shard_backed else None
+            )
+            lbase = (
+                lsrc.paired if isinstance(lsrc, DenseShardView) else lsrc
+            )
+            base = getattr(src, "paired", src)
+            same = lsrc is src or (
+                lbase is not None and _same_provider(lbase, base)
+            )
+            if not same:
+                raise ValueError(
+                    "data's shard source embeds its own labels; pass the "
+                    "matching labels view of the same shards (unrelated "
+                    "labels would be silently ignored)"
+                )
+        return src
+    raise TypeError(
+        f"cannot stream a fit from shard source {type(src).__name__}"
+    )
+
+
+def _fit_paired_source(source, featurize, d_feat: int, block_size: int,
+                       lam, num_iter: int, center: bool,
+                       prefetch_depth: int = 2,
+                       ) -> "StreamingFeaturizedLinearModel":
+    """Shared disk-tier fit body: prefetched segment folds -> centered
+    BCD on the normal equations -> the same affine model every streaming
+    tier returns (existing streaming parity tolerances apply)."""
+    W, fmean, ymean, _ = streaming.streaming_bcd_fit_segments(
+        source, bank=streaming.as_bank(featurize), d_feat=d_feat,
+        block_size=block_size, lam=lam, num_iter=num_iter, center=center,
+        prefetch_depth=prefetch_depth,
+    )
+    return StreamingFeaturizedLinearModel(
+        featurize, W, streaming.pick_tile_rows(d_feat, 4),
+        fmean=fmean, ymean=ymean,
+    )
+
+
 def pick_block_size(d_feat: int, hint: int) -> int:
     """Largest divisor of d_feat that is <= hint (BCD needs d % bs == 0)."""
     for b in range(min(hint, d_feat), 0, -1):
@@ -394,6 +511,15 @@ class BlockStreamedLeastSquares(LabelEstimator):
         import jax as _jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        if data.is_shard_backed:
+            # The block tier's residual sweep re-featurizes X every block
+            # step — it NEEDS raw rows device-resident, so a ShardSource
+            # materializes here (spilled datasets that still fit run;
+            # genuinely over-RAM sets belong to the gram/disk tier, which
+            # the capacity selector routes there).
+            data = data.materialize()
+        if labels.is_shard_backed:
+            labels = labels.materialize()
         X = jnp.asarray(data.array)
         Y = jnp.asarray(labels.array)
         mesh = data.mesh
@@ -479,6 +605,13 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         # Gramians only, num_iter featurize passes) for d where 8d²
         # itself exceeds the budget (~60k dims on a 16 GB chip).
         self.budget_bytes: Optional[float] = None
+        # DISK-tier knobs (set by the owner when the sampled input is
+        # shard-backed): raw rows then stream from disk segments, so the
+        # capacity model prices staged buffers instead of n·raw resident,
+        # and the fit folds over a prefetched ShardSource.
+        self.data_is_shard_backed: bool = False
+        self.shard_segment_bytes: Optional[float] = None
+        self.prefetch_depth: int = 2
 
     @property
     def label(self) -> str:
@@ -550,9 +683,27 @@ class StreamingLeastSquaresChoice(LabelEstimator):
     def fuse_with_members(self, members) -> "StreamedFitEstimator":
         return StreamedFitEstimator(members, self)
 
+    def fit_source(self, data: Dataset, labels: Dataset, featurize,
+                   d_feat: int):
+        """The DISK tier: fold the normal equations over prefetched
+        shard segments (featurize applied per tile inside the fold), so
+        neither host RAM nor HBM ever holds the raw rows — the
+        capacity-selected path for datasets past the host budget."""
+        return _fit_paired_source(
+            _paired_source(data, labels), featurize, d_feat,
+            block_size=pick_block_size(d_feat, self.block_size_hint),
+            lam=self.lam, num_iter=self.num_iter, center=self.center,
+            prefetch_depth=self.prefetch_depth,
+        )
+
     def fit(self, data: Dataset, labels: Dataset):
         from keystone_tpu.ops.sparse import Densify, is_sparse_dataset
 
+        if data.is_shard_backed:
+            return self.fit_source(
+                data, labels, _identity_featurize,
+                _source_d_in(data.shard_source),
+            )
         if is_sparse_dataset(data):
             data = Densify().batch_apply(data)
         d_feat = int(jnp.asarray(data.array).shape[-1])
@@ -594,6 +745,21 @@ class StreamingLeastSquaresChoice(LabelEstimator):
             * d * 4.0,
             float(self.slab_bytes),
         )
+        if self.data_is_shard_backed:
+            # Disk tier: raw rows + labels live in the shard files and
+            # stream through (prefetch_depth + 1) staged segment buffers,
+            # so no term scales with n. The fit ALWAYS runs the gram fold
+            # here (fit_source — the block tier needs resident raw rows),
+            # so price the gram-tier stash unconditionally: if its 8d²
+            # Gramian busts the device budget, the disk tier honestly
+            # reports infeasible rather than OOMing mid-fold.
+            seg = self.shard_segment_bytes or (8192.0 * (raw + 4.0 * k))
+            return (
+                (self.prefetch_depth + 1) * seg
+                + 8.0 * d * d
+                + 8.0 * d * bs
+                + slab
+            )
         common = n * raw / num_machines + 4.0 * n * k / num_machines
         if self._gram_tier_ok(d):
             return (
@@ -681,6 +847,8 @@ class StreamedFitEstimator(LabelEstimator):
     def fit(self, data: Dataset, labels: Dataset):
         if data.is_host or labels.is_host:
             return self._fallback(data, labels)
+        if data.is_shard_backed:
+            return self._fit_shard_backed(data, labels)
         X = jnp.asarray(data.array)
         d_feat = int(
             jax.eval_shape(
@@ -703,5 +871,25 @@ class StreamedFitEstimator(LabelEstimator):
             # rows (featurize-inside, tile-wise); saved-state reuse in
             # later pipelines with intact featurize nodes feeds
             # featurized rows.
+            model.d_in = d_in
+        return model
+
+    def _fit_shard_backed(self, data: Dataset, labels: Dataset):
+        """The out-of-core pipeline fit: raw rows stream from disk shards
+        through the prefetcher, the bound featurize program runs per tile
+        inside the fold, and the feature matrix never materializes at ANY
+        tier — disk, host, or HBM."""
+        d_in = _source_d_in(data.shard_source)
+        d_feat = int(
+            jax.eval_shape(
+                self._featurize,
+                jax.ShapeDtypeStruct((1, d_in), jnp.float32),
+            ).shape[-1]
+        )
+        model = self.choice.fit_source(data, labels, self._featurize, d_feat)
+        if d_in == d_feat:
+            model.featurize = _identity_featurize
+            model.d_in = None
+        else:
             model.d_in = d_in
         return model

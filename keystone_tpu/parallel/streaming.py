@@ -372,6 +372,36 @@ class BankFeaturize:
         return type(self).apply_bank(self.static_key(), self.params, X_t)
 
 
+class CallableBank(BankFeaturize):
+    """Any traceable featurize callable through the BankFeaturize
+    contract: no operand arrays; the callable itself is the static key,
+    so the segmented folds' jit cache keys on its identity exactly like
+    the closure-path fits (one executable per callable per geometry).
+    Lets ``streaming_bcd_fit_segments`` — whose fold is bank-keyed —
+    drive composed/fused featurize programs and the identity path."""
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    @property
+    def params(self):
+        return ()
+
+    def static_key(self) -> tuple:
+        return (self.fn,)
+
+    @classmethod
+    def apply_bank(cls, static_key, params, X_t):
+        return static_key[0](X_t)
+
+
+def as_bank(featurize) -> BankFeaturize:
+    """Normalize a featurize to the BankFeaturize contract."""
+    if isinstance(featurize, BankFeaturize):
+        return featurize
+    return CallableBank(featurize)
+
+
 def _fit_core(X, Y, featurize, d_feat, tile_rows, block_size, lam,
               num_iter, use_pallas, valid, labelize, center):
     """Shared traceable fit body: tile folds → (optional rank-1 centering)
@@ -507,17 +537,19 @@ def _dense_segment_fold(carry, X_seg, Y_seg, valid_rows, bank_params, *,
 
 def streaming_bcd_fit_segments(
     segment_source,
-    num_segments: int,
-    n_true: int,
-    bank,
-    d_feat: int,
-    tile_rows: int,
-    block_size: int,
-    lam,
-    num_iter: int,
+    num_segments: Optional[int] = None,
+    n_true: Optional[int] = None,
+    bank=None,
+    d_feat: int = None,
+    tile_rows: int = None,
+    block_size: int = None,
+    lam=0.0,
+    num_iter: int = 1,
     use_pallas: bool = False,
     center: bool = True,
     inflight: int = 2,
+    prefetch_depth: int = 2,
+    prefetch_stats=None,
 ):
     """Disk-bounded dense streamed fit: fold (G, FY, moments) over
     segments delivered one at a time (e.g.
@@ -527,26 +559,59 @@ def streaming_bcd_fit_segments(
     ``run_lbfgs_gram_streamed(segment_source=...)``: n is bounded by
     DISK, not host RAM or HBM.
 
-    ``segment_source(s) -> (X_seg (T, tile_rows, d_in), Y_seg (T,
-    tile_rows, k), valid_rows)`` — valid_rows counts the segment's true
-    rows (phantom/padding tiles past it are masked). Returns
+    ``segment_source``: either a :class:`keystone_tpu.data.prefetch.
+    ShardSource` (then ``num_segments``/``n_true`` default from it and a
+    background reader thread prefetches segment k+1 while segment k's
+    H2D transfer + fold are in flight — ``prefetch_depth`` bounds the
+    staged-host-buffer depth; 0 loads serially, byte-identical results),
+    or the legacy callable ``segment_source(s) -> (X_seg (T, tile_rows,
+    d_in), Y_seg (T, tile_rows, k), valid_rows)`` — valid_rows counts the
+    segment's true rows (phantom/padding tiles past it are masked); the
+    callable form loads serially (a callable makes no thread-safety
+    promise). ``bank`` may be any featurize callable (wrapped via
+    :class:`CallableBank` when not already a BankFeaturize). Returns
     (W, fmean, ymean, loss) when centered, else (W, None, None, loss).
     """
+    from keystone_tpu.data.prefetch import is_shard_source, iter_segments
+
+    if is_shard_source(segment_source):
+        if num_segments is None:
+            num_segments = segment_source.num_segments
+        if n_true is None:
+            n_true = segment_source.n_true
+        if tile_rows is None:
+            tile_rows = segment_source.tile_rows
+    else:
+        prefetch_depth = 0  # plain callables make no thread-safety promise
+    if num_segments is None or n_true is None:
+        raise ValueError(
+            "callable segment sources need explicit num_segments and n_true"
+        )
+    if bank is None or d_feat is None or tile_rows is None or block_size is None:
+        # Fail here, not as a cryptic NoneType error mid-trace: only
+        # tile_rows defaults (from a ShardSource) — the rest are required.
+        raise ValueError(
+            "streamed segment fit needs bank, d_feat, block_size, and "
+            "tile_rows (tile_rows defaults only from a ShardSource)"
+        )
+    bank = as_bank(bank)
     bank_type, bank_key = type(bank), bank.static_key()
     bank_params = bank.params  # raw pytree — the BankFeaturize contract
-    first = segment_source(0)
-    k = int(first[1].shape[-1])
-    carry = (
-        jnp.zeros((d_feat, d_feat), jnp.float32),
-        jnp.zeros((d_feat, k), jnp.float32),
-        jnp.zeros((), jnp.float32),
-        jnp.zeros((d_feat,), jnp.float32),
-        jnp.zeros((k,), jnp.float32),
-    )
+    carry = None
     throttle = BoundedInflight(inflight)
-    for s in range(num_segments):
-        X_seg, Y_seg, valid_rows = first if s == 0 else segment_source(s)
-        first = None
+    for s, (X_seg, Y_seg, valid_rows) in iter_segments(
+        segment_source, num_segments=num_segments,
+        prefetch_depth=prefetch_depth, stats=prefetch_stats,
+    ):
+        if carry is None:
+            k = int(Y_seg.shape[-1])
+            carry = (
+                jnp.zeros((d_feat, d_feat), jnp.float32),
+                jnp.zeros((d_feat, k), jnp.float32),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((d_feat,), jnp.float32),
+                jnp.zeros((k,), jnp.float32),
+            )
         carry = _dense_segment_fold(
             carry, jnp.asarray(X_seg), jnp.asarray(Y_seg),
             jnp.asarray(int(valid_rows), jnp.int32), bank_params,

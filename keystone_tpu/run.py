@@ -114,12 +114,28 @@ def resolve(name: str) -> Callable:
     return PIPELINES[bare]
 
 
+def _extract_host_budget(argv):
+    """Pop the global ``--host-budget-bytes=N`` flag (any pipeline): caps
+    the host RAM the capacity selector lets a dataset claim, past which
+    fits route through disk shards (docs/data.md). Exported as the
+    ``KEYSTONE_HOST_BUDGET_BYTES`` env knob ``cost.host_memory_bytes``
+    reads, so per-pipeline flag parsers never see it."""
+    out = []
+    for a in argv:
+        if a.startswith("--host-budget-bytes="):
+            os.environ["KEYSTONE_HOST_BUDGET_BYTES"] = a.split("=", 1)[1]
+        else:
+            out.append(a)
+    return out
+
+
 def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     if not argv or argv[0] in ("-h", "--help"):
         print(__doc__)
         print("Pipelines:", ", ".join(sorted(PIPELINES)))
         return 0
+    argv = _extract_host_budget(argv)
     _enable_compile_cache()
     runner = resolve(argv[0])
     runner(argv[1:])

@@ -265,6 +265,27 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
 
             num_shards = mesh_lib.axis_size(ds.mesh, mesh_lib.DATA_AXIS)
         k = min(ds.n, samples_per_shard * max(num_shards, 1))
+        if getattr(ds, "is_shard_backed", False):
+            # Out-of-core source: sample the FIRST segment only (never
+            # materialize the dataset just to cost-model it) and carry
+            # the disk-tier capacity facts the selector prices on.
+            src = ds.shard_source
+            first = src.load(0)
+            arr = (
+                first if isinstance(first, np.ndarray)
+                else np.asarray(first[0]).reshape(
+                    -1, np.asarray(first[0]).shape[-1]
+                )
+            )
+            rows = min(k, arr.shape[0], ds.n)
+            out = Dataset(np.asarray(arr[:rows]), n=rows)
+            out.total_n = ds.n
+            out.source_row_bytes = src.row_bytes or float(
+                arr.shape[-1] * arr.dtype.itemsize
+            )
+            out.shard_backed = True
+            out.shard_segment_bytes = src.segment_bytes
+            return out
         if ds.is_host:
             out = Dataset.of(ds.to_list()[:k])
         else:
@@ -320,6 +341,27 @@ def _collect_samples(plan: Graph, nodes, samples_per_shard: int):
                 ]
                 if raws:
                     value.source_row_bytes = max(raws)
+                # Disk-tier provenance: a derived sample whose SOURCE is
+                # shard-backed keeps the flag ONLY through device-fusable
+                # operators — exactly the chains StreamedFitFusionRule can
+                # rewire to consume the raw shard source. Through a
+                # non-fusable op the fit would receive a materialized
+                # intermediate, so pricing the disk tier as feasible
+                # there would admit the very host-RAM blowup the budget
+                # cut exists to prevent.
+                from .fusion import fusable
+
+                if fusable(op) and any(
+                    getattr(v, "shard_backed", False) for v in dep_ds
+                ):
+                    value.shard_backed = True
+                    segs = [
+                        v.shard_segment_bytes for v in dep_ds
+                        if getattr(v, "shard_segment_bytes", None)
+                        is not None
+                    ]
+                    if segs:
+                        value.shard_segment_bytes = max(segs)
                 _attach_sparse_width(op, value, deps)
         memo[gid] = value
         return value

@@ -1,0 +1,101 @@
+"""Bench timing conventions can't silently diverge (ISSUE 2 satellite):
+every emitted row must carry a validated ``detail.timing`` field. Fast —
+no metric is executed; the structural guarantee is that (a) make_row is
+the only row constructor and rejects undeclared conventions, and (b)
+every *_metric function in bench.py returns through make_row.
+"""
+
+import ast
+import importlib.util
+import os
+
+import pytest
+
+_BENCH_PATH = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location("bench", _BENCH_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestMakeRow:
+    def test_valid_timing_enum(self):
+        bench = _load_bench()
+        assert bench.VALID_TIMING == {
+            "min_of_N_warm", "single_run_cold", "single_run_warm",
+            "host_only",
+        }
+
+    def test_row_carries_timing_in_detail(self):
+        bench = _load_bench()
+        row = bench.make_row("m", 1.0, "s", 2.0, "min_of_N_warm", {"x": 1})
+        assert row["detail"]["timing"] == "min_of_N_warm"
+        assert row["metric"] == "m" and row["detail"]["x"] == 1
+
+    def test_undeclared_convention_rejected(self):
+        bench = _load_bench()
+        with pytest.raises(ValueError, match="timing"):
+            bench.make_row("m", 1.0, "s", None, "whatever_felt_right", {})
+        with pytest.raises(ValueError, match="timing"):
+            bench.make_row("m", 1.0, "s", None, None, {})
+
+
+class TestEveryMetricUsesMakeRow:
+    def _metric_functions(self, tree):
+        return [
+            node for node in tree.body
+            if isinstance(node, ast.FunctionDef)
+            and node.name.endswith("_metric")
+        ]
+
+    def test_every_metric_function_returns_make_row(self):
+        with open(_BENCH_PATH) as f:
+            tree = ast.parse(f.read())
+        metrics = self._metric_functions(tree)
+        assert len(metrics) >= 8, [m.name for m in metrics]
+        for fn in metrics:
+            returns_make_row = any(
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "make_row"
+                for node in ast.walk(fn)
+            )
+            assert returns_make_row, (
+                f"{fn.name} does not return via make_row — its row would "
+                f"carry no validated timing convention"
+            )
+
+    def test_no_handwritten_metric_dict_outside_make_row(self):
+        # A dict literal with a "metric" key anywhere except make_row
+        # itself / main()'s error fallback would be a row dodging the
+        # timing validation.
+        with open(_BENCH_PATH) as f:
+            tree = ast.parse(f.read())
+        offenders = []
+        for top in tree.body:
+            if (
+                isinstance(top, ast.FunctionDef)
+                and top.name in ("make_row", "main")
+            ):
+                continue
+            for node in ast.walk(top):
+                if isinstance(node, ast.Dict):
+                    for key in node.keys:
+                        if (
+                            isinstance(key, ast.Constant)
+                            and key.value == "metric"
+                        ):
+                            offenders.append(getattr(top, "name", str(top)))
+        assert not offenders, offenders
+
+    def test_outofcore_row_registered(self):
+        bench = _load_bench()
+        assert callable(bench.outofcore_prefetch_metric)
+        with open(_BENCH_PATH) as f:
+            src = f.read()
+        main_body = src[src.index("def main("):]
+        assert "outofcore_prefetch_metric," in main_body
