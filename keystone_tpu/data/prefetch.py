@@ -356,15 +356,19 @@ def is_shard_source(obj: Any) -> bool:
 
 
 class PrefetchStats:
-    """Where the ingestion time went, for the bench's overlap accounting:
-    ``load_s`` sums time spent inside ``source.load`` (reader thread —
-    disk + staging copies), ``wait_s`` sums time the CONSUMER blocked
-    waiting on the queue (latency the prefetch failed to hide)."""
+    """Where the ingestion time went, for the overlap accounting
+    (``utils.profiling.prefetch_overlap_fraction``): ``load_s`` sums time
+    spent inside ``source.load`` (reader thread — disk + staging copies),
+    ``wait_s`` sums time the CONSUMER blocked waiting on the queue
+    (latency the prefetch failed to hide). ``prefetched`` records whether
+    a background reader actually ran — a serial (depth-0) pass fills
+    load_s with no waits, which must read as zero overlap, not full."""
 
     def __init__(self):
         self.load_s = 0.0
         self.wait_s = 0.0
         self.segments = 0
+        self.prefetched = False
 
 
 class _ReaderDone:
@@ -432,6 +436,7 @@ class Prefetcher:
                 "Prefetcher is single-use; create a new one per pass"
             )
         self._started = True
+        self.stats.prefetched = True
         self._thread = threading.Thread(
             target=self._reader, name="keystone-prefetch", daemon=True
         )

@@ -362,6 +362,7 @@ def run_lbfgs_gram_streamed(
     segment_source=None,
     inflight: int = 2,
     prefetch_depth: int = 2,
+    pipeline: bool = True,
 ):
     """Streamed sparse ridge fit: fold G = AᵀA over COO chunks ONCE
     (``sparse.sparse_gram_stream`` — chunks may be regenerated/loaded per
@@ -404,6 +405,11 @@ def run_lbfgs_gram_streamed(
     blocks — keeps dispatch bounded (the tunnel-watchdog constraint the
     old per-segment synchronous drain served) while segment i+1's host
     load and transfer overlap segment i's fold.
+
+    ``pipeline``: double-buffer the densified chunk slab inside the fold
+    (``sparse.sparse_gram_fold``) so chunk k+1's regen+densify is
+    schedulable against chunk k's accumulating syrk; costs one extra
+    resident slab — pass False beside large resident operands.
     """
     if n is None:
         raise ValueError("streamed fit needs the true row count n")
@@ -435,7 +441,7 @@ def run_lbfgs_gram_streamed(
         program = _gram_streamed_program(
             chunk_fn, int(num_chunks), int(d), int(k), float(lam),
             int(num_iterations), float(convergence_tol), int(n),
-            bool(use_pallas), jnp.dtype(val_dtype),
+            bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline),
         )
         return program(tuple(operands))
 
@@ -447,12 +453,12 @@ def run_lbfgs_gram_streamed(
             raise ValueError("segment_source requires max_chunks_per_dispatch")
         fold = _gram_fold_program_rel(
             chunk_fn, int(num_chunks), int(d), int(k), int(seg),
-            bool(use_pallas), jnp.dtype(val_dtype),
+            bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline),
         )
     else:
         fold = _gram_fold_program(
             chunk_fn, int(num_chunks), int(d), int(k), int(seg),
-            bool(use_pallas), jnp.dtype(val_dtype),
+            bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline),
         )
     solve = _gram_solve_program(
         int(d), int(k), float(lam), int(num_iterations),
@@ -486,7 +492,7 @@ def run_lbfgs_gram_streamed(
 
 @functools.lru_cache(maxsize=16)
 def _gram_fold_program(chunk_fn, num_chunks, d, k, seg, use_pallas,
-                       val_dtype):
+                       val_dtype, pipeline=True):
     """Compiled fold of ``seg`` consecutive chunks into the (G, AtY, yty)
     carry; the starting chunk id is a traced operand so every segment —
     including the phantom-padded final one — reuses this one executable.
@@ -506,7 +512,7 @@ def _gram_fold_program(chunk_fn, num_chunks, d, k, seg, use_pallas,
 
         return sparse_gram_fold(
             carry, cid0 + jnp.arange(seg), cf, d, k,
-            use_pallas=use_pallas, val_dtype=val_dtype,
+            use_pallas=use_pallas, val_dtype=val_dtype, pipeline=pipeline,
         )
 
     return fold
@@ -514,7 +520,7 @@ def _gram_fold_program(chunk_fn, num_chunks, d, k, seg, use_pallas,
 
 @functools.lru_cache(maxsize=16)
 def _gram_fold_program_rel(chunk_fn, num_chunks, d, k, seg, use_pallas,
-                           val_dtype):
+                           val_dtype, pipeline=True):
     """Segment fold over SEGMENT-RELATIVE chunk ids: operands hold only
     this segment's ``seg`` chunks (a disk-backed loader's slice), so
     ``chunk_fn`` slices by rel id while liveness masks by the absolute
@@ -534,7 +540,7 @@ def _gram_fold_program_rel(chunk_fn, num_chunks, d, k, seg, use_pallas,
 
         return sparse_gram_fold(
             carry, jnp.arange(seg), cf, d, k,
-            use_pallas=use_pallas, val_dtype=val_dtype,
+            use_pallas=use_pallas, val_dtype=val_dtype, pipeline=pipeline,
         )
 
     return fold
@@ -566,7 +572,8 @@ def _gram_solve_program(d, k, lam, num_iterations, convergence_tol, n,
 
 @functools.lru_cache(maxsize=16)
 def _gram_streamed_program(chunk_fn, num_chunks, d, k, lam, num_iterations,
-                           convergence_tol, n, use_pallas, val_dtype):
+                           convergence_tol, n, use_pallas, val_dtype,
+                           pipeline=True):
     """Compiled streamed-fit program, cached per (chunk_fn identity, fit
     geometry). Building the jit inside every call would make EVERY fit —
     including the timed second run of a warm benchmark — retrace and
@@ -584,7 +591,7 @@ def _gram_streamed_program(chunk_fn, num_chunks, d, k, lam, num_iterations,
 
         G, AtY, yty = sparse_gram_stream(
             cf, num_chunks, d, k, use_pallas=use_pallas,
-            val_dtype=val_dtype,
+            val_dtype=val_dtype, pipeline=pipeline,
         )
         # Solve at the padded width: padded rows of AtY are zero and G's
         # padded rows/cols are zero, so those W rows stay exactly zero
@@ -652,6 +659,12 @@ class SparseLBFGSwithL2(LabelEstimator):
         # relative — the iterates shift by the same order; quantified in
         # tests/test_sparse_gram.py).
         self.gram_dtype = gram_dtype
+        # Resolved at CONSTRUCTION like the selector's cpu/mem/network
+        # weights (cost.py) — a mid-process KEYSTONE_COST_WEIGHTS flip
+        # must not mix weight families within one estimator's ranking.
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        self._sparse_overhead = cost_mod.sparse_gather_overhead()
 
     @property
     def weight(self) -> int:
@@ -738,6 +751,12 @@ class SparseLBFGSwithL2(LabelEstimator):
             use_pallas=pallas_ops.pallas_direct_ok(val_t),
             val_dtype=val_dtype,
             operands=(idx_t, val_t, Y_t),
+            # Resident operands already hold the whole dataset: the
+            # double-buffered second slab would be pure extra HBM beside
+            # them (the measured resident-capacity cliff sits at n=30e6 /
+            # 9.8 GB — bench.py's probe), and there is no regen work to
+            # overlap — chunks are slices of the resident buffers.
+            pipeline=False,
         )
         logger.info("LBFGS(gram) final loss: %s", float(final_loss))
         return W
@@ -751,13 +770,26 @@ class SparseLBFGSwithL2(LabelEstimator):
 
     def cost(
         self, n, d, k, sparsity, num_machines, cpu_weight, mem_weight, network_weight,
-        sparse_overhead: float = 8.0,
+        sparse_overhead: Optional[float] = None,
     ) -> float:
         """Analytic cost model (LBFGS.scala:264-280). The ``gram`` engine
         is priced as a measured iteration-equivalent of the gather engine
-        (fold once, then data-free iterations) — see _GRAM_FOLD_ITER_EQUIV."""
+        (fold once, then data-free iterations) — see _GRAM_FOLD_ITER_EQUIV.
+        ``sparse_overhead`` (the gather engine's random-access multiplier
+        on the sequential mem rate) defaults from the weight family active
+        at CONSTRUCTION (cost.sparse_gather_overhead): 500 for the TPU
+        weights — measured 2.1e8 random cells/s vs the sequential-scan
+        rate on the amazon bench row — 8 for the reference's EC2 set."""
         import math
 
+        if sparse_overhead is None:
+            # getattr: instances unpickled from pre-round-6 saves lack the
+            # construction-time attribute — resolve from the env then.
+            sparse_overhead = getattr(self, "_sparse_overhead", None)
+        if sparse_overhead is None:
+            from keystone_tpu.ops.learning import cost as cost_mod
+
+            sparse_overhead = cost_mod.sparse_gather_overhead()
         flops = n * sparsity * d * k / num_machines
         bytes_scanned = n * d * sparsity / num_machines
         network = 2.0 * d * k * math.log2(max(num_machines, 2))

@@ -732,13 +732,22 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         north-star program): raw rows + labels + residual + per-BLOCK
         Gramian/factor stash + one block slab + the bank — no d² term."""
         raw = self.raw_row_bytes
-        if not raw:
-            # Unknown raw width. Dense input: the raw operand IS the full
+        if self.input_is_sparse:
+            # Resident SPARSE input: fit() densifies before the tile scan
+            # (the streamed fold featurizes dense row tiles), so the
+            # resident operand is the DENSIFIED matrix — 4d bytes/row —
+            # whatever the COO row width was. Pricing the COO width here
+            # let this tier look feasible at geometries where its own
+            # densify would OOM (found by the round-6 replay test when
+            # the TPU weights made it cost-competitive with the sparse
+            # gram engine).
+            raw = 4.0 * d
+        elif not raw:
+            # Unknown raw width, dense input: the raw operand IS the full
             # f32 row — 4d bytes (the old min(d, 512) cap underestimated
             # wide-dense rows ~32x at d=16384, letting this tier look
-            # feasible when the raw operand alone exceeds HBM). Sparse
-            # input: rows are padded COO, bounded by the old cap.
-            raw = 4.0 * min(d, 512) if self.input_is_sparse else 4.0 * d
+            # feasible when the raw operand alone exceeds HBM).
+            raw = 4.0 * d
         bs = min(self.block_size_hint, d)
         slab = min(
             streaming.pick_tile_rows(d, 4, slab_bytes=self.slab_bytes)

@@ -38,9 +38,12 @@ from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
     "gaussian_kernel_block",
+    "gaussian_resid_block",
     "cosine_features",
     "gram_corr",
     "gram_corr_sym",
+    "gram_corr_sym_acc",
+    "gram_corr_acc_ok",
     "pallas_enabled",
     "pallas_direct_ok",
 ]
@@ -48,6 +51,13 @@ __all__ = [
 _TILE_M = 256
 _TILE_N = 256
 _TILE_K = 512
+
+# jax >= 0.6 renamed TPUCompilerParams -> CompilerParams; support both so
+# the interpreter-mode tests run on either (the dev container pins the
+# older spelling, the TPU host the newer).
+_COMPILER_PARAMS = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams"
+)
 
 
 def _interpret() -> bool:
@@ -204,6 +214,121 @@ def gaussian_kernel_block(
         interpret=_interpret() if interpret is None else interpret,
     )(Xp, Yp, xnp, ynp)
     return out[:m, :n]
+
+
+# ---------------------------------------------------------------------------
+# Fused Gaussian kernel block + residual epilogue: (K_block)ᵀ W
+# ---------------------------------------------------------------------------
+
+
+def _gaussian_resid_kernel(
+    x_ref, y_ref, xn_ref, yn_ref, w_ref, out_ref, acc_ref, *,
+    gamma, nk, compute_dtype
+):
+    """Grid (j, i, k): j over the block's columns (slowest — the resid tile
+    (j, 0) stays resident across the whole i sweep), i over train-row
+    tiles, k over feature tiles. The kernel tile K(i, j) is assembled in
+    VMEM at k == nk − 1 and immediately contracted into the residual —
+    it is never written to HBM."""
+    i = pl.program_id(1)
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    acc_ref[:] += jax.lax.dot_general(
+        x_ref[:].astype(compute_dtype),
+        y_ref[:].astype(compute_dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when((i == 0) & (k == 0))
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    @pl.when(k == nk - 1)
+    def _():
+        sq = xn_ref[:] + yn_ref[:] - 2.0 * acc_ref[:]
+        kt = jnp.exp(-gamma * jnp.maximum(sq, 0.0))
+        # The residual contraction runs exact-f32 (kt is f32 from the exp
+        # epilogue); its MXU cost is one 128-lane tile per K tile — noise
+        # beside the kernel-generation GEMM it rides on.
+        out_ref[:] += jax.lax.dot_general(
+            kt, w_ref[:],
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            **_dot_kwargs(jnp.float32),
+        )
+
+
+def gaussian_resid_block(
+    X,
+    Y,
+    x_norms,
+    y_norms,
+    W,
+    gamma: float,
+    compute_dtype=jnp.float32,
+    interpret: Optional[bool] = None,
+):
+    """resid = K(X, Y)ᵀ @ W with the kernel block fused away.
+
+    X: (m, d) train rows, Y: (n, d) the block's rows, W: (m, k) the dual
+    model. Computes K[i, j] = exp(-γ‖X_i − Y_j‖²) tile-by-tile in VMEM and
+    contracts each finished tile into the (n, k) residual in the same grid
+    step — the (m, n) kernel block never exists in HBM (the separate
+    ``gaussian_kernel_block`` + XLA ``K.T @ W`` composition writes and
+    re-reads it: 2·m·n·4 bytes per block step at the KRR geometry).
+
+    Padding is exact: ghost train rows have nonzero kernel values but zero
+    W rows; ghost feature columns are zero in both operands; ghost block
+    rows are sliced off the result. The caller masks ghost-column rows of
+    the residual downstream (the same ``valid_col`` mask the unfused path
+    applies). Requires W's rows beyond the true train count to be zero —
+    the KRR solver's invariant (ghost solves are exactly zero).
+    """
+    X = jnp.asarray(X, dtype=jnp.float32)
+    Y = jnp.asarray(Y, dtype=jnp.float32)
+    W = jnp.asarray(W, dtype=jnp.float32)
+    m, d = X.shape
+    n = Y.shape[0]
+    kdim = W.shape[1]
+    xn = jnp.asarray(x_norms, dtype=jnp.float32).reshape(m, 1)
+    yn = jnp.asarray(y_norms, dtype=jnp.float32).reshape(1, n)
+
+    tm, tn, tk = min(_TILE_M, m), min(_TILE_N, n), min(_TILE_K, d)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Xp = _pad_to(_pad_to(X, tm, 0), tk, 1)
+    Yp = _pad_to(_pad_to(Y, tn, 0), tk, 1)
+    xnp = _pad_to(xn, tm, 0)
+    ynp = _pad_to(yn, tn, 1)
+    Wp = _pad_to(_pad_to(W, tm, 0), tr, 1)
+    mp, dp = Xp.shape
+    np_ = Yp.shape[0]
+    nk = dp // tk
+
+    out = pl.pallas_call(
+        functools.partial(
+            _gaussian_resid_kernel,
+            gamma=float(gamma),
+            nk=nk,
+            compute_dtype=compute_dtype,
+        ),
+        grid=(np_ // tn, mp // tm, nk),
+        in_specs=[
+            pl.BlockSpec((tm, tk), lambda j, i, k: (i, k)),
+            pl.BlockSpec((tn, tk), lambda j, i, k: (j, k)),
+            pl.BlockSpec((tm, 1), lambda j, i, k: (i, 0)),
+            pl.BlockSpec((1, tn), lambda j, i, k: (0, j)),
+            pl.BlockSpec((tm, tr), lambda j, i, k: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((tn, tr), lambda j, i, k: (j, 0)),
+        out_shape=jax.ShapeDtypeStruct((np_, tr), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        interpret=_interpret() if interpret is None else interpret,
+    )(Xp, Yp, xnp, ynp, Wp)
+    return out[:n, :kdim]
 
 
 # ---------------------------------------------------------------------------
@@ -691,7 +816,7 @@ def gram_sym_acc(G, F, interpret: Optional[bool] = None):
         # conservative 16 MB default but well under the chip's 128 MB.
         # Raising the limit keeps the wide tiles (F is re-read (nt+1)
         # times per row tile, so halving nt halves that traffic).
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_COMPILER_PARAMS(
             vmem_limit_bytes=48 * 1024 * 1024
         ),
         interpret=_interpret() if interpret is None else interpret,
@@ -703,6 +828,134 @@ def gram_acc_ok(F) -> bool:
     n, d = F.shape
     ti = _strided_ti(F.dtype, d)
     return n % min(_TILE_K, n) == 0 and d % ti == 0
+
+
+def _gram_corr_sym_acc_kernel(
+    ii_ref, jj_ref, g_ref, c_ref, ai_ref, aj_ref, r_ref, gout_ref, cout_ref,
+    *, compute_dtype
+):
+    """The streaming fold's ONE-kernel chunk step: grid (p, k) over
+    upper-triangle block pairs × row tiles, accumulating BOTH
+
+        gout[pair p] = g[pair p] + Σ_k FᵢᵀFⱼ        (the syrk)
+        cout[row i]  = c[row i]  + Σ_k FᵢᵀR          (the correlation)
+
+    with the correlation riding the diagonal pairs exactly like
+    :func:`gram_corr_sym` — Fᵢ's tiles are already resident there, so the
+    correlation adds one (tk, tr) R stream and zero extra reads of F. The
+    running (G, C) ride through as operands (same contract as
+    :func:`gram_sym_acc`): the per-chunk contribution never materializes
+    as separate (d, d)/(d, k) buffers + adds, and the separate XLA FᵀR
+    GEMM — which re-read the whole chunk slab from HBM — disappears."""
+    p = pl.program_id(0)
+    k = pl.program_id(1)
+    diag = ii_ref[p] == jj_ref[p]
+
+    @pl.when(k == 0)
+    def _():
+        gout_ref[:] = g_ref[:]
+
+    ai = ai_ref[:].astype(compute_dtype)
+    gout_ref[:] += jax.lax.dot_general(
+        ai,
+        aj_ref[:].astype(compute_dtype),
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        **_dot_kwargs(compute_dtype),
+    )
+
+    @pl.when(diag & (k == 0))
+    def _():
+        cout_ref[:] = c_ref[:]
+
+    @pl.when(diag)
+    def _():
+        cout_ref[:] += jax.lax.dot_general(
+            ai,
+            r_ref[:].astype(compute_dtype),
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            **_dot_kwargs(compute_dtype),
+        )
+
+
+def gram_corr_sym_acc(G, C, F, R, interpret: Optional[bool] = None):
+    """(G + FᵀF, C + FᵀR) in a single pass over F — the fused form of
+    ``gram_sym_acc(G, F)`` + an XLA ``FᵀR`` GEMM.
+
+    G: (d, d) f32 with a *meaningful upper triangle only* (the
+    :func:`gram_sym_acc` contract — strictly-lower blocks of the result
+    are UNDEFINED memory; mirror once after the last accumulation).
+    C: (d, k) f32, fully valid in and out. F: (n, d), R: (n, k) — R is
+    quantized to F's compute dtype inside the kernel, matching the
+    unfused composition's ``FᵀR.astype(F.dtype)`` recipe bit-for-bit in
+    operand precision. Requires :func:`gram_corr_acc_ok`.
+    """
+    F = jnp.asarray(F)
+    G = jnp.asarray(G, dtype=jnp.float32)
+    R = jnp.asarray(R, dtype=jnp.float32)
+    C = jnp.asarray(C, dtype=jnp.float32)
+    compute_dtype = jnp.bfloat16 if F.dtype == jnp.bfloat16 else jnp.float32
+    n, d = F.shape
+    kdim = R.shape[1]
+    ti = _strided_ti(F.dtype, d)
+    tk = min(_TILE_K, n)
+    tr = max(128, ((kdim + 127) // 128) * 128)
+    Cp = _pad_to(C, tr, 1)
+    Rp = _pad_to(R, tr, 1)
+    nt = d // ti
+    nk = n // tk
+    pairs = [(i, j) for i in range(nt) for j in range(i, nt)]
+    ii = jnp.asarray(np.array([p[0] for p in pairs], dtype=np.int32))
+    jj = jnp.asarray(np.array([p[1] for p in pairs], dtype=np.int32))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(len(pairs), nk),
+        in_specs=[
+            pl.BlockSpec((ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])),
+            pl.BlockSpec((ti, tr), lambda p, k, ii, jj: (ii[p], 0)),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, ii[p])),
+            pl.BlockSpec((tk, ti), lambda p, k, ii, jj: (k, jj[p])),
+            # Off-diagonal pairs never read R: pin their index so the tile
+            # stays resident instead of streaming R past every pair.
+            pl.BlockSpec(
+                (tk, tr),
+                lambda p, k, ii, jj: (jnp.where(ii[p] == jj[p], k, 0), 0),
+            ),
+        ],
+        out_specs=[
+            pl.BlockSpec((ti, ti), lambda p, k, ii, jj: (ii[p], jj[p])),
+            # The corr tile (i, 0) is written on row i's diagonal pair —
+            # FIRST in the row-major pair order — then stays resident
+            # (untouched) across the row's off-diagonal pairs and flushes
+            # at the row boundary.
+            pl.BlockSpec((ti, tr), lambda p, k, ii, jj: (ii[p], 0)),
+        ],
+    )
+    gout, cout = pl.pallas_call(
+        functools.partial(
+            _gram_corr_sym_acc_kernel, compute_dtype=compute_dtype
+        ),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((d, d), jnp.float32),
+            jax.ShapeDtypeStruct((d, tr), jnp.float32),
+        ],
+        # Riding G in+out at (ti, ti) f32 plus the corr/R tiles measures
+        # ~22 MB scoped VMEM at 1024-wide bf16 tiles — past the compiler's
+        # conservative 16 MB default, well under the chip's 128 MB (same
+        # reasoning as gram_sym_acc, plus the ~3 MB corr ride).
+        compiler_params=_COMPILER_PARAMS(
+            vmem_limit_bytes=64 * 1024 * 1024
+        ),
+        interpret=_interpret() if interpret is None else interpret,
+    )(ii, jj, G, Cp, F, F, Rp)
+    return gout, cout[:, :kdim]
+
+
+def gram_corr_acc_ok(F) -> bool:
+    """Static alignment check for :func:`gram_corr_sym_acc` (same tiling
+    as the gram-only accumulator; R/C widths are lane-padded internally)."""
+    return gram_acc_ok(F)
 
 
 def _block_corr_kernel(base_ref, f_ref, r_ref, out_ref, *, compute_dtype):

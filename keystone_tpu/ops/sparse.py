@@ -229,6 +229,7 @@ def sparse_gram_stream(
     k: int,
     use_pallas: bool = False,
     val_dtype=jnp.float32,
+    pipeline: bool = True,
 ):
     """Fold (G = AᵀA, AᵀY, ΣY²) over padded-COO row chunks — the sparse
     arm of the out-of-core streaming tier (parallel/streaming.py).
@@ -255,10 +256,13 @@ def sparse_gram_stream(
     SEGMENTED folding (long chunk streams must not run as one multi-minute
     program on hosts with dispatch watchdogs), use :func:`sparse_gram_fold`
     over cid ranges and :func:`gram_finalize` once at the end.
+    ``pipeline`` is the double-buffer knob of :func:`sparse_gram_fold` —
+    pass False when an extra resident chunk slab would bust HBM (e.g. the
+    bench's resident-capacity probe beside a 9.8 GB COO).
     """
     carry = sparse_gram_fold(
         None, jnp.arange(num_chunks), chunk_fn, d, k,
-        use_pallas=use_pallas, val_dtype=val_dtype,
+        use_pallas=use_pallas, val_dtype=val_dtype, pipeline=pipeline,
     )
     G, AtY, yty = carry
     return gram_finalize(G), AtY, yty
@@ -287,12 +291,33 @@ def sparse_gram_fold(
     k: int,
     use_pallas: bool = False,
     val_dtype=jnp.float32,
+    pipeline: bool = True,
 ):
     """Fold the chunk ids ``cids`` into the (G_raw, AtY, yty) carry.
 
     ``carry=None`` starts fresh (:func:`sparse_gram_init`). G_raw carries
     the accumulating-syrk upper-triangle contract — call
     :func:`gram_finalize` after the LAST fold. Traceable.
+
+    Two chunk-loop structures (identical results — same chunk order, same
+    per-chunk arithmetic):
+
+    - ``pipeline=True`` (default): the scan carry holds the NEXT chunk's
+      densified slab, so each step folds slab k while regenerating +
+      scattering slab k+1 — the two are data-independent inside one step,
+      which hands the scheduler regen/densify work (VPU + scatter) to
+      overlap with the accumulating syrk (MXU), the device-compute analog
+      of ``data/prefetch.py``'s host-side double buffer. Costs one extra
+      resident chunk slab (c × d_pad of ``val_dtype``).
+    - ``pipeline=False``: the round-5 serial body (regen → densify →
+      fold per step); one slab resident. Use when the extra slab busts
+      HBM (resident-capacity probes).
+
+    When ``use_pallas`` and the slab is tile-aligned
+    (:func:`~keystone_tpu.ops.pallas_ops.gram_corr_acc_ok`), the chunk
+    step is ONE accumulating Pallas kernel — syrk + correlation fused
+    (:func:`~keystone_tpu.ops.pallas_ops.gram_corr_sym_acc`), so the
+    separate AᵀY GEMM's full re-read of the slab from HBM disappears.
     """
     from keystone_tpu.ops import pallas_ops
 
@@ -300,8 +325,7 @@ def sparse_gram_fold(
         carry = sparse_gram_init(d, k, val_dtype)
     d_pad = carry[0].shape[0]
 
-    def body(carry, cid):
-        G, AtY, yty = carry
+    def densify_chunk(cid):
         indices, values, Yc = chunk_fn(cid)
         c, w = indices.shape
         mask = (indices >= 0) & (indices < d)
@@ -309,19 +333,51 @@ def sparse_gram_fold(
         vals = jnp.where(mask, values, 0).astype(val_dtype)
         rows = jnp.broadcast_to(jnp.arange(c)[:, None], (c, w))
         dense = jnp.zeros((c, d_pad), val_dtype).at[rows, safe].add(vals)
-        if use_pallas and pallas_ops.gram_acc_ok(dense):
-            G = pallas_ops.gram_sym_acc(G, dense)
+        return dense, Yc
+
+    # Fused-kernel eligibility is static (shapes only): probe the slab
+    # shape abstractly so the decision never depends on a chunk id.
+    slab_shape = jax.eval_shape(
+        densify_chunk, jax.ShapeDtypeStruct((), jnp.asarray(cids).dtype)
+    )[0]
+    fused = use_pallas and pallas_ops.gram_corr_acc_ok(slab_shape)
+
+    def fold_slab(G, AtY, yty, dense, Yc):
+        if fused:
+            G, AtY = pallas_ops.gram_corr_sym_acc(G, AtY, dense, Yc)
         else:
-            G = G + jax.lax.dot_general(
-                dense, dense, (((0,), (0,)), ((), ())),
+            if use_pallas and pallas_ops.gram_acc_ok(dense):
+                G = pallas_ops.gram_sym_acc(G, dense)
+            else:
+                G = G + jax.lax.dot_general(
+                    dense, dense, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+            AtY = AtY + jax.lax.dot_general(
+                dense, Yc.astype(dense.dtype), (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        AtY = AtY + jax.lax.dot_general(
-            dense, Yc.astype(dense.dtype), (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
         Yf = Yc.astype(jnp.float32)
-        return (G, AtY, yty + jnp.sum(Yf * Yf)), None
+        return G, AtY, yty + jnp.sum(Yf * Yf)
+
+    cids = jnp.asarray(cids)
+    num = int(cids.shape[0])
+    if pipeline and num > 1:
+        staged = densify_chunk(cids[0])
+
+        def body(state, cid_next):
+            (G, AtY, yty), (dense, Yc) = state
+            nxt = densify_chunk(cid_next)  # independent of the fold below
+            G, AtY, yty = fold_slab(G, AtY, yty, dense, Yc)
+            return ((G, AtY, yty), nxt), None
+
+        (carry, last), _ = jax.lax.scan(body, (carry, staged), cids[1:])
+        carry = fold_slab(*carry, *last)
+        return carry
+
+    def body(carry, cid):
+        dense, Yc = densify_chunk(cid)
+        return fold_slab(*carry, dense, Yc), None
 
     carry, _ = jax.lax.scan(body, carry, cids)
     return carry

@@ -13,6 +13,9 @@ ad-hoc per-phase nanosecond logs inside solvers (KernelRidgeRegression.scala:
     compiled XLA executable (FLOPs / bytes accessed), the analog of the
     reference's analytic ``CostModel`` inputs but read from the compiler
     instead of hand-derived.
+  - ``prefetch_overlap_fraction`` — the achieved ingestion-overlap share
+    of a prefetched streamed fit, from its
+    :class:`~keystone_tpu.data.prefetch.PrefetchStats`.
 """
 
 from __future__ import annotations
@@ -64,6 +67,35 @@ class PhaseTimer:
 
     def log_summary(self, level: int = logging.INFO) -> None:
         logger.log(level, "%s", self.summary())
+
+
+def prefetch_overlap_fraction(stats) -> Optional[float]:
+    """Achieved ingestion-overlap fraction of one prefetched streamed fit.
+
+    ``stats`` is the :class:`~keystone_tpu.data.prefetch.PrefetchStats` the
+    fit's Prefetcher filled: ``load_s`` is total time inside
+    ``source.load`` (reader thread — disk + staging copies), ``wait_s`` is
+    total time the CONSUMER blocked on the queue (latency the prefetch
+    failed to hide). The hidden share is
+
+        (load_s − wait_s) / load_s        clamped to [0, 1]
+
+    — 1.0 means every second of disk→host ingestion ran behind device
+    compute; 0.0 means fully serial (every load was waited on). Unlike the
+    bench's two-leg A/B (``(wall_off − wall_on) / load_s``), this needs
+    ONE run, so any streamed fit can report it (pass ``prefetch_stats`` to
+    ``streaming_bcd_fit_segments`` / ``run_lbfgs_gram_streamed``). Returns
+    None when no load time was recorded; a serial ``prefetch_depth=0``
+    pass (``stats.prefetched`` False — loads ran inline on the consumer,
+    nothing overlapped) reports 0.0.
+    """
+    load_s = float(getattr(stats, "load_s", 0.0) or 0.0)
+    if load_s <= 0.0:
+        return None
+    if not getattr(stats, "prefetched", False):
+        return 0.0
+    wait_s = float(getattr(stats, "wait_s", 0.0) or 0.0)
+    return min(max((load_s - wait_s) / load_s, 0.0), 1.0)
 
 
 @contextlib.contextmanager

@@ -291,6 +291,21 @@ class FusedGatherTransformer(Transformer):
         self._build_composed()
 
     def _build_composed(self) -> None:
+        # Shape-specialized lowering first: a gather of
+        # [RandomSign → PaddedFFT → LinearRectifier] branches packs branch
+        # pairs into complex FFTs and reads X once for all branches
+        # (stats.packed_fft_gather_fn) — the generic composition below
+        # reads X per branch and runs one real FFT each.
+        from keystone_tpu.ops.stats import packed_fft_gather_fn
+
+        packed = packed_fft_gather_fn(self.branches, self.combiner)
+        # Observable engagement: tests pin that the MNIST-shaped gather
+        # actually lowers to the packed program (whose flop/traffic model
+        # the bench row states), not the generic composition.
+        self.uses_packed_fft = packed is not None
+        if packed is not None:
+            self._composed = jax.jit(packed)
+            return
         branch_fns = [[m.device_fn() for m in br] for br in self.branches]
         combine = self.combiner.device_combine_fn()
 

@@ -91,26 +91,54 @@ def main():
     out["syrk_ceiling_tflops"] = round(2 * macs / dt / 1e12, 1)
     out["fold_floor_s_fulln"] = round(65e6 / c * (dt / REPS), 1)
 
+    # (b2) the FUSED syrk+correlation accumulator on the same slab — the
+    # round-6 chunk kernel. Its delta vs (b) is the fused correlation's
+    # marginal cost; the unfused composition instead re-read the whole
+    # slab from HBM for a separate AᵀY GEMM.
+    R = jnp.full((c, k), 0.5, jnp.float32)
+
+    @jax.jit
+    def fused_only(F, R):
+        def step(i, carry):
+            G, C = carry
+            return pallas_ops.gram_corr_sym_acc(G, C, F, R)
+        return jax.lax.fori_loop(
+            0, REPS, step,
+            (jnp.zeros((d_pad, d_pad), jnp.float32),
+             jnp.zeros((d_pad, k), jnp.float32)),
+        )
+
+    float(jnp.sum(fused_only(F, R)[0]))
+    t0 = time.perf_counter()
+    float(jnp.sum(fused_only(F, R)[0]))
+    dt_f = time.perf_counter() - t0
+    out["fused_syrk_corr_s_per_chunk"] = round(dt_f / REPS, 4)
+
     # (c) whole fold, 24 chunks, warm (the fit dispatch is async: block
-    # on the loss before stopping the clock).
+    # on the loss before stopping the clock) — pipelined (round-6
+    # default: chunk k+1 regen/densify double-buffered against chunk k's
+    # fused kernel) vs the round-5 serial body.
     chunks = 24
     n = chunks * c
     cf24 = make_chunk_fn(n)
 
-    def fold_once():
+    def fold_once(pipeline):
         t0 = time.perf_counter()
         _, loss = run_lbfgs_gram_streamed(
             cf24, chunks, d + 1, k, lam=1e-3, num_iterations=2, n=n,
             use_pallas=pallas_ops.pallas_enabled(),
-            val_dtype=jnp.bfloat16,
+            val_dtype=jnp.bfloat16, pipeline=pipeline,
         )
         assert np.isfinite(float(loss))
         return time.perf_counter() - t0
 
-    fold_once()  # compile
-    per_chunk = fold_once() / chunks
-    out["fold_s_per_chunk_warm"] = round(per_chunk, 4)
-    out["fulln_warm_est_s"] = round(per_chunk * 993, 1)
+    for name, flag in (("serial", False), ("pipelined", True)):
+        fold_once(flag)  # compile
+        per_chunk = fold_once(flag) / chunks
+        out[f"fold_s_per_chunk_warm_{name}"] = round(per_chunk, 4)
+        out[f"fulln_warm_est_s_{name}"] = round(per_chunk * 993, 1)
+    out["fold_s_per_chunk_warm"] = out["fold_s_per_chunk_warm_pipelined"]
+    out["fulln_warm_est_s"] = out["fulln_warm_est_s_pipelined"]
     print(json.dumps(out))
 
 

@@ -265,9 +265,20 @@ class TestUnsetRawBytesDenseDefault:
         choice = StreamingLeastSquaresChoice(num_iter=2, lam=1e-2)
         rb_dense = choice.resident_bytes(n, d, k, 1.0, 1)
         assert rb_dense >= 4.0 * n * d  # raw operand priced at full width
+
+    def test_sparse_input_priced_at_densified_width(self):
+        # Resident sparse input: fit() DENSIFIES before the tile scan, so
+        # the capacity model must price the 4d densified operand even when
+        # the COO row width is known and tiny — pricing COO width let the
+        # tier look feasible at geometries where its own densify OOMs
+        # (caught by the round-6 selector replay when the TPU weights made
+        # it cost-competitive with the sparse gram engine).
+        n, d, k = 1_000_000, 8192, 4
+        choice = StreamingLeastSquaresChoice(num_iter=2, lam=1e-2)
         choice.input_is_sparse = True
+        choice.raw_row_bytes = 8.0 * 80  # 80-nnz COO rows
         rb_sparse = choice.resident_bytes(n, d, k, 0.01, 1)
-        assert rb_sparse < rb_dense  # COO rows keep the bounded default
+        assert rb_sparse >= 4.0 * n * d
 
 
 class TestStreamedFitFusion:

@@ -1,0 +1,204 @@
+"""Selector replay against recorded bench measurements (ISSUE 3 satellite).
+
+The cost model's job is to rank candidates the way the hardware ranks them.
+These tests replay geometries with MEASURED on-chip outcomes (BENCH_r05 /
+BENCH_FULL_r05.json device-time rows, provenance noted per case) through
+the ACTIVE selector weights and assert the selector picks the
+measured-fastest feasible candidate:
+
+  - TIMIT resident (n=262144, d=16384, k=147): resident block BCD measured
+    0.327 s device; the streamed tier's per-row rate from the full-n
+    headline (4.107 s at n=2.2e6) is ~0.49 s at this n — resident wins.
+  - TIMIT full-n (n=2.2e6): resident candidates bust HBM; the streamed
+    tier is the only feasible fit (measured 4.107 s — the headline).
+  - Amazon sparse (n=500k, d=16384, nnz=82, k=2): gram engine measured
+    1.805 s vs gather 7.903 s — gram wins while its Gramian fits.
+  - dense LBFGS vs BCD at the TIMIT-resident geometry: 20 data passes vs
+    3 block sweeps — the measured block row bounds LBFGS from below, so
+    the model must rank block cheaper.
+
+Weight-set plumbing (KEYSTONE_COST_WEIGHTS) is covered at the bottom.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.learning.cost import (
+    EC2_CPU_WEIGHT,
+    EC2_MEM_WEIGHT,
+    EC2_NETWORK_WEIGHT,
+    LeastSquaresEstimator,
+    TPU_CPU_WEIGHT,
+    TPU_MEM_WEIGHT,
+    TPU_NETWORK_WEIGHT,
+    TransformerLabelEstimatorChain,
+    active_weights,
+    sparse_gather_overhead,
+)
+from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+from keystone_tpu.ops.learning.lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2
+from keystone_tpu.ops.learning.streaming_ls import StreamingLeastSquaresChoice
+
+
+@pytest.fixture(autouse=True)
+def _tpu_weight_family(monkeypatch):
+    """The replay cases pin the TPU weight family: an ambient
+    KEYSTONE_COST_WEIGHTS=ec2 (the documented A/B workflow) must not make
+    them fail spuriously. TestWeightFamilySwitch sets the env itself."""
+    monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)
+
+
+def _dense_sample(n_total, d, k, seed=0):
+    rng = np.random.default_rng(seed)
+    s = Dataset.of(rng.normal(size=(24, d)).astype(np.float32))
+    s.total_n = n_total
+    s.source_row_bytes = 4.0 * 440  # raw TIMIT rows upstream of featurize
+    ls = Dataset.of(rng.normal(size=(24, k)).astype(np.float32))
+    return s, ls
+
+
+def _cost_of(est, opt, n, d, k, sparsity=1.0, machines=1):
+    return opt.cost(
+        n, d, k, sparsity, machines,
+        est.cpu_weight, est.mem_weight, est.network_weight,
+    )
+
+
+class TestReplayTimitResident:
+    # BENCH_r05 timit_resident_262k: device 0.327 s, block BCD, bf16
+    # features. The capacity models price conservative f32 (+ centered
+    # copy), which busts a 16 GB budget at this n — the bench row's bf16 +
+    # in-loop-block layout halves that. Budget set so the candidates the
+    # row measured are feasible; what is under replay test is the RANKING
+    # among them.
+    N, D, K = 262_144, 16_384, 147
+
+    def test_block_selected_over_streaming_and_lbfgs(self):
+        # num_machines=1: the replayed rows are SINGLE-chip measurements
+        # (the test env forces an 8-device CPU mesh, which would shard
+        # capacity 8x and change feasibility).
+        est = LeastSquaresEstimator(
+            lam=1e-4, hbm_bytes=48 << 30, num_machines=1
+        )
+        s, ls = _dense_sample(self.N, self.D, self.K)
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
+        assert isinstance(chosen.estimator, BlockLeastSquaresEstimator), (
+            type(chosen.estimator).__name__
+        )
+
+    def test_measured_orderings_reproduced(self):
+        est = LeastSquaresEstimator(
+            lam=1e-4, hbm_bytes=48 << 30, num_machines=1
+        )
+        by_type = {type(o[0]).__name__ + getattr(o[0], "solver", ""): o[0]
+                   for o in est.options}
+        block = by_type["BlockLeastSquaresEstimator"]
+        lbfgs = by_type["DenseLBFGSwithL2"]
+        streaming = by_type["StreamingLeastSquaresChoice"]
+        c_block = _cost_of(est, block, self.N, self.D, self.K)
+        c_lbfgs = _cost_of(est, lbfgs, self.N, self.D, self.K)
+        c_stream = _cost_of(est, streaming, self.N, self.D, self.K)
+        # Measured: block 0.327 s device; streamed ~0.49 s (headline
+        # per-row rate); 20-iteration LBFGS's 20 data passes bound it
+        # above the 3-sweep block row.
+        assert c_block < c_stream, (c_block, c_stream)
+        assert c_block < c_lbfgs, (c_block, c_lbfgs)
+
+
+class TestReplayTimitFullN:
+    # BENCH_r05 headline: n=2.2e6 × d=16384, streamed 4.107 s device —
+    # the ONLY tier that fits a 16 GB chip at this geometry.
+    def test_streaming_selected_past_hbm(self):
+        est = LeastSquaresEstimator(
+            lam=1e-4, hbm_bytes=16 << 30, num_machines=1
+        )
+        s, ls = _dense_sample(2_200_000, 16_384, 147)
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, StreamingLeastSquaresChoice), chosen
+
+
+class TestReplayAmazonSparse:
+    # BENCH_r05 amazon_sparse_lbfgs_d16384: gram 1.805 s vs gather
+    # 7.903 s at n=500k, d=16384, nnz=82, k=2, 20 iterations.
+    N, D, NNZ, K = 500_000, 16_384, 82, 2
+
+    def _sample(self):
+        rng = np.random.default_rng(4)
+        idx = rng.integers(0, self.D, size=(24, self.NNZ)).astype(np.int32)
+        idx[0, 0] = self.D - 1
+        s = Dataset(
+            {"indices": jnp.asarray(idx),
+             "values": jnp.asarray(
+                 rng.normal(size=(24, self.NNZ)).astype(np.float32))},
+            n=24,
+        )
+        s.total_n = self.N
+        s.source_row_bytes = self.NNZ * 4.0
+        ls = Dataset.of(rng.normal(size=(24, self.K)).astype(np.float32))
+        return s, ls
+
+    def test_gram_selected_and_ranked_below_gather(self):
+        est = LeastSquaresEstimator(
+            lam=1e-3, hbm_bytes=16 << 30, num_machines=1
+        )
+        s, ls = self._sample()
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2) and inner.solver == "gram"
+        sparsity = self.NNZ / self.D
+        gather = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=20, solver="gather"
+        )
+        gram = SparseLBFGSwithL2(lam=1e-3, num_iterations=20, solver="gram")
+        c_gather = _cost_of(est, gather, self.N, self.D, self.K, sparsity)
+        c_gram = _cost_of(est, gram, self.N, self.D, self.K, sparsity)
+        assert c_gram < c_gather, (c_gram, c_gather)
+
+    def test_tpu_weight_magnitudes_land_near_measured(self):
+        """The TPU fit should PREDICT the two measured engine times within
+        a small factor, not just rank them: gather 7.903 s, gram 1.805 s
+        (n=500k row). Guards against weights that rank correctly by
+        accident while being orders of magnitude off."""
+        sparsity = self.NNZ / self.D
+        gather = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=20, solver="gather"
+        )
+        gram = SparseLBFGSwithL2(lam=1e-3, num_iterations=20, solver="gram")
+        cpu, mem, net = TPU_CPU_WEIGHT, TPU_MEM_WEIGHT, TPU_NETWORK_WEIGHT
+        c_gather = gather.cost(
+            self.N, self.D, self.K, sparsity, 1, cpu, mem, net
+        )
+        c_gram = gram.cost(self.N, self.D, self.K, sparsity, 1, cpu, mem, net)
+        assert 0.5 < c_gather / 7.903 < 2.0, c_gather
+        assert 0.5 < c_gram / 1.805 < 2.0, c_gram
+
+
+class TestWeightFamilySwitch:
+    def test_tpu_active_by_default(self, monkeypatch):
+        monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)
+        assert active_weights() == (
+            TPU_CPU_WEIGHT, TPU_MEM_WEIGHT, TPU_NETWORK_WEIGHT
+        )
+        assert sparse_gather_overhead() == 500.0
+        est = LeastSquaresEstimator(lam=0.1)
+        assert est.cpu_weight == TPU_CPU_WEIGHT
+        assert est.mem_weight == TPU_MEM_WEIGHT
+
+    def test_ec2_env_restores_reference_constants(self, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_COST_WEIGHTS", "ec2")
+        assert active_weights() == (
+            EC2_CPU_WEIGHT, EC2_MEM_WEIGHT, EC2_NETWORK_WEIGHT
+        )
+        assert sparse_gather_overhead() == 8.0
+        est = LeastSquaresEstimator(lam=0.1)
+        assert est.cpu_weight == EC2_CPU_WEIGHT
+
+    def test_explicit_weights_still_win(self, monkeypatch):
+        monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)
+        est = LeastSquaresEstimator(lam=0.1, cpu_weight=1.0, mem_weight=2.0)
+        assert est.cpu_weight == 1.0 and est.mem_weight == 2.0

@@ -4,6 +4,7 @@ final batch — the TPU-specific optimizer transform (one dispatch per chain,
 XLA fusing across old node boundaries, vs the reference's one Spark stage
 per node)."""
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -193,3 +194,104 @@ class TestStageFusionRule:
         assert fusable(NormalizeRows())
         assert fusable(MaxClassifier())
         assert not fusable(Cacher())
+
+
+class TestPackedFFTGather:
+    """ISSUE 3: the packed-pair FFT lowering must be equality-tested
+    against the per-branch composition it silently replaces, and its
+    ENGAGEMENT on the MNIST shape must be pinned (the bench row states
+    the packed program's flop/traffic model)."""
+
+    def _branches(self, nb, d_in, alphas=None):
+        from keystone_tpu.ops.stats import (
+            LinearRectifier,
+            PaddedFFT,
+            RandomSignNode,
+        )
+
+        return [
+            [
+                RandomSignNode.create(d_in, seed=i),
+                PaddedFFT(),
+                LinearRectifier(0.0, alpha=(alphas[i] if alphas else 0.0)),
+            ]
+            for i in range(nb)
+        ]
+
+    @pytest.mark.parametrize("nb,d_in", [(2, 100), (3, 48), (4, 784)])
+    def test_packed_matches_per_branch_composition(self, nb, d_in):
+        from keystone_tpu.ops.stats import packed_fft_gather_fn
+        from keystone_tpu.ops.util import VectorCombiner
+
+        branches = self._branches(nb, d_in, alphas=[0.1 * i for i in range(nb)])
+        fn = packed_fft_gather_fn(branches, VectorCombiner())
+        assert fn is not None
+        X = rng.normal(size=(16, d_in)).astype(np.float32)
+        out = np.asarray(fn(jnp.asarray(X)))
+        refs = []
+        for br in branches:
+            b = jnp.asarray(X)
+            for m in br:
+                b = m.device_fn()(b)
+            refs.append(np.asarray(b))
+        ref = np.concatenate(refs, axis=-1)
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    def test_fused_gather_engages_packed_path(self):
+        from keystone_tpu.ops.util import VectorCombiner
+        from keystone_tpu.workflow.fusion import FusedGatherTransformer
+
+        fg = FusedGatherTransformer(
+            self._branches(4, 64), VectorCombiner()
+        )
+        assert fg.uses_packed_fft
+        # And the engaged program still matches the per-branch math
+        # through the transformer's own batch path.
+        X = rng.normal(size=(8, 64)).astype(np.float32)
+        out = np.asarray(fg.batch_apply(Dataset.of(jnp.asarray(X))).array)
+        refs = []
+        for br in self._branches(4, 64):
+            b = jnp.asarray(X)
+            for m in br:
+                b = m.device_fn()(b)
+            refs.append(np.asarray(b))
+        np.testing.assert_allclose(
+            out, np.concatenate(refs, axis=-1), atol=1e-4
+        )
+
+    def test_non_matching_gather_falls_back(self):
+        from keystone_tpu.ops.stats import packed_fft_gather_fn
+        from keystone_tpu.ops.util import VectorCombiner
+        from keystone_tpu.workflow.fusion import FusedGatherTransformer
+
+        # Branch shape differs (no rectifier): recognizer must decline
+        # and the generic composition must serve.
+        branches = [
+            [m for m in br[:2]] for br in self._branches(2, 32)
+        ]
+        assert packed_fft_gather_fn(branches, VectorCombiner()) is None
+        fg = FusedGatherTransformer(branches, VectorCombiner())
+        assert not fg.uses_packed_fft
+        X = rng.normal(size=(4, 32)).astype(np.float32)
+        out = np.asarray(fg.batch_apply(Dataset.of(jnp.asarray(X))).array)
+        assert out.shape == (4, 2 * 16)  # two branches x (32-pad FFT)/2
+
+    def test_mnist_pipeline_gather_is_packed(self):
+        from keystone_tpu.pipelines.mnist_random_fft import (
+            MnistRandomFFTConfig,
+            build_featurizer,
+        )
+        from keystone_tpu.workflow.fusion import FusedGatherTransformer
+
+        cfg = MnistRandomFFTConfig(num_ffts=4, block_size=32, image_size=48)
+        pipe = build_featurizer(cfg)
+        X = rng.normal(size=(8, 48)).astype(np.float32)
+        handle = pipe.apply(Dataset.of(jnp.asarray(X)))
+        handle.get()
+        graph = handle.executor.optimized_graph
+        fgs = [
+            graph.get_operator(n) for n in graph.nodes
+            if isinstance(graph.get_operator(n), FusedGatherTransformer)
+        ]
+        assert fgs and all(fg.uses_packed_fft for fg in fgs)

@@ -324,3 +324,165 @@ class TestF64Preservation:
             np.testing.assert_allclose(
                 np.asarray(W_pl), np.asarray(W_ref), atol=1e-12
             )
+
+
+class TestGramCorrSymAcc:
+    """ISSUE 3 fused-kernel pinning: the one-kernel chunk step (syrk +
+    correlation accumulating through riding operands) against its unfused
+    composition, on the CPU interpreter."""
+
+    def test_matches_unfused_composition_f32(self):
+        n, d, k = 512, 1024, 3
+        F = rng.normal(size=(n, d)).astype(np.float32)
+        R = rng.normal(size=(n, k)).astype(np.float32)
+        G0 = rng.normal(size=(d, d)).astype(np.float32)
+        C0 = rng.normal(size=(d, k)).astype(np.float32)
+        assert po.gram_corr_acc_ok(jnp.asarray(F))
+        G1, C1 = po.gram_corr_sym_acc(G0, C0, F, R, interpret=True)
+        # Unfused composition: the accumulating gram-only kernel + an
+        # XLA FᵀR GEMM — the round-5 chunk step.
+        G_ref = po.gram_sym_acc(G0, F, interpret=True)
+        C_ref = C0 + F.T @ R
+        np.testing.assert_allclose(
+            np.triu(np.asarray(G1)), np.triu(np.asarray(G_ref)), atol=1e-3
+        )
+        np.testing.assert_allclose(np.asarray(C1), C_ref, atol=1e-3)
+
+    def test_matches_unfused_composition_bf16(self):
+        n, d, k = 512, 1024, 2
+        F32 = rng.normal(size=(n, d)).astype(np.float32)
+        F = jnp.asarray(F32, dtype=jnp.bfloat16)
+        R = rng.normal(size=(n, k)).astype(np.float32)
+        G0 = np.zeros((d, d), np.float32)
+        C0 = np.zeros((d, k), np.float32)
+        G1, C1 = po.gram_corr_sym_acc(G0, C0, F, R, interpret=True)
+        Fq = np.asarray(F, dtype=np.float32)  # the bf16 quantization
+        Rq = np.asarray(jnp.asarray(R).astype(jnp.bfloat16), np.float32)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(G1)), np.triu(Fq.T @ Fq), rtol=2e-2, atol=2e-1
+        )
+        np.testing.assert_allclose(
+            np.asarray(C1), Fq.T @ Rq, rtol=2e-2, atol=2e-1
+        )
+
+    def test_accumulates_across_chunks(self):
+        # Three folds through the fused kernel == one big unfused gram.
+        n, d, k = 512, 512, 2
+        chunks = [rng.normal(size=(n, d)).astype(np.float32) for _ in range(3)]
+        Rs = [rng.normal(size=(n, k)).astype(np.float32) for _ in range(3)]
+        G = jnp.zeros((d, d), jnp.float32)
+        C = jnp.zeros((d, k), jnp.float32)
+        for F, R in zip(chunks, Rs):
+            G, C = po.gram_corr_sym_acc(G, C, F, R, interpret=True)
+        F_all = np.concatenate(chunks)
+        R_all = np.concatenate(Rs)
+        np.testing.assert_allclose(
+            np.triu(np.asarray(G)), np.triu(F_all.T @ F_all), atol=5e-3
+        )
+        np.testing.assert_allclose(np.asarray(C), F_all.T @ R_all, atol=5e-3)
+
+    def test_fold_level_fused_matches_xla_fold(self):
+        # sparse_gram_fold with the fused kernel (use_pallas, interpret)
+        # against the pure-XLA fold — the composition the bench runs.
+        from keystone_tpu.ops.sparse import sparse_gram_stream
+
+        c, w, d, k, nchunks = 512, 9, 700, 3, 3
+        idx = jnp.asarray(
+            rng.integers(-1, d, size=(nchunks, c, w)).astype(np.int32)
+        )
+        val = jnp.asarray(
+            rng.normal(size=(nchunks, c, w)).astype(np.float32)
+        )
+        Y = jnp.asarray(rng.normal(size=(nchunks, c, k)).astype(np.float32))
+
+        def cf(cid):
+            return idx[cid], val[cid], Y[cid]
+
+        with force_interpret():
+            G_pl, A_pl, y_pl = sparse_gram_stream(
+                cf, nchunks, d, k, use_pallas=True
+            )
+        G_ref, A_ref, y_ref = sparse_gram_stream(
+            cf, nchunks, d, k, use_pallas=False
+        )
+        np.testing.assert_allclose(np.asarray(G_pl), np.asarray(G_ref),
+                                   atol=1e-3)
+        np.testing.assert_allclose(np.asarray(A_pl), np.asarray(A_ref),
+                                   atol=1e-3)
+
+    def test_pipelined_fold_bit_identical_to_serial(self):
+        from keystone_tpu.ops.sparse import sparse_gram_stream
+
+        c, w, d, k, nchunks = 256, 5, 300, 2, 4
+        idx = jnp.asarray(
+            rng.integers(-1, d, size=(nchunks, c, w)).astype(np.int32)
+        )
+        val = jnp.asarray(rng.normal(size=(nchunks, c, w)).astype(np.float32))
+        Y = jnp.asarray(rng.normal(size=(nchunks, c, k)).astype(np.float32))
+
+        def cf(cid):
+            return idx[cid], val[cid], Y[cid]
+
+        G1, A1, y1 = sparse_gram_stream(cf, nchunks, d, k, pipeline=False)
+        G2, A2, y2 = sparse_gram_stream(cf, nchunks, d, k, pipeline=True)
+        np.testing.assert_array_equal(np.asarray(G1), np.asarray(G2))
+        np.testing.assert_array_equal(np.asarray(A1), np.asarray(A2))
+        np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+
+
+class TestGaussianResidBlock:
+    """ISSUE 3 fused-kernel pinning: the KRR residual epilogue (kernel
+    block generated in VMEM, contracted into K_blockᵀW, never written)
+    against the unfused gaussian_kernel_block + GEMM composition."""
+
+    def test_matches_unfused_composition(self):
+        m, nb, d, k = 96, 40, 30, 5
+        X = rng.normal(size=(m, d)).astype(np.float32)
+        Y = rng.normal(size=(nb, d)).astype(np.float32)
+        W = rng.normal(size=(m, k)).astype(np.float32)
+        xn = (X**2).sum(1)
+        yn = (Y**2).sum(1)
+        resid = po.gaussian_resid_block(X, Y, xn, yn, W, 0.07, interpret=True)
+        K = po.gaussian_kernel_block(X, Y, xn, yn, 0.07, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(resid), np.asarray(K).T @ W, atol=1e-3
+        )
+
+    def test_ghost_w_rows_contribute_zero(self):
+        # The solver invariant the fused path relies on: W rows past the
+        # true train count are zero, so masking K's ghost rows is not
+        # needed — assert the unmasked fused result equals the masked
+        # unfused one.
+        m, nb, d, k, n_true = 64, 32, 16, 3, 50
+        X = rng.normal(size=(m, d)).astype(np.float32)
+        Y = rng.normal(size=(nb, d)).astype(np.float32)
+        W = rng.normal(size=(m, k)).astype(np.float32)
+        W[n_true:] = 0.0
+        xn = (X**2).sum(1)
+        yn = (Y**2).sum(1)
+        resid = po.gaussian_resid_block(X, Y, xn, yn, W, 0.3, interpret=True)
+        K = np.array(
+            po.gaussian_kernel_block(X, Y, xn, yn, 0.3, interpret=True)
+        )
+        K[n_true:] = 0.0  # the round-5 valid_row mask
+        np.testing.assert_allclose(np.asarray(resid), K.T @ W, atol=1e-3)
+
+    def test_krr_sweep_fused_matches_xla(self):
+        # The whole fused KRR sweep with the Pallas residual epilogue
+        # (interpret) against the XLA path — ragged final block included.
+        from keystone_tpu.ops.learning.kernel import _krr_fit_fused
+
+        n, d, k, bs, nb, n_train = 96, 20, 3, 32, 3, 90
+        X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
+        Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
+        order = jnp.asarray(np.tile(np.arange(nb, dtype=np.int32), 2))
+        _, ws_xla = _krr_fit_fused(
+            X, Y, order, 0.05, 1e-2, bs, n_train, nb, False
+        )
+        with force_interpret():
+            _, ws_pl = _krr_fit_fused(
+                X, Y, order, 0.05, 1e-2, bs, n_train, nb, True
+            )
+        np.testing.assert_allclose(
+            np.asarray(ws_pl), np.asarray(ws_xla), atol=2e-4
+        )

@@ -73,3 +73,64 @@ class TestTrace:
         for root, _, files in os.walk(d):
             found.extend(files)
         assert found, f"trace produced no files under {d}"
+
+
+class TestPrefetchOverlapFraction:
+    """ISSUE 3 satellite: the Prefetcher's achieved-overlap fraction is a
+    profiling-level primitive (one-run accounting), not bench-row ad-hoc
+    arithmetic."""
+
+    def _stats(self, load_s, wait_s, prefetched=True):
+        from keystone_tpu.data.prefetch import PrefetchStats
+
+        s = PrefetchStats()
+        s.load_s, s.wait_s, s.prefetched = load_s, wait_s, prefetched
+        return s
+
+    def test_fully_hidden_and_fully_exposed(self):
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(2.0, 0.0)
+        ) == 1.0
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(2.0, 2.0)
+        ) == 0.0
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(2.0, 0.5)
+        ) == 0.75
+
+    def test_clamped_and_degenerate(self):
+        # Waits can exceed loads (queue startup latency): clamp, don't go
+        # negative. No load time at all -> None (nothing to attribute).
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(1.0, 3.0)
+        ) == 0.0
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(0.0, 0.0)
+        ) is None
+
+    def test_serial_pass_reports_zero_not_one(self):
+        # A depth-0 serial pass records loads but never waits (they run
+        # inline on the consumer): that is ZERO overlap, not full.
+        assert profiling.prefetch_overlap_fraction(
+            self._stats(2.0, 0.0, prefetched=False)
+        ) == 0.0
+
+    def test_real_prefetcher_fills_the_flag(self):
+        import numpy as np
+
+        from keystone_tpu.data.prefetch import (
+            PrefetchStats,
+            iter_segments,
+            ResidentDenseSource,
+        )
+
+        X = np.ones((64, 4), np.float32)
+        Y = np.ones((64, 2), np.float32)
+        src = ResidentDenseSource(X, Y, tile_rows=8, tiles_per_segment=2)
+        on, off = PrefetchStats(), PrefetchStats()
+        list(iter_segments(src, prefetch_depth=2, stats=on))
+        list(iter_segments(src, prefetch_depth=0, stats=off))
+        assert on.prefetched and not off.prefetched
+        assert profiling.prefetch_overlap_fraction(off) == 0.0
+        frac = profiling.prefetch_overlap_fraction(on)
+        assert frac is None or 0.0 <= frac <= 1.0
