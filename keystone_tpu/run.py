@@ -4,6 +4,12 @@ pipeline class name and forwarding flags).
 
 Pipeline names accept the reference's fully-qualified class names
 (``keystoneml.pipelines.images.mnist.MnistRandomFFT``) or the bare name.
+
+``python -m keystone_tpu.run serve [--model fitted.pkl | --pipeline
+MnistRandomFFT] --rate 200 --duration-s 5`` starts the online serving
+path instead: export the fitted pipeline, run the deadline-aware
+micro-batch server under open-loop Poisson load, and print the p50/p99
+latency + throughput summary line (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -88,6 +94,108 @@ def _stupid_backoff(argv):
     stupid_backoff.main(argv)
 
 
+def _serve(argv):
+    """``--serve`` mode: load (or quick-fit) a pipeline, export the
+    serving plan, start the micro-batch server, drive it with open-loop
+    Poisson load, and print the percentile summary line (docs/serving.md).
+
+    ``python -m keystone_tpu.run serve --model fitted.pkl --input-dim 784``
+    serves a saved FittedPipeline; without ``--model`` it fits the named
+    ``--pipeline`` (MnistRandomFFT) on synthetic data first.
+    """
+    import argparse
+    import json
+
+    parser = argparse.ArgumentParser("keystone-serve")
+    parser.add_argument("--model", default="", help="FittedPipeline pickle")
+    parser.add_argument("--pipeline", default="MnistRandomFFT",
+                        help="pipeline to quick-fit when no --model is given")
+    parser.add_argument("--input-dim", type=int, default=784)
+    parser.add_argument("--numFFTs", type=int, default=4)
+    parser.add_argument("--blockSize", type=int, default=2048)
+    parser.add_argument("--fit-n", type=int, default=4096)
+    parser.add_argument("--max-batch", type=int, default=256)
+    parser.add_argument("--max-wait-ms", type=float, default=5.0)
+    parser.add_argument("--queue-depth", type=int, default=1024)
+    parser.add_argument("--rate", type=float, default=200.0,
+                        help="offered Poisson rate (requests/s)")
+    parser.add_argument("--duration-s", type=float, default=5.0)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    import numpy as np
+
+    from keystone_tpu.serving import (
+        MicroBatchServer,
+        export_plan,
+        run_open_loop,
+    )
+    from keystone_tpu.workflow.pipeline import FittedPipeline
+
+    if args.model:
+        fitted = FittedPipeline.load(args.model)
+        d_in = args.input_dim
+    elif args.pipeline.rsplit(".", 1)[-1] == "MnistRandomFFT":
+        import jax.numpy as jnp
+
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+        from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+        from keystone_tpu.pipelines.mnist_random_fft import (
+            MnistRandomFFTConfig,
+            build_featurizer,
+        )
+
+        d_in = args.input_dim
+        rng = np.random.default_rng(args.seed)
+        X = jnp.asarray(rng.normal(size=(args.fit_n, d_in)).astype(np.float32))
+        y = rng.integers(0, 10, size=args.fit_n)
+        labels = ClassLabelIndicatorsFromIntLabels(10)(
+            Dataset.of(jnp.asarray(y))
+        )
+        cfg = MnistRandomFFTConfig(
+            num_ffts=args.numFFTs, block_size=args.blockSize, image_size=d_in
+        )
+        fitted = build_featurizer(cfg).and_then(
+            BlockLeastSquaresEstimator(args.blockSize, 1, 1e-3),
+            Dataset.of(X), labels,
+        ).fit()
+    else:
+        raise SystemExit(
+            f"--serve quick-fit supports MnistRandomFFT (got "
+            f"{args.pipeline!r}); pass --model for anything else"
+        )
+
+    plan = export_plan(
+        fitted, np.zeros(d_in, np.float32), max_batch=args.max_batch
+    )
+    single_s = plan.measure_single_request_s()
+    rng = np.random.default_rng(args.seed + 1)
+    pool = rng.normal(size=(256, d_in)).astype(np.float32)
+
+    server = MicroBatchServer(
+        plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+    )
+    try:
+        report = run_open_loop(
+            server.submit, lambda i: pool[i % len(pool)],
+            rate_hz=args.rate, duration_s=args.duration_s, seed=args.seed,
+        )
+    finally:
+        server.close()
+    summary = report.to_row_dict()
+    summary.update({
+        "single_request_s": round(single_s, 6),
+        "buckets": plan.buckets,
+        "plan_compiled": plan.compiled,
+        "max_wait_ms": args.max_wait_ms,
+        "mean_pad_fraction": server.stats().get("mean_pad_fraction"),
+    })
+    print(json.dumps(summary))
+    return 0
+
+
 PIPELINES: Dict[str, Callable] = {
     "MnistRandomFFT": _mnist,
     "TimitPipeline": _timit,
@@ -137,6 +245,8 @@ def main(argv=None):
         return 0
     argv = _extract_host_budget(argv)
     _enable_compile_cache()
+    if argv[0] in ("serve", "--serve"):
+        return _serve(argv[1:])
     runner = resolve(argv[0])
     runner(argv[1:])
     return 0

@@ -1,0 +1,33 @@
+"""Online inference subsystem: exported apply plans + deadline-aware
+micro-batching + open-loop load tooling (docs/serving.md).
+
+The offline tiers fit pipelines and apply them to whole datasets; this
+package turns a :class:`~keystone_tpu.workflow.pipeline.FittedPipeline`
+into something that serves streams of single-datum requests:
+
+  - :func:`export_plan` / :class:`ExportedPlan` — apply-only subgraph,
+    re-run through the fusion optimizer, weights pinned device-resident,
+    pre-compiled at power-of-two padding buckets (warm path never traces).
+  - :class:`MicroBatchServer` — deadline-aware request coalescing on a
+    background worker thread, bounded queue with explicit
+    earliest-deadline load shedding, per-request spans, rolling p50/p99.
+  - :func:`run_open_loop` / :func:`closed_loop_qps` — Poisson load
+    generation and the batch-size-1 baseline the bench A/Bs against.
+"""
+
+from .batcher import MicroBatchServer, ServerClosed, ServerOverloaded
+from .export import BatchInfo, ExportedPlan, export_plan
+from .loadgen import LoadReport, closed_loop_qps, poisson_arrivals, run_open_loop
+
+__all__ = [
+    "BatchInfo",
+    "ExportedPlan",
+    "LoadReport",
+    "MicroBatchServer",
+    "ServerClosed",
+    "ServerOverloaded",
+    "closed_loop_qps",
+    "export_plan",
+    "poisson_arrivals",
+    "run_open_loop",
+]

@@ -1,0 +1,316 @@
+"""Export a FittedPipeline as an online-serving apply plan.
+
+The offline world applies a fitted pipeline to whole datasets; serving
+applies it to streams of single datums under a latency budget. The export
+step does everything expensive ONCE, ahead of traffic:
+
+  1. **Apply-only subgraph.** A :class:`FittedPipeline` is already the
+     apply-only subgraph of the fitted DAG — every estimator was executed
+     at ``fit()`` time and replaced by its fitted transformer. Export
+     re-validates that invariant (``TransformerGraph.from_graph``) so a
+     hand-built graph smuggling an ``EstimatorOperator`` or
+     ``DelegatingOperator`` fails at export, not mid-request.
+  2. **Optimizer reuse.** The existing whole-pipeline fusion passes
+     (StageFusionRule, GatherFusionRule — workflow/fusion.py) run on the
+     apply graph. Chains the offline fit never fused (the model node and
+     anything downstream of it were DelegatingOperators during
+     optimization) collapse here: the MNIST plan becomes ONE program —
+     packed-FFT featurize → flat GEMM → argmax.
+  3. **Weight pinning.** Operator device arrays are ``jax.device_put``
+     onto the serving device so the warm path never re-uploads weights.
+  4. **Bucketed pre-compilation.** The composed apply function is
+     AOT-compiled at a fixed set of padding buckets (powers of two up to
+     ``max_batch``), keyed by bucket shape. Warm-path requests NEVER
+     trigger a trace: the micro-batcher pads each coalesced batch to the
+     smallest bucket that fits and calls a pre-built executable. The
+     ``trace_count`` counter makes that property testable.
+
+Pipelines that do not compose to a pure array function (host stages,
+multi-input combiners fusion could not collapse) still export: the plan
+falls back to per-node batch execution (``compiled == False``) — slower,
+but the batching/padding/shedding machinery above it is identical.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.workflow.graph import Graph, SinkId, SourceId
+from keystone_tpu.workflow.pipeline import (
+    FittedPipeline,
+    TransformerGraph,
+    compose_apply_fn,
+)
+
+__all__ = ["BatchInfo", "ExportedPlan", "export_plan"]
+
+
+def _default_buckets(max_batch: int) -> List[int]:
+    """Powers of two up to (and including) max_batch, starting at TWO; a
+    non-power-of-two max_batch becomes the final bucket so the full batch
+    size is always reachable.
+
+    Bucket 1 is deliberately absent: XLA lowers some kernels (CPU FFT
+    among them, measured) through a different codepath at batch 1,
+    producing last-ulp differences against every other batch size — one
+    bucket-1 dispatch would break the served-vs-offline bit-identity
+    contract. A singleton request pads to 2 (one wasted row) and stays
+    bitwise faithful; pass explicit ``buckets`` to reclaim that row for
+    a pipeline measured stable at batch 1."""
+    if max_batch < 1:
+        raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+    if max_batch == 1:
+        return [1]
+    buckets = []
+    b = 2
+    while b < max_batch:
+        buckets.append(b)
+        b <<= 1
+    buckets.append(max_batch)
+    return buckets
+
+
+def _pin_operator_arrays(graph: Graph) -> int:
+    """Pin every operator's device arrays onto the default serving device
+    (committed placement — the warm path never re-uploads weights).
+    Conservative by design: only jax.Array attributes (and lists of them,
+    the BlockLinearMapper.xs shape) are touched; host-side numpy state is
+    left alone so host-path operators keep their numpy semantics. Returns
+    the pinned byte count. Runs BEFORE the plan composes/captures any
+    closures so the pinned arrays are the ones the program embeds."""
+    from keystone_tpu.workflow.fusion import fused_members
+
+    device = jax.devices()[0]
+    pinned = 0
+    seen = set()
+    for node in graph.nodes:
+        for op in fused_members(graph.get_operator(node)) + [
+            graph.get_operator(node)
+        ]:
+            if id(op) in seen or not hasattr(op, "__dict__"):
+                continue
+            seen.add(id(op))
+            for k, v in list(op.__dict__.items()):
+                try:
+                    if isinstance(v, jax.Array):
+                        object.__setattr__(op, k, jax.device_put(v, device))
+                        pinned += v.size * v.dtype.itemsize
+                    elif isinstance(v, list) and v and all(
+                        isinstance(a, jax.Array) for a in v
+                    ):
+                        object.__setattr__(
+                            op, k, [jax.device_put(a, device) for a in v]
+                        )
+                        pinned += sum(a.size * a.dtype.itemsize for a in v)
+                except Exception:
+                    continue  # an unpinnable attr never blocks export
+    return pinned
+
+
+@dataclass(frozen=True)
+class BatchInfo:
+    """How one coalesced batch actually ran."""
+
+    batch_size: int
+    bucket: int
+    pad_fraction: float
+
+
+class ExportedPlan:
+    """A fitted pipeline frozen for online serving.
+
+    Thread contract: ``apply_batch`` is intended to be called from ONE
+    thread (the micro-batcher's worker owns all device interaction —
+    the same single-JAX-thread discipline as data/prefetch.py); the
+    read-only metadata (buckets, trace_count) is safe to read anywhere.
+    """
+
+    def __init__(
+        self,
+        graph: Graph,
+        source: SourceId,
+        sink: SinkId,
+        example: Any,
+        max_batch: int = 256,
+        buckets: Optional[Sequence[int]] = None,
+        precompile: bool = True,
+        pin_weights: bool = True,
+    ):
+        self.graph = graph
+        self.source = source
+        self.sink = sink
+        ex = np.asarray(example)
+        self.item_shape = tuple(ex.shape)
+        self.dtype = jnp.asarray(ex).dtype
+        self.max_batch = int(max_batch)
+        self.buckets = sorted(set(
+            int(b) for b in (buckets or _default_buckets(self.max_batch))
+        ))
+        if self.buckets[-1] != self.max_batch:
+            raise ValueError(
+                f"largest bucket {self.buckets[-1]} != max_batch "
+                f"{self.max_batch} — the full batch size must be reachable"
+            )
+        self.pinned_bytes = _pin_operator_arrays(graph) if pin_weights else 0
+
+        self._trace_count = 0
+        self._trace_lock = threading.Lock()
+        composed = compose_apply_fn(graph, source, sink)
+        self.compiled = composed is not None
+        self._executables: Dict[int, Any] = {}
+        if self.compiled:
+            def counted(X):
+                # Executes only while TRACING (the jitted body is python
+                # once per shape) — the warm-path-never-traces test pin.
+                with self._trace_lock:
+                    self._trace_count += 1
+                return composed(X)
+
+            self._jit = jax.jit(counted)
+            if precompile:
+                for b in self.buckets:
+                    spec = jax.ShapeDtypeStruct(
+                        (b,) + self.item_shape, self.dtype
+                    )
+                    self._executables[b] = self._jit.lower(spec).compile()
+        else:
+            self._jit = None
+            self._fallback = FittedPipeline(graph, source, sink)
+
+    @property
+    def trace_count(self) -> int:
+        return self._trace_count
+
+    def bucket_for(self, m: int) -> int:
+        """Smallest pre-compiled bucket that fits m rows."""
+        if m < 1 or m > self.max_batch:
+            raise ValueError(
+                f"batch of {m} outside [1, max_batch={self.max_batch}]"
+            )
+        for b in self.buckets:
+            if b >= m:
+                return b
+        return self.buckets[-1]  # unreachable given the checks above
+
+    def _pad(self, X: np.ndarray, bucket: int) -> np.ndarray:
+        if X.shape[0] == bucket:
+            return X
+        pad = np.zeros((bucket - X.shape[0],) + self.item_shape, X.dtype)
+        return np.concatenate([X, pad], axis=0)
+
+    def _eager_apply(self, Xp: np.ndarray, m: int) -> np.ndarray:
+        """Per-node fallback for non-composable plans: the canonical
+        FittedPipeline batch walk over the (re-fused) serving graph —
+        not a re-implementation, so the two paths can't drift. ``n=m``
+        marks the padding rows so row-masking operators keep them
+        zeroed."""
+        out = self._fallback.apply(Dataset(jnp.asarray(Xp), n=m))
+        return np.asarray(out.array if isinstance(out, Dataset) else out)
+
+    def apply_padded(self, Xp) -> np.ndarray:
+        """Run one bucket-shaped batch (padding rows included) and return
+        the full padded output as numpy (the conversion is the execution
+        barrier)."""
+        bucket = int(np.shape(Xp)[0])
+        if self.compiled:
+            executable = self._executables.get(bucket)
+            Xd = jnp.asarray(Xp, self.dtype)
+            if executable is not None:
+                return np.asarray(executable(Xd))
+            return np.asarray(self._jit(Xd))  # un-bucketed shape: traces
+        return np.asarray(self._eager_apply(np.asarray(Xp), bucket))
+
+    def apply_batch(self, items) -> np.ndarray:
+        out, _ = self.apply_batch_info(items)
+        return out
+
+    def apply_batch_info(self, items):
+        """Serve ``m`` datums: stack, pad to the smallest fitting bucket,
+        run the pre-compiled program, mask the padding rows off the
+        response. Returns ``(outputs[:m], BatchInfo)``."""
+        X = np.stack([np.asarray(x) for x in items]).astype(
+            np.dtype(self.dtype), copy=False
+        )
+        m = X.shape[0]
+        bucket = self.bucket_for(m)
+        if self.compiled:
+            out = self.apply_padded(self._pad(X, bucket))
+        else:
+            out = self._eager_apply(self._pad(X, bucket), m)
+        info = BatchInfo(
+            batch_size=m, bucket=bucket, pad_fraction=(bucket - m) / bucket
+        )
+        return out[:m], info
+
+    def measure_single_request_s(self, reps: int = 10) -> float:
+        """Warm min-of-N wall of a bucket-1 request — the single-request
+        device+dispatch time the serving bench's p99 acceptance gate is
+        stated against."""
+        import time
+
+        x = np.zeros(self.item_shape, np.dtype(self.dtype))
+        self.apply_batch([x])  # warm (pre-compiled, but page in everything)
+        best = float("inf")
+        for _ in range(max(reps, 1)):
+            t0 = time.perf_counter()
+            self.apply_batch([x])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+
+def export_plan(
+    fitted: FittedPipeline,
+    example_input: Any,
+    max_batch: int = 256,
+    buckets: Optional[Sequence[int]] = None,
+    precompile: bool = True,
+    pin_weights: bool = True,
+) -> ExportedPlan:
+    """Freeze a :class:`FittedPipeline` into an :class:`ExportedPlan`.
+
+    ``example_input`` fixes the per-request shape/dtype every bucket is
+    compiled at (a single datum, e.g. one ``(784,)`` image row).
+
+    NOTE: the plan's graph SHARES operator objects with ``fitted``, and
+    ``pin_weights=True`` (the default) commits their device arrays to the
+    serving device in place — export freezes the pipeline FOR serving.
+    Keep using the same fitted object for placement-sensitive offline
+    work on other devices only with ``pin_weights=False``.
+    """
+    if not isinstance(fitted, FittedPipeline):
+        raise TypeError(
+            f"export_plan needs a FittedPipeline (got {type(fitted).__name__});"
+            " call .fit() first — serving never runs estimator fits"
+        )
+    # Re-validate the transformer-only invariant: estimator state must be
+    # frozen (no fit_datasets operator can execute at request time).
+    graph = TransformerGraph.from_graph(fitted.transformer_graph)
+
+    # Reuse the offline optimizer's fusion passes on the apply-only graph.
+    # The fit-time optimization couldn't fuse across the (then-unfitted)
+    # delegating nodes; here the model IS a transformer and the chain
+    # collapses. Prefixes are empty: an exported plan materializes nothing
+    # for cross-pipeline reuse — it exists to be a single program.
+    from keystone_tpu.workflow.fusion import GatherFusionRule, StageFusionRule
+
+    plan_graph: Graph = graph
+    for rule in (StageFusionRule(), GatherFusionRule(), StageFusionRule()):
+        plan_graph, _ = rule.apply(plan_graph, {})
+
+    return ExportedPlan(
+        plan_graph,
+        fitted.source,
+        fitted.sink,
+        example_input,
+        max_batch=max_batch,
+        buckets=buckets,
+        precompile=precompile,
+        pin_weights=pin_weights,
+    )

@@ -16,15 +16,21 @@ ad-hoc per-phase nanosecond logs inside solvers (KernelRidgeRegression.scala:
   - ``prefetch_overlap_fraction`` — the achieved ingestion-overlap share
     of a prefetched streamed fit, from its
     :class:`~keystone_tpu.data.prefetch.PrefetchStats`.
+  - ``RequestSpan`` / ``SpanLog`` — per-request serving spans (queue wait /
+    pad fraction / execution time) recorded by the online micro-batcher
+    (:mod:`keystone_tpu.serving.batcher`), bounded so a long-lived server
+    never grows its profiling state without limit.
 """
 
 from __future__ import annotations
 
 import contextlib
 import logging
+import threading
 import time
-from collections import OrderedDict
-from typing import Any, Dict, Optional
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
 
 import jax
 
@@ -96,6 +102,79 @@ def prefetch_overlap_fraction(stats) -> Optional[float]:
         return 0.0
     wait_s = float(getattr(stats, "wait_s", 0.0) or 0.0)
     return min(max((load_s - wait_s) / load_s, 0.0), 1.0)
+
+
+@dataclass(frozen=True)
+class RequestSpan:
+    """Where one served request's latency went (the serving analog of a
+    PhaseTimer breakdown): ``queue_wait_s`` is time spent queued before
+    its batch dispatched, ``exec_s`` the batch's execution wall (shared
+    by every request coalesced into it), ``batch_size`` the real
+    requests in the batch, ``bucket`` the padded shape it ran at, and
+    ``pad_fraction`` the share of bucket rows that were padding — the
+    amortization price the micro-batcher paid for a warm compile-cache
+    hit."""
+
+    queue_wait_s: float
+    exec_s: float
+    batch_size: int
+    bucket: int
+    pad_fraction: float
+
+
+class SpanLog:
+    """Bounded, thread-safe log of :class:`RequestSpan` records.
+
+    The micro-batcher records one span per request from its worker
+    thread while ``stats()`` readers snapshot from submitter threads;
+    the lock keeps the snapshot consistent and ``maxlen`` bounds a
+    long-lived server's profiling memory."""
+
+    def __init__(self, maxlen: int = 4096):
+        self._spans: "deque[RequestSpan]" = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def record(self, span: RequestSpan) -> None:
+        with self._lock:
+            self._spans.append(span)
+
+    def snapshot(self) -> List[RequestSpan]:
+        with self._lock:
+            return list(self._spans)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def summary(self) -> Dict[str, float]:
+        """Mean queue wait / exec / pad fraction over the retained window
+        (empty dict when nothing has been served)."""
+        spans = self.snapshot()
+        if not spans:
+            return {}
+        n = float(len(spans))
+        return {
+            "num_spans": len(spans),
+            "mean_queue_wait_s": sum(s.queue_wait_s for s in spans) / n,
+            "mean_exec_s": sum(s.exec_s for s in spans) / n,
+            "mean_batch_size": sum(s.batch_size for s in spans) / n,
+            "mean_pad_fraction": sum(s.pad_fraction for s in spans) / n,
+        }
+
+
+def latency_percentiles(
+    latencies_s: Sequence[float], qs: Sequence[float] = (50.0, 99.0)
+) -> Optional[Dict[str, float]]:
+    """p-th percentile latencies in SECONDS keyed ``p50``/``p99``/...;
+    None for an empty sample (a server that has completed nothing has no
+    percentiles — callers must not report zeros as measurements)."""
+    import numpy as np
+
+    if not len(latencies_s):
+        return None
+    arr = np.asarray(list(latencies_s), dtype=np.float64)
+    return {f"p{int(q) if float(q).is_integer() else q}": float(v)
+            for q, v in zip(qs, np.percentile(arr, list(qs)))}
 
 
 @contextlib.contextmanager

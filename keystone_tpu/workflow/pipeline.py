@@ -229,6 +229,49 @@ class Pipeline(Chainable[A, B]):
 # ---------------------------------------------------------------------------
 
 
+def compose_apply_fn(
+    graph: Graph, source: SourceId, sink: SinkId
+) -> Optional[Callable]:
+    """Compose a transformer graph into ONE pure batched array function
+    ``X -> Y``, or None when the graph is not expressible as one.
+
+    Requirements: every node on the sink's ancestry declares a
+    ``device_fn`` and takes exactly one input, and ``source`` is the only
+    unbound source. After the fusion rules have run, linear pipelines —
+    including gather trees, which GatherFusionRule collapses to a single
+    node — satisfy this; anything host-side or multi-input does not and
+    the caller keeps the per-node execution path.
+
+    Shared by the per-datum apply fast path (one compiled executable per
+    input shape instead of an eager op-by-op walk) and by
+    :mod:`keystone_tpu.serving.export`'s bucketed plan compiler.
+    """
+    from . import analysis
+
+    steps = []
+    for gid in analysis.linearize(graph, sink):
+        if gid == source or isinstance(gid, SinkId):
+            continue
+        if isinstance(gid, SourceId):
+            return None  # a second unbound source — not a pure X -> Y map
+        op = graph.get_operator(gid)
+        fn_getter = getattr(op, "device_fn", None)
+        fn = fn_getter() if callable(fn_getter) else None
+        deps = graph.get_dependencies(gid)
+        if fn is None or len(deps) != 1:
+            return None
+        steps.append((gid, fn, deps[0]))
+    final = graph.get_sink_dependency(sink)
+
+    def composed(X):
+        values = {source: X}
+        for gid, fn, dep in steps:
+            values[gid] = fn(values[dep])
+        return values[final]
+
+    return composed
+
+
 class TransformerGraph(Graph):
     """A Graph whose every operator is a TransformerOperator — the
     serializable transformer-only restriction backing FittedPipeline
@@ -256,10 +299,76 @@ class FittedPipeline(Generic[A, B]):
     Java-serializable FittedPipeline (FittedPipeline.scala:12-48).
     """
 
+    # Per-process cap on cached per-shape datum executables: a client
+    # sweeping many input shapes must not retain one program per shape.
+    _DATUM_PROGRAM_CACHE_MAX = 16
+
     def __init__(self, graph: Graph, source: SourceId, sink: SinkId):
         self.transformer_graph = graph
         self.source = source
         self.sink = sink
+        self._init_datum_cache()
+
+    def _init_datum_cache(self) -> None:
+        # (shape, dtype) -> jitted single-datum program; _batched_fn is
+        # the graph's composed batch function (False = "checked, not
+        # composable" so the walk only ever happens once). The lock makes
+        # concurrent apply(datum) callers safe: cache insertion/eviction
+        # would otherwise race (dict pop during iteration) exactly in the
+        # threaded-serving setting this PR exists for.
+        import threading
+
+        self._datum_programs: Dict[tuple, Any] = {}
+        self._batched_fn: Any = None
+        self._datum_lock = threading.Lock()
+
+    # Jitted closures are not picklable; FittedPipeline.save() pickles the
+    # whole object, so the compile caches rebuild lazily after load (same
+    # contract as the fused transformers' __getstate__).
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        state.pop("_datum_programs", None)
+        state.pop("_batched_fn", None)
+        state.pop("_datum_lock", None)
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._init_datum_cache()
+
+    def _datum_program(self, x) -> Optional[Callable]:
+        """One compiled executable per input (shape, dtype) for the
+        single-datum serve path.
+
+        Repeated ``apply(datum)`` calls previously walked the graph
+        op-by-op, dispatching each node's eager ops every call; now the
+        first call with a given shape traces ONE program (the composed
+        batched function at batch 1) and later calls reuse the compiled
+        executable — no re-trace, no per-node dispatch waves. Returns
+        None (caller keeps the per-node path) for pipelines that don't
+        compose to a pure array function.
+        """
+        if not hasattr(x, "shape") or not hasattr(x, "dtype"):
+            return None
+        with self._datum_lock:
+            if self._batched_fn is None:
+                self._batched_fn = (
+                    compose_apply_fn(
+                        self.transformer_graph, self.source, self.sink
+                    )
+                    or False
+                )
+            if self._batched_fn is False:
+                return None
+            key = (tuple(x.shape), str(x.dtype))
+            program = self._datum_programs.get(key)
+            if program is None:
+                batched = self._batched_fn
+                program = jax.jit(lambda v: batched(v[None])[0])
+                if len(self._datum_programs) >= self._DATUM_PROGRAM_CACHE_MAX:
+                    self._datum_programs.pop(next(iter(self._datum_programs)))
+                self._datum_programs[key] = program
+            return program
 
     def apply(self, data: Any) -> Any:
         from . import analysis
@@ -267,6 +376,11 @@ class FittedPipeline(Generic[A, B]):
         is_dataset = isinstance(data, (Dataset, PipelineDataset))
         if isinstance(data, (PipelineDataset, PipelineDatum)):
             data = data.get()
+
+        if not is_dataset and not isinstance(data, Dataset):
+            program = self._datum_program(data)
+            if program is not None:
+                return program(data)
 
         values: Dict[GraphId, Any] = {self.source: data}
         for gid in analysis.linearize(self.transformer_graph, self.sink):

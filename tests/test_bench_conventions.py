@@ -26,7 +26,7 @@ class TestMakeRow:
         bench = _load_bench()
         assert bench.VALID_TIMING == {
             "min_of_N_warm", "single_run_cold", "single_run_warm",
-            "host_only",
+            "host_only", "open_loop_latency",
         }
 
     def test_row_carries_timing_in_detail(self):
@@ -100,6 +100,14 @@ class TestEveryMetricUsesMakeRow:
         main_body = src[src.index("def main("):]
         assert "outofcore_prefetch_metric," in main_body
 
+    def test_serving_row_registered(self):
+        bench = _load_bench()
+        assert callable(bench.serving_mnist_metric)
+        with open(_BENCH_PATH) as f:
+            src = f.read()
+        main_body = src[src.index("def main("):]
+        assert "serving_mnist_metric," in main_body
+
 
 class TestRooflineAuditability:
     """ISSUE 3 satellite: every row claiming an ``mfu`` or achieved-GB/s
@@ -157,6 +165,42 @@ class TestRooflineAuditability:
             }}
             with pytest.raises(ValueError, match=pat):
                 bench.make_row("m", 1.0, "x", None, "min_of_N_warm", d)
+
+    def test_latency_percentiles_require_samples_and_offered_rate(self):
+        """ISSUE 4 satellite: a latency row claiming percentiles must
+        carry its sample count AND the offered rate in the same dict —
+        a p99 with no n and no arrival schedule is not a measurement."""
+        bench = _load_bench()
+        good = {
+            "p50_latency_ms": 3.1, "p99_latency_ms": 9.7,
+            "num_samples": 1450, "offered_rate_hz": 300.0,
+        }
+        row = bench.make_row(
+            "m", 0.0097, "s", 4.0, "open_loop_latency", good
+        )
+        assert row["detail"]["p99_latency_ms"] == 9.7
+        for missing, pat in (
+            ("num_samples", "num_samples"),
+            ("offered_rate_hz", "offered"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row("m", 0.0097, "s", 4.0, "open_loop_latency", d)
+        # A prose offered_* field must NOT satisfy the rule — the rate
+        # has to be a number.
+        d = dict(good)
+        d.pop("offered_rate_hz")
+        d["offered_note"] = "about 300/s give or take"
+        with pytest.raises(ValueError, match="numeric offered"):
+            bench.make_row("m", 0.0097, "s", 4.0, "open_loop_latency", d)
+
+    def test_nested_latency_claims_validated_too(self):
+        bench = _load_bench()
+        nested = {"rates": [{"p99_latency_ms": 5.0, "num_samples": 10}]}
+        with pytest.raises(ValueError, match="offered"):
+            bench.make_row("m", 1.0, "s", None, "open_loop_latency", nested)
+        nested["rates"][0]["offered_rate_hz"] = 100.0
+        bench.make_row("m", 1.0, "s", None, "open_loop_latency", nested)
 
     def test_mnist_row_carries_hbm_claim_fields(self):
         # The MNIST row must state achieved HBM GB/s beside chip peak at

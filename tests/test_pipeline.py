@@ -265,3 +265,66 @@ class TestBatchApplyDefault:
 
         out = PlusOne().batch_apply(Dataset.of([1.0, 2.0]))
         assert [float(v) for v in out.to_list()] == [2.0, 3.0]
+
+
+class TestDatumApplyCompileCache:
+    """ISSUE 4 satellite: repeated single-datum FittedPipeline.apply calls
+    with the same shape reuse ONE compiled executable — the trace-counter
+    fixture pins the compile count."""
+
+    def _fitted_chain(self, counter):
+        from keystone_tpu.workflow.pipeline import (
+            FittedPipeline,
+            TransformerGraph,
+        )
+
+        pipe = counter.to_pipeline()
+        return FittedPipeline(
+            TransformerGraph.from_graph(pipe.executor.graph),
+            pipe.source,
+            pipe.sink,
+        )
+
+    def test_same_shape_compiles_once(self):
+        from tests._serving_util import TraceCountingScale
+
+        t = TraceCountingScale()
+        fitted = self._fitted_chain(t)
+        x = np.arange(6, dtype=np.float32)
+        outs = [np.asarray(fitted.apply(x + i)) for i in range(4)]
+        assert t.traces == 1, "same-shape datum applies re-traced"
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o, (x + i) * 2.0)
+
+    def test_new_shape_compiles_again_and_caps(self):
+        from tests._serving_util import TraceCountingScale
+
+        t = TraceCountingScale()
+        fitted = self._fitted_chain(t)
+        fitted.apply(np.zeros(3, np.float32))
+        fitted.apply(np.zeros(5, np.float32))
+        fitted.apply(np.zeros(3, np.float32))  # cache hit
+        assert t.traces == 2
+
+    def test_non_traceable_pipeline_keeps_per_node_path(self):
+        class HostOnly(Transformer):
+            def apply(self, x):
+                return np.asarray(x) + 1.0
+
+        fitted = self._fitted_chain(HostOnly())
+        out = fitted.apply(np.zeros(4, np.float32))
+        np.testing.assert_array_equal(np.asarray(out), np.ones(4))
+
+    def test_save_load_drops_and_rebuilds_datum_cache(self, tmp_path):
+        from tests._serving_util import TraceCountingScale
+
+        t = TraceCountingScale()
+        fitted = self._fitted_chain(t)
+        fitted.apply(np.zeros(4, np.float32))
+        path = str(tmp_path / "fitted.pkl")
+        fitted.save(path)
+        from keystone_tpu.workflow.pipeline import FittedPipeline
+
+        loaded = FittedPipeline.load(path)
+        out = loaded.apply(np.ones(4, np.float32))
+        np.testing.assert_array_equal(np.asarray(out), np.ones(4) * 2.0)

@@ -1,0 +1,290 @@
+"""Micro-batcher behavior (ISSUE 4 tentpole + satellites): bit-identity
+of the served path vs offline apply under any bucket interleaving,
+explicit overload shedding, clean shutdown mid-load (mirrors
+tests/test_prefetch.py's shutdown coverage), and error re-raise to the
+submitter."""
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.serving import (
+    MicroBatchServer,
+    ServerClosed,
+    ServerOverloaded,
+    export_plan,
+    run_open_loop,
+)
+from keystone_tpu.workflow import Transformer
+
+from tests._serving_util import (
+    TINY_D_IN,
+    fit_tiny_mnist,
+    fitted_from_transformer,
+)
+
+
+class GatedScale(Transformer):
+    """Device-less x -> 3x whose batch path blocks on an Event — gives
+    the tests deterministic control over when the worker is busy."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.batches = 0
+
+    def apply(self, x):
+        return jnp.asarray(x) * 3.0
+
+    def batch_apply(self, ds):
+        self.gate.wait(timeout=10.0)
+        self.batches += 1
+        return Dataset(jnp.asarray(ds.array) * 3.0, n=ds.n)
+
+
+def _gated_server(**kw):
+    op = GatedScale()
+    plan = export_plan(
+        fitted_from_transformer(op), np.zeros(4, np.float32), max_batch=8
+    )
+    assert not plan.compiled  # the gated op keeps the eager path
+    return op, MicroBatchServer(plan, **kw)
+
+
+class TestBitIdentity:
+    def test_served_equals_offline_any_interleaving(self):
+        """For a fixed request set, served outputs — whatever bucket sizes
+        the batcher happened to coalesce, padding masked — equal offline
+        FittedPipeline.apply on the concatenated batch, bit for bit."""
+        fitted, _ = fit_tiny_mnist()
+        plan = export_plan(
+            fitted, np.zeros(TINY_D_IN, np.float32), max_batch=8
+        )
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(37, TINY_D_IN)).astype(np.float32)
+        offline = np.asarray(fitted.apply(Dataset.of(jnp.asarray(X))).array)
+
+        server = MicroBatchServer(plan, max_batch=8, max_wait_ms=1.0)
+        try:
+            futures = []
+            for i in range(len(X)):
+                futures.append(server.submit(X[i]))
+                if i % 7 == 3:
+                    time.sleep(0.003)  # stagger arrivals: varied buckets
+            served = np.stack([f.result(timeout=30) for f in futures])
+        finally:
+            server.close()
+        np.testing.assert_array_equal(served, offline)
+        # The interleaving genuinely exercised more than one bucket.
+        buckets = {s.bucket for s in server.span_log.snapshot()}
+        assert len(buckets) >= 2, buckets
+
+    def test_spans_and_stats_populated(self):
+        fitted, _ = fit_tiny_mnist()
+        plan = export_plan(
+            fitted, np.zeros(TINY_D_IN, np.float32), max_batch=4
+        )
+        with MicroBatchServer(plan, max_wait_ms=1.0) as server:
+            futs = [server.submit(np.zeros(TINY_D_IN, np.float32))
+                    for _ in range(9)]
+            for f in futs:
+                f.result(timeout=30)
+            stats = server.stats()
+        assert stats["completed"] == 9
+        assert stats["num_latency_samples"] == 9
+        assert stats["p99_latency_s"] >= stats["p50_latency_s"] > 0.0
+        assert 0.0 <= stats["mean_pad_fraction"] < 1.0
+        span = server.span_log.snapshot()[0]
+        assert span.queue_wait_s >= 0.0 and span.exec_s > 0.0
+        assert span.bucket >= span.batch_size
+
+
+class TestOverload:
+    def test_bounded_queue_sheds_explicitly_and_inflight_completes(self):
+        op, server = _gated_server(
+            max_batch=4, max_wait_ms=0.0, max_queue_depth=4
+        )
+        op.gate.clear()  # worker blocks inside the first batch
+        try:
+            first = server.submit(np.ones(4, np.float32))
+            time.sleep(0.05)  # let the worker pick it up
+            futs = [server.submit(np.ones(4, np.float32) * i)
+                    for i in range(12)]
+            op.gate.set()
+            outcomes = {"ok": 0, "shed": 0}
+            for f in [first] + futs:
+                try:
+                    f.result(timeout=10)
+                    outcomes["ok"] += 1
+                except ServerOverloaded:
+                    outcomes["shed"] += 1
+        finally:
+            server.close()
+        # Nothing silently dropped: every future resolved one way.
+        assert outcomes["ok"] + outcomes["shed"] == 13
+        assert outcomes["shed"] > 0  # the bounded queue genuinely shed
+        assert outcomes["ok"] >= 5  # in-flight + queue-depth worth served
+        assert server.stats()["rejected"] == outcomes["shed"]
+
+    def test_earliest_deadline_is_the_shedding_victim(self):
+        op, server = _gated_server(
+            max_batch=2, max_wait_ms=0.0, max_queue_depth=2
+        )
+        op.gate.clear()
+        try:
+            blocker = server.submit(np.ones(4, np.float32))
+            time.sleep(0.05)  # worker now busy; queue empty
+            f_tight = server.submit(np.ones(4, np.float32), deadline_ms=50.0)
+            f_loose = server.submit(np.ones(4, np.float32), deadline_ms=1e6)
+            # Queue full; a new tighter-deadline request is itself the
+            # earliest-deadline victim -> rejected synchronously.
+            with pytest.raises(ServerOverloaded):
+                server.submit(np.ones(4, np.float32), deadline_ms=1.0)
+            # A new LOOSER-deadline request evicts the tightest queued one.
+            f_new = server.submit(np.ones(4, np.float32))
+            with pytest.raises(ServerOverloaded):
+                f_tight.result(timeout=5)
+            op.gate.set()
+            blocker.result(timeout=10)
+            f_loose.result(timeout=10)
+            f_new.result(timeout=10)
+        finally:
+            server.close()
+        assert server.stats()["rejected"] == 2
+
+
+class TestShutdown:
+    def test_shutdown_midload_no_deadlock_no_thread_leak(self):
+        op, server = _gated_server(
+            max_batch=4, max_wait_ms=0.0, max_queue_depth=64
+        )
+        op.gate.clear()
+        inflight = server.submit(np.ones(4, np.float32))
+        time.sleep(0.05)
+        queued = [server.submit(np.ones(4, np.float32) * i) for i in range(10)]
+        op.gate.set()
+        t0 = time.perf_counter()
+        server.close(timeout=10.0)
+        assert time.perf_counter() - t0 < 10.0
+        assert not server.is_alive
+        assert not any(
+            t.name == "keystone-serving-batcher" for t in threading.enumerate()
+        )
+        # In-flight completed; queued-but-unstarted failed EXPLICITLY.
+        np.testing.assert_array_equal(
+            np.asarray(inflight.result(timeout=1)), np.ones(4) * 3.0
+        )
+        for f in queued:
+            with pytest.raises(ServerClosed):
+                f.result(timeout=1)
+
+    def test_submit_after_close_raises(self):
+        _, server = _gated_server()
+        server.close()
+        with pytest.raises(ServerClosed):
+            server.submit(np.zeros(4, np.float32))
+
+    def test_close_is_idempotent(self):
+        _, server = _gated_server()
+        server.close()
+        server.close()
+        assert not server.is_alive
+
+
+class TestRobustness:
+    def test_client_cancelled_future_does_not_kill_worker(self):
+        # A cancelled future rejects set_result with InvalidStateError;
+        # unguarded, that would kill the worker and hang every later
+        # request forever.
+        op, server = _gated_server(max_batch=4, max_wait_ms=0.0)
+        op.gate.clear()
+        try:
+            blocker = server.submit(np.ones(4, np.float32))
+            time.sleep(0.05)
+            doomed = server.submit(np.ones(4, np.float32))
+            assert doomed.cancel()
+            op.gate.set()
+            blocker.result(timeout=10)
+            # The worker survived the cancelled future: new requests serve.
+            out = server.submit(np.ones(4, np.float32)).result(timeout=10)
+            np.testing.assert_array_equal(np.asarray(out), np.ones(4) * 3.0)
+            assert server.is_alive
+        finally:
+            server.close()
+
+    def test_nonpositive_max_batch_rejected_at_build(self):
+        op = GatedScale()
+        plan = export_plan(
+            fitted_from_transformer(op), np.zeros(4, np.float32), max_batch=8
+        )
+        with pytest.raises(ValueError, match="max_batch"):
+            MicroBatchServer(plan, max_batch=0)
+        with pytest.raises(ValueError, match="max_batch"):
+            MicroBatchServer(plan, max_batch=-1)
+
+
+class TestErrors:
+    def test_plan_error_reraises_in_submitter_and_server_survives(self):
+        class Exploding(Transformer):
+            def __init__(self):
+                self.arm = True
+
+            def apply(self, x):
+                return x
+
+            def batch_apply(self, ds):
+                if self.arm:
+                    raise ValueError("kernel went sideways")
+                return ds
+
+        op = Exploding()
+        plan = export_plan(
+            fitted_from_transformer(op), np.zeros(4, np.float32), max_batch=4
+        )
+        server = MicroBatchServer(plan, max_wait_ms=0.0)
+        try:
+            with pytest.raises(ValueError, match="sideways"):
+                server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            assert server.is_alive  # a batch failure never kills the worker
+            op.arm = False
+            server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            assert server.stats()["failed"] == 1
+        finally:
+            server.close()
+
+
+@pytest.mark.slow
+class TestOpenLoopPoisson:
+    """Poisson load smoke (slow tier: real sleeps over a multi-second
+    window — tier-1 wall time must not pay for it)."""
+
+    def test_open_loop_report_fields_and_batching_wins(self):
+        fitted, _ = fit_tiny_mnist()
+        plan = export_plan(
+            fitted, np.zeros(TINY_D_IN, np.float32), max_batch=32
+        )
+        rng = np.random.default_rng(5)
+        pool = rng.normal(size=(64, TINY_D_IN)).astype(np.float32)
+        server = MicroBatchServer(plan, max_batch=32, max_wait_ms=2.0,
+                                  max_queue_depth=4096)
+        try:
+            report = run_open_loop(
+                server.submit, lambda i: pool[i % 64],
+                rate_hz=300.0, duration_s=2.0, seed=7,
+            )
+            stats = server.stats()
+        finally:
+            server.close()
+        assert report.completed > 100
+        assert report.failed == 0
+        assert report.p99_latency_s >= report.p50_latency_s > 0.0
+        assert report.achieved_qps > 0.0
+        d = report.to_row_dict()
+        assert d["num_samples"] == report.completed
+        assert d["offered_rate_hz"] == 300.0
+        # Under offered load the batcher genuinely coalesced.
+        assert stats["mean_batch_size"] > 1.0
