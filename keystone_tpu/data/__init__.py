@@ -1,7 +1,9 @@
 """Data plane: the Dataset abstraction, data loaders, and the out-of-core
-shard/prefetch tier (disk-backed Datasets streamed through the solvers)."""
+shard/prefetch tier (disk-backed Datasets streamed through the solvers) —
+checksummed, atomically written, and retry-wrapped (docs/reliability.md)."""
 
 from .dataset import Dataset, LabeledData, one_hot_pm1
+from .durable import CheckpointSpec, ShardCorrupted
 from .prefetch import (
     COOShardSource,
     DenseShardSource,
@@ -16,8 +18,10 @@ from .prefetch import (
 from .shards import DiskCOOShards, DiskDenseShards, DiskDenseShardWriter
 
 __all__ = [
+    "CheckpointSpec",
     "Dataset",
     "LabeledData",
+    "ShardCorrupted",
     "one_hot_pm1",
     "ShardSource",
     "DenseShardSource",

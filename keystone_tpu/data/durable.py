@@ -1,0 +1,490 @@
+"""Durable on-disk state: checksummed atomic writes + fold checkpoints.
+
+The disk tier (shards.py) replaced Spark's lineage-backed RDDs with raw
+``.npy`` files — and raw files have raw failure modes: a killed writer
+leaves a directory that *parses* as a valid-but-short dataset, and a
+bit flip feeds garbage straight into an hours-long fit. This module is
+the shared substrate both shard formats and the fit checkpoints build
+on:
+
+  - **Atomic metadata**: :func:`atomic_write_json` writes to a temp name
+    in the same directory, fsyncs, then ``os.replace``\\ s — a reader
+    either sees the old meta, no meta, or the complete new meta, never a
+    torn one. Writers order *meta last*, so the presence of meta implies
+    the arrays it describes were fully written and flushed.
+  - **Checksums**: CRC32C when a ``crc32c`` module is available in the
+    environment, else zlib's CRC32 (C-speed; the container has no
+    crc32c wheel and nothing may be installed). The algorithm actually
+    used is recorded next to every digest, so readers verify with the
+    writer's algorithm — mixed environments interoperate.
+  - **Fold checkpoints**: :class:`CheckpointSpec` + save/load of a
+    streamed fit's carry (Gram/correlation accumulators + segment
+    cursor), bit-exact: arrays round-trip as raw bytes with dtype/shape
+    manifest, so a resumed fit folds the *identical* f32 state the
+    interrupted run held — the bit-identity contract
+    tests/test_chaos.py proves under injected kills.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import zlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "CheckpointSpec",
+    "ShardCorrupted",
+    "atomic_write_json",
+    "checksum_algo",
+    "crc_of_array",
+    "fingerprint_token",
+    "fsync_file",
+    "resolve_checkpoint",
+    "source_fingerprint",
+]
+
+
+class ShardCorrupted(RuntimeError):
+    """On-disk bytes failed checksum verification (torn write, bit flip,
+    or injected corruption). Deliberately NOT an OSError: corruption is
+    persistent state — the retry layer must never spin on it, and no
+    caller may silently fold the data."""
+
+
+try:  # pragma: no cover - container has no crc32c wheel
+    import crc32c as _crc32c_mod
+
+    def _crc(data, value: int = 0) -> int:
+        return _crc32c_mod.crc32c(data, value)
+
+    _ALGO = "crc32c"
+except ImportError:
+    def _crc(data, value: int = 0) -> int:
+        return zlib.crc32(data, value) & 0xFFFFFFFF
+
+    _ALGO = "crc32"
+
+
+def checksum_algo() -> str:
+    """The digest algorithm this process WRITES ("crc32c" when the
+    optional module exists, else "crc32"). Readers always verify with
+    the algorithm recorded in the metadata being read."""
+    return _ALGO
+
+
+def _crc_named(algo: str):
+    if algo == _ALGO:
+        return _crc
+    if algo == "crc32":
+        return lambda data, value=0: zlib.crc32(data, value) & 0xFFFFFFFF
+    if algo == "crc32c":
+        raise ShardCorrupted(
+            "metadata was written with crc32c but no crc32c module is "
+            "available to verify it"
+        )
+    raise ShardCorrupted(f"unknown checksum algorithm {algo!r}")
+
+
+def crc_of_array(arr: np.ndarray, algo: Optional[str] = None) -> int:
+    """Digest of an array's raw bytes (C-order copy if needed)."""
+    fn = _crc if algo is None else _crc_named(algo)
+    return fn(np.ascontiguousarray(arr).view(np.uint8).reshape(-1).data)
+
+
+
+
+def verify_array(
+    arr: np.ndarray, expected: int, algo: str, what: str
+) -> None:
+    got = crc_of_array(arr, algo)
+    if got != int(expected):
+        raise ShardCorrupted(
+            f"{what}: checksum mismatch ({algo} {got:#010x} != recorded "
+            f"{int(expected):#010x}) — torn write or bit corruption; "
+            f"re-ingest the shard directory"
+        )
+
+
+def fsync_file(path: str) -> None:
+    """Flush a file's contents to stable storage (best-effort on
+    filesystems that reject fsync, e.g. some overlayfs tmp mounts)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
+    except OSError:  # pragma: no cover - fs-dependent
+        pass
+
+
+def atomic_write_json(path: str, obj: Any) -> None:
+    """Write JSON so ``path`` is either absent, the old content, or the
+    complete new content — never torn. Temp file in the same directory
+    (os.replace must not cross filesystems), fsync'd before the rename,
+    directory fsync'd after so the rename itself is durable."""
+    directory = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(
+        prefix=os.path.basename(path) + ".tmp.", dir=directory
+    )
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    try:  # pragma: no cover - fs-dependent
+        dfd = os.open(directory, os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Fit checkpoints
+# ---------------------------------------------------------------------------
+
+_CKPT_META = "checkpoint.json"
+_CKPT_DATA = "carry.bin"
+
+
+class CheckpointSpec:
+    """Where and how often a streamed fit snapshots its fold carry.
+
+    ``directory`` holds at most one checkpoint PER FIT: snapshots are
+    namespaced by a digest of the fit's fingerprint (``fit-<digest>/``
+    subdirectories), so one global ``--checkpoint-dir`` serves a
+    pipeline with several segmented streamed fits — fit A's snapshots
+    and clears never clobber fit B's. Within a fit only the latest
+    snapshot is kept (the carry is cumulative, so older snapshots are
+    strictly dominated). ``every_segments`` is the snapshot cadence K.
+    Snapshot cost is one device→host sync of the carry plus an atomic
+    file write, so the steady-state overhead is ~(carry_bytes /
+    disk_rate) per K segments — the ``recovery_overhead`` bench row
+    measures it at the default K.
+
+    A checkpoint records a caller-built *fingerprint* (fit kind, segment
+    count, featurizer identity + parameter digests, source identity);
+    :meth:`load` returns None when the fingerprint does not match, so a
+    stale checkpoint from a different fit — including the same geometry
+    under a different feature bank or a re-ingested shard directory —
+    can never leak its accumulators into this one. (Resident operands
+    are fingerprinted by shape/dtype only: digesting gigabytes of live
+    arrays per snapshot would dwarf the snapshot itself; disk sources
+    are covered through their recorded per-tile checksums.)
+    """
+
+    def __init__(self, directory: str, every_segments: int = 8):
+        if every_segments < 1:
+            raise ValueError(
+                f"every_segments must be >= 1, got {every_segments}"
+            )
+        self.directory = str(directory)
+        self.every_segments = int(every_segments)
+
+    def _fit_dir(self, fingerprint: Dict[str, Any]) -> str:
+        """The fingerprint-digest subdirectory this fit's snapshot lives
+        in — the namespacing that lets several fits share one
+        ``--checkpoint-dir`` without clobbering each other."""
+        canonical = json.dumps(fingerprint, sort_keys=True).encode()
+        return os.path.join(self.directory, f"fit-{_crc(canonical):08x}")
+
+    # -- save --------------------------------------------------------------
+
+    def save(
+        self,
+        arrays: Sequence[np.ndarray],
+        cursor: int,
+        fingerprint: Dict[str, Any],
+    ) -> None:
+        """Atomically snapshot (arrays, cursor). The data file is
+        VERSIONED per cursor (``carry-<cursor>.bin``) and the meta —
+        written last, atomically — names the file it describes: a kill
+        at ANY point (including between the data write and the meta
+        write, where a fixed data name would pair old meta with new
+        bytes) leaves either the previous complete checkpoint or the
+        new one, never a meta describing the wrong data. Superseded
+        data files are deleted only after the new meta is durable."""
+        fit_dir = self._fit_dir(fingerprint)
+        os.makedirs(fit_dir, exist_ok=True)
+        arrays = [np.asarray(a) for a in arrays]
+        manifest: List[Dict[str, Any]] = []
+        offset = 0
+        data_name = f"carry-{int(cursor)}.bin"
+        data_path = os.path.join(fit_dir, data_name)
+        fd, tmp = tempfile.mkstemp(prefix=data_name + ".tmp.",
+                                   dir=fit_dir)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                for i, a in enumerate(arrays):
+                    raw = np.ascontiguousarray(a).tobytes()
+                    f.write(raw)
+                    manifest.append({
+                        "index": i,
+                        "dtype": str(a.dtype),
+                        "shape": list(a.shape),
+                        "offset": offset,
+                        "nbytes": len(raw),
+                        "crc": _crc(raw),
+                    })
+                    offset += len(raw)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, data_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        atomic_write_json(
+            os.path.join(fit_dir, _CKPT_META),
+            {
+                "cursor": int(cursor),
+                "algo": _ALGO,
+                "data": data_name,
+                "fingerprint": fingerprint,
+                "arrays": manifest,
+            },
+        )
+        # The new meta is durable: earlier snapshots' data files are now
+        # unreachable — reclaim them.
+        for name in self._data_files(fit_dir):
+            if name != data_name:
+                try:
+                    os.unlink(os.path.join(fit_dir, name))
+                except OSError:
+                    pass
+
+    @staticmethod
+    def _data_files(fit_dir: str) -> List[str]:
+        try:
+            entries = os.listdir(fit_dir)
+        except OSError:
+            return []
+        return [
+            e for e in entries
+            if (e == _CKPT_DATA
+                or (e.startswith("carry-") and e.endswith(".bin")))
+        ]
+
+    # -- load --------------------------------------------------------------
+
+    def load(
+        self, fingerprint: Dict[str, Any]
+    ) -> Optional[Tuple[List[np.ndarray], int]]:
+        """(carry arrays, next segment cursor) from the latest snapshot,
+        or None when no checkpoint exists or its fingerprint belongs to
+        a different fit (the namespaced directory makes a mismatch a
+        digest collision — still checked). Corrupt data raises
+        :class:`ShardCorrupted` — a bad checkpoint must never silently
+        seed a fresh-looking fit."""
+        fit_dir = self._fit_dir(fingerprint)
+        meta_path = os.path.join(fit_dir, _CKPT_META)
+        if not os.path.exists(meta_path):
+            return None
+        with open(meta_path) as f:
+            meta = json.load(f)
+        if meta.get("fingerprint") != fingerprint:
+            return None
+        crc_fn = _crc_named(meta.get("algo", "crc32"))
+        arrays: List[np.ndarray] = []
+        data_name = meta.get("data", _CKPT_DATA)  # legacy fixed name
+        with open(os.path.join(fit_dir, data_name), "rb") as f:
+            blob = f.read()
+        for ent in meta["arrays"]:
+            raw = blob[ent["offset"]: ent["offset"] + ent["nbytes"]]
+            if len(raw) != ent["nbytes"] or crc_fn(raw) != ent["crc"]:
+                raise ShardCorrupted(
+                    f"checkpoint array {ent['index']} in "
+                    f"{fit_dir}: checksum mismatch — discard the "
+                    f"checkpoint directory and restart the fit"
+                )
+            arrays.append(
+                np.frombuffer(raw, dtype=_resolve_dtype(ent["dtype"]))
+                .reshape(ent["shape"])
+            )
+        return arrays, int(meta["cursor"])
+
+    def restore(
+        self, fingerprint: Dict[str, Any]
+    ) -> Tuple[Optional[List[np.ndarray]], int]:
+        """(carry arrays, start segment) — (None, 0) when there is
+        nothing (matching) to resume from. The shared entry point of
+        both streamed solvers, so resume semantics cannot drift apart."""
+        loaded = self.load(fingerprint)
+        if loaded is None:
+            return None, 0
+        return loaded
+
+    def maybe_save(
+        self,
+        arrays: Sequence[Any],
+        segment: int,
+        num_segments: int,
+        fingerprint: Dict[str, Any],
+    ) -> bool:
+        """Shared snapshot cadence of the streamed solvers: after
+        ``segment``, snapshot when the every-K boundary hits and it is
+        not the final segment (a completed fit clears instead of
+        snapshotting). ``np.asarray`` here is the device sync — the
+        snapshot captures exactly the post-segment carry a resumed run
+        restores. Returns whether a snapshot was written."""
+        if (
+            (segment + 1) % self.every_segments != 0
+            or (segment + 1) >= num_segments
+        ):
+            return False
+        self.save([np.asarray(a) for a in arrays], segment + 1, fingerprint)
+        return True
+
+    def has_snapshot(
+        self, fingerprint: Optional[Dict[str, Any]] = None
+    ) -> bool:
+        """Whether a snapshot exists — for ``fingerprint``'s fit, or for
+        ANY fit in the directory when None (the drill/test probe)."""
+        if fingerprint is not None:
+            return os.path.exists(
+                os.path.join(self._fit_dir(fingerprint), _CKPT_META)
+            )
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            return False
+        return any(
+            os.path.exists(os.path.join(self.directory, e, _CKPT_META))
+            for e in entries if e.startswith("fit-")
+        )
+
+    def clear(self, fingerprint: Optional[Dict[str, Any]] = None) -> None:
+        """Remove ``fingerprint``'s snapshot (called after a successful
+        fit so a later fit with the same fingerprint starts fresh) —
+        ONLY that fit's: other fits sharing the directory keep theirs.
+        With no fingerprint, every fit's snapshot is removed."""
+        if fingerprint is not None:
+            dirs = [self._fit_dir(fingerprint)]
+        else:
+            try:
+                dirs = [
+                    os.path.join(self.directory, e)
+                    for e in os.listdir(self.directory)
+                    if e.startswith("fit-")
+                ]
+            except OSError:
+                dirs = []
+        for d in dirs:
+            for name in [_CKPT_META] + self._data_files(d):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
+            try:
+                os.rmdir(d)
+            except OSError:
+                pass
+
+
+def fingerprint_token(x: Any) -> Any:
+    """A JSON-safe, address-free identity token for fingerprint fields:
+    scalars pass through, sequences tokenize elementwise, callables
+    become ``module.qualname`` (``repr`` would embed a memory address
+    and never match across processes), arrays become a
+    shape/dtype/content-CRC triple, and anything else degrades to its
+    type name."""
+    if x is None or isinstance(x, (bool, int, float, str)):
+        return x
+    if isinstance(x, (list, tuple)):
+        return [fingerprint_token(v) for v in x]
+    if callable(x):
+        mod = getattr(x, "__module__", "?")
+        qn = getattr(x, "__qualname__", type(x).__name__)
+        return f"{mod}.{qn}"
+    try:
+        arr = np.asarray(x)
+        if arr.dtype == object:
+            return type(x).__name__
+        return {
+            "shape": list(arr.shape),
+            "dtype": str(arr.dtype),
+            "crc": int(crc_of_array(arr)),
+        }
+    except Exception:
+        return type(x).__name__
+
+
+def _shards_behind(obj: Any, depth: int = 0):
+    """The Disk*Shards object a segment source is a view over, through
+    any of the documented source forms: the shards object itself, a
+    ShardSource wrapper (``.shards``), a field view (``.paired``), or a
+    BOUND METHOD like ``shards.segment_source`` (``__self__`` — the
+    legacy callable form the solvers also accept)."""
+    if obj is None or depth > 4:
+        return None
+    if hasattr(obj, "_checksums") and hasattr(obj, "directory"):
+        return obj
+    for attr in ("shards", "paired", "__self__"):
+        found = _shards_behind(getattr(obj, attr, None), depth + 1)
+        if found is not None:
+            return found
+    return None
+
+
+def source_fingerprint(source: Any) -> Optional[Dict[str, Any]]:
+    """Identity of a segment source's backing data, for checkpoint
+    fingerprints: the shard directory plus a digest of its recorded
+    per-tile checksums — a content fingerprint that costs nothing
+    (the CRCs were computed at write time), so a re-ingested directory
+    with different rows of the same geometry never matches a stale
+    snapshot. Resolves every documented source form, including the
+    bound-method ``shards.segment_source`` callable; None only for
+    sources with no disk shards behind them."""
+    shards = _shards_behind(source)
+    if shards is None:
+        return None
+    sums = getattr(shards, "_checksums", None)
+    return {
+        "directory": getattr(shards, "directory", None),
+        "checksums_crc": (
+            None if sums is None
+            else int(_crc(repr(sorted(sums.items())).encode()))
+        ),
+    }
+
+
+def _resolve_dtype(name: str) -> np.dtype:
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; carries bfloat16 etc.
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def resolve_checkpoint(checkpoint) -> Optional[CheckpointSpec]:
+    """Normalize a streamed fit's ``checkpoint`` argument: a
+    CheckpointSpec passes through, a string becomes a spec at the
+    default cadence, and None consults ``KEYSTONE_CHECKPOINT_DIR`` (the
+    ``run.py --checkpoint-dir`` wiring) — unset means no checkpointing,
+    exactly the pre-reliability behavior."""
+    if checkpoint is None:
+        env = os.environ.get("KEYSTONE_CHECKPOINT_DIR", "").strip()
+        if not env:
+            return None
+        every = int(os.environ.get("KEYSTONE_CHECKPOINT_EVERY", "8"))
+        return CheckpointSpec(env, every_segments=every)
+    if isinstance(checkpoint, str):
+        return CheckpointSpec(checkpoint)
+    return checkpoint

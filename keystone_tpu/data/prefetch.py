@@ -35,6 +35,8 @@ from typing import Any, Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu.utils import faults
+
 
 class ShardSource:
     """Ordered segments of ready host buffers feeding a streamed fold.
@@ -54,6 +56,13 @@ class ShardSource:
 
     num_segments: int
     n_true: int
+
+    # True when load() already retries transient IO internally (the
+    # disk-shard views — shards.py's RetryPolicy at the shard.load
+    # site). The Prefetcher then does NOT wrap load in its own retry:
+    # nesting two policies would multiply attempts and compound backoff
+    # (a dead disk would cost attempts² reads before surfacing).
+    load_retries_transients: bool = False
 
     def load(self, s: int):
         raise NotImplementedError
@@ -82,6 +91,8 @@ class DenseShardSource(ShardSource):
     ``load(s) -> (X_seg (T, tile_rows, d_in), Y_seg (T, tile_rows, k),
     valid_rows)`` — exactly the ``segment_source`` contract of
     ``streaming_bcd_fit_segments``."""
+
+    load_retries_transients = True  # shards.py retries at shard.load
 
     def __init__(self, shards):
         self.shards = shards
@@ -140,6 +151,8 @@ class DenseShardView(ShardSource):
     set of disk files. ``load(s)`` returns the (seg_rows, width) slice of
     the field; the paired (X, Y, valid) form the solvers fold lives on
     ``.paired`` (the underlying :class:`DenseShardSource`)."""
+
+    load_retries_transients = True  # shards.py retries at shard.load
 
     def __init__(self, paired: DenseShardSource, field: str):
         if field not in ("x", "y"):
@@ -246,6 +259,8 @@ class PairedDenseSource(ShardSource):
     array sliced per segment (labels usually fit host RAM even when rows
     don't)."""
 
+    load_retries_transients = True  # shards.py retries at shard.load
+
     def __init__(self, data_view: DenseShardView, labels=None):
         if data_view.field != "x":
             # A y-view as "data" would silently fit labels against labels.
@@ -311,6 +326,8 @@ class COOShardSource(ShardSource):
     [s·cps, (s+1)·cps) — the per-segment operand contract of
     ``run_lbfgs_gram_streamed(segment_source=...)``."""
 
+    load_retries_transients = True  # shards.py retries at shard.load
+
     def __init__(self, shards, chunks_per_segment: int):
         self.shards = shards
         self.chunks_per_segment = int(chunks_per_segment)
@@ -362,13 +379,21 @@ class PrefetchStats:
     ``wait_s`` sums time the CONSUMER blocked waiting on the queue
     (latency the prefetch failed to hide). ``prefetched`` records whether
     a background reader actually ran — a serial (depth-0) pass fills
-    load_s with no waits, which must read as zero overlap, not full."""
+    load_s with no waits, which must read as zero overlap, not full.
+
+    Reliability counters (docs/reliability.md, surfaced through
+    ``utils.profiling.prefetch_retry_counters``): ``retries`` counts
+    transient read failures the reader recovered from, ``backoff_s``
+    sums the backoff it slept — nonzero values mean the fit SUCCEEDED
+    over flaky IO and say how much wall that cost."""
 
     def __init__(self):
         self.load_s = 0.0
         self.wait_s = 0.0
         self.segments = 0
         self.prefetched = False
+        self.retries = 0
+        self.backoff_s = 0.0
 
 
 class _ReaderDone:
@@ -386,15 +411,26 @@ class Prefetcher:
     the consuming loop, via the context manager or generator finalizer)
     stops the reader before it loads further segments. Reader exceptions
     re-raise in the consumer at the segment that failed.
+
+    Transient read failures (``OSError``) retry on the reader thread
+    with bounded exponential backoff (``retry_policy``, default
+    :func:`keystone_tpu.utils.faults.default_retry_policy`): a single
+    flaky IO no longer kills an hours-long fit. Exhaustion re-raises
+    consumer-side exactly as an unretried error would; retry/backoff
+    totals accumulate into :class:`PrefetchStats`. The ``prefetch.read``
+    fault site fires once per load ATTEMPT, so chaos tests can place
+    errors under and past the retry budget deterministically.
     """
 
     def __init__(self, source: ShardSource, depth: int = 2,
-                 stats: Optional[PrefetchStats] = None):
+                 stats: Optional[PrefetchStats] = None,
+                 retry_policy=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.source = source
         self.depth = int(depth)
         self.stats = stats if stats is not None else PrefetchStats()
+        self.retry_policy = retry_policy or faults.default_retry_policy()
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -402,15 +438,42 @@ class Prefetcher:
 
     # -- reader side -------------------------------------------------------
 
+    def _load_with_retry(self, s: int):
+        def on_retry(_attempt, delay_s, _exc):
+            self.stats.retries += 1
+            self.stats.backoff_s += delay_s
+
+        if getattr(self.source, "load_retries_transients", False):
+            # The shard layer already owns disk retries (shard.load
+            # site); wrapping load() again would multiply attempts and
+            # compound backoff on a genuinely dead disk. The outer
+            # policy then covers only this site's own injected faults.
+            self.retry_policy.call(
+                lambda: faults.maybe_fail(faults.SITE_PREFETCH_READ),
+                key=f"prefetch:{s}", on_retry=on_retry,
+            )
+            return self.source.load(s)
+
+        def attempt():
+            faults.maybe_fail(faults.SITE_PREFETCH_READ)
+            return self.source.load(s)
+
+        return self.retry_policy.call(
+            attempt, key=f"prefetch:{s}", on_retry=on_retry
+        )
+
     def _reader(self):
         try:
-            for s in range(self.source.num_segments):
-                if self._stop.is_set():
-                    return
-                t0 = time.perf_counter()
-                payload = self.source.load(s)
-                self.stats.load_s += time.perf_counter() - t0
-                self._put((s, payload))
+            # Lower layers' retries (the shard classes' RetryPolicy)
+            # report into THIS fit's stats for the thread's lifetime.
+            with faults.observing_retries(self.stats):
+                for s in range(self.source.num_segments):
+                    if self._stop.is_set():
+                        return
+                    t0 = time.perf_counter()
+                    payload = self._load_with_retry(s)
+                    self.stats.load_s += time.perf_counter() - t0
+                    self._put((s, payload))
             self._put(_ReaderDone())
         except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
             self._put(e)
@@ -482,6 +545,15 @@ class Prefetcher:
                 pass
             self._thread.join(timeout=10.0)
             self._thread = None
+            # A put already blocked when the stop flag went up may have
+            # landed one more payload AFTER the drain above — release it
+            # too, or its staging buffer lives until the prefetcher is
+            # garbage-collected (found by the depth>1 shutdown test).
+            try:
+                while True:
+                    self._queue.get_nowait()
+            except queue.Empty:
+                pass
 
 
 def iter_segments(
@@ -489,28 +561,50 @@ def iter_segments(
     num_segments: Optional[int] = None,
     prefetch_depth: int = 2,
     stats: Optional[PrefetchStats] = None,
+    start: int = 0,
 ) -> Iterator[Tuple[int, Any]]:
     """Uniform segment iteration for the streamed folds: ``source`` is a
     :class:`ShardSource` or a plain ``load_fn(s)`` callable (then
     ``num_segments`` is required). ``prefetch_depth >= 1`` runs the
     double-buffered background reader; ``0`` loads serially on the
     consumer thread (the prefetch-off A/B leg — identical order and
-    payloads by construction)."""
+    payloads by construction). ``start`` skips the first segments and
+    yields ABSOLUTE ids from ``start`` on — the checkpoint-resume entry
+    point: a resumed fold sees exactly the segment stream the
+    interrupted run had left."""
     if not is_shard_source(source):
         if num_segments is None:
             raise ValueError("callable segment sources need num_segments")
         source = FunctionSource(source, num_segments)
     elif num_segments is not None and num_segments < source.num_segments:
         # An explicit cap folds a PREFIX of the source (partial-fold
-        # callers); the wrapped loads stay thread-safe for prefetch.
-        source = FunctionSource(source.load, num_segments, source.n_true)
+        # callers); the wrapped loads stay thread-safe for prefetch —
+        # and the rebox must carry the retry-ownership flag, or the
+        # Prefetcher would nest a second policy over shard loads.
+        inner = source
+        source = FunctionSource(inner.load, num_segments, inner.n_true)
+        source.load_retries_transients = inner.load_retries_transients
+    if start:
+        if start >= source.num_segments:
+            return
+        base = source
+        source = FunctionSource(
+            lambda s: base.load(s + start),
+            base.num_segments - start, base.n_true,
+        )
+        source.load_retries_transients = base.load_retries_transients
     if prefetch_depth and source.num_segments > 1:
-        yield from Prefetcher(source, depth=prefetch_depth, stats=stats)
+        for s, payload in Prefetcher(source, depth=prefetch_depth,
+                                     stats=stats):
+            yield s + start, payload
         return
     for s in range(source.num_segments):
         t0 = time.perf_counter()
-        payload = source.load(s)
         if stats is not None:
+            with faults.observing_retries(stats):
+                payload = source.load(s)
             stats.load_s += time.perf_counter() - t0
             stats.segments += 1
-        yield s, payload
+        else:
+            payload = source.load(s)
+        yield s + start, payload

@@ -10,18 +10,93 @@ memory-mapped, and feed the segmented Gramian folds one SEGMENT at a time
 (``run_lbfgs_gram_streamed(segment_source=...)``) — peak host residency
 is the mmap page cache (OS-evictable) plus ``seg`` chunks of copy buffer,
 regardless of dataset size.
+
+Durability contract (docs/reliability.md): the reference inherited fault
+tolerance from Spark lineage; raw ``.npy`` files inherit nothing, so the
+formats here carry it explicitly —
+
+  - **Meta is written last, atomically** (temp name + ``os.replace``,
+    arrays fsync'd first): a killed writer leaves a directory with no
+    (or the previous) metadata, never one that parses as a
+    valid-but-short dataset. Writers also DELETE stale metadata before
+    touching array files, so re-ingesting over an old directory can't
+    resurrect the old meta against new partial arrays.
+  - **Per-tile/chunk checksums** ride in the metadata and are verified
+    on every ``segment_source`` read: torn or bit-flipped bytes raise
+    :class:`~keystone_tpu.data.durable.ShardCorrupted` instead of
+    feeding garbage into a fit. Directories written before this scheme
+    (no ``checksums`` key) still load, unverified.
+  - **Retrying reads**: transient ``OSError`` during a segment read is
+    retried with bounded exponential backoff
+    (:class:`~keystone_tpu.utils.faults.RetryPolicy`); exhaustion
+    re-raises exactly as before. The ``shard.load`` fault site
+    (:mod:`keystone_tpu.utils.faults`) makes both paths chaos-testable.
 """
 
 from __future__ import annotations
 
 import json
 import os
-from typing import Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu.data.durable import (
+    ShardCorrupted,
+    atomic_write_json,
+    checksum_algo,
+    crc_of_array,
+    fsync_file,
+    verify_array,
+)
+from keystone_tpu.utils import faults
+
 _META = "shards.json"
 _FILES = {"indices": "indices.npy", "values": "values.npy", "labels": "labels.npy"}
+
+
+def _chunk_checksums(arr, num: int) -> List[int]:
+    """Per-leading-index digests of ``arr[:num]`` (one CRC per chunk or
+    tile — the verification granularity of a segment read)."""
+    return [int(crc_of_array(arr[i])) for i in range(num)]
+
+
+def _read_verified(arr, lo: int, hi: int, *, what: str, key: str,
+                   checksums: Optional[List[int]], algo: str,
+                   retry) -> np.ndarray:
+    """THE durable read protocol, shared by both shard formats: copy
+    units [lo, hi) out of the mmap with transient-retry (recovered
+    retries reported to the consuming fit's stats via
+    ``faults.observe_retry``) and per-unit checksum verification. The
+    ``shard.load`` fault site fires once per read attempt; corruption
+    injections land AFTER the copy so the checksum layer (not the mmap)
+    is what catches them."""
+    def read():
+        faults.maybe_fail(faults.SITE_SHARD_LOAD)
+        return np.asarray(arr[lo:hi])
+
+    seg = retry.call(
+        read, key=key,
+        on_retry=lambda _a, delay_s, _e: faults.observe_retry(delay_s),
+    )
+    seg = faults.corrupt_array(faults.SITE_SHARD_LOAD, seg)
+    if checksums is not None:
+        for i in range(lo, hi):
+            verify_array(seg[i - lo], checksums[i], algo, f"{what} {i}")
+    return seg
+
+
+# Write-path checksum convention: ingestion loops digest each tile/chunk
+# from the memmap IMMEDIATELY after writing it — the pages are still
+# dirty in the page cache, so the digest is a RAM-speed read of exactly
+# the file's bytes, and sealing a multi-GB shard directory never has to
+# read the dataset back off disk. The read-back in seal()/_final_meta
+# remains only as the fallback for externally-filled memmaps
+# (DiskCOOShards.create + caller fill), where write order is unknown.
+
+
+def _meta_checksums(meta: dict) -> Tuple[Optional[Dict[str, List[int]]], str]:
+    return meta.get("checksums"), meta.get("checksum_algo", "crc32")
 
 
 class DiskCOOShards:
@@ -32,20 +107,37 @@ class DiskCOOShards:
       values.npy   (num_chunks, chunk_rows, w)  f32/bf16-as-u16 is NOT used;
                    values keep their numpy dtype (float32 or float16-like)
       labels.npy   (num_chunks, chunk_rows, k)
-      shards.json  {n_true, d, num_chunks, chunk_rows}
+      shards.json  {n_true, d, num_chunks, chunk_rows, checksum_algo,
+                    checksums: {indices: [per chunk], values: [...],
+                    labels: [...]}}
 
     ``write`` builds the files with ``open_memmap`` so the full dataset
     never needs to exist in RAM either at write time (callers may fill
-    chunk ranges incrementally via the returned memmaps).
+    chunk ranges incrementally via the memmaps :meth:`create` returns —
+    then :meth:`seal` computes the checksums and publishes the final
+    metadata atomically; loading an unsealed directory raises
+    :class:`ShardCorrupted`, never silently short data).
     """
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, verify: bool = True,
+                 retry_policy=None):
+        self.directory = os.path.abspath(directory)
         with open(os.path.join(directory, _META)) as f:
             meta = json.load(f)
+        if meta.get("building"):
+            raise ShardCorrupted(
+                f"{self.directory}: shard directory was never sealed "
+                f"(writer killed mid-build, or DiskCOOShards.seal() not "
+                f"called after an incremental fill)"
+            )
         self.n_true = int(meta["n_true"])
         self.d = int(meta["d"])
         self.num_chunks = int(meta["num_chunks"])
         self.chunk_rows = int(meta["chunk_rows"])
+        self._checksums, self._algo = _meta_checksums(meta)
+        if not verify:
+            self._checksums = None
+        self._retry = retry_policy or faults.default_retry_policy()
         self._idx = np.load(
             os.path.join(directory, _FILES["indices"]), mmap_mode="r"
         )
@@ -71,7 +163,8 @@ class DiskCOOShards:
 
         Rows past the last full chunk are padded with inactive (-1)
         lanes / zero labels. For datasets too big to hold even once,
-        build the memmaps with :meth:`create` and fill ranges instead.
+        build the memmaps with :meth:`create`, fill ranges, then
+        :meth:`seal`.
         """
         n, w = indices.shape
         k = labels.shape[1]
@@ -83,16 +176,24 @@ class DiskCOOShards:
             idx_dtype=indices.dtype, val_dtype=values.dtype,
             y_dtype=labels.dtype, n_true=n_true, d=d,
         )
+        sums: Dict[str, List[int]] = {
+            "indices": [], "values": [], "labels": []
+        }
         for c in range(num_chunks):
             lo, hi = c * chunk_rows, min((c + 1) * chunk_rows, n)
             m = hi - lo
             mm_i[c, :m] = indices[lo:hi]
             mm_v[c, :m] = values[lo:hi]
             mm_y[c, :m] = labels[lo:hi]
+            # Digest while the chunk's pages are hot (see convention
+            # note above) — no read-back pass at seal time.
+            sums["indices"].append(int(crc_of_array(mm_i[c])))
+            sums["values"].append(int(crc_of_array(mm_v[c])))
+            sums["labels"].append(int(crc_of_array(mm_y[c])))
         for mm in (mm_i, mm_v, mm_y):
             mm.flush()
         del mm_i, mm_v, mm_y
-        return DiskCOOShards(directory)
+        return DiskCOOShards.seal(directory, _precomputed=sums)
 
     @staticmethod
     def create(
@@ -108,8 +209,18 @@ class DiskCOOShards:
         d: int = 0,
     ) -> Tuple[np.memmap, np.memmap, np.memmap]:
         """Allocate the on-disk chunk files and return writable memmaps
-        (indices prefilled with -1, values/labels with 0)."""
+        (indices prefilled with -1, values/labels with 0). The metadata
+        written here carries ``building: true`` — the directory will not
+        LOAD until :meth:`seal` publishes the final meta (atomically,
+        with checksums), so a writer killed mid-fill leaves a directory
+        that fails loudly instead of parsing as short-but-valid data."""
         os.makedirs(directory, exist_ok=True)
+        # Stale final meta from a previous complete build must not pair
+        # with the new (partially filled) arrays.
+        try:
+            os.unlink(os.path.join(directory, _META))
+        except OSError:
+            pass
         shape2 = (num_chunks, chunk_rows)
         mm_i = np.lib.format.open_memmap(
             os.path.join(directory, _FILES["indices"]), mode="w+",
@@ -124,25 +235,64 @@ class DiskCOOShards:
             os.path.join(directory, _FILES["labels"]), mode="w+",
             dtype=y_dtype, shape=shape2 + (k,),
         )
-        with open(os.path.join(directory, _META), "w") as f:
-            json.dump(
-                {"n_true": int(n_true), "d": int(d),
-                 "num_chunks": int(num_chunks),
-                 "chunk_rows": int(chunk_rows)},
-                f,
-            )
+        atomic_write_json(
+            os.path.join(directory, _META),
+            {"n_true": int(n_true), "d": int(d),
+             "num_chunks": int(num_chunks),
+             "chunk_rows": int(chunk_rows),
+             "building": True},
+        )
         return mm_i, mm_v, mm_y
 
+    @staticmethod
+    def seal(directory: str, _precomputed=None) -> "DiskCOOShards":
+        """Finish a build: fsync the array files, compute per-chunk
+        checksums (read-back — callers that filled the memmaps
+        themselves are the only ones who must pay it; ``write`` digests
+        during its fill and passes them in), and atomically replace the
+        ``building`` metadata with the final one — meta last, so the
+        directory becomes loadable only once everything it describes is
+        durably on disk."""
+        with open(os.path.join(directory, _META)) as f:
+            meta = json.load(f)
+        sums: Dict[str, List[int]] = {}
+        for field, fname in _FILES.items():
+            path = os.path.join(directory, fname)
+            fsync_file(path)
+            if _precomputed is not None:
+                sums[field] = list(_precomputed[field])
+            else:
+                arr = np.load(path, mmap_mode="r")
+                sums[field] = _chunk_checksums(arr, int(meta["num_chunks"]))
+                del arr
+        meta.pop("building", None)
+        meta["checksum_algo"] = checksum_algo()
+        meta["checksums"] = sums
+        atomic_write_json(os.path.join(directory, _META), meta)
+        return DiskCOOShards(directory)
+
     # ------------------------------------------------------------------
+    def _read_chunks(self, arr, lo: int, hi: int, field: str) -> np.ndarray:
+        return _read_verified(
+            arr, lo, hi,
+            what=f"{self.directory}/{_FILES[field]} chunk",
+            key=f"{self.directory}:{field}:{lo}",
+            checksums=(
+                None if self._checksums is None
+                else self._checksums.get(field)
+            ),
+            algo=self._algo, retry=self._retry,
+        )
+
     def segment_source(self, cid0: int, seg: int):
         """The ``segment_source`` contract of ``run_lbfgs_gram_streamed``:
         materialize ONLY chunks [cid0, cid0+seg) as host arrays (phantom
         chunks past the end are inactive/-1 padded — the fold masks them
         by absolute id anyway)."""
         hi = min(cid0 + seg, self.num_chunks)
-        idx = np.asarray(self._idx[cid0:hi])
-        val = np.asarray(self._val[cid0:hi])
-        y = np.asarray(self._y[cid0:hi])
+        idx = self._read_chunks(self._idx, cid0, hi, "indices")
+        val = self._read_chunks(self._val, cid0, hi, "values")
+        y = self._read_chunks(self._y, cid0, hi, "labels")
         pad = seg - (hi - cid0)
         if pad:
             idx = np.concatenate(
@@ -160,6 +310,10 @@ class DiskCOOShards:
             isinstance(a, np.memmap) for a in (self._idx, self._val, self._y)
         )
 
+    @property
+    def is_checksummed(self) -> bool:
+        return self._checksums is not None
+
     def as_source(self, chunks_per_segment: int):
         """This shard set as a prefetchable ShardSource of
         ``chunks_per_segment``-chunk segments (the
@@ -176,12 +330,14 @@ class DiskDenseShards:
 
     Layout: ``x.npy`` (num_tiles, tile_rows, d_in), ``y.npy``
     (num_tiles, tile_rows, k), ``dense_shards.json``
-    {n_true, tile_rows, num_tiles, tiles_per_segment}.
+    {n_true, tile_rows, num_tiles, tiles_per_segment, checksum_algo,
+    checksums: {x: [per tile], y: [per tile]}}.
     """
 
     _META = "dense_shards.json"
 
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, verify: bool = True,
+                 retry_policy=None):
         self.directory = os.path.abspath(directory)
         with open(os.path.join(directory, self._META)) as f:
             meta = json.load(f)
@@ -189,12 +345,46 @@ class DiskDenseShards:
         self.tile_rows = int(meta["tile_rows"])
         self.num_tiles = int(meta["num_tiles"])
         self.tiles_per_segment = int(meta["tiles_per_segment"])
+        self._checksums, self._algo = _meta_checksums(meta)
+        if not verify:
+            self._checksums = None
+        self._retry = retry_policy or faults.default_retry_policy()
         self._x = np.load(os.path.join(directory, "x.npy"), mmap_mode="r")
         self._y = np.load(os.path.join(directory, "y.npy"), mmap_mode="r")
 
     @property
     def num_segments(self) -> int:
         return -(-self.num_tiles // self.tiles_per_segment)
+
+    @staticmethod
+    def _final_meta(directory: str, n_true: int, tile_rows: int,
+                    num_tiles: int, tiles_per_segment: int,
+                    checksums: Optional[Dict[str, List[int]]] = None,
+                    ) -> None:
+        """Fsync the arrays, then publish metadata LAST and atomically —
+        the commit point of a dense shard build. Checksums cover the
+        tiles the metadata claims (capacity tiles past ``num_tiles``,
+        e.g. an overshooting writer's sparse tail, are not claimed and
+        not digested); both writers digest tiles hot during the fill and
+        pass them here, so the read-back below is only a fallback."""
+        sums: Dict[str, List[int]] = {}
+        for field in ("x", "y"):
+            path = os.path.join(directory, f"{field}.npy")
+            fsync_file(path)
+            if checksums is not None:
+                sums[field] = list(checksums[field])
+            else:
+                arr = np.load(path, mmap_mode="r")
+                sums[field] = _chunk_checksums(arr, num_tiles)
+                del arr
+        atomic_write_json(
+            os.path.join(directory, DiskDenseShards._META),
+            {"n_true": int(n_true), "tile_rows": int(tile_rows),
+             "num_tiles": int(num_tiles),
+             "tiles_per_segment": int(tiles_per_segment),
+             "checksum_algo": checksum_algo(),
+             "checksums": sums},
+        )
 
     @staticmethod
     def write(
@@ -210,6 +400,13 @@ class DiskDenseShards:
         k = Y.shape[1]
         num_tiles = -(-n // tile_rows)
         os.makedirs(directory, exist_ok=True)
+        # A stale meta from a previous build must never describe the new
+        # partially-written arrays (kill-mid-write would otherwise load
+        # as a valid-but-wrong dataset).
+        try:
+            os.unlink(os.path.join(directory, DiskDenseShards._META))
+        except OSError:
+            pass
         mm_x = np.lib.format.open_memmap(
             os.path.join(directory, "x.npy"), mode="w+", dtype=X.dtype,
             shape=(num_tiles, tile_rows, d_in),
@@ -220,19 +417,21 @@ class DiskDenseShards:
         )
         # open_memmap('w+') creates the file zero-filled via ftruncate
         # (sparse allocation) — the ragged tail needs no explicit pass.
+        sums: Dict[str, List[int]] = {"x": [], "y": []}
         for t in range(num_tiles):
             lo, hi = t * tile_rows, min((t + 1) * tile_rows, n)
             mm_x[t, : hi - lo] = X[lo:hi]
             mm_y[t, : hi - lo] = Y[lo:hi]
+            # Digest while the tile's pages are hot (convention note at
+            # the top of the module).
+            sums["x"].append(int(crc_of_array(mm_x[t])))
+            sums["y"].append(int(crc_of_array(mm_y[t])))
         mm_x.flush(); mm_y.flush()
         del mm_x, mm_y
-        with open(os.path.join(directory, DiskDenseShards._META), "w") as f:
-            json.dump(
-                {"n_true": int(n), "tile_rows": int(tile_rows),
-                 "num_tiles": int(num_tiles),
-                 "tiles_per_segment": int(tiles_per_segment)},
-                f,
-            )
+        DiskDenseShards._final_meta(
+            directory, n, tile_rows, num_tiles, tiles_per_segment,
+            checksums=sums,
+        )
         return DiskDenseShards(directory)
 
     def segment_source(self, s: int):
@@ -243,10 +442,19 @@ class DiskDenseShards:
         Y_seg, _ = self.segment_source_y(s)
         return X_seg, Y_seg, valid_rows
 
-    def _segment_field(self, arr, s: int):
+    def _segment_field(self, arr, s: int, field: str):
         tps = self.tiles_per_segment
         lo, hi = s * tps, min((s + 1) * tps, self.num_tiles)
-        seg = np.asarray(arr[lo:hi])
+        seg = _read_verified(
+            arr, lo, hi,
+            what=f"{self.directory}/{field}.npy tile",
+            key=f"{self.directory}:{field}:{lo}",
+            checksums=(
+                None if self._checksums is None
+                else self._checksums.get(field)
+            ),
+            algo=self._algo, retry=self._retry,
+        )
         pad = tps - (hi - lo)
         if pad:
             seg = np.concatenate(
@@ -260,18 +468,22 @@ class DiskDenseShards:
     def segment_source_x(self, s: int):
         """(X_seg, valid_rows) only — pairings that bring their own
         resident labels skip the on-disk label read entirely."""
-        return self._segment_field(self._x, s)
+        return self._segment_field(self._x, s, "x")
 
     def segment_source_y(self, s: int):
         """(Y_seg, valid_rows) only — label views (e.g. the cost-model
         sample collector) skip the much wider row read."""
-        return self._segment_field(self._y, s)
+        return self._segment_field(self._y, s, "y")
 
     @property
     def is_memory_mapped(self) -> bool:
         return isinstance(self._x, np.memmap) and isinstance(
             self._y, np.memmap
         )
+
+    @property
+    def is_checksummed(self) -> bool:
+        return self._checksums is not None
 
     def as_source(self):
         """This shard set as a prefetchable ShardSource delivering the
@@ -305,6 +517,11 @@ class DiskDenseShardWriter:
     the true count (e.g. a newline-count upper bound): unwritten tail
     tiles stay sparse zero-fill on disk and the metadata written at
     ``close`` records only the rows actually appended.
+
+    Crash safety: any previous metadata is deleted at open, and the new
+    metadata (with per-tile checksums) is written atomically, LAST, at
+    :meth:`close` — a writer killed mid-append leaves a directory that
+    refuses to load rather than one that silently truncates the data.
     """
 
     def __init__(
@@ -325,6 +542,10 @@ class DiskDenseShardWriter:
         self.tiles_per_segment = int(tiles_per_segment)
         cap_tiles = -(-int(capacity_rows) // self.tile_rows)
         os.makedirs(directory, exist_ok=True)
+        try:
+            os.unlink(os.path.join(directory, DiskDenseShards._META))
+        except OSError:
+            pass
         self._mm_x = np.lib.format.open_memmap(
             os.path.join(directory, "x.npy"), mode="w+", dtype=x_dtype,
             shape=(cap_tiles, self.tile_rows, int(d_in)),
@@ -335,6 +556,9 @@ class DiskDenseShardWriter:
         )
         self._rows = 0
         self._closed = False
+        # Tiles digested so far (hot, as appends complete them — the
+        # module's write-path checksum convention).
+        self._sums: Dict[str, List[int]] = {"x": [], "y": []}
 
     def append(self, X_block: np.ndarray, Y_block: np.ndarray) -> None:
         X_block = np.asarray(X_block)
@@ -356,23 +580,30 @@ class DiskDenseShardWriter:
         flat_x[self._rows : self._rows + m] = X_block
         flat_y[self._rows : self._rows + m] = Y_block
         self._rows += m
+        # Digest tiles this block COMPLETED while their pages are hot.
+        for t in range(len(self._sums["x"]), self._rows // self.tile_rows):
+            self._sums["x"].append(int(crc_of_array(self._mm_x[t])))
+            self._sums["y"].append(int(crc_of_array(self._mm_y[t])))
 
     def close(self) -> "DiskDenseShards":
-        """Flush, write metadata for the rows actually appended, and
-        reopen read-only as :class:`DiskDenseShards`."""
+        """Flush + fsync the arrays, write checksummed metadata for the
+        rows actually appended (atomically, last), and reopen read-only
+        as :class:`DiskDenseShards`."""
         if self._closed:
             raise RuntimeError("writer already closed")
         self._closed = True
         if self._rows == 0:
             raise ValueError("no rows were appended")
+        num_tiles = -(-self._rows // self.tile_rows)
+        # Digest the trailing partial tile (its zero tail reads straight
+        # from the sparse file's hole pages — no disk IO).
+        for t in range(len(self._sums["x"]), num_tiles):
+            self._sums["x"].append(int(crc_of_array(self._mm_x[t])))
+            self._sums["y"].append(int(crc_of_array(self._mm_y[t])))
         self._mm_x.flush(); self._mm_y.flush()
         del self._mm_x, self._mm_y
-        num_tiles = -(-self._rows // self.tile_rows)
-        with open(os.path.join(self.directory, DiskDenseShards._META), "w") as f:
-            json.dump(
-                {"n_true": int(self._rows), "tile_rows": int(self.tile_rows),
-                 "num_tiles": int(num_tiles),
-                 "tiles_per_segment": int(self.tiles_per_segment)},
-                f,
-            )
+        DiskDenseShards._final_meta(
+            self.directory, self._rows, self.tile_rows, num_tiles,
+            self.tiles_per_segment, checksums=self._sums,
+        )
         return DiskDenseShards(self.directory)

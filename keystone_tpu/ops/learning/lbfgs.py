@@ -363,6 +363,8 @@ def run_lbfgs_gram_streamed(
     inflight: int = 2,
     prefetch_depth: int = 2,
     pipeline: bool = True,
+    prefetch_stats=None,
+    checkpoint=None,
 ):
     """Streamed sparse ridge fit: fold G = AᵀA over COO chunks ONCE
     (``sparse.sparse_gram_stream`` — chunks may be regenerated/loaded per
@@ -410,9 +412,32 @@ def run_lbfgs_gram_streamed(
     (``sparse.sparse_gram_fold``) so chunk k+1's regen+densify is
     schedulable against chunk k's accumulating syrk; costs one extra
     resident slab — pass False beside large resident operands.
+
+    ``prefetch_stats``: a :class:`keystone_tpu.data.prefetch.
+    PrefetchStats` filled by the prefetched source path (overlap +
+    retry/backoff accounting — ``utils.profiling``).
+
+    ``checkpoint``: a :class:`keystone_tpu.data.durable.CheckpointSpec`
+    (or directory path; None consults ``KEYSTONE_CHECKPOINT_DIR``)
+    snapshotting the (G, AtY, yty) carry + segment cursor every
+    ``every_segments`` segments, atomically. A fit killed mid-stream and
+    re-run with the same spec resumes from the snapshot BIT-IDENTICALLY
+    (tests/test_chaos.py). Requires a segmented fit — an explicit
+    checkpoint with the whole fold in one dispatch raises (there is no
+    boundary to snapshot at); the env-default spec is simply ignored
+    there so a global ``--checkpoint-dir`` drill never breaks
+    single-dispatch fits.
     """
+    from keystone_tpu.data.durable import (
+        fingerprint_token,
+        resolve_checkpoint,
+        source_fingerprint,
+    )
+
     if n is None:
         raise ValueError("streamed fit needs the true row count n")
+    explicit_checkpoint = checkpoint is not None
+    checkpoint = resolve_checkpoint(checkpoint)
     seg = max_chunks_per_dispatch
     source = None
     if segment_source is not None and not callable(segment_source):
@@ -438,6 +463,12 @@ def run_lbfgs_gram_streamed(
                 f"chunks_per_segment {source.chunks_per_segment}"
             )
     if segment_source is None and (seg is None or seg >= num_chunks):
+        if explicit_checkpoint:
+            raise ValueError(
+                "checkpointing needs a segmented fit: pass "
+                "max_chunks_per_dispatch (or a segment_source) so there "
+                "are fold boundaries to snapshot at"
+            )
         program = _gram_streamed_program(
             chunk_fn, int(num_chunks), int(d), int(k), float(lam),
             int(num_iterations), float(convergence_tol), int(n),
@@ -464,7 +495,38 @@ def run_lbfgs_gram_streamed(
         int(d), int(k), float(lam), int(num_iterations),
         float(convergence_tol), int(n), jnp.dtype(val_dtype),
     )
-    carry = sparse_gram_init(d, k, val_dtype)
+    num_segs = -(-int(num_chunks) // int(seg))
+    carry = None
+    start_seg = 0
+    fingerprint = None
+    if checkpoint is not None:
+        # Geometry + fold-program identity (chunk_fn, dtype/engine
+        # flags, operand shapes) + source identity — a stale snapshot
+        # from a different chunk source must never seed this fold.
+        # Resident operands are fingerprinted by shape/dtype only (a
+        # content digest would transfer the dataset host-side); disk
+        # sources carry a free content digest via their recorded
+        # checksums.
+        fingerprint = {
+            "kind": "coo_gram_segments", "num_chunks": int(num_chunks),
+            "d": int(d), "k": int(k), "seg": int(seg), "n": int(n),
+            "val_dtype": str(jnp.dtype(val_dtype)),
+            "use_pallas": bool(use_pallas), "pipeline": bool(pipeline),
+            "chunk_fn": fingerprint_token(chunk_fn),
+            "operands": [
+                {"shape": [int(v) for v in getattr(o, "shape", ())],
+                 "dtype": str(getattr(o, "dtype", "?"))}
+                for o in operands
+            ],
+            "source": source_fingerprint(
+                source if source is not None else segment_source
+            ),
+        }
+        arrays, start_seg = checkpoint.restore(fingerprint)
+        if arrays is not None:
+            carry = tuple(jnp.asarray(a) for a in arrays)
+    if carry is None:
+        carry = sparse_gram_init(d, k, val_dtype)
     throttle = BoundedInflight(inflight)
 
     def folded(cid0, ops):
@@ -475,19 +537,35 @@ def run_lbfgs_gram_streamed(
         )
         throttle.admit(carry[2])
 
+    def maybe_snapshot(s):
+        if checkpoint is not None:
+            checkpoint.maybe_save(carry, s, num_segs, fingerprint)
+
+    def finish():
+        result = solve(carry)
+        if checkpoint is not None:
+            checkpoint.clear(fingerprint)  # this fit's snapshot only
+        return result
+
     if source is not None:
         from keystone_tpu.data.prefetch import iter_segments
 
-        for s, ops in iter_segments(source, prefetch_depth=prefetch_depth):
+        for s, ops in iter_segments(
+            source, prefetch_depth=prefetch_depth, stats=prefetch_stats,
+            start=start_seg,
+        ):
             folded(s * int(seg), ops)
-        return solve(carry)
-    for cid0 in range(0, int(num_chunks), int(seg)):
+            maybe_snapshot(s)
+        return finish()
+    for s in range(start_seg, num_segs):
+        cid0 = s * int(seg)
         if segment_source is not None:
             ops = segment_source(int(cid0), int(seg))
         else:
             ops = operands
         folded(cid0, ops)
-    return solve(carry)
+        maybe_snapshot(s)
+    return finish()
 
 
 @functools.lru_cache(maxsize=16)

@@ -375,15 +375,19 @@ def _paired_source(data: Dataset, labels: Dataset):
 
 def _fit_paired_source(source, featurize, d_feat: int, block_size: int,
                        lam, num_iter: int, center: bool,
-                       prefetch_depth: int = 2,
+                       prefetch_depth: int = 2, checkpoint=None,
                        ) -> "StreamingFeaturizedLinearModel":
     """Shared disk-tier fit body: prefetched segment folds -> centered
     BCD on the normal equations -> the same affine model every streaming
-    tier returns (existing streaming parity tolerances apply)."""
+    tier returns (existing streaming parity tolerances apply).
+    ``checkpoint`` (a CheckpointSpec / directory; None consults
+    ``KEYSTONE_CHECKPOINT_DIR``) makes the fold resumable — a killed fit
+    re-run with the same spec continues from its last snapshot,
+    bit-identically (docs/reliability.md)."""
     W, fmean, ymean, _ = streaming.streaming_bcd_fit_segments(
         source, bank=streaming.as_bank(featurize), d_feat=d_feat,
         block_size=block_size, lam=lam, num_iter=num_iter, center=center,
-        prefetch_depth=prefetch_depth,
+        prefetch_depth=prefetch_depth, checkpoint=checkpoint,
     )
     return StreamingFeaturizedLinearModel(
         featurize, W, streaming.pick_tile_rows(d_feat, 4),
@@ -612,6 +616,11 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         self.data_is_shard_backed: bool = False
         self.shard_segment_bytes: Optional[float] = None
         self.prefetch_depth: int = 2
+        # Reliability knob: CheckpointSpec (or directory) the disk-tier
+        # fold snapshots/resumes through; None defers to the
+        # KEYSTONE_CHECKPOINT_DIR env (the run.py --checkpoint-dir
+        # wiring), unset = no checkpointing.
+        self.checkpoint = None
 
     @property
     def label(self) -> str:
@@ -694,6 +703,7 @@ class StreamingLeastSquaresChoice(LabelEstimator):
             block_size=pick_block_size(d_feat, self.block_size_hint),
             lam=self.lam, num_iter=self.num_iter, center=self.center,
             prefetch_depth=self.prefetch_depth,
+            checkpoint=getattr(self, "checkpoint", None),
         )
 
     def fit(self, data: Dataset, labels: Dataset):

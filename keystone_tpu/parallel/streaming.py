@@ -550,6 +550,7 @@ def streaming_bcd_fit_segments(
     inflight: int = 2,
     prefetch_depth: int = 2,
     prefetch_stats=None,
+    checkpoint=None,
 ):
     """Disk-bounded dense streamed fit: fold (G, FY, moments) over
     segments delivered one at a time (e.g.
@@ -571,8 +572,26 @@ def streaming_bcd_fit_segments(
     promise). ``bank`` may be any featurize callable (wrapped via
     :class:`CallableBank` when not already a BankFeaturize). Returns
     (W, fmean, ymean, loss) when centered, else (W, None, None, loss).
+
+    ``checkpoint``: a :class:`keystone_tpu.data.durable.CheckpointSpec`
+    (or directory path; None consults ``KEYSTONE_CHECKPOINT_DIR``) that
+    atomically snapshots the fold carry — the (G, FY, yty, fsum, ysum)
+    accumulators plus the segment cursor — every ``every_segments``
+    segments. A fit killed mid-stream and re-run with the same spec
+    resumes at the last snapshot and produces BIT-IDENTICAL results to
+    the uninterrupted run (the carry round-trips as raw f32 bytes and
+    the remaining segments fold through the same compiled program —
+    proven under injected kills in tests/test_chaos.py). The snapshot is
+    cleared on successful completion.
     """
+    from keystone_tpu.data.durable import (
+        fingerprint_token,
+        resolve_checkpoint,
+        source_fingerprint,
+    )
     from keystone_tpu.data.prefetch import is_shard_source, iter_segments
+
+    checkpoint = resolve_checkpoint(checkpoint)
 
     if is_shard_source(segment_source):
         if num_segments is None:
@@ -598,10 +617,33 @@ def streaming_bcd_fit_segments(
     bank_type, bank_key = type(bank), bank.static_key()
     bank_params = bank.params  # raw pytree — the BankFeaturize contract
     carry = None
+    start = 0
+    fingerprint = None
+    if checkpoint is not None:
+        # Geometry + featurizer identity (type, static key, parameter
+        # digests) + source identity: a stale snapshot from a different
+        # bank or a re-ingested shard directory must never seed this
+        # fold's accumulators.
+        fingerprint = {
+            "kind": "dense_bcd_segments",
+            "num_segments": int(num_segments), "n_true": int(n_true),
+            "d_feat": int(d_feat), "tile_rows": int(tile_rows),
+            "bank": {
+                "type": bank_type.__name__,
+                "key": fingerprint_token(bank_key),
+                "params": fingerprint_token(
+                    tuple(jax.tree_util.tree_leaves(bank_params))
+                ),
+            },
+            "source": source_fingerprint(segment_source),
+        }
+        arrays, start = checkpoint.restore(fingerprint)
+        if arrays is not None:
+            carry = tuple(jnp.asarray(a) for a in arrays)
     throttle = BoundedInflight(inflight)
     for s, (X_seg, Y_seg, valid_rows) in iter_segments(
         segment_source, num_segments=num_segments,
-        prefetch_depth=prefetch_depth, stats=prefetch_stats,
+        prefetch_depth=prefetch_depth, stats=prefetch_stats, start=start,
     ):
         if carry is None:
             k = int(Y_seg.shape[-1])
@@ -619,6 +661,8 @@ def streaming_bcd_fit_segments(
             use_pallas=use_pallas,
         )
         throttle.admit(carry[2])
+        if checkpoint is not None:
+            checkpoint.maybe_save(carry, s, num_segments, fingerprint)
     G, FY, yty, fsum, ysum = carry
     G = jnp.triu(G) + jnp.triu(G, 1).T
     # The accumulated moments ride into the shared jitted solve either
@@ -628,6 +672,12 @@ def streaming_bcd_fit_segments(
         jnp.asarray(n_true, jnp.float32), jnp.asarray(lam, jnp.float32),
         block_size=block_size, num_iter=num_iter, center=center,
     )
+    if checkpoint is not None:
+        # The fit completed: a later fit with this fingerprint must
+        # start fresh, not resume a finished run's final carry. Only
+        # THIS fit's snapshot — other fits sharing the directory keep
+        # theirs.
+        checkpoint.clear(fingerprint)
     return W, fmean, ymean, loss
 
 

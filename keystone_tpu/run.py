@@ -10,6 +10,12 @@ MnistRandomFFT] --rate 200 --duration-s 5`` starts the online serving
 path instead: export the fitted pipeline, run the deadline-aware
 micro-batch server under open-loop Poisson load, and print the p50/p99
 latency + throughput summary line (docs/serving.md).
+
+Global reliability flags (any pipeline, and serve — docs/reliability.md):
+``--checkpoint-dir=DIR`` makes segmented streamed fits snapshot their
+fold carry there (and resume from it on re-run, bit-identically);
+``--fault-plan=JSON|@file.json`` installs a deterministic fault-injection
+plan (``utils/faults.py``) for manual chaos drills.
 """
 
 from __future__ import annotations
@@ -130,12 +136,63 @@ def _serve(argv):
         export_plan,
         run_open_loop,
     )
+
+    # Load/fit and export fail as a ONE-LINE diagnostic + non-zero exit,
+    # not a bare traceback: serve is the operator-facing entry point, and
+    # a supervisor restarting it needs the exit code, not a stack.
+    phase = "load" if args.model else "quick-fit"
+    try:
+        fitted, d_in = _serve_build_fitted(args)
+        phase = "export"
+        plan = export_plan(
+            fitted, np.zeros(d_in, np.float32), max_batch=args.max_batch
+        )
+    except SystemExit:
+        raise
+    except Exception as e:
+        print(
+            f"serve: {phase} failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    single_s = plan.measure_single_request_s()
+    rng = np.random.default_rng(args.seed + 1)
+    pool = rng.normal(size=(256, d_in)).astype(np.float32)
+
+    server = MicroBatchServer(
+        plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+    )
+    try:
+        report = run_open_loop(
+            server.submit, lambda i: pool[i % len(pool)],
+            rate_hz=args.rate, duration_s=args.duration_s, seed=args.seed,
+        )
+    finally:
+        server.close()
+    summary = report.to_row_dict()
+    summary.update({
+        "single_request_s": round(single_s, 6),
+        "buckets": plan.buckets,
+        "plan_compiled": plan.compiled,
+        "max_wait_ms": args.max_wait_ms,
+        "mean_pad_fraction": server.stats().get("mean_pad_fraction"),
+        "breaker_state": server.stats().get("breaker_state"),
+    })
+    print(json.dumps(summary))
+    return 0
+
+
+def _serve_build_fitted(args):
+    """(fitted, d_in) for serve mode: load a saved FittedPipeline or
+    quick-fit the named pipeline on synthetic data."""
+    import numpy as np
+
     from keystone_tpu.workflow.pipeline import FittedPipeline
 
     if args.model:
-        fitted = FittedPipeline.load(args.model)
-        d_in = args.input_dim
-    elif args.pipeline.rsplit(".", 1)[-1] == "MnistRandomFFT":
+        return FittedPipeline.load(args.model), args.input_dim
+    if args.pipeline.rsplit(".", 1)[-1] == "MnistRandomFFT":
         import jax.numpy as jnp
 
         from keystone_tpu.data import Dataset
@@ -160,40 +217,11 @@ def _serve(argv):
             BlockLeastSquaresEstimator(args.blockSize, 1, 1e-3),
             Dataset.of(X), labels,
         ).fit()
-    else:
-        raise SystemExit(
-            f"--serve quick-fit supports MnistRandomFFT (got "
-            f"{args.pipeline!r}); pass --model for anything else"
-        )
-
-    plan = export_plan(
-        fitted, np.zeros(d_in, np.float32), max_batch=args.max_batch
+        return fitted, d_in
+    raise SystemExit(
+        f"--serve quick-fit supports MnistRandomFFT (got "
+        f"{args.pipeline!r}); pass --model for anything else"
     )
-    single_s = plan.measure_single_request_s()
-    rng = np.random.default_rng(args.seed + 1)
-    pool = rng.normal(size=(256, d_in)).astype(np.float32)
-
-    server = MicroBatchServer(
-        plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        max_queue_depth=args.queue_depth,
-    )
-    try:
-        report = run_open_loop(
-            server.submit, lambda i: pool[i % len(pool)],
-            rate_hz=args.rate, duration_s=args.duration_s, seed=args.seed,
-        )
-    finally:
-        server.close()
-    summary = report.to_row_dict()
-    summary.update({
-        "single_request_s": round(single_s, 6),
-        "buckets": plan.buckets,
-        "plan_compiled": plan.compiled,
-        "max_wait_ms": args.max_wait_ms,
-        "mean_pad_fraction": server.stats().get("mean_pad_fraction"),
-    })
-    print(json.dumps(summary))
-    return 0
 
 
 PIPELINES: Dict[str, Callable] = {
@@ -222,16 +250,31 @@ def resolve(name: str) -> Callable:
     return PIPELINES[bare]
 
 
-def _extract_host_budget(argv):
-    """Pop the global ``--host-budget-bytes=N`` flag (any pipeline): caps
-    the host RAM the capacity selector lets a dataset claim, past which
-    fits route through disk shards (docs/data.md). Exported as the
-    ``KEYSTONE_HOST_BUDGET_BYTES`` env knob ``cost.host_memory_bytes``
-    reads, so per-pipeline flag parsers never see it."""
+# Global flags popped before any per-pipeline parser sees them; each
+# becomes the env knob the library layer reads:
+#   --host-budget-bytes=N  -> KEYSTONE_HOST_BUDGET_BYTES (cost.py: caps
+#       host RAM a dataset claims before routing through disk shards)
+#   --checkpoint-dir=DIR   -> KEYSTONE_CHECKPOINT_DIR (durable.py:
+#       segmented streamed fits snapshot + resume their fold carry)
+#   --fault-plan=JSON|@f   -> KEYSTONE_FAULT_PLAN (faults.py: install a
+#       deterministic fault-injection plan for manual chaos drills)
+_GLOBAL_FLAGS = {
+    "--host-budget-bytes=": "KEYSTONE_HOST_BUDGET_BYTES",
+    "--checkpoint-dir=": "KEYSTONE_CHECKPOINT_DIR",
+    "--fault-plan=": "KEYSTONE_FAULT_PLAN",
+}
+
+
+def _extract_global_flags(argv):
+    """Pop the global reliability/capacity flags (any pipeline, and
+    serve) into their env knobs — per-pipeline flag parsers never see
+    them, and the library layer picks them up with no plumbing."""
     out = []
     for a in argv:
-        if a.startswith("--host-budget-bytes="):
-            os.environ["KEYSTONE_HOST_BUDGET_BYTES"] = a.split("=", 1)[1]
+        for prefix, env in _GLOBAL_FLAGS.items():
+            if a.startswith(prefix):
+                os.environ[env] = a.split("=", 1)[1]
+                break
         else:
             out.append(a)
     return out
@@ -243,7 +286,11 @@ def main(argv=None):
         print(__doc__)
         print("Pipelines:", ", ".join(sorted(PIPELINES)))
         return 0
-    argv = _extract_host_budget(argv)
+    argv = _extract_global_flags(argv)
+    if not argv:  # invocation was ONLY global flags — show help, no crash
+        print(__doc__)
+        print("Pipelines:", ", ".join(sorted(PIPELINES)))
+        return 0
     _enable_compile_cache()
     if argv[0] in ("serve", "--serve"):
         return _serve(argv[1:])

@@ -15,7 +15,12 @@ into something that serves streams of single-datum requests:
     generation and the batch-size-1 baseline the bench A/Bs against.
 """
 
-from .batcher import MicroBatchServer, ServerClosed, ServerOverloaded
+from .batcher import (
+    MicroBatchServer,
+    ServerClosed,
+    ServerDegraded,
+    ServerOverloaded,
+)
 from .export import BatchInfo, ExportedPlan, export_plan
 from .loadgen import LoadReport, closed_loop_qps, poisson_arrivals, run_open_loop
 
@@ -25,6 +30,7 @@ __all__ = [
     "LoadReport",
     "MicroBatchServer",
     "ServerClosed",
+    "ServerDegraded",
     "ServerOverloaded",
     "closed_loop_qps",
     "export_plan",

@@ -27,6 +27,17 @@ that something:
     ``tests/test_prefetch.py``'s coverage: the executing batch completes,
     queued-but-unstarted requests fail with :class:`ServerClosed`, the
     worker thread joins — no deadlock, no leak.
+  - Degradation is explicit (docs/reliability.md): a CIRCUIT BREAKER
+    counts consecutive plan failures and OPENs past ``breaker_threshold``
+    — submissions then fail fast with :class:`ServerDegraded` instead of
+    queueing against a plan that is failing every batch; after
+    ``breaker_reset_s`` one half-open probe batch is admitted and a
+    success re-closes the breaker. A worker WATCHDOG catches the worker
+    thread dying on an unexpected error: every queued and in-flight
+    future fails loudly with :class:`ServerDegraded` (cause chained) and
+    later submissions raise immediately — submitters never hang on a
+    dead server. The ``serving.execute`` fault site
+    (:mod:`keystone_tpu.utils.faults`) drives both paths in chaos tests.
 
 Observability: per-request spans (queue wait / pad fraction / batch exec
 time) are recorded through :class:`keystone_tpu.utils.profiling.SpanLog`,
@@ -45,9 +56,14 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
-from keystone_tpu.utils import profiling
+from keystone_tpu.utils import faults, profiling
 
-__all__ = ["MicroBatchServer", "ServerClosed", "ServerOverloaded"]
+__all__ = [
+    "MicroBatchServer",
+    "ServerClosed",
+    "ServerDegraded",
+    "ServerOverloaded",
+]
 
 
 class ServerOverloaded(RuntimeError):
@@ -60,14 +76,23 @@ class ServerClosed(RuntimeError):
     """The server was shut down before this request executed."""
 
 
+class ServerDegraded(RuntimeError):
+    """The server is failing fast: the circuit breaker is OPEN (the
+    plan failed ``breaker_threshold`` consecutive batches) or the worker
+    thread died. The request was NOT executed; submitters should back
+    off or fail over — queueing more work against a failing plan only
+    converts each request into a slow error."""
+
+
 class _Request:
-    __slots__ = ("x", "future", "enqueue_t", "deadline_t")
+    __slots__ = ("x", "future", "enqueue_t", "deadline_t", "is_probe")
 
     def __init__(self, x, future: Future, enqueue_t: float, deadline_t: float):
         self.x = x
         self.future = future
         self.enqueue_t = enqueue_t
         self.deadline_t = deadline_t
+        self.is_probe = False  # the half-open breaker's single probe
 
     def shed_key(self):
         # Earliest deadline first; among equal deadlines (including the
@@ -80,8 +105,13 @@ class _Request:
         future, and an unguarded raise here would kill the worker thread
         — every later request would then hang forever. Returns whether
         the value/exception was actually delivered."""
-        if not self.future.set_running_or_notify_cancel():
-            return False  # client cancelled before dispatch
+        try:
+            if not self.future.set_running_or_notify_cancel():
+                return False  # client cancelled before dispatch
+        except RuntimeError:
+            # Already resolved — the watchdog may sweep a batch whose
+            # early members the worker finished before dying.
+            return False
         try:
             if exc is not None:
                 self.future.set_exception(exc)
@@ -103,6 +133,11 @@ class MicroBatchServer:
         loops — batches still form under backlog).
       - ``max_queue_depth``: bound on queued-not-yet-dispatched requests;
         beyond it admission sheds earliest-deadline-first.
+      - ``breaker_threshold`` / ``breaker_reset_s``: consecutive plan
+        failures before the circuit breaker OPENs (submit then fails
+        fast with :class:`ServerDegraded`), and the cooldown before a
+        half-open probe is admitted. ``breaker_threshold=0`` disables
+        the breaker (pre-reliability behavior).
     """
 
     def __init__(
@@ -112,9 +147,13 @@ class MicroBatchServer:
         max_wait_ms: float = 2.0,
         max_queue_depth: int = 1024,
         span_log_len: int = 4096,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 1.0,
     ):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
+        if breaker_threshold < 0:
+            raise ValueError("breaker_threshold must be >= 0")
         self.plan = plan
         self.max_batch = min(
             int(plan.max_batch if max_batch is None else max_batch),
@@ -137,6 +176,17 @@ class MicroBatchServer:
         # needs and would inflate exactly the p99 tail being measured.
         self._finite_deadlines = 0
         self._closed = False
+
+        # Circuit breaker + worker watchdog state (all under _lock).
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._consecutive_failures = 0
+        self._breaker_open = False
+        self._breaker_opened_t = 0.0
+        self._breaker_probing = False  # ONE half-open probe in flight
+        self._worker_dead = False
+        self.breaker_opens = 0
+        self.degraded_rejected = 0
 
         # Rolling observability state. Deques bound memory; counters are
         # cumulative. All mutated under _lock (worker + submitters).
@@ -171,6 +221,31 @@ class MicroBatchServer:
         with self._cond:
             if self._closed:
                 raise ServerClosed("submit() after close()")
+            if self._worker_dead:
+                raise ServerDegraded(
+                    "serving worker thread died; the server cannot "
+                    "execute requests (restart it)"
+                )
+            if self._breaker_open:
+                elapsed = now - self._breaker_opened_t
+                if elapsed >= self.breaker_reset_s and not self._breaker_probing:
+                    # Half-open: admit EXACTLY ONE probe. The breaker
+                    # stays open for everyone else until the probe
+                    # batch's outcome lands — otherwise full offered
+                    # load would pour in against the still-unverified
+                    # plan during the probe's execution. The flag is
+                    # only set AFTER the request actually enqueues (a
+                    # shed on the full queue below must not leak the
+                    # probe slot with no probe in flight).
+                    req.is_probe = True
+                else:
+                    self.degraded_rejected += 1
+                    raise ServerDegraded(
+                        f"circuit breaker open: the plan failed "
+                        f"{self._consecutive_failures} consecutive "
+                        f"batches; retrying in "
+                        f"{self.breaker_reset_s:.3g}s windows"
+                    )
             if len(self._pending) >= self.max_queue_depth:
                 if self._finite_deadlines:
                     victim = min(self._pending, key=_Request.shed_key)
@@ -180,6 +255,10 @@ class MicroBatchServer:
                     self._pending.remove(victim)
                     if victim.deadline_t != math.inf:
                         self._finite_deadlines -= 1
+                    if victim.is_probe:
+                        # A shed probe never executes: free the slot or
+                        # the breaker would reject forever.
+                        self._breaker_probing = False
                     shed = victim
                 else:
                     self.rejected += 1
@@ -188,6 +267,8 @@ class MicroBatchServer:
                         f"request holds the earliest deadline"
                     )
             self._pending.append(req)
+            if req.is_probe:
+                self._breaker_probing = True
             if req.deadline_t != math.inf:
                 self._finite_deadlines += 1
             if shed is not None:
@@ -203,12 +284,34 @@ class MicroBatchServer:
     # -- worker side -------------------------------------------------------
 
     def _worker(self) -> None:
-        while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            if batch:  # empty = a close() drained the queue mid-wait
-                self._execute(batch)
+        batch: Optional[List[_Request]] = None
+        try:
+            while True:
+                batch = self._take_batch()
+                if batch is None:
+                    return
+                if batch:  # empty = a close() drained the queue mid-wait
+                    self._execute(batch)
+                batch = None
+        except BaseException as e:  # noqa: BLE001 — watchdog of last resort
+            self._worker_died(e, batch or [])
+
+    def _worker_died(self, exc: BaseException,
+                     inflight: List[_Request]) -> None:
+        """Watchdog: the worker loop itself failed (not a plan error —
+        those are caught in :meth:`_execute`). Fail every in-flight and
+        queued future loudly and poison submit, so no submitter ever
+        blocks on a Future nothing will resolve."""
+        with self._cond:
+            self._worker_dead = True
+            drained = list(self._pending)
+            self._pending.clear()
+            self._finite_deadlines = 0
+            self._cond.notify_all()
+        err = ServerDegraded(f"serving worker thread died: {exc!r}")
+        err.__cause__ = exc
+        for r in inflight + drained:
+            r.resolve(exc=err)
 
     def _take_batch(self) -> Optional[List[_Request]]:
         """Block until a batch is due (fill, wait-out, or deadline), pop
@@ -248,13 +351,45 @@ class MicroBatchServer:
     def _execute(self, batch: List[_Request]) -> None:
         t0 = time.perf_counter()
         try:
+            faults.maybe_fail(faults.SITE_SERVING_EXECUTE)
             outs, info = self.plan.apply_batch_info([r.x for r in batch])
         except BaseException as e:  # noqa: BLE001 — re-raised submitter-side
             with self._lock:
                 self.failed += len(batch)
+                if self.breaker_threshold:
+                    self._consecutive_failures += 1
+                    if self._breaker_probing and any(
+                        r.is_probe for r in batch
+                    ):
+                        # THE half-open probe failed: re-open and
+                        # restart the cooldown. Both conditions matter:
+                        # batch membership keeps a pre-open queued batch
+                        # failing during the probe's wait from being
+                        # misattributed, and the probing flag keeps a
+                        # STALE probe (breaker already re-closed by an
+                        # earlier batch's success) from bumping
+                        # breaker_opens on a closed breaker — a stale
+                        # probe's failure counts like any other.
+                        self._breaker_probing = False
+                        self._breaker_open = True
+                        self._breaker_opened_t = time.perf_counter()
+                        self.breaker_opens += 1
+                    elif (
+                        self._consecutive_failures >= self.breaker_threshold
+                        and not self._breaker_open
+                    ):
+                        self._breaker_open = True
+                        self._breaker_opened_t = time.perf_counter()
+                        self.breaker_opens += 1
             for r in batch:
                 r.resolve(exc=e)
             return
+        with self._lock:
+            # Any successful batch (including the half-open probe)
+            # re-closes the breaker.
+            self._consecutive_failures = 0
+            self._breaker_open = False
+            self._breaker_probing = False
         t1 = time.perf_counter()
         exec_s = t1 - t0
         for i, r in enumerate(batch):
@@ -288,12 +423,20 @@ class MicroBatchServer:
                 self._last_done_t - self._first_done_t
                 if self._first_done_t is not None else None
             )
+            breaker_state = self._breaker_state_locked()
+            breaker_opens = self.breaker_opens
+            degraded_rejected = self.degraded_rejected
+            consecutive_failures = self._consecutive_failures
         pct = profiling.latency_percentiles(lat)
         span_summary = self.span_log.summary()
         return {
             "completed": completed,
             "rejected": rejected,
             "failed": failed,
+            "breaker_state": breaker_state,
+            "breaker_opens": breaker_opens,
+            "degraded_rejected": degraded_rejected,
+            "consecutive_failures": consecutive_failures,
             "p50_latency_s": pct["p50"] if pct else None,
             "p99_latency_s": pct["p99"] if pct else None,
             "num_latency_samples": len(lat),
@@ -326,6 +469,27 @@ class MicroBatchServer:
             ))
         if not already:
             self._thread.join(timeout=timeout)
+
+    def _breaker_state_locked(self) -> str:
+        if self._worker_dead:
+            return "dead"
+        if not self.breaker_threshold:
+            return "disabled"
+        if self._breaker_open:
+            if self._breaker_probing or (
+                time.perf_counter() - self._breaker_opened_t
+                >= self.breaker_reset_s
+            ):
+                # Probe in flight, or the next submit is admitted as one.
+                return "half_open"
+            return "open"
+        return "closed"
+
+    @property
+    def breaker_state(self) -> str:
+        """"closed" / "open" / "half_open" / "disabled" / "dead"."""
+        with self._lock:
+            return self._breaker_state_locked()
 
     @property
     def is_alive(self) -> bool:

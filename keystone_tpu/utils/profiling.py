@@ -104,6 +104,21 @@ def prefetch_overlap_fraction(stats) -> Optional[float]:
     return min(max((load_s - wait_s) / load_s, 0.0), 1.0)
 
 
+def prefetch_retry_counters(stats) -> Dict[str, float]:
+    """Reliability accounting of one streamed fit's ingestion
+    (docs/reliability.md): how many transient read failures the retry
+    layer absorbed (``retries``) and the backoff wall it paid for them
+    (``backoff_s``), from the fit's
+    :class:`~keystone_tpu.data.prefetch.PrefetchStats`. Zero/zero on a
+    healthy run — the steady-state cost of the retry layer is nothing
+    but the counters themselves. Nonzero values mean the fit SUCCEEDED
+    over flaky IO; alert on them before they become exhaustions."""
+    return {
+        "retries": int(getattr(stats, "retries", 0) or 0),
+        "backoff_s": float(getattr(stats, "backoff_s", 0.0) or 0.0),
+    }
+
+
 @dataclass(frozen=True)
 class RequestSpan:
     """Where one served request's latency went (the serving analog of a

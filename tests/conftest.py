@@ -34,10 +34,18 @@ from keystone_tpu.workflow import PipelineEnv
 
 
 def pytest_configure(config):
+    # Markers are canonically registered in pytest.ini; re-registering
+    # here keeps direct `pytest tests/...` invocations from an odd
+    # rootdir warning-free.
     config.addinivalue_line(
         "markers",
         "slow: golden / end-to-end / multihost / heavyweight-property tier "
         "(skipped by default; run with KEYSTONE_FULL_TESTS=1 or -m slow)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection reliability suite "
+        "(kill/resume, corrupt-shard, flaky IO, breaker drills)",
     )
 
 
@@ -62,12 +70,18 @@ def pytest_collection_modifyitems(config, items):
 
 @pytest.fixture(autouse=True)
 def clean_pipeline_env():
-    """Reset global prefix state + optimizer around every test."""
+    """Reset global prefix state + optimizer around every test, and make
+    sure no fault-injection plan leaks out of a chaos test into the rest
+    of the suite."""
+    from keystone_tpu.utils import faults
+
     PipelineEnv.get_or_create().reset()
     mesh_lib.set_default_mesh(None)
+    faults.uninstall()
     yield
     PipelineEnv.get_or_create().reset()
     mesh_lib.set_default_mesh(None)
+    faults.uninstall()
 
 
 @pytest.fixture

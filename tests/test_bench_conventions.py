@@ -26,7 +26,7 @@ class TestMakeRow:
         bench = _load_bench()
         assert bench.VALID_TIMING == {
             "min_of_N_warm", "single_run_cold", "single_run_warm",
-            "host_only", "open_loop_latency",
+            "host_only", "open_loop_latency", "recovery_overhead",
         }
 
     def test_row_carries_timing_in_detail(self):
@@ -107,6 +107,14 @@ class TestEveryMetricUsesMakeRow:
             src = f.read()
         main_body = src[src.index("def main("):]
         assert "serving_mnist_metric," in main_body
+
+    def test_recovery_row_registered(self):
+        bench = _load_bench()
+        assert callable(bench.recovery_overhead_metric)
+        with open(_BENCH_PATH) as f:
+            src = f.read()
+        main_body = src[src.index("def main("):]
+        assert "recovery_overhead_metric," in main_body
 
 
 class TestRooflineAuditability:
@@ -201,6 +209,44 @@ class TestRooflineAuditability:
             bench.make_row("m", 1.0, "s", None, "open_loop_latency", nested)
         nested["rates"][0]["offered_rate_hz"] = 100.0
         bench.make_row("m", 1.0, "s", None, "open_loop_latency", nested)
+
+    def test_recovery_row_requires_interval_and_baseline(self):
+        """ISSUE 5 satellite: a recovery_overhead row's wall fraction is
+        unauditable without the checkpoint interval it was measured at
+        and the baseline seconds it divides by — both numeric, in the
+        same dict."""
+        bench = _load_bench()
+        good = {
+            "checkpoint_every_segments": 8,
+            "baseline_wall_s": 12.31,
+            "checkpointed_wall_s": 12.52,
+        }
+        row = bench.make_row(
+            "recovery_overhead", 0.017, "fraction", None,
+            "recovery_overhead", good,
+        )
+        assert row["detail"]["checkpoint_every_segments"] == 8
+        for missing, pat in (
+            ("checkpoint_every_segments", "checkpoint_every"),
+            ("baseline_wall_s", "baseline"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "recovery_overhead", 0.017, "fraction", None,
+                    "recovery_overhead", d,
+                )
+        # A prose field must not satisfy the rule — the interval and
+        # baseline have to be numbers.
+        d = dict(good)
+        d["checkpoint_every_segments"] = "every eighth segment or so"
+        with pytest.raises(ValueError, match="checkpoint_every"):
+            bench.make_row(
+                "recovery_overhead", 0.017, "fraction", None,
+                "recovery_overhead", d,
+            )
+        # Other timings are not burdened with recovery fields.
+        bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
 
     def test_mnist_row_carries_hbm_claim_fields(self):
         # The MNIST row must state achieved HBM GB/s beside chip peak at

@@ -1,0 +1,436 @@
+"""Chaos suite (ISSUE 5 acceptance): every recovery path in the
+reliability layer executed under the deterministic fault-injection
+harness — kill/resume bit-identity, flaky-IO-under-prefetch, injected
+corruption, circuit breaker open/recover, and the worker watchdog. All
+replayable: plans are seeded/call-indexed (utils/faults.py), so a
+failure here reproduces identically every run.
+
+The heavyweight cases (kill/resume, Poisson fault storms) are marked
+``slow`` so the tier-1 wall is unchanged; run the full suite with
+``pytest -m chaos``.
+"""
+
+import os
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.durable import CheckpointSpec, ShardCorrupted
+from keystone_tpu.data.prefetch import PrefetchStats
+from keystone_tpu.data.shards import DiskCOOShards, DiskDenseShards
+from keystone_tpu.ops.learning.lbfgs import (
+    _resident_chunk_fn,
+    run_lbfgs_gram_streamed,
+)
+from keystone_tpu.ops.learning.streaming_ls import CosineBankFeaturize
+from keystone_tpu.parallel import streaming
+from keystone_tpu.serving import (
+    MicroBatchServer,
+    ServerDegraded,
+    export_plan,
+)
+from keystone_tpu.utils import faults, profiling
+from keystone_tpu.utils.faults import FaultPlan, FaultRule
+from keystone_tpu.workflow import Transformer
+
+from tests._serving_util import fitted_from_transformer
+
+pytestmark = pytest.mark.chaos
+
+# Tiny per-attempt backoff so retry-path tests cost milliseconds.
+FAST_RETRY = {"KEYSTONE_RETRY_BASE_S": "0.001"}
+
+
+@pytest.fixture(autouse=True)
+def fast_retry(monkeypatch):
+    for k, v in FAST_RETRY.items():
+        monkeypatch.setenv(k, v)
+
+
+def _dense_problem(tmp_path, n=700, d_in=10, k=3, tile=64, tps=2):
+    rng = np.random.default_rng(7)
+    X = rng.normal(size=(n, d_in)).astype(np.float32)
+    Y = rng.normal(size=(n, k)).astype(np.float32)
+    shards = DiskDenseShards.write(
+        str(tmp_path / "dense"), X, Y, tile_rows=tile, tiles_per_segment=tps
+    )
+    d_feat, bs = 32, 8
+    bank = CosineBankFeaturize(
+        rng.normal(size=(d_feat, d_in)).astype(np.float32) * 0.3,
+        rng.uniform(0, 6, d_feat).astype(np.float32),
+    )
+
+    def fit(**kw):
+        return streaming.streaming_bcd_fit_segments(
+            shards.as_source(), bank=bank, d_feat=d_feat, block_size=bs,
+            lam=1e-2, num_iter=2, **kw
+        )
+
+    return shards, fit
+
+
+class TestKillResume:
+    """A streamed fit killed via injected fault mid-run and resumed from
+    its checkpoint produces BIT-IDENTICAL results to the uninterrupted
+    run (the acceptance contract)."""
+
+    @pytest.mark.slow
+    def test_dense_fit_killed_and_resumed_bit_identical(self, tmp_path):
+        shards, fit = _dense_problem(tmp_path)
+        assert shards.num_segments >= 5
+        W0, fm0, ym0, loss0 = fit()  # uninterrupted reference
+
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=2)
+        # Exhaust the 3-attempt retry budget on the mid-run segment load
+        # (three consecutive prefetch.read attempts of one segment).
+        kill = FaultPlan([FaultRule("prefetch.read", "error",
+                                    calls=[4, 5, 6])])
+        with kill:
+            with pytest.raises(OSError):
+                fit(checkpoint=ck)
+        assert ck.has_snapshot(), (
+            "the killed fit left no snapshot to resume from"
+        )
+
+        W1, fm1, ym1, loss1 = fit(checkpoint=ck)  # resume, no faults
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+        np.testing.assert_array_equal(np.asarray(fm0), np.asarray(fm1))
+        np.testing.assert_array_equal(np.asarray(ym0), np.asarray(ym1))
+        assert float(loss0) == float(loss1)
+        # Completion cleared the snapshot: the next fit starts fresh.
+        assert not ck.has_snapshot()
+
+    @pytest.mark.slow
+    def test_coo_gram_fit_killed_and_resumed_bit_identical(self, tmp_path):
+        rng = np.random.default_rng(3)
+        n, d, k, w_act, chunk = 900, 96, 2, 5, 128
+        idx = rng.integers(0, d, size=(n, w_act)).astype(np.int32)
+        val = rng.normal(size=(n, w_act)).astype(np.float32)
+        y = rng.normal(size=(n, k)).astype(np.float32)
+        coo = DiskCOOShards.write(
+            str(tmp_path / "coo"), idx, val, y, chunk_rows=chunk,
+            n_true=n, d=d,
+        )
+
+        def fit(**kw):
+            return run_lbfgs_gram_streamed(
+                _resident_chunk_fn, coo.num_chunks, d, k, lam=1e-2,
+                num_iterations=12, n=n, segment_source=coo.as_source(2),
+                prefetch_depth=2, **kw
+            )
+
+        W0, loss0 = fit()
+        ck = CheckpointSpec(str(tmp_path / "ck2"), every_segments=1)
+        kill = FaultPlan([FaultRule("prefetch.read", "error",
+                                    calls=[2, 3, 4])])
+        with kill:
+            with pytest.raises(OSError):
+                fit(checkpoint=ck)
+        W1, loss1 = fit(checkpoint=ck)
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+        assert float(loss0) == float(loss1)
+
+    @pytest.mark.slow
+    def test_stale_checkpoint_from_different_bank_is_ignored(self, tmp_path):
+        """Fingerprints cover the FEATURIZER (type, key, parameter
+        digests), not just geometry: a snapshot left by a killed fit
+        must never seed a fit over a different random-feature bank of
+        the same shape — that would be silently wrong W."""
+        shards, fit = _dense_problem(tmp_path)
+        rng = np.random.default_rng(99)
+        other_bank = CosineBankFeaturize(
+            rng.normal(size=(32, 10)).astype(np.float32) * 0.3,
+            rng.uniform(0, 6, 32).astype(np.float32),
+        )
+
+        def fit_other(**kw):
+            return streaming.streaming_bcd_fit_segments(
+                shards.as_source(), bank=other_bank, d_feat=32,
+                block_size=8, lam=1e-2, num_iter=2, **kw
+            )
+
+        W_ref, *_ = fit_other()  # uninterrupted, other bank
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=2)
+        kill = FaultPlan([FaultRule("prefetch.read", "error",
+                                    calls=[4, 5, 6])])
+        with kill:
+            with pytest.raises(OSError):
+                fit(checkpoint=ck)  # original bank leaves a snapshot
+        # Same spec, different bank: the stale carry is ignored, the
+        # fit restarts from segment 0 and matches its own reference.
+        W1, *_ = fit_other(checkpoint=ck)
+        np.testing.assert_array_equal(np.asarray(W_ref), np.asarray(W1))
+
+    def test_checkpoint_needs_segmented_fit(self):
+        with pytest.raises(ValueError, match="segmented"):
+            run_lbfgs_gram_streamed(
+                _resident_chunk_fn, 2, 8, 1, n=16,
+                operands=(jnp.zeros((2, 8, 2), jnp.int32),
+                          jnp.zeros((2, 8, 2), jnp.float32),
+                          jnp.zeros((2, 8, 1), jnp.float32)),
+                checkpoint=CheckpointSpec("/tmp/never-used"),
+            )
+
+
+class TestFlakyIO:
+    """Transient faults UNDER the retry budget are absorbed — results
+    stay bit-identical to the healthy run, and the recovery is visible
+    in the stats rather than silent."""
+
+    def test_flaky_prefetch_reads_absorbed_bit_identically(self, tmp_path):
+        _, fit = _dense_problem(tmp_path)
+        W0, _, _, loss0 = fit()
+        stats = PrefetchStats()
+        flaky = FaultPlan([FaultRule("prefetch.read", "error",
+                                     calls=[1, 4, 7])])
+        with flaky:
+            W1, _, _, loss1 = fit(prefetch_stats=stats)
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+        assert float(loss0) == float(loss1)
+        counters = profiling.prefetch_retry_counters(stats)
+        assert counters["retries"] == 3
+        assert counters["backoff_s"] > 0.0
+
+    def test_flaky_shard_reads_absorbed_and_counted(self, tmp_path):
+        shards, fit = _dense_problem(tmp_path)
+        W0, *_ = fit()
+        stats = PrefetchStats()
+        flaky = FaultPlan([FaultRule("shard.load", "error", calls=[0, 5])])
+        with flaky:
+            W1, *_ = fit(prefetch_stats=stats)
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+        # SHARD-layer recoveries surface in the fit's stats too (the
+        # observer thread-local) — flaky disks are never structurally
+        # invisible to prefetch_retry_counters.
+        assert stats.retries == 2 and stats.backoff_s > 0.0
+
+    def test_retry_exhaustion_reraises_consumer_side(self, tmp_path):
+        _, fit = _dense_problem(tmp_path)
+        dead = FaultPlan([FaultRule("prefetch.read", "error", p=1.0)])
+        with dead:
+            with pytest.raises(faults.FaultError):
+                fit()
+        # The reader thread did not leak past the failure.
+        time.sleep(0.05)
+        assert not any(
+            t.name == "keystone-prefetch" for t in threading.enumerate()
+        )
+
+    @pytest.mark.slow
+    def test_poisson_fault_storm_under_retry_budget(self, tmp_path):
+        """Seeded probabilistic faults (the Poisson-style drill): a
+        per-read failure rate well under the retry budget must never
+        change the fit result, run after replayable run."""
+        _, fit = _dense_problem(tmp_path)
+        W0, *_ = fit()
+        for seed in (1, 2, 3):
+            storm = FaultPlan(
+                [FaultRule("prefetch.read", "error", p=0.2)], seed=seed
+            )
+            with storm:
+                W1, *_ = fit()
+            np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+
+
+class TestCorruption:
+    def test_injected_corruption_raises_shard_corrupted(self, tmp_path):
+        _, fit = _dense_problem(tmp_path)
+        fit()  # warm/compile on the healthy path
+        plan = FaultPlan([FaultRule("shard.load", "corrupt", calls=[2])])
+        with plan:
+            with pytest.raises(ShardCorrupted, match="checksum"):
+                fit()
+
+    def test_corruption_through_prefetcher_raises_not_retries(self, tmp_path):
+        """Corruption detected on the reader thread re-raises in the
+        consumer as ShardCorrupted — the retry layer must NOT have
+        spun on it (it would re-read the same bytes)."""
+        shards, fit = _dense_problem(tmp_path)
+        plan = FaultPlan([FaultRule("shard.load", "corrupt", calls=[0])])
+        stats = PrefetchStats()
+        with plan:
+            with pytest.raises(ShardCorrupted):
+                fit(prefetch_stats=stats)
+        assert stats.retries == 0
+
+
+class _FailableScale(Transformer):
+    """Device-less x -> 3x for breaker drills (plan failures come from
+    the injected ``serving.execute`` site, not the transformer)."""
+
+    def apply(self, x):
+        return jnp.asarray(x) * 3.0
+
+    def batch_apply(self, ds):
+        return Dataset(jnp.asarray(ds.array) * 3.0, n=ds.n)
+
+
+def _server(**kw):
+    plan = export_plan(
+        fitted_from_transformer(_FailableScale()), np.zeros(4, np.float32),
+        max_batch=8,
+    )
+    kw.setdefault("max_wait_ms", 0.0)
+    return MicroBatchServer(plan, **kw)
+
+
+class TestCircuitBreaker:
+    def test_opens_after_consecutive_failures_then_recovers(self):
+        srv = _server(breaker_threshold=3, breaker_reset_s=0.25)
+        inject = FaultPlan([FaultRule("serving.execute", "error",
+                                      calls=[0, 1, 2])])
+        try:
+            with inject:
+                for _ in range(3):
+                    f = srv.submit(np.ones(4, np.float32))
+                    with pytest.raises(OSError):
+                        f.result(timeout=10)
+                deadline = time.perf_counter() + 5.0
+                while (srv.breaker_state != "open"
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.005)
+                assert srv.breaker_state == "open"
+                # OPEN: fail-fast, synchronously, without queueing.
+                with pytest.raises(ServerDegraded, match="breaker"):
+                    srv.submit(np.ones(4, np.float32))
+                # Cooldown -> half-open probe -> success -> CLOSED.
+                time.sleep(0.3)
+                assert srv.breaker_state == "half_open"
+                probe = srv.submit(np.ones(4, np.float32))
+                np.testing.assert_allclose(probe.result(timeout=10), 3.0)
+                assert srv.breaker_state == "closed"
+            stats = srv.stats()
+            assert stats["breaker_opens"] == 1
+            assert stats["degraded_rejected"] >= 1
+            assert stats["consecutive_failures"] == 0
+        finally:
+            srv.close()
+
+    def test_failed_probe_reopens(self):
+        srv = _server(breaker_threshold=2, breaker_reset_s=0.2)
+        inject = FaultPlan([FaultRule("serving.execute", "error",
+                                      calls=[0, 1, 2])])
+        try:
+            with inject:
+                for _ in range(2):
+                    f = srv.submit(np.ones(4, np.float32))
+                    with pytest.raises(OSError):
+                        f.result(timeout=10)
+                time.sleep(0.25)
+                probe = srv.submit(np.ones(4, np.float32))  # probe fails
+                with pytest.raises(OSError):
+                    probe.result(timeout=10)
+                deadline = time.perf_counter() + 5.0
+                while (srv.breaker_state != "open"
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.005)
+                assert srv.breaker_state == "open"
+                assert srv.stats()["breaker_opens"] == 2
+        finally:
+            srv.close()
+
+    def test_half_open_admits_exactly_one_probe(self):
+        """While the half-open probe is in flight, further submissions
+        still fail fast — otherwise full offered load pours in against
+        the still-unverified plan during the probe's execution."""
+        gate = threading.Event()
+        gate.set()
+
+        class Gated(Transformer):
+            def apply(self, x):
+                return jnp.asarray(x) * 3.0
+
+            def batch_apply(self, ds):
+                gate.wait(timeout=10.0)
+                return Dataset(jnp.asarray(ds.array) * 3.0, n=ds.n)
+
+        plan = export_plan(
+            fitted_from_transformer(Gated()), np.zeros(4, np.float32),
+            max_batch=8,
+        )
+        srv = MicroBatchServer(plan, max_wait_ms=0.0,
+                               breaker_threshold=2, breaker_reset_s=0.15)
+        inject = FaultPlan([FaultRule("serving.execute", "error",
+                                      calls=[0, 1])])
+        try:
+            with inject:
+                for _ in range(2):
+                    with pytest.raises(OSError):
+                        srv.submit(np.ones(4, np.float32)).result(timeout=10)
+                deadline = time.perf_counter() + 5.0
+                while (srv.breaker_state != "open"
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.005)
+                time.sleep(0.2)  # cooldown elapses
+                gate.clear()  # the probe batch will block mid-execution
+                probe = srv.submit(np.ones(4, np.float32))
+                time.sleep(0.05)  # worker picks the probe up, blocks
+                assert srv.breaker_state == "half_open"
+                with pytest.raises(ServerDegraded):
+                    srv.submit(np.ones(4, np.float32))  # NOT a 2nd probe
+                gate.set()
+                np.testing.assert_allclose(probe.result(timeout=10), 3.0)
+                assert srv.breaker_state == "closed"
+        finally:
+            gate.set()
+            srv.close()
+
+    def test_disabled_breaker_keeps_accepting(self):
+        srv = _server(breaker_threshold=0)
+        inject = FaultPlan([FaultRule("serving.execute", "error", p=1.0)])
+        try:
+            with inject:
+                for _ in range(8):
+                    f = srv.submit(np.ones(4, np.float32))
+                    with pytest.raises(OSError):
+                        f.result(timeout=10)
+            assert srv.breaker_state == "disabled"
+            out = srv.submit(np.ones(4, np.float32)).result(timeout=10)
+            np.testing.assert_allclose(out, 3.0)
+        finally:
+            srv.close()
+
+
+class TestWorkerWatchdog:
+    def test_dead_worker_fails_pending_futures_and_poisons_submit(self):
+        srv = _server(max_wait_ms=100.0)
+        # First request proves the server healthy.
+        np.testing.assert_allclose(
+            srv.submit(np.ones(4, np.float32)).result(timeout=10), 3.0
+        )
+        # Sabotage the worker loop OUTSIDE the per-batch error guard
+        # (_execute is the guard; replacing it makes the loop itself
+        # raise with the popped batch in flight).
+        srv._execute = None
+        fut = srv.submit(np.ones(4, np.float32))
+        with pytest.raises(ServerDegraded, match="worker thread died"):
+            fut.result(timeout=10)
+        deadline = time.perf_counter() + 5.0
+        while srv.is_alive and time.perf_counter() < deadline:
+            time.sleep(0.01)
+        assert not srv.is_alive
+        assert srv.breaker_state == "dead"
+        with pytest.raises(ServerDegraded):
+            srv.submit(np.ones(4, np.float32))
+        srv.close()  # join of a dead worker must not hang
+
+
+class TestZeroFaultTransparency:
+    """With no plan installed, the reliability layer must be invisible:
+    identical outputs and zero retry accounting (the acceptance's
+    byte-identity clause; steady-state wall is priced by the
+    recovery_overhead bench row)."""
+
+    def test_prefetched_fit_identical_with_and_without_harness(self, tmp_path):
+        _, fit = _dense_problem(tmp_path)
+        stats = PrefetchStats()
+        W0, *_ = fit(prefetch_stats=stats)
+        assert stats.retries == 0 and stats.backoff_s == 0.0
+        empty = FaultPlan([])  # installed but ruleless
+        with empty:
+            W1, *_ = fit()
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))

@@ -85,7 +85,12 @@ class TestDiskShards:
         assert (tail[0][1] == -1).all() and (tail[1][1] == 0).all()
 
     def test_incremental_create_fill(self, tmp_path):
-        # The too-big-to-hold-once path: create memmaps, fill per chunk.
+        # The too-big-to-hold-once path: create memmaps, fill per chunk,
+        # then SEAL — the durability commit point (ISSUE 5): loading an
+        # unsealed directory must fail loudly, never parse as a
+        # valid-but-short dataset.
+        from keystone_tpu.data.durable import ShardCorrupted
+
         n = 3 * CHUNK
         idx, val, y = _coo_problem(n, seed=2)
         d = str(tmp_path / "inc")
@@ -97,7 +102,10 @@ class TestDiskShards:
             mm_i[c], mm_v[c], mm_y[c] = idx[sl], val[sl], y[sl]
         for mm in (mm_i, mm_v, mm_y):
             mm.flush()
-        shards = DiskCOOShards(d)
+        with pytest.raises(ShardCorrupted, match="sealed"):
+            DiskCOOShards(d)  # killed-mid-build directories look like this
+        shards = DiskCOOShards.seal(d)
+        assert shards.is_checksummed
         got = shards.segment_source(1, 1)
         np.testing.assert_array_equal(got[0][0], idx[CHUNK : 2 * CHUNK])
 

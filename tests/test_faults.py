@@ -1,0 +1,205 @@
+"""Fault-injection harness + retry policy (ISSUE 5 tentpole): plans are
+deterministic and replayable, site counters are exact, the env wiring
+works, and the backoff schedule is a pure function of its seed."""
+
+import os
+
+import numpy as np
+import pytest
+
+from keystone_tpu.utils import faults
+from keystone_tpu.utils.faults import FaultPlan, FaultRule, RetryPolicy
+
+
+class TestFaultPlan:
+    def test_call_indexed_rule_fires_exactly_listed_calls(self):
+        plan = FaultPlan([FaultRule("s", "error", calls=[1, 3])])
+        with plan:
+            faults.maybe_fail("s")  # call 0
+            with pytest.raises(faults.FaultError):
+                faults.maybe_fail("s")  # call 1
+            faults.maybe_fail("s")  # call 2
+            with pytest.raises(faults.FaultError):
+                faults.maybe_fail("s")  # call 3
+            faults.maybe_fail("s")  # call 4
+        assert plan.calls_seen("s") == 5
+        assert [c for _, c, _ in plan.log] == [1, 3]
+
+    def test_sites_count_independently(self):
+        plan = FaultPlan([FaultRule("a", "error", calls=[0])])
+        with plan:
+            faults.maybe_fail("b")  # does not advance or trip site a
+            with pytest.raises(faults.FaultError):
+                faults.maybe_fail("a")
+        assert plan.calls_seen("a") == 1 and plan.calls_seen("b") == 1
+
+    def test_probabilistic_rule_replayable(self):
+        def run():
+            plan = FaultPlan(
+                [FaultRule("s", "error", calls=None, p=0.5)], seed=7
+            )
+            hits = []
+            with plan:
+                for i in range(32):
+                    try:
+                        faults.maybe_fail("s")
+                        hits.append(0)
+                    except faults.FaultError:
+                        hits.append(1)
+            return hits
+
+        first, second = run(), run()
+        assert first == second  # same seed -> identical injection trace
+        assert 0 < sum(first) < 32
+
+    def test_count_bounds_probabilistic_rule(self):
+        plan = FaultPlan([FaultRule("s", "error", p=1.0, count=2)])
+        errors = 0
+        with plan:
+            for _ in range(5):
+                try:
+                    faults.maybe_fail("s")
+                except faults.FaultError:
+                    errors += 1
+        assert errors == 2
+
+    def test_latency_rule_sleeps(self):
+        import time
+
+        plan = FaultPlan(
+            [FaultRule("s", "latency", calls=[0], latency_s=0.05)]
+        )
+        with plan:
+            t0 = time.perf_counter()
+            faults.maybe_fail("s")
+            assert time.perf_counter() - t0 >= 0.045
+
+    def test_corrupt_rule_flips_one_byte_deterministically(self):
+        arr = np.arange(8, dtype=np.float32)
+        plan = FaultPlan([FaultRule("s", "corrupt", calls=[0])])
+        with plan:
+            out = faults.corrupt_array("s", arr)
+            clean = faults.corrupt_array("s", arr)  # call 1: no rule
+        assert not np.array_equal(out, arr)
+        np.testing.assert_array_equal(clean, arr)
+        # The original buffer is never mutated in place.
+        np.testing.assert_array_equal(arr, np.arange(8, dtype=np.float32))
+
+    def test_error_rules_do_not_shift_corrupt_counters(self):
+        # maybe_fail and corrupt_array at one site keep separate call
+        # counters, so composing rules never renumbers either sequence.
+        plan = FaultPlan([
+            FaultRule("s", "error", calls=[0]),
+            FaultRule("s", "corrupt", calls=[0]),
+        ])
+        arr = np.ones(4, np.float32)
+        with plan:
+            out = faults.corrupt_array("s", arr)  # corrupt call 0: fires
+            with pytest.raises(faults.FaultError):
+                faults.maybe_fail("s")  # error call 0: fires
+        assert not np.array_equal(out, arr)
+
+    def test_no_plan_hooks_are_noops(self):
+        faults.uninstall()
+        faults.maybe_fail("anything")
+        arr = np.ones(3)
+        assert faults.corrupt_array("anything", arr) is arr
+
+    def test_nested_install_rejected(self):
+        with FaultPlan([FaultRule("s", "error", calls=[0])]):
+            with pytest.raises(RuntimeError, match="already installed"):
+                faults.install(FaultPlan([FaultRule("t", "error",
+                                                    calls=[0])]))
+
+    def test_env_plan_roundtrip(self):
+        plan = FaultPlan(
+            [FaultRule("shard.load", "error", calls=[2], exc="OSError"),
+             FaultRule("prefetch.read", "corrupt", calls=[1])],
+            seed=3,
+        )
+        import json
+
+        restored = FaultPlan.from_json(json.dumps(plan.to_dict()))
+        assert restored.seed == 3
+        assert restored.rules[0].site == "shard.load"
+        assert restored.rules[0].calls == frozenset([2])
+        assert restored.rules[1].kind == "corrupt"
+
+    def test_env_var_activation(self, monkeypatch):
+        monkeypatch.setenv(
+            "KEYSTONE_FAULT_PLAN",
+            '{"rules": [{"site": "s", "kind": "error", "calls": [0]}]}',
+        )
+        faults._reset_env_cache()
+        try:
+            with pytest.raises(faults.FaultError):
+                faults.maybe_fail("s")
+        finally:
+            faults.uninstall()
+            faults._reset_env_cache()
+
+    def test_invalid_rule_rejected(self):
+        with pytest.raises(ValueError, match="kind"):
+            FaultRule("s", "explode", calls=[0])
+        with pytest.raises(ValueError, match="calls"):
+            FaultRule("s", "error")
+
+
+class TestRetryPolicy:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        policy = RetryPolicy(attempts=3, base_delay_s=0.001)
+        retried = []
+        assert policy.call(
+            flaky, on_retry=lambda a, d, e: retried.append((a, d))
+        ) == "ok"
+        assert len(calls) == 3 and len(retried) == 2
+
+    def test_exhaustion_reraises_last_error(self):
+        policy = RetryPolicy(attempts=2, base_delay_s=0.001)
+        with pytest.raises(OSError, match="always"):
+            policy.call(lambda: (_ for _ in ()).throw(OSError("always")))
+
+    def test_non_transient_raises_immediately(self):
+        from keystone_tpu.data.durable import ShardCorrupted
+
+        calls = []
+
+        def corrupt():
+            calls.append(1)
+            raise ShardCorrupted("bad bytes")
+
+        policy = RetryPolicy(attempts=5, base_delay_s=0.001)
+        with pytest.raises(ShardCorrupted):
+            policy.call(corrupt)
+        assert len(calls) == 1  # persistent failures are never retried
+
+    def test_backoff_deterministic_and_bounded(self):
+        p1 = RetryPolicy(attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                         seed=11)
+        p2 = RetryPolicy(attempts=5, base_delay_s=0.1, max_delay_s=0.5,
+                         seed=11)
+        seq1 = [p1.delay_s(a, "k") for a in range(1, 5)]
+        seq2 = [p2.delay_s(a, "k") for a in range(1, 5)]
+        assert seq1 == seq2  # deterministic jitter
+        assert all(d <= 0.5 for d in seq1)  # capped
+        assert seq1[1] > seq1[0] * 1.5  # roughly exponential
+        other = RetryPolicy(attempts=5, base_delay_s=0.1, seed=12)
+        assert [other.delay_s(a, "k") for a in range(1, 5)] != seq1
+
+    def test_default_policy_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_RETRY_ATTEMPTS", "7")
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0.5")
+        policy = faults.default_retry_policy()
+        assert policy.attempts == 7 and policy.base_delay_s == 0.5
+
+    def test_attempts_must_be_positive(self):
+        with pytest.raises(ValueError):
+            RetryPolicy(attempts=0)

@@ -129,6 +129,117 @@ class TestPrefetcher:
         assert stats.segments == 6
         assert stats.load_s >= 6 * 0.01
 
+    def test_consumer_error_depth_gt_1_slow_reader_joins_promptly(self):
+        """ISSUE 5 satellite regression: the depth-1 shutdown test left
+        the depth>1 + slow-reader stop path uncovered — a consumer that
+        raises while the reader is mid-load with a FULL queue must still
+        join the reader promptly and release every queued buffer."""
+        src = CountingSource(1000, delay=0.02)  # slow reader
+        p = Prefetcher(src, depth=3)
+        t0 = time.perf_counter()
+        with pytest.raises(RuntimeError, match="consumer boom"):
+            for s, _ in p:
+                if s == 1:
+                    time.sleep(0.12)  # let the reader fill all 3 slots
+                    raise RuntimeError("consumer boom")
+        join_wall = time.perf_counter() - t0
+        # close() (via the generator finalizer) joined the reader: no
+        # thread leaked, the join did not ride out the 1000-segment
+        # stream, and the staged buffers were drained, not leaked.
+        assert not any(
+            t.name == "keystone-prefetch" for t in threading.enumerate()
+        )
+        assert join_wall < 5.0
+        assert p._queue.qsize() == 0
+        assert len(src.loaded) < 20
+
+    def test_reader_retries_transient_errors_into_stats(self, monkeypatch):
+        """ISSUE 5: transient OSErrors on the reader thread retry with
+        backoff instead of killing the pass; the recovery is visible in
+        PrefetchStats (surfaced via profiling.prefetch_retry_counters)."""
+        from keystone_tpu.utils import profiling
+
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0.001")
+
+        class FlakyOnce(ShardSource):
+            num_segments = 5
+            n_true = 50
+
+            def __init__(self):
+                self.failed = set()
+
+            def load(self, s):
+                if s == 2 and s not in self.failed:
+                    self.failed.add(s)
+                    raise OSError("transient blip")
+                return np.full(3, s, np.float32)
+
+        stats = PrefetchStats()
+        got = [s for s, _ in Prefetcher(FlakyOnce(), depth=2, stats=stats)]
+        assert got == list(range(5))  # nothing dropped or reordered
+        counters = profiling.prefetch_retry_counters(stats)
+        assert counters["retries"] == 1 and counters["backoff_s"] > 0.0
+
+    def test_shard_backed_sources_do_not_nest_retries(self, tmp_path,
+                                                      monkeypatch):
+        """The shard layer owns disk retries for shard-backed sources;
+        the prefetcher must NOT wrap load() in a second policy, or a
+        dead disk costs attempts^2 reads and compounded backoff before
+        the error surfaces."""
+        from keystone_tpu.utils import faults
+
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0.001")
+        rng = np.random.default_rng(5)
+        shards = DiskDenseShards.write(
+            str(tmp_path / "d"),
+            rng.normal(size=(200, 6)).astype(np.float32),
+            rng.normal(size=(200, 2)).astype(np.float32),
+            tile_rows=32, tiles_per_segment=2,
+        )
+        source = shards.as_source()
+        assert source.load_retries_transients
+        dead = faults.FaultPlan(
+            [faults.FaultRule("shard.load", "error", p=1.0)]
+        )
+        with dead:
+            with pytest.raises(OSError):
+                for _ in Prefetcher(source, depth=2):
+                    pass
+        # Exactly ONE bounded retry cycle: 3 attempts at the shard
+        # layer, not 3x3 through a nested prefetch-layer policy.
+        assert dead.calls_seen("shard.load") == 3
+        # The resume rebox (iter_segments start=) must keep the same
+        # ownership — a checkpointed fit's remaining segments get the
+        # identical failure cost.
+        dead2 = faults.FaultPlan(
+            [faults.FaultRule("shard.load", "error", p=1.0)]
+        )
+        with dead2:
+            with pytest.raises(OSError):
+                for _ in iter_segments(shards.as_source(), start=1):
+                    pass
+        assert dead2.calls_seen("shard.load") == 3
+
+    def test_reader_retry_exhaustion_reraises_consumer_side(self, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0.001")
+
+        class AlwaysDown(ShardSource):
+            num_segments = 4
+            n_true = 40
+
+            def load(self, s):
+                if s == 1:
+                    raise OSError("disk gone for good")
+                return np.zeros(2)
+
+        stats = PrefetchStats()
+        seen = []
+        with pytest.raises(OSError, match="disk gone for good"):
+            for s, _ in Prefetcher(AlwaysDown(), depth=2, stats=stats):
+                seen.append(s)
+        assert seen == [0]
+        assert stats.retries == 2  # 3 attempts = 2 retries, then re-raise
+
 
 class TestPrefetchedFits:
     """Streamed fits from a prefetched ShardSource are bit-identical to

@@ -257,6 +257,96 @@ class TestErrors:
             server.close()
 
 
+class TestDegradation:
+    """ISSUE 5: explicit degradation — breaker states surface in stats,
+    defaults never trip on a healthy server, and a dying worker fails
+    futures instead of hanging submitters (the chaos drills in
+    tests/test_chaos.py exercise the injected-fault forms)."""
+
+    def _exploding_server(self, **kw):
+        class Exploding(Transformer):
+            def __init__(self):
+                self.arm = True
+
+            def apply(self, x):
+                return x
+
+            def batch_apply(self, ds):
+                if self.arm:
+                    raise ValueError("plan down")
+                return ds
+
+        op = Exploding()
+        plan = export_plan(
+            fitted_from_transformer(op), np.zeros(4, np.float32), max_batch=4
+        )
+        return op, MicroBatchServer(plan, max_wait_ms=0.0, **kw)
+
+    def test_healthy_server_reports_closed_breaker(self):
+        _, server = _gated_server()
+        try:
+            server.submit(np.ones(4, np.float32)).result(timeout=10)
+            stats = server.stats()
+            assert stats["breaker_state"] == "closed"
+            assert stats["breaker_opens"] == 0
+            assert stats["degraded_rejected"] == 0
+            assert stats["consecutive_failures"] == 0
+        finally:
+            server.close()
+
+    def test_breaker_opens_and_recovers_via_half_open_probe(self):
+        from keystone_tpu.serving import ServerDegraded
+
+        op, server = self._exploding_server(
+            breaker_threshold=2, breaker_reset_s=0.2
+        )
+        try:
+            for _ in range(2):
+                with pytest.raises(ValueError, match="plan down"):
+                    server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            deadline = time.perf_counter() + 5.0
+            while (server.breaker_state != "open"
+                   and time.perf_counter() < deadline):
+                time.sleep(0.005)
+            with pytest.raises(ServerDegraded):
+                server.submit(np.zeros(4, np.float32))
+            op.arm = False  # plan healthy again
+            time.sleep(0.25)  # cooldown elapses -> half-open
+            server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            assert server.breaker_state == "closed"
+            assert server.stats()["breaker_opens"] == 1
+        finally:
+            server.close()
+
+    def test_default_threshold_absorbs_isolated_failures(self):
+        # One failed batch out of many must NOT trip the default
+        # breaker: isolated errors re-raise submitter-side, stream
+        # continues (pre-reliability behavior).
+        op, server = self._exploding_server()
+        try:
+            with pytest.raises(ValueError):
+                server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            op.arm = False
+            server.submit(np.zeros(4, np.float32)).result(timeout=10)
+            assert server.breaker_state == "closed"
+        finally:
+            server.close()
+
+    def test_worker_death_never_hangs_submitters(self):
+        from keystone_tpu.serving import ServerDegraded
+
+        _, server = _gated_server(max_wait_ms=100.0)
+        server.submit(np.ones(4, np.float32)).result(timeout=10)
+        server._execute = None  # loop-level failure, outside the guard
+        fut = server.submit(np.ones(4, np.float32))
+        with pytest.raises(ServerDegraded, match="worker thread died"):
+            fut.result(timeout=10)
+        with pytest.raises(ServerDegraded):
+            server.submit(np.ones(4, np.float32))
+        assert server.stats()["breaker_state"] == "dead"
+        server.close()  # must not hang on the dead worker
+
+
 @pytest.mark.slow
 class TestOpenLoopPoisson:
     """Poisson load smoke (slow tier: real sleeps over a multi-second
