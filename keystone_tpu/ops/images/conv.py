@@ -151,6 +151,12 @@ class Convolver(Transformer):
         out = self._convolve(batch)
         return out[0] if single else out
 
+    # The convolution computes in float32 BY DESIGN (filters are cast at
+    # construction, the einsum pins preferred_element_type): float64
+    # image input narrowing to f32 here is the declared compute dtype,
+    # not silent drift — tell the plan verifier so (workflow/verify.py).
+    declares_dtype_change = True
+
     def _batch_fn(self, X):
         return self._convolve(jnp.asarray(X, jnp.float32))
 

@@ -18,11 +18,19 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.workflow import Estimator, Transformer
+from keystone_tpu.workflow.verify import HostSig, expect_host
 
 
 # ---------------------------------------------------------------------------
 # String transformers (reference: StringUtils.scala:13-29)
 # ---------------------------------------------------------------------------
+#
+# These run host-side (jax.eval_shape cannot trace them), so each one
+# DECLARES its static output signature for the plan verifier
+# (workflow/verify.py): what host kind it consumes and what it emits.
+# A text pipeline wired out of order (e.g. n-grams before tokenization)
+# then fails verification with node coordinates instead of raising a
+# confusing AttributeError mid-fit.
 
 
 class Tokenizer(Transformer):
@@ -39,15 +47,25 @@ class Tokenizer(Transformer):
             tokens.pop()
         return tokens
 
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("str",), self)
+        return HostSig("tokens", n=sig.n, datum=sig.datum)
+
 
 class Trim(Transformer):
     def apply(self, s: str) -> str:
         return s.strip()
 
+    def output_signature(self, sig):
+        return expect_host(sig, ("str",), self)
+
 
 class LowerCase(Transformer):
     def apply(self, s: str) -> str:
         return s.lower()
+
+    def output_signature(self, sig):
+        return expect_host(sig, ("str",), self)
 
 
 # ---------------------------------------------------------------------------
@@ -101,6 +119,10 @@ class NGramsFeaturizer(Transformer):
                 out.append(tuple(tokens[i : i + order]))
         return out
 
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("tokens", "int_tokens"), self)
+        return HostSig("ngrams", n=sig.n, datum=sig.datum)
+
 
 class NGramsCounts(Transformer):
     """Count n-gram occurrences over the whole dataset, returning a Dataset of
@@ -126,6 +148,13 @@ class NGramsCounts(Transformer):
             counts.update(NGram(g) for g in item)
         ordered = sorted(counts.items(), key=lambda kv: -kv[1])
         return Dataset.of(ordered)
+
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("ngrams", "tokens"), self)
+        # The default mode aggregates ACROSS examples — the output count
+        # is the number of distinct n-grams, not the input n.
+        n = sig.n if self.mode == "no_add" else None
+        return HostSig("ngram_counts", n=n, datum=sig.datum)
 
 
 # ---------------------------------------------------------------------------
@@ -178,6 +207,10 @@ class HashingTF(Transformer):
             tf[i] = tf.get(i, 0.0) + 1.0
         return tf
 
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("tokens", "ngrams", "int_tokens"), self)
+        return HostSig("tf_dict", n=sig.n, datum=sig.datum)
+
 
 class NGramsHashingTF(Transformer):
     """Fused n-gram extraction + hashing TF, computing each n-gram's hash by
@@ -209,6 +242,10 @@ class NGramsHashingTF(Transformer):
                     tf[idx] = tf.get(idx, 0.0) + 1.0
         return tf
 
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("tokens", "int_tokens"), self)
+        return HostSig("tf_dict", n=sig.n, datum=sig.datum)
+
 
 # ---------------------------------------------------------------------------
 # Word frequency encoding (reference: WordFrequencyEncoder.scala:7-62)
@@ -227,6 +264,10 @@ class WordFrequencyTransformer(Transformer):
     def apply(self, words: Sequence[str]) -> List[int]:
         return [self.word_index.get(w, self.OOV_INDEX) for w in words]
 
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("tokens",), self)
+        return HostSig("int_tokens", n=sig.n, datum=sig.datum)
+
 
 class WordFrequencyEncoder(Estimator):
     """Fit the vocabulary sorted by descending frequency
@@ -240,6 +281,14 @@ class WordFrequencyEncoder(Estimator):
         word_index = {w: i for i, (w, _) in enumerate(ordered)}
         unigram_counts = {word_index[w]: c for w, c in ordered}
         return WordFrequencyTransformer(word_index, unigram_counts)
+
+    def fitted_signature(self, input_sigs):
+        """Static signature of the fitted transformer's output at the
+        delegating apply site (verifier contract)."""
+        sig = input_sigs[0] if input_sigs else None
+        if isinstance(sig, HostSig):
+            return HostSig("int_tokens", n=sig.n, datum=sig.datum)
+        return None
 
 
 # ---------------------------------------------------------------------------
@@ -271,6 +320,10 @@ class CoreNLPFeatureExtractor(Transformer):
     def apply(self, sentence: str) -> List[Tuple]:
         lemmas = [self.lemmatizer(t) for t in self.tokenizer.apply(sentence) if t]
         return self.featurizer.apply(lemmas)
+
+    def output_signature(self, sig):
+        sig = expect_host(sig, ("str",), self)
+        return HostSig("ngrams", n=sig.n, datum=sig.datum)
 
 
 # ---------------------------------------------------------------------------

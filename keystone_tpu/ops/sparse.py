@@ -477,6 +477,25 @@ class SparseFeatureVectorizer(Transformer):
             data.to_list(), self.feature_space, self.max_nnz
         )
 
+    def output_signature(self, sig):
+        """Verifier declaration: weighted host items in, padded-COO
+        sparse batch out (`sparse` kind — the dict pytree the sparse
+        solvers consume)."""
+        from keystone_tpu.workflow.verify import HostSig, expect_host
+
+        sig = expect_host(sig, ("tf_dict", "ngram_counts"), self)
+        return HostSig("sparse", n=sig.n, datum=sig.datum)
+
+
+def _check_sparse_fit_input(est, input_sigs):
+    """Shared fit-input contract for the sparse feature-space estimators:
+    the DATA input must be weighted host items (a raw token stream here
+    means the TermFrequency/weighting stage was dropped)."""
+    from keystone_tpu.workflow.verify import HostSig, expect_host
+
+    if input_sigs and isinstance(input_sigs[0], HostSig):
+        expect_host(input_sigs[0], ("tf_dict", "ngram_counts"), est)
+
 
 class CommonSparseFeatures(Estimator):
     """Keep the top-K features by document frequency, deterministic tie-break
@@ -499,6 +518,17 @@ class CommonSparseFeatures(Estimator):
         feature_space = {f: i for i, (f, _) in enumerate(top)}
         return SparseFeatureVectorizer(feature_space, self.max_nnz)
 
+    def check_fit_signature(self, input_sigs):
+        _check_sparse_fit_input(self, input_sigs)
+
+    def fitted_signature(self, input_sigs):
+        from keystone_tpu.workflow.verify import HostSig
+
+        sig = input_sigs[0] if input_sigs else None
+        n = getattr(sig, "n", None)
+        datum = getattr(sig, "datum", False)
+        return HostSig("sparse", n=n, datum=datum)
+
 
 class AllSparseFeatures(Estimator):
     """Use every observed feature (reference: AllSparseFeatures.scala:15-27)."""
@@ -513,3 +543,14 @@ class AllSparseFeatures(Estimator):
                 if f not in seen:
                     seen[f] = len(seen)
         return SparseFeatureVectorizer(seen, self.max_nnz)
+
+    def check_fit_signature(self, input_sigs):
+        _check_sparse_fit_input(self, input_sigs)
+
+    def fitted_signature(self, input_sigs):
+        from keystone_tpu.workflow.verify import HostSig
+
+        sig = input_sigs[0] if input_sigs else None
+        n = getattr(sig, "n", None)
+        datum = getattr(sig, "datum", False)
+        return HostSig("sparse", n=n, datum=datum)

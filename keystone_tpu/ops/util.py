@@ -40,11 +40,20 @@ class Cacher(Transformer):
 
     name: Optional[str] = None
 
+    # Verifier contract (workflow/verify.py): a cache marker is a
+    # signature passthrough, and its PLACEMENT is checked — a cut that
+    # severs an edge the fusion rules would compile into one program is
+    # reported as `cache-splits-fusion`.
+    is_cache = True
+
     def apply(self, x):
         return x
 
     def batch_apply(self, data: Dataset) -> Dataset:
         return data.cache()
+
+    def output_signature(self, sig):
+        return sig
 
 
 @dataclass(frozen=True)
@@ -70,6 +79,24 @@ class ClassLabelIndicatorsFromIntLabels(Transformer):
         # ±1 encoding is non-zero-preserving: re-zero padding rows.
         return out._rezero_padding()
 
+    def output_signature(self, sig):
+        """Verifier declaration: int labels (lead,) -> ±1 indicators
+        (lead, num_classes) float32."""
+        from keystone_tpu.workflow.verify import ArraySig, SignatureError
+
+        if not isinstance(sig, ArraySig):
+            return None
+        if len(sig.shape) > (0 if sig.datum else 1):
+            raise SignatureError(
+                f"{self.label} expects scalar int labels per example, got "
+                f"{sig.describe()}"
+            )
+        shape = (self.num_classes,) if sig.datum else (
+            sig.shape[0], self.num_classes
+        )
+        return ArraySig(shape, "float32", n=sig.n, mesh=sig.mesh,
+                        datum=sig.datum)
+
 
 @dataclass(frozen=True)
 class ClassLabelIndicatorsFromIntArrayLabels(Transformer):
@@ -93,6 +120,14 @@ class ClassLabelIndicatorsFromIntArrayLabels(Transformer):
 
     def batch_apply(self, data: Dataset) -> Dataset:
         return Dataset.of([self.apply(x) for x in data.to_list()])
+
+    def output_signature(self, sig):
+        from keystone_tpu.workflow.verify import ArraySig
+
+        datum = getattr(sig, "datum", False)
+        n = getattr(sig, "n", None)
+        shape = (self.num_classes,) if datum else (n, self.num_classes)
+        return ArraySig(shape, "float32", n=n, datum=datum)
 
 
 @dataclass(frozen=True)
@@ -125,6 +160,20 @@ class TopKClassifier(Transformer):
         arr = jnp.asarray(data.array)
         _, idx = jax.lax.top_k(arr, min(self.k, arr.shape[-1]))
         return Dataset(idx, n=data.n, mesh=data.mesh)
+
+    def output_signature(self, sig):
+        from keystone_tpu.workflow.verify import ArraySig, SignatureError
+
+        if not isinstance(sig, ArraySig):
+            return None
+        if not sig.shape:
+            raise SignatureError(
+                f"{self.label} needs a score vector, got {sig.describe()}"
+            )
+        d = sig.shape[-1]
+        k = min(self.k, d) if d is not None else self.k
+        return ArraySig(sig.shape[:-1] + (k,), "int32", n=sig.n,
+                        mesh=sig.mesh, datum=sig.datum)
 
 
 @dataclass(frozen=True)
@@ -178,6 +227,10 @@ class FloatToDouble(Transformer):
 
     strict: bool = False
 
+    # The whole point of this node is a dtype change — tell the plan
+    # verifier's drift check it is declared, not silent.
+    declares_dtype_change = True
+
     def _dtype(self):
         return jnp.float64 if self.strict else jnp.float32
 
@@ -200,6 +253,9 @@ class Shuffler(Transformer):
 
     def apply(self, x):
         return x
+
+    def output_signature(self, sig):
+        return sig  # a permutation is a signature passthrough
 
     def batch_apply(self, data: Dataset) -> Dataset:
         if data.is_host:

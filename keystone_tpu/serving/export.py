@@ -293,6 +293,18 @@ def export_plan(
     # frozen (no fit_datasets operator can execute at request time).
     graph = TransformerGraph.from_graph(fitted.transformer_graph)
 
+    # Static verification of the apply plan (workflow/verify.py): no
+    # estimator state reachable at request time, and the whole chain must
+    # typecheck from the example input's concrete signature — a shape or
+    # dtype bug fails HERE with node coordinates, before any bucket is
+    # AOT-compiled. KEYSTONE_VERIFY=off disables.
+    from keystone_tpu.workflow.verify import verify_apply_graph
+
+    verify_apply_graph(
+        graph, fitted.source, fitted.sink, example=example_input,
+        context="export_plan apply plan",
+    )
+
     # Reuse the offline optimizer's fusion passes on the apply-only graph.
     # The fit-time optimization couldn't fuse across the (then-unfitted)
     # delegating nodes; here the model IS a transformer and the chain

@@ -1,0 +1,2 @@
+"""Developer tooling: the discipline linter (:mod:`.lint`) and the
+static-verifier dry-run over the bundled pipelines (:mod:`.dryrun`)."""

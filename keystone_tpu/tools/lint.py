@@ -1,0 +1,555 @@
+"""Discipline linter: AST checks encoding the repo's written invariants.
+
+PRs 2–5 introduced hand-rolled thread and fault disciplines that, until
+now, lived only in comments and docs — nothing enforced them
+mechanically. This module turns each one into an AST rule, runnable as a
+CLI (``python -m keystone_tpu.tools.lint [paths...]``) and as a tier-1
+test over the whole package (``tests/test_lint.py``):
+
+``jax-off-thread``
+    No ``jax``/``jnp`` usage reachable from a background-thread target —
+    the ``data/prefetch.py`` / ``serving/batcher.py`` discipline: reader
+    threads own disk+numpy ONLY; exactly one thread owns JAX. Reachability
+    is per-module and depth-limited: the target function plus the local
+    / same-class helpers it calls. A function that IS the designated JAX
+    owner opts out with a ``# lint: jax-owner-thread`` marker on its
+    ``def`` line.
+
+``thread-join``
+    Every scope (class or function) that ``.start()``s a
+    ``threading.Thread`` must also ``.join()`` one on its shutdown path —
+    the "close() joins the worker" contract both Prefetcher and
+    MicroBatchServer document and test.
+
+``retry-transient``
+    ``RetryPolicy(transient=...)`` tuples must never include
+    ``ShardCorrupted``: a checksum mismatch is persistent state and
+    retrying it re-reads the same bad bytes while hiding the corruption
+    (the data/durable.py invariant — ShardCorrupted is deliberately NOT
+    an OSError for exactly this reason).
+
+``fault-site``
+    Fault-injection site names (``faults.maybe_fail(...)``,
+    ``faults.corrupt_array(...)``, ``FaultRule(site=...)``) must exist in
+    the ``SITE_*`` registry of :mod:`keystone_tpu.utils.faults` — a typo
+    in a site name silently turns a chaos drill into a no-op.
+
+``bench-row``
+    Bench result rows must be built through ``make_row`` (which validates
+    the timing convention and the roofline-auditability rules); a raw
+    ``{"metric": ..., "value": ..., "detail": ...}`` dict literal bypasses
+    every convention check.
+
+Findings are ``path:line: [rule] message``; the CLI exits 1 on any.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "lint_file", "lint_paths", "main", "RULES"]
+
+RULES = (
+    "jax-off-thread",
+    "thread-join",
+    "retry-transient",
+    "fault-site",
+    "bench-row",
+)
+
+_JAX_NAMES = {"jax", "jnp"}
+_OWNER_MARK = "lint: jax-owner-thread"
+_CALL_DEPTH = 6  # transitive same-scope helper expansion bound
+
+
+@dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+# ---------------------------------------------------------------------------
+# Site registry (parsed from utils/faults.py, never imported — the linter
+# must work on a broken tree)
+# ---------------------------------------------------------------------------
+
+
+def _faults_module_path() -> Path:
+    return Path(__file__).resolve().parent.parent / "utils" / "faults.py"
+
+
+def fault_site_registry(path: Optional[Path] = None) -> Dict[str, str]:
+    """``{SITE_ATTR_NAME: "site.string"}`` parsed from faults.py."""
+    src = (path or _faults_module_path()).read_text()
+    tree = ast.parse(src)
+    registry: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.startswith("SITE_")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            registry[node.targets[0].id] = node.value.value
+    return registry
+
+
+# ---------------------------------------------------------------------------
+# Helpers
+# ---------------------------------------------------------------------------
+
+
+def _call_name(func: ast.AST) -> str:
+    """Trailing name of a call target: ``faults.maybe_fail`` → maybe_fail."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _uses_jax(node: ast.AST) -> Optional[ast.AST]:
+    """First descendant that reads a name bound to jax/jnp, or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and sub.id in _JAX_NAMES:
+            return sub
+        if (
+            isinstance(sub, (ast.Import, ast.ImportFrom))
+            and any(
+                (alias.asname or alias.name).split(".")[0] in _JAX_NAMES
+                or alias.name.split(".")[0] == "jax"
+                for alias in sub.names
+            )
+        ):
+            return sub
+    return None
+
+
+def _called_local_names(fn: ast.AST) -> Set[str]:
+    """Names of functions/methods this function calls that could resolve
+    in the same scope: bare ``helper(...)`` and ``self._helper(...)``."""
+    out: Set[str] = set()
+    for sub in ast.walk(fn):
+        if not isinstance(sub, ast.Call):
+            continue
+        f = sub.func
+        if isinstance(f, ast.Name):
+            out.add(f.id)
+        elif (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id in ("self", "cls")
+        ):
+            out.add(f.attr)
+    return out
+
+
+def _is_owner_marked(fn: ast.AST, source_lines: Sequence[str]) -> bool:
+    """``# lint: jax-owner-thread`` on the def line (or the line above)."""
+    line = fn.lineno - 1
+    for i in (line, line - 1):
+        if 0 <= i < len(source_lines) and _OWNER_MARK in source_lines[i]:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Rule: jax-off-thread + thread-join
+# ---------------------------------------------------------------------------
+
+
+def _thread_targets(scope: ast.AST) -> List[Tuple[ast.Call, Optional[str]]]:
+    """``threading.Thread(...)`` calls in a scope, with the local name of
+    their ``target=`` when resolvable (``self._reader`` / ``reader``)."""
+    out = []
+    for sub in ast.walk(scope):
+        if not isinstance(sub, ast.Call):
+            continue
+        if _call_name(sub.func) != "Thread":
+            continue
+        target_name: Optional[str] = None
+        for kw in sub.keywords:
+            if kw.arg != "target":
+                continue
+            v = kw.value
+            if isinstance(v, ast.Name):
+                target_name = v.id
+            elif isinstance(v, ast.Attribute) and isinstance(
+                v.value, ast.Name
+            ) and v.value.id in ("self", "cls"):
+                target_name = v.attr
+        out.append((sub, target_name))
+    return out
+
+
+def _thread_binding_names(members: Sequence[ast.AST]) -> Set[str]:
+    """Names a ``threading.Thread(...)`` result is bound to within a
+    scope's members: ``self._thread = Thread(...)`` → ``_thread``,
+    ``t = Thread(...)`` → ``t``."""
+    out: Set[str] = set()
+    for m in members:
+        for sub in ast.walk(m):
+            if not isinstance(sub, ast.Assign):
+                continue
+            value = sub.value
+            if not (
+                isinstance(value, ast.Call)
+                and _call_name(value.func) == "Thread"
+            ):
+                continue
+            for target in sub.targets:
+                if isinstance(target, ast.Name):
+                    out.add(target.id)
+                elif isinstance(target, ast.Attribute):
+                    out.add(target.attr)
+    return out
+
+
+def _scope_functions(scope: ast.AST) -> Dict[str, ast.AST]:
+    """Directly-nested function/method defs of a class or module."""
+    body = getattr(scope, "body", [])
+    return {
+        n.name: n
+        for n in body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _check_thread_rules(
+    tree: ast.Module, path: str, source_lines: Sequence[str]
+) -> List[Finding]:
+    findings: List[Finding] = []
+    scopes: List[ast.AST] = [tree] + [
+        n for n in ast.walk(tree) if isinstance(n, ast.ClassDef)
+    ]
+    for scope in scopes:
+        in_class = isinstance(scope, ast.ClassDef)
+        fns = _scope_functions(scope)
+        # Class methods' bodies belong to the class scope; the module
+        # scope must not double-report what a class scope owns.
+        if not in_class:
+            members = [
+                n for n in tree.body
+                if not isinstance(n, ast.ClassDef)
+            ]
+        else:
+            members = scope.body
+        threads = []
+        for m in members:
+            threads.extend(_thread_targets(m))
+        if not threads:
+            continue
+
+        # Names threads are bound to in this scope (``self._thread =
+        # threading.Thread(...)`` / ``t = Thread(...)``) — a join only
+        # counts when called on one of them (or, when no binding is
+        # resolvable, on SOME name — never on a string literal:
+        # ``", ".join(...)`` must not satisfy the thread contract).
+        thread_names = _thread_binding_names(members)
+
+        def _join_receiver_ok(call: ast.Call) -> bool:
+            recv = call.func.value if isinstance(
+                call.func, ast.Attribute
+            ) else None
+            if recv is None or isinstance(recv, ast.Constant):
+                return False
+            name = None
+            if isinstance(recv, ast.Name):
+                name = recv.id
+            elif isinstance(recv, ast.Attribute):
+                name = recv.attr
+            if thread_names:
+                return name in thread_names
+            return name is not None
+
+        started = any(
+            isinstance(sub, ast.Call)
+            and _call_name(sub.func) == "start"
+            for m in members
+            for sub in ast.walk(m)
+        )
+        joined = any(
+            isinstance(sub, ast.Call)
+            and _call_name(sub.func) == "join"
+            and _join_receiver_ok(sub)
+            for m in members
+            for sub in ast.walk(m)
+        )
+        if started and not joined:
+            line = threads[0][0].lineno
+            where = f"class {scope.name}" if in_class else "module scope"
+            findings.append(Finding(
+                path, line, "thread-join",
+                f"{where} starts a threading.Thread but never joins it — "
+                "every started thread needs a join on the close()/shutdown "
+                "path (the Prefetcher/MicroBatchServer contract)",
+            ))
+
+        # jax-off-thread: walk each resolvable target transitively
+        # through same-scope helpers.
+        for call, target_name in threads:
+            if target_name is None or target_name not in fns:
+                continue
+            seen: Set[str] = set()
+            frontier = [target_name]
+            depth = 0
+            while frontier and depth < _CALL_DEPTH:
+                nxt: List[str] = []
+                for name in frontier:
+                    if name in seen or name not in fns:
+                        continue
+                    seen.add(name)
+                    fn = fns[name]
+                    if _is_owner_marked(fn, source_lines):
+                        # The designated JAX-owner thread (e.g. a serving
+                        # worker that owns ALL device interaction).
+                        seen.clear()
+                        frontier = []
+                        nxt = []
+                        break
+                    hit = _uses_jax(fn)
+                    if hit is not None:
+                        findings.append(Finding(
+                            path, getattr(hit, "lineno", fn.lineno),
+                            "jax-off-thread",
+                            f"function {name!r} runs on a background "
+                            f"thread (Thread target at line {call.lineno}) "
+                            "but touches jax/jnp — background threads own "
+                            "disk+numpy only; one thread owns JAX "
+                            "(data/prefetch.py discipline). Mark the "
+                            "designated owner with "
+                            f"`# {_OWNER_MARK}` if intended",
+                        ))
+                        continue
+                    nxt.extend(_called_local_names(fn))
+                frontier = nxt
+                depth += 1
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule: retry-transient
+# ---------------------------------------------------------------------------
+
+
+def _check_retry_rule(tree: ast.Module, path: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _call_name(node.func) != "RetryPolicy":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "transient":
+                continue
+            for sub in ast.walk(kw.value):
+                name = None
+                if isinstance(sub, ast.Name):
+                    name = sub.id
+                elif isinstance(sub, ast.Attribute):
+                    name = sub.attr
+                if name == "ShardCorrupted":
+                    findings.append(Finding(
+                        path, node.lineno, "retry-transient",
+                        "RetryPolicy transient tuple includes "
+                        "ShardCorrupted — checksum corruption is "
+                        "persistent state; retrying re-reads the same bad "
+                        "bytes and hides the failure (data/durable.py "
+                        "invariant)",
+                    ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule: fault-site
+# ---------------------------------------------------------------------------
+
+
+def _check_fault_sites(
+    tree: ast.Module, path: str, registry: Dict[str, str]
+) -> List[Finding]:
+    findings = []
+    site_values = set(registry.values())
+    site_names = set(registry)
+
+    def check_site_expr(expr: ast.AST, call: ast.Call) -> None:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            if expr.value not in site_values:
+                findings.append(Finding(
+                    path, call.lineno, "fault-site",
+                    f"fault site {expr.value!r} is not in the faults.py "
+                    f"registry {sorted(site_values)} — a typo'd site makes "
+                    "the chaos drill a silent no-op",
+                ))
+        elif isinstance(expr, ast.Attribute) and expr.attr.startswith(
+            "SITE_"
+        ):
+            if expr.attr not in site_names:
+                findings.append(Finding(
+                    path, call.lineno, "fault-site",
+                    f"faults.{expr.attr} is not defined in faults.py "
+                    f"(known: {sorted(site_names)})",
+                ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in ("maybe_fail", "corrupt_array") and node.args:
+            check_site_expr(node.args[0], node)
+        elif name == "FaultRule":
+            if node.args:
+                check_site_expr(node.args[0], node)
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    check_site_expr(kw.value, node)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Rule: bench-row
+# ---------------------------------------------------------------------------
+
+_ROW_KEYS = {"metric", "value", "detail"}
+
+
+def _check_bench_rows(tree: ast.Module, path: str) -> List[Finding]:
+    findings = []
+    # Dict literals inside make_row itself are the one legitimate site.
+    allowed: Set[int] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "make_row"
+        ):
+            allowed.update(id(sub) for sub in ast.walk(node))
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict) or id(node) in allowed:
+            continue
+        keys = {
+            k.value for k in node.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+        }
+        if _ROW_KEYS <= keys:
+            findings.append(Finding(
+                path, node.lineno, "bench-row",
+                "raw bench-row dict literal (metric/value/detail) — build "
+                "rows through make_row so the timing convention and "
+                "roofline-auditability rules are enforced",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+_DISABLE_MARK = "# lint: disable="
+
+
+def _file_disabled_rules(src: str) -> Set[str]:
+    """File-level opt-out: a ``# lint: disable=rule1,rule2`` comment
+    anywhere in the file's first 40 lines disables those rules for the
+    file. The opt-out is explicit and greppable — e.g. the fault-harness
+    unit tests fabricate synthetic site names on purpose."""
+    out: Set[str] = set()
+    for line in src.splitlines()[:40]:
+        idx = line.find(_DISABLE_MARK)
+        if idx >= 0:
+            spec = line[idx + len(_DISABLE_MARK):].strip()
+            out.update(r.strip() for r in spec.split(",") if r.strip())
+    return out
+
+
+def lint_file(
+    path: Path,
+    registry: Optional[Dict[str, str]] = None,
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """Lint one file; returns findings (parse failures are findings too —
+    a file the linter cannot read is a file nothing checks)."""
+    if registry is None:
+        registry = fault_site_registry()
+    src = path.read_text()
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(str(path), e.lineno or 0, "parse",
+                        f"cannot parse: {e.msg}")]
+    enabled = set(rules or RULES) - _file_disabled_rules(src)
+    lines = src.splitlines()
+    findings: List[Finding] = []
+    sp = str(path)
+    if {"jax-off-thread", "thread-join"} & enabled:
+        thread_findings = _check_thread_rules(tree, sp, lines)
+        findings.extend(f for f in thread_findings if f.rule in enabled)
+    if "retry-transient" in enabled:
+        findings.extend(_check_retry_rule(tree, sp))
+    if "fault-site" in enabled:
+        # faults.py itself defines the registry (and uses site strings in
+        # docstrings/constants); skip it.
+        if path.name != "faults.py":
+            findings.extend(_check_fault_sites(tree, sp, registry))
+    if "bench-row" in enabled:
+        findings.extend(_check_bench_rows(tree, sp))
+    return findings
+
+
+def _iter_py(paths: Iterable[Path]) -> Iterable[Path]:
+    for p in paths:
+        if p.is_dir():
+            yield from sorted(p.rglob("*.py"))
+        elif p.suffix == ".py":
+            yield p
+
+
+def lint_paths(
+    paths: Sequence[Path],
+    rules: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    registry = fault_site_registry()
+    findings: List[Finding] = []
+    for f in _iter_py(paths):
+        if "__pycache__" in f.parts:
+            continue
+        findings.extend(lint_file(f, registry=registry, rules=rules))
+    return findings
+
+
+def default_paths() -> List[Path]:
+    """The enforced surface: the package itself, the test suite, the
+    bench driver, and the measurement scripts."""
+    root = Path(__file__).resolve().parent.parent.parent
+    out = [root / "keystone_tpu", root / "tests"]
+    for extra in (root / "bench.py", root / "scripts"):
+        if extra.exists():
+            out.append(extra)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    args = list(sys.argv[1:] if argv is None else argv)
+    paths = [Path(a) for a in args] or default_paths()
+    findings = lint_paths(paths)
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"{len(findings)} finding(s)")
+        return 1
+    print("lint clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

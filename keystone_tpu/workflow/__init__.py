@@ -48,3 +48,18 @@ from .pipeline import (
     TransformerGraph,
     transformer,
 )
+from .verify import (
+    UNKNOWN,
+    ArraySig,
+    Finding,
+    HostSig,
+    PlanVerificationError,
+    SignatureError,
+    TransformerSig,
+    TupleSig,
+    VerifyReport,
+    expect_host,
+    verify_apply_graph,
+    verify_fit_graph,
+    verify_graph,
+)

@@ -58,17 +58,33 @@ def linearize(graph: Graph, gid: GraphId = None) -> List[GraphId]:
 
     With a target id: the ancestors of that id in dependency order, ending at
     the id itself. Without: the whole graph (all sinks' chains, sinks sorted).
+
+    Iterative (explicit stack) on purpose: the static verifier and the
+    executor walk arbitrarily deep pipelines, and a recursive DFS dies at
+    Python's recursion limit around a ~1000-node chain.
     """
     order: List[GraphId] = []
     seen: Set[GraphId] = set()
 
-    def visit(cur: GraphId) -> None:
-        if cur in seen:
-            return
-        seen.add(cur)
-        for parent in sorted(get_parents(graph, cur), key=_sort_key):
-            visit(parent)
-        order.append(cur)
+    def visit(root: GraphId) -> None:
+        # Each stack frame is (id, expanded?): first visit pushes the
+        # parents (reverse-sorted so the smallest pops first), the second
+        # emits the id after its parents have been emitted.
+        stack = [(root, False)]
+        while stack:
+            cur, expanded = stack.pop()
+            if expanded:
+                order.append(cur)
+                continue
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.append((cur, True))
+            for parent in sorted(
+                get_parents(graph, cur), key=_sort_key, reverse=True
+            ):
+                if parent not in seen:
+                    stack.append((parent, False))
 
     if gid is not None:
         visit(gid)

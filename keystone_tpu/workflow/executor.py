@@ -79,12 +79,37 @@ class GraphExecutor:
             operator = graph.get_operator(graph_id)
             expression = operator.execute(dep_exprs)
             self._observe(graph, graph_id, operator, dep_exprs, expression)
+            self._annotate_failures(graph_id, operator, dep_exprs, expression)
             # Publish results the optimizer marked for prefix-state reuse.
             if self._prefixes and graph_id in self._prefixes:
                 PipelineEnv.get_or_create().state[self._prefixes[graph_id]] = expression
 
         self._execution_state[graph_id] = expression
         return expression
+
+    def _annotate_failures(self, graph_id, operator, dep_exprs, expression) -> None:
+        """Wrap the node's thunk so a runtime failure carries the same
+        coordinates a static-verifier report would: the NodeId, the
+        operator class, and the inferred signatures of its inputs. The
+        exception TYPE is preserved (the context is appended in place,
+        once, at the deepest failing node) so callers' except clauses
+        and tests keep matching — see verify.annotate_node_error."""
+        orig = getattr(expression, "_thunk", None)
+        if orig is None:  # already computed (shared expression)
+            return
+        from .verify import annotate_node_error
+
+        def annotated():
+            try:
+                return orig()
+            except Exception as e:
+                dep_values = [
+                    d._value if d._computed else None for d in dep_exprs
+                ]
+                annotate_node_error(e, graph_id, operator, dep_values)
+                raise
+
+        expression._thunk = annotated
 
     def _observe(self, graph, graph_id, operator, dep_exprs, expression) -> None:
         """Arrange for the node's first force to record an observed profile.

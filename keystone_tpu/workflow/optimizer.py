@@ -100,7 +100,21 @@ class RuleExecutor:
 
 
 class Optimizer(RuleExecutor):
-    """Base class for whole-pipeline optimizers (DefaultOptimizer.scala)."""
+    """Base class for whole-pipeline optimizers (DefaultOptimizer.scala).
+
+    Every optimizer run starts with the static plan verifier
+    (workflow/verify.py): an invalid candidate plan — shape mismatch,
+    estimator state consumed as data, conflicting shardings — is
+    rejected with a structured :class:`~keystone_tpu.workflow.verify.
+    PlanVerificationError` BEFORE any rule, cost model, or compile
+    touches it. ``KEYSTONE_VERIFY=off`` disables the pre-pass.
+    """
+
+    def execute(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
+        from .verify import verify_fit_graph
+
+        verify_fit_graph(plan, context="optimizer input plan")
+        return super().execute(plan, prefixes)
 
 
 def _make_stage_fusion():

@@ -174,10 +174,18 @@ class Pipeline(Chainable[A, B]):
 
     def fit(self) -> "FittedPipeline[A, B]":
         """Fit all estimators, returning a transformer-only serializable pipeline
-        (Pipeline.scala:38-65)."""
+        (Pipeline.scala:38-65).
+
+        Runs the static plan verifier first (workflow/verify.py): a
+        malformed plan — the compile-time error KeystoneML's typed Scala
+        API would have raised — fails HERE with node-level coordinates,
+        not deep inside an estimator fit. ``KEYSTONE_VERIFY=off``
+        disables the pre-pass."""
         from .env import PipelineEnv
         from .rules import UnusedBranchRemovalRule
+        from .verify import verify_fit_graph
 
+        verify_fit_graph(self.executor.graph, context="Pipeline.fit plan")
         optimized, prefixes = PipelineEnv.get_or_create().optimizer.execute(
             self.executor.graph, {}
         )
@@ -391,10 +399,20 @@ class FittedPipeline(Generic[A, B]):
             elif isinstance(gid, NodeId):
                 op = self.transformer_graph.get_operator(gid)
                 inputs = [values[d] for d in self.transformer_graph.get_dependencies(gid)]
-                if is_dataset:
-                    values[gid] = op.batch_transform(inputs)
-                else:
-                    values[gid] = op.single_transform(inputs)
+                try:
+                    if is_dataset:
+                        values[gid] = op.batch_transform(inputs)
+                    else:
+                        values[gid] = op.single_transform(inputs)
+                except Exception as e:
+                    # Runtime failures cite the same coordinates as
+                    # static-verifier reports (NodeId + operator +
+                    # inferred input signatures), appended in place so
+                    # the exception type survives.
+                    from .verify import annotate_node_error
+
+                    annotate_node_error(e, gid, op, inputs)
+                    raise
             else:
                 raise ValueError(f"Unbound source {gid} in FittedPipeline")
         return values[self.sink]

@@ -82,3 +82,84 @@ class TestLinearize:
         g, a, b, c, d, s1, s2 = diamond
         order = analysis.linearize(g, s2)
         assert c not in order and d not in order
+
+    def test_whole_graph_covers_all_sink_chains(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        order = analysis.linearize(g)
+        # Every sink-reachable id appears exactly once, in dependency order.
+        assert set(order) == {SourceId(0), a, b, c, d, s1, s2}
+        assert len(order) == len(set(order))
+        pos = {gid: i for i, gid in enumerate(order)}
+        for node in (a, b, c, d):
+            for parent in analysis.get_parents(g, node):
+                assert pos[parent] < pos[node]
+
+    def test_whole_graph_skips_sinkless_islands(self, diamond):
+        g, a, *_ = diamond
+        g, island = g.add_node(op("island"), [a])
+        assert island not in analysis.linearize(g)
+        # ...but an explicit target reaches it.
+        assert island in analysis.linearize(g, island)
+
+    def test_empty_graph(self):
+        assert analysis.linearize(Graph()) == []
+
+    def test_deep_chain_does_not_hit_recursion_limit(self):
+        """The verifier/executor linearize arbitrarily deep pipelines; a
+        recursive DFS dies near Python's recursion limit (~1000). The
+        iterative implementation must walk a 3000-node chain."""
+        g = Graph(sources=frozenset({SourceId(0)}))
+        prev = SourceId(0)
+        nodes = []
+        for _ in range(3000):
+            g, prev = g.add_node(op("x"), [prev])
+            nodes.append(prev)
+        g, sink = g.add_sink(prev)
+        order = analysis.linearize(g, sink)
+        assert len(order) == 3002  # source + 3000 nodes + sink
+        assert order[0] == SourceId(0)
+        assert order[-1] == sink
+        assert order[1:-1] == nodes  # chain emits in dependency order
+
+    def test_target_node_order_ends_at_target(self, diamond):
+        g, a, b, c, d, *_ = diamond
+        order = analysis.linearize(g, d)
+        assert order[-1] == d
+        assert set(order) == {SourceId(0), a, b, c, d}
+
+
+class TestReachability:
+    def test_descendants_of_sink_empty(self, diamond):
+        g, *_, s1, _ = diamond
+        assert analysis.get_descendants(g, s1) == set()
+
+    def test_ancestors_of_source_empty(self, diamond):
+        g, *_ = diamond
+        assert analysis.get_ancestors(g, SourceId(0)) == set()
+
+    def test_descendants_reach_sinks(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        desc = analysis.get_descendants(g, a)
+        assert desc == {b, c, d, s1, s2}
+
+    def test_branch_reachability_is_asymmetric(self, diamond):
+        g, a, b, c, d, *_ = diamond
+        # b and c are parallel branches: neither reaches the other.
+        assert c not in analysis.get_descendants(g, b)
+        assert b not in analysis.get_descendants(g, c)
+        assert c not in analysis.get_ancestors(g, b)
+
+
+class TestSourceSinkSets:
+    def test_source_and_sink_sets(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        assert g.sources == frozenset({SourceId(0)})
+        assert g.sinks == {s1, s2}
+        assert g.nodes == {a, b, c, d}
+
+    def test_sets_track_surgery(self, diamond):
+        g, a, b, c, d, s1, s2 = diamond
+        g2 = g.remove_sink(s2)
+        assert g2.sinks == {s1}
+        g3, new_src = g2.add_source()
+        assert new_src in g3.sources and len(g3.sources) == 2

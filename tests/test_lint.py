@@ -1,0 +1,297 @@
+"""Discipline linter (ISSUE 6 tentpole): the whole package + test suite
+lints clean in tier-1, and every rule has a fixture test proving it
+fires on a violating snippet."""
+
+from pathlib import Path
+
+import pytest
+
+from keystone_tpu.tools.lint import (
+    RULES,
+    default_paths,
+    fault_site_registry,
+    lint_file,
+    lint_paths,
+)
+
+
+def _lint_snippet(tmp_path: Path, source: str, rules=None):
+    f = tmp_path / "snippet.py"
+    f.write_text(source)
+    return lint_file(f, rules=rules)
+
+
+def _codes(findings):
+    return [f.rule for f in findings]
+
+
+class TestPackageIsClean:
+    def test_full_package_and_tests_lint_clean(self):
+        findings = lint_paths(default_paths())
+        assert not findings, "\n".join(str(f) for f in findings)
+
+    def test_registry_matches_faults_module(self):
+        from keystone_tpu.utils import faults
+
+        registry = fault_site_registry()
+        assert registry == {
+            "SITE_SHARD_LOAD": faults.SITE_SHARD_LOAD,
+            "SITE_PREFETCH_READ": faults.SITE_PREFETCH_READ,
+            "SITE_SERVING_EXECUTE": faults.SITE_SERVING_EXECUTE,
+        }
+
+
+class TestJaxOffThreadRule:
+    VIOLATION = """
+import threading
+import jax.numpy as jnp
+
+class Reader:
+    def _reader(self):
+        return self._load(0)
+
+    def _load(self, s):
+        return jnp.zeros((4,))  # JAX on the reader thread
+
+    def start(self):
+        self._thread = threading.Thread(target=self._reader)
+        self._thread.start()
+
+    def close(self):
+        self._thread.join()
+"""
+
+    def test_fires_on_jax_in_thread_target(self, tmp_path):
+        findings = _lint_snippet(tmp_path, self.VIOLATION)
+        assert _codes(findings) == ["jax-off-thread"]
+        assert "_load" in findings[0].message
+
+    def test_numpy_only_reader_is_clean(self, tmp_path):
+        clean = self.VIOLATION.replace(
+            "import jax.numpy as jnp", "import numpy as np"
+        ).replace("jnp.zeros", "np.zeros")
+        assert not _lint_snippet(tmp_path, clean)
+
+    def test_owner_marker_opts_out(self, tmp_path):
+        marked = self.VIOLATION.replace(
+            "    def _reader(self):",
+            "    def _reader(self):  # lint: jax-owner-thread",
+        )
+        assert not _lint_snippet(tmp_path, marked)
+
+
+class TestThreadJoinRule:
+    def test_fires_when_started_thread_never_joins(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+import threading
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._work)
+        self._thread.start()
+
+    def _work(self):
+        pass
+
+    def close(self):
+        pass  # forgot the join
+""")
+        assert _codes(findings) == ["thread-join"]
+        assert "class Server" in findings[0].message
+
+    def test_clean_when_close_joins(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import threading
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._work)
+        self._thread.start()
+
+    def _work(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=5)
+""")
+
+    def test_string_join_does_not_satisfy_thread_contract(self, tmp_path):
+        """Regression: ``", ".join(...)`` anywhere in the class must not
+        count as joining the worker thread."""
+        findings = _lint_snippet(tmp_path, """
+import threading
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._work)
+        self._thread.start()
+
+    def _work(self):
+        pass
+
+    def close(self):
+        msg = ", ".join(["a", "b"])  # a string join, not a thread join
+        return msg
+""")
+        assert _codes(findings) == ["thread-join"]
+
+    def test_join_must_target_the_thread_binding(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+import threading
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._work)
+        self._thread.start()
+
+    def _work(self):
+        pass
+
+    def close(self):
+        self._other.join()  # joins something, but not the thread binding
+""")
+        assert _codes(findings) == ["thread-join"]
+
+    def test_module_level_thread_needs_join(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+import threading
+
+def run():
+    t = threading.Thread(target=print)
+    t.start()
+""")
+        assert _codes(findings) == ["thread-join"]
+
+
+class TestRetryTransientRule:
+    def test_fires_on_shardcorrupted_in_transient_tuple(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.utils.faults import RetryPolicy
+from keystone_tpu.data.durable import ShardCorrupted
+
+policy = RetryPolicy(attempts=3, transient=(OSError, ShardCorrupted))
+""")
+        assert _codes(findings) == ["retry-transient"]
+
+    def test_oserror_only_is_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+from keystone_tpu.utils.faults import RetryPolicy
+
+policy = RetryPolicy(attempts=3, transient=(OSError, TimeoutError))
+""")
+
+
+class TestFaultSiteRule:
+    def test_fires_on_unregistered_string_site(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.utils import faults
+
+def read():
+    faults.maybe_fail("shard.lod")  # typo
+""")
+        assert _codes(findings) == ["fault-site"]
+        assert "shard.lod" in findings[0].message
+
+    def test_fires_on_unknown_site_attribute(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.utils import faults
+
+def read():
+    faults.maybe_fail(faults.SITE_DOES_NOT_EXIST)
+""")
+        assert _codes(findings) == ["fault-site"]
+
+    def test_fires_on_faultrule_site_kwarg(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.utils.faults import FaultRule
+
+rule = FaultRule(site="serving.exec", calls=[0])
+""")
+        assert _codes(findings) == ["fault-site"]
+
+    def test_registered_sites_are_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+from keystone_tpu.utils import faults
+from keystone_tpu.utils.faults import FaultRule
+
+def read():
+    faults.maybe_fail(faults.SITE_SHARD_LOAD)
+    faults.maybe_fail("prefetch.read")
+
+rule = FaultRule(site="serving.execute", calls=[0])
+""")
+
+    def test_file_level_disable_pragma(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+# lint: disable=fault-site
+from keystone_tpu.utils import faults
+
+def read():
+    faults.maybe_fail("synthetic.site")
+""")
+        assert not findings
+
+    def test_real_fault_harness_tests_are_exempt(self):
+        root = default_paths()[0].parent
+        findings = lint_file(root / "tests" / "test_faults.py")
+        assert not findings
+
+
+class TestBenchRowRule:
+    def test_fires_on_raw_row_dict(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+def my_metric():
+    return {
+        "metric": "foo",
+        "value": 1.0,
+        "unit": "s",
+        "detail": {},
+    }
+""")
+        assert _codes(findings) == ["bench-row"]
+
+    def test_make_row_itself_is_allowed(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+def make_row(metric, value, unit, vs_baseline, timing, detail):
+    return {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "detail": detail,
+    }
+""")
+
+    def test_partial_dicts_are_not_rows(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+config = {"metric": "foo", "value": 1.0}
+""")
+
+
+class TestDriver:
+    def test_unparseable_file_is_a_finding(self, tmp_path):
+        findings = _lint_snippet(tmp_path, "def broken(:\n")
+        assert _codes(findings) == ["parse"]
+
+    def test_rule_selection(self, tmp_path):
+        src = TestJaxOffThreadRule.VIOLATION
+        only_join = _lint_snippet(tmp_path, src, rules=["thread-join"])
+        assert not only_join  # the snippet joins correctly
+
+    def test_cli_exit_codes(self, tmp_path):
+        from keystone_tpu.tools import lint
+
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "from keystone_tpu.utils import faults\n"
+            'faults.maybe_fail("nope")\n'
+        )
+        assert lint.main([str(bad)]) == 1
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert lint.main([str(good)]) == 0
+
+    def test_all_rules_have_fixture_coverage(self):
+        # Every advertised rule id appears in this test module.
+        source = Path(__file__).read_text()
+        for rule in RULES:
+            assert rule in source

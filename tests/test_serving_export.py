@@ -67,14 +67,20 @@ class TestWarmPathNeverTraces:
             fitted_from_transformer(t), np.zeros(6, np.float32), max_batch=16
         )
         assert plan.compiled
-        # Pre-compilation traced once per bucket shape, nothing more.
-        assert t.traces == len(plan.buckets) == 4
+        # Export-time traces: ONE abstract evaluation by the static plan
+        # verifier (jax.eval_shape typechecks the chain against the
+        # example input — workflow/verify.py) plus once per bucket shape
+        # for AOT compilation. Nothing more.
+        assert len(plan.buckets) == 4
+        assert t.traces == len(plan.buckets) + 1
         rng = np.random.default_rng(0)
         for m in (1, 3, 4, 5, 11, 16, 2, 7):
             X = rng.normal(size=(m, 6)).astype(np.float32)
             out = plan.apply_batch(list(X))
             np.testing.assert_array_equal(out, X * 2.0)
-        assert t.traces == 4, "warm-path request triggered a re-trace"
+        assert t.traces == 5, "warm-path request triggered a re-trace"
+        # trace_count counts the jit's traces only (the verifier's
+        # eval_shape never enters the jitted counter).
         assert plan.trace_count == 4
 
     def test_mnist_plan_compiles_to_one_program(self):
