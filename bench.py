@@ -43,6 +43,13 @@ Additional metrics ride in detail.additional_metrics:
     (keystone_tpu/serving/) under open-loop Poisson load — p50/p99
     latency, achieved QPS and pad overhead at 3 offered rates, A/B
     against naive batch-size-1 serving.
+  - serving_replicated_chaos: the replicated serving plane
+    (serving/replicas.py) under open-loop Poisson load across three
+    legs — steady state, a replica KILL mid-storm (watchdog restart),
+    and an atomic hot-swap under sustained load — recording the
+    degraded-window p99 against the steady-state p99, with zero-drop
+    accounting (offered == completed + rejected + failed) and
+    per-fingerprint response attribution on the swap leg.
   - stupidbackoff_batch_scoring: vectorized LM serving vs the dict loop.
 
 Timing method: the tunneled dev TPU adds ~80-110 ms of per-dispatch
@@ -2301,6 +2308,215 @@ def serving_mnist_metric():
     )
 
 
+def serving_replicated_chaos_metric():
+    """The replicated serving plane under chaos (ISSUE 7 tentpole):
+    N micro-batch replicas behind one admission-controlled front door
+    (serving/replicas.py), driven open-loop at a fixed Poisson rate
+    through three legs of equal duration:
+
+      1. ``steady``   — no faults: the plane's baseline p99.
+      2. ``kill``     — a deterministic ``serving.replica.execute``
+         fault kills one replica worker mid-storm; the watchdog
+         restarts it from the exported plan. The LEG's p99 is the
+         degraded-window p99 the row reports as its value.
+      3. ``swap``     — ``swap_plan`` hot-swaps every replica onto a
+         second fitted model mid-storm: zero requests dropped, both
+         plan fingerprints attributed on completions.
+
+    value = degraded-window (kill-leg) p99 seconds; vs_baseline =
+    steady p99 / degraded p99 (1.0 = no degradation; smaller = the kill
+    window cost more tail). Every leg dict carries num_samples + the
+    offered rate (make_row's latency-audit rule), and zero-drop
+    accounting (offered == completed + rejected + failed) is asserted
+    into the row.
+
+    Env knobs: BENCH_REPLICAS (default 3), BENCH_REPLICA_DURATION_S
+    (per-leg window, default 4), BENCH_REPLICA_RATE_X (offered rate as
+    a multiple of one replica's naive single-request throughput,
+    default 4).
+    """
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_featurizer,
+    )
+    from keystone_tpu.serving import ReplicatedServer, export_plan, run_open_loop
+    from keystone_tpu.utils.faults import FaultPlan, FaultRule
+
+    n, d_in, num_ffts, bs = 8_192, 784, 2, 1_024
+    num_replicas = int(os.environ.get("BENCH_REPLICAS", "3"))
+    duration_s = float(os.environ.get("BENCH_REPLICA_DURATION_S", "4"))
+    rate_x = float(os.environ.get("BENCH_REPLICA_RATE_X", "4"))
+    rng = np.random.default_rng(17)
+
+    def fit_model(seed):
+        r = np.random.default_rng(seed)
+        X = jnp.asarray(r.normal(size=(n, d_in)).astype(np.float32))
+        y = r.integers(0, 10, size=n)
+        labels = Dataset.of(jnp.asarray(np.asarray(
+            ClassLabelIndicatorsFromIntLabels(10)(Dataset.of(y)).array
+        )))
+        cfg = MnistRandomFFTConfig(
+            num_ffts=num_ffts, block_size=bs, image_size=d_in
+        )
+        return build_featurizer(cfg).and_then(
+            BlockLeastSquaresEstimator(bs, 1, 1e-4), Dataset.of(X), labels
+        ).fit()
+
+    plan = export_plan(fit_model(17), np.zeros(d_in, np.float32),
+                       max_batch=128)
+    plan2 = export_plan(fit_model(18), np.zeros(d_in, np.float32),
+                        max_batch=128)
+    single_s = plan.measure_single_request_s(reps=5)
+    rate_hz = rate_x / single_s  # rate_x x one replica's naive throughput
+    pool = rng.normal(size=(512, d_in)).astype(np.float32)
+
+    def req(i):
+        return pool[i % len(pool)]
+
+    def run_leg(srv, seed, fault_plan=None, mid_leg=None):
+        import threading
+
+        timer = None
+        mid_errors = []
+        if mid_leg is not None:
+            def guarded_mid_leg():
+                try:
+                    mid_leg()
+                except BaseException as e:  # noqa: BLE001 — re-raised below
+                    mid_errors.append(e)
+
+            timer = threading.Timer(duration_s / 2.0, guarded_mid_leg)
+            timer.start()
+        try:
+            if fault_plan is not None:
+                with fault_plan:
+                    report = run_open_loop(
+                        srv.submit, req, rate_hz=rate_hz,
+                        duration_s=duration_s, seed=seed,
+                    )
+            else:
+                report = run_open_loop(
+                    srv.submit, req, rate_hz=rate_hz,
+                    duration_s=duration_s, seed=seed,
+                )
+        finally:
+            if timer is not None:
+                timer.cancel()  # no-op if already fired; unarms on error
+                timer.join()
+        if mid_errors:
+            # A swallowed swap failure would leave a clean-looking leg
+            # that silently tested nothing — fail the row instead.
+            raise RuntimeError(
+                f"mid-leg action failed: {mid_errors[0]!r}"
+            ) from mid_errors[0]
+        d = report.to_row_dict()
+        d["accounting_ok"] = (
+            report.completed + report.rejected + report.failed
+            == report.num_offered
+        )
+        return report, d
+
+    legs = {}
+    swap_report = {}
+    srv = ReplicatedServer(plan, num_replicas=num_replicas,
+                           max_wait_ms=min(25.0, max(2.0, 1.5e3 * single_s)),
+                           max_queue_depth=4096, watchdog_interval_s=0.02)
+    try:
+        _, legs["steady"] = run_leg(srv, seed=21)
+        # Kill whichever replica executes the mid-storm batch: scale the
+        # call index off the steady leg's observed batch count so the
+        # kill lands inside the window at any offered rate.
+        batches_est = max(10, int(
+            legs["steady"]["num_samples"]
+            / max(srv.stats()["per_replica"][0].get("mean_batch_size")
+                  or 1.0, 1.0)
+        ))
+        kill = FaultPlan([FaultRule(
+            "serving.replica.execute", "error",
+            calls=[max(5, batches_est // 2)],
+        )])
+        _, legs["kill"] = run_leg(srv, seed=22, fault_plan=kill)
+        kill_stats = srv.stats()
+        if kill_stats["restarts_total"] < 1:
+            # The row's VALUE is the degraded-window p99 — if the
+            # call-indexed kill never landed (batch-count estimate off),
+            # a fault-free leg would silently masquerade as it.
+            raise RuntimeError(
+                "serving_replicated_chaos: the injected replica kill "
+                f"never fired (estimated batch index {batches_est // 2}); "
+                "the kill leg measured nothing"
+            )
+        _, legs["swap"] = run_leg(
+            srv, seed=23,
+            mid_leg=lambda: swap_report.update(srv.swap_plan(plan2)),
+        )
+        final_stats = srv.stats()
+    finally:
+        srv.close()
+
+    for leg_name, leg in legs.items():
+        if not leg["num_samples"]:
+            # A leg with zero completions has no p99 — publishing a
+            # sentinel as the row's headline value would dress a broken
+            # window (total eviction, all-shed overload) as a clean
+            # measurement. Fail loudly like the kill-never-fired guard.
+            raise RuntimeError(
+                f"serving_replicated_chaos: the {leg_name} leg completed "
+                f"zero requests (offered {leg['num_offered']}, rejected "
+                f"{leg['rejected']}, failed {leg['failed']}) — no p99 to "
+                "report"
+            )
+    p99_steady_s = legs["steady"]["p99_latency_ms"] / 1e3
+    p99_degraded_s = legs["kill"]["p99_latency_ms"] / 1e3
+    return make_row(
+        "serving_replicated_chaos",
+        round(p99_degraded_s, 5),
+        "s",
+        round(p99_steady_s / p99_degraded_s, 3),
+        "open_loop_latency",
+        {
+            "pipeline": "mnist_random_fft (fit n=8192, replicated online)",
+            "num_replicas": num_replicas,
+            "single_request_s": round(single_s, 6),
+            "offered_rate_hz": round(rate_hz, 2),
+            "buckets": plan.buckets,
+            "legs": legs,
+            "kill_leg": {
+                "restarts_total": kill_stats["restarts_total"],
+                "healthy_after": kill_stats["healthy_replicas"],
+                "evicted": kill_stats["evicted_replicas"],
+            },
+            "swap_leg": {
+                "swap_report": swap_report.get("replicas"),
+                "old_fingerprint": plan.fingerprint,
+                "new_fingerprint": plan2.fingerprint,
+                "per_fingerprint_completed": legs["swap"].get(
+                    "per_fingerprint_completed"
+                ),
+                # Requests that resolved with a NAMED error (e.g. a sync
+                # degraded reject through a drain window) — NOT drops;
+                # zero silent drops is what accounting_ok asserts.
+                "failed_named": legs["swap"]["failed"],
+            },
+            "final_degraded": final_stats["degraded"],
+            "timing_note": (
+                "value = p99 latency (s) over the KILL leg (the "
+                "degraded window: one replica dies mid-storm and the "
+                "watchdog restarts it); vs_baseline = steady-leg p99 / "
+                "kill-leg p99 (1.0 = kill invisible in the tail); all "
+                f"legs open-loop Poisson at the same offered rate for "
+                f"{duration_s:.0f}s each; accounting_ok per leg asserts "
+                "offered == completed + rejected + failed (zero silent "
+                "drops)"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    )
+
+
 def main():
     headline = timit_streaming_metric()
     if os.environ.get("BENCH_ONLY", "") != "timit":
@@ -2314,6 +2530,7 @@ def main():
             krr_metric,
             mnist_fft_metric,
             serving_mnist_metric,
+            serving_replicated_chaos_metric,
             autocache_metric,
             autocache_host_boundary_metric,
             stupidbackoff_metric,

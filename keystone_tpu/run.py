@@ -9,7 +9,10 @@ Pipeline names accept the reference's fully-qualified class names
 MnistRandomFFT] --rate 200 --duration-s 5`` starts the online serving
 path instead: export the fitted pipeline, run the deadline-aware
 micro-batch server under open-loop Poisson load, and print the p50/p99
-latency + throughput summary line (docs/serving.md).
+latency + throughput summary line (docs/serving.md). ``--replicas N``
+serves through the replicated plane instead (least-loaded routing,
+per-replica breakers, watchdog restarts, hot-swap — docs/serving.md's
+replicated section).
 
 Global reliability flags (any pipeline, and serve — docs/reliability.md):
 ``--checkpoint-dir=DIR`` makes segmented streamed fits snapshot their
@@ -123,6 +126,12 @@ def _serve(argv):
     parser.add_argument("--max-batch", type=int, default=256)
     parser.add_argument("--max-wait-ms", type=float, default=5.0)
     parser.add_argument("--queue-depth", type=int, default=1024)
+    parser.add_argument("--replicas", type=int, default=1,
+                        help="serve through a ReplicatedServer with this "
+                        "many replicas (1 = single MicroBatchServer)")
+    parser.add_argument("--restart-budget", type=int, default=3,
+                        help="replica respawn attempts before permanent "
+                        "eviction (with --replicas > 1)")
     parser.add_argument("--rate", type=float, default=200.0,
                         help="offered Poisson rate (requests/s)")
     parser.add_argument("--duration-s", type=float, default=5.0)
@@ -133,6 +142,7 @@ def _serve(argv):
 
     from keystone_tpu.serving import (
         MicroBatchServer,
+        ReplicatedServer,
         export_plan,
         run_open_loop,
     )
@@ -159,15 +169,23 @@ def _serve(argv):
     rng = np.random.default_rng(args.seed + 1)
     pool = rng.normal(size=(256, d_in)).astype(np.float32)
 
-    server = MicroBatchServer(
-        plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-        max_queue_depth=args.queue_depth,
-    )
+    if args.replicas > 1:
+        server = ReplicatedServer(
+            plan, num_replicas=args.replicas, max_batch=args.max_batch,
+            max_wait_ms=args.max_wait_ms, max_queue_depth=args.queue_depth,
+            restart_budget=args.restart_budget,
+        )
+    else:
+        server = MicroBatchServer(
+            plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
+            max_queue_depth=args.queue_depth,
+        )
     try:
         report = run_open_loop(
             server.submit, lambda i: pool[i % len(pool)],
             rate_hz=args.rate, duration_s=args.duration_s, seed=args.seed,
         )
+        stats = server.stats()
     finally:
         server.close()
     summary = report.to_row_dict()
@@ -176,9 +194,21 @@ def _serve(argv):
         "buckets": plan.buckets,
         "plan_compiled": plan.compiled,
         "max_wait_ms": args.max_wait_ms,
-        "mean_pad_fraction": server.stats().get("mean_pad_fraction"),
-        "breaker_state": server.stats().get("breaker_state"),
+        "plan_fingerprint": plan.fingerprint,
     })
+    if args.replicas > 1:
+        summary.update({
+            "replicas": args.replicas,
+            "healthy_replicas": stats.get("healthy_replicas"),
+            "restarts_total": stats.get("restarts_total"),
+            "evicted_replicas": stats.get("evicted_replicas"),
+            "degraded": stats.get("degraded"),
+        })
+    else:
+        summary.update({
+            "mean_pad_fraction": stats.get("mean_pad_fraction"),
+            "breaker_state": stats.get("breaker_state"),
+        })
     print(json.dumps(summary))
     return 0
 

@@ -11,6 +11,11 @@ into something that serves streams of single-datum requests:
   - :class:`MicroBatchServer` — deadline-aware request coalescing on a
     background worker thread, bounded queue with explicit
     earliest-deadline load shedding, per-request spans, rolling p50/p99.
+  - :class:`ReplicatedServer` — N replicas behind one
+    admission-controlled front door: least-loaded routing with
+    per-replica breakers, watchdog restarts within a bounded budget,
+    and zero-drop atomic hot-swap of the plan under live traffic
+    (``serving/replicas.py``).
   - :func:`run_open_loop` / :func:`closed_loop_qps` — Poisson load
     generation and the batch-size-1 baseline the bench A/Bs against.
 """
@@ -21,19 +26,22 @@ from .batcher import (
     ServerDegraded,
     ServerOverloaded,
 )
-from .export import BatchInfo, ExportedPlan, export_plan
+from .export import BatchInfo, ExportedPlan, export_plan, plan_fingerprint
 from .loadgen import LoadReport, closed_loop_qps, poisson_arrivals, run_open_loop
+from .replicas import ReplicatedServer
 
 __all__ = [
     "BatchInfo",
     "ExportedPlan",
     "LoadReport",
     "MicroBatchServer",
+    "ReplicatedServer",
     "ServerClosed",
     "ServerDegraded",
     "ServerOverloaded",
     "closed_loop_qps",
     "export_plan",
+    "plan_fingerprint",
     "poisson_arrivals",
     "run_open_loop",
 ]

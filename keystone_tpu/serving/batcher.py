@@ -149,6 +149,7 @@ class MicroBatchServer:
         span_log_len: int = 4096,
         breaker_threshold: int = 5,
         breaker_reset_s: float = 1.0,
+        replica_index: Optional[int] = None,
     ):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
@@ -165,6 +166,8 @@ class MicroBatchServer:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.max_queue_depth = int(max_queue_depth)
+        # Span attribution tag for the replicated plane (None standalone).
+        self.replica_index = replica_index
 
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
@@ -399,6 +402,7 @@ class MicroBatchServer:
                 batch_size=info.batch_size,
                 bucket=info.bucket,
                 pad_fraction=info.pad_fraction,
+                replica=self.replica_index,
             ))
             with self._lock:
                 self._latencies_s.append(t1 - r.enqueue_t)
@@ -410,10 +414,25 @@ class MicroBatchServer:
 
     # -- observability -----------------------------------------------------
 
+    @property
+    def queue_depth(self) -> int:
+        """Requests queued but not yet dispatched (the admission side of
+        the load picture; in-flight batches are not counted)."""
+        with self._lock:
+            return len(self._pending)
+
     def stats(self) -> Dict[str, Any]:
         """Rolling latency percentiles + throughput counters. Percentiles
         are over the retained completion window (span_log_len); None
-        until something completes."""
+        until something completes.
+
+        End-to-end latency is reported SPLIT into its two sides —
+        ``p50/p99_queue_wait_s`` (time queued before the batch
+        dispatched) and ``p50/p99_exec_s`` (the batch's execution wall)
+        — so admission-control tuning can see which side of the SLO is
+        burning budget: queue-wait blowing up wants a lower
+        ``max_wait_ms``/``max_queue_depth`` (or another replica), exec
+        blowing up wants a smaller ``max_batch`` or a faster plan."""
         with self._lock:
             lat = list(self._latencies_s)
             completed, rejected, failed = (
@@ -428,7 +447,15 @@ class MicroBatchServer:
             degraded_rejected = self.degraded_rejected
             consecutive_failures = self._consecutive_failures
         pct = profiling.latency_percentiles(lat)
-        span_summary = self.span_log.summary()
+        # ONE ring copy: the wait/exec percentiles and the summary all
+        # derive from the same snapshot (stats() polls contend the span
+        # lock with the worker's record() on the serving hot path).
+        spans = self.span_log.snapshot()
+        wait_pct = profiling.latency_percentiles(
+            [s.queue_wait_s for s in spans]
+        )
+        exec_pct = profiling.latency_percentiles([s.exec_s for s in spans])
+        span_summary = profiling.summarize_spans(spans)
         return {
             "completed": completed,
             "rejected": rejected,
@@ -439,6 +466,12 @@ class MicroBatchServer:
             "consecutive_failures": consecutive_failures,
             "p50_latency_s": pct["p50"] if pct else None,
             "p99_latency_s": pct["p99"] if pct else None,
+            # The two sides of end-to-end latency, separately (over the
+            # span_log window — admission-control tuning reads these).
+            "p50_queue_wait_s": wait_pct["p50"] if wait_pct else None,
+            "p99_queue_wait_s": wait_pct["p99"] if wait_pct else None,
+            "p50_exec_s": exec_pct["p50"] if exec_pct else None,
+            "p99_exec_s": exec_pct["p99"] if exec_pct else None,
             "num_latency_samples": len(lat),
             # completions/second across the observed completion span;
             # needs >= 2 completions to bound a span.
@@ -448,6 +481,9 @@ class MicroBatchServer:
             "mean_pad_fraction": span_summary.get("mean_pad_fraction"),
             "mean_batch_size": span_summary.get("mean_batch_size"),
             "mean_queue_wait_s": span_summary.get("mean_queue_wait_s"),
+            # The full span summary of the same one snapshot, so
+            # aggregators (the replicated plane) never re-copy the ring.
+            "span_summary": span_summary,
         }
 
     # -- shutdown ----------------------------------------------------------
@@ -490,6 +526,22 @@ class MicroBatchServer:
         """"closed" / "open" / "half_open" / "disabled" / "dead"."""
         with self._lock:
             return self._breaker_state_locked()
+
+    @property
+    def routing_state(self) -> "tuple[str, bool]":
+        """``(breaker_state, probe_free)`` in ONE lock acquisition — the
+        replicated plane's router reads both per candidate per submit
+        while holding its own global lock, so splitting them across two
+        property calls would double the contended server-lock traffic
+        on the admission path. ``probe_free`` is True only when the
+        breaker is half-open with the probe slot FREE: while a probe is
+        already in flight the state reads ``half_open`` but every
+        further submit fails fast, so a router should not offer this
+        server traffic until the slot resolves."""
+        with self._lock:
+            state = self._breaker_state_locked()
+            return state, (state == "half_open"
+                           and not self._breaker_probing)
 
     @property
     def is_alive(self) -> bool:

@@ -49,7 +49,86 @@ from keystone_tpu.workflow.pipeline import (
     compose_apply_fn,
 )
 
-__all__ = ["BatchInfo", "ExportedPlan", "export_plan"]
+__all__ = ["BatchInfo", "ExportedPlan", "export_plan", "plan_fingerprint"]
+
+
+def plan_fingerprint(graph: Graph, item_shape, dtype,
+                     buckets: Optional[Sequence[int]] = None) -> str:
+    """Content fingerprint of a serving plan version: a CRC over every
+    operator's type + state (weights included, via
+    ``durable.fingerprint_token``'s shape/dtype/content-CRC triples)
+    AND the graph wiring (per-node dependency lists, sources, sinks —
+    the same operators composed in a different order are a different
+    function) plus the request signature and the padding-bucket ladder. Buckets
+    are part of the identity because they are part of the served bits:
+    a plan exported with explicit ``buckets=[1, ...]`` serves singleton
+    responses through XLA's batch-1 codepath — a ulp off every other
+    batch size (see ``_default_buckets``) — so it must never share a
+    fingerprint with the default-bucket export of the same weights.
+    Computed ONCE at export (operator state is frozen for serving), it
+    is the identity the replicated plane stamps on every response — the
+    hot-swap bit-identity contract (docs/reliability.md) is stated per
+    fingerprint: any response carrying fingerprint F is bit-identical
+    to offline apply under the plan version that exported F, and no
+    batch ever mixes versions."""
+    import json
+    import zlib
+
+    from keystone_tpu.data.durable import fingerprint_token
+    from keystone_tpu.workflow.fusion import fused_members
+
+    def state_token(v):
+        # Recurse into plain containers BEFORE delegating to
+        # fingerprint_token: it degrades a dict/set to its bare type
+        # name, which would let two plans differing only in (say) a
+        # vocabulary dict share a fingerprint — voiding the
+        # per-fingerprint bit-identity contract. Unordered containers
+        # sort by token repr so the digest is iteration-order-free.
+        if isinstance(v, dict):
+            return {"dict": sorted(
+                ([state_token(k), state_token(u)] for k, u in v.items()),
+                key=repr,
+            )}
+        if isinstance(v, (set, frozenset)):
+            return {"set": sorted((state_token(e) for e in v), key=repr)}
+        if isinstance(v, (list, tuple)):
+            return [state_token(e) for e in v]
+        return fingerprint_token(v)
+
+    ops = []
+    for node in sorted(graph.nodes, key=repr):
+        op = graph.get_operator(node)
+        members = []
+        for member in fused_members(op) + [op]:
+            state = {
+                k: state_token(v)
+                for k, v in sorted(getattr(member, "__dict__", {}).items())
+                if not k.startswith("_")
+            }
+            members.append([type(member).__name__, state])
+        # The node's WIRING rides beside its operators: the same
+        # operator multiset composed in a different order is a
+        # different function, and must be a different fingerprint.
+        ops.append([
+            repr(node),
+            [repr(d) for d in graph.get_dependencies(node)],
+            members,
+        ])
+    token = json.dumps(
+        {
+            "item_shape": list(item_shape),
+            "dtype": str(dtype),
+            "buckets": list(buckets) if buckets is not None else None,
+            "sources": sorted(repr(s) for s in graph.sources),
+            "sinks": sorted(
+                [repr(k), repr(v)]
+                for k, v in graph.sink_dependencies.items()
+            ),
+            "ops": ops,
+        },
+        sort_keys=True, default=str,
+    )
+    return f"{zlib.crc32(token.encode()) & 0xFFFFFFFF:08x}"
 
 
 def _default_buckets(max_batch: int) -> List[int]:
@@ -159,6 +238,11 @@ class ExportedPlan:
                 f"{self.max_batch} — the full batch size must be reachable"
             )
         self.pinned_bytes = _pin_operator_arrays(graph) if pin_weights else 0
+        # Version identity, frozen at export (state never changes after):
+        # the replicated plane stamps this on every response it serves.
+        self.fingerprint = plan_fingerprint(
+            graph, self.item_shape, self.dtype, self.buckets
+        )
 
         self._trace_count = 0
         self._trace_lock = threading.Lock()
@@ -175,14 +259,34 @@ class ExportedPlan:
 
             self._jit = jax.jit(counted)
             if precompile:
-                for b in self.buckets:
+                self.warm()
+        else:
+            self._jit = None
+            self._fallback = FittedPipeline(graph, source, sink)
+
+    def warm(self) -> "ExportedPlan":
+        """Ensure every padding bucket has its pre-built executable (AOT
+        warm). A no-op for plans exported with ``precompile=True`` (the
+        default — export already built them); for lazily-exported plans
+        it backfills every bucket, which is how the replicated plane's
+        hot-swap guarantees a new plan is warm at the SAME padding
+        buckets *before* it is admitted to traffic — a swap must never
+        convert live requests into trace time."""
+        if self.compiled:
+            for b in self.buckets:
+                if b not in self._executables:
                     spec = jax.ShapeDtypeStruct(
                         (b,) + self.item_shape, self.dtype
                     )
                     self._executables[b] = self._jit.lower(spec).compile()
-        else:
-            self._jit = None
-            self._fallback = FittedPipeline(graph, source, sink)
+        return self
+
+    @property
+    def is_warm(self) -> bool:
+        """Every bucket pre-compiled (vacuously true for eager plans)."""
+        return not self.compiled or all(
+            b in self._executables for b in self.buckets
+        )
 
     @property
     def trace_count(self) -> int:

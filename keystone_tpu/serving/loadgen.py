@@ -23,7 +23,7 @@ import numpy as np
 
 from keystone_tpu.utils import profiling
 
-from .batcher import ServerClosed, ServerOverloaded
+from .batcher import ServerClosed, ServerDegraded, ServerOverloaded
 
 __all__ = ["LoadReport", "closed_loop_qps", "poisson_arrivals", "run_open_loop"]
 
@@ -62,11 +62,17 @@ class LoadReport:
     mean_latency_s: Optional[float]
     achieved_qps: Optional[float]
     latencies_s: List[float] = field(default_factory=list, repr=False)
+    # Per-replica / per-plan-version completion attribution, populated
+    # when the submit target annotates futures with ``replica_index`` /
+    # ``plan_fingerprint`` (the ReplicatedServer contract). Empty dicts
+    # against a standalone MicroBatchServer.
+    per_replica_completed: Dict[int, int] = field(default_factory=dict)
+    per_fingerprint_completed: Dict[str, int] = field(default_factory=dict)
 
     def to_row_dict(self) -> Dict[str, Any]:
         """The bench-facing dict: percentiles WITH their sample count and
         offered rate in the same dict (make_row's latency audit rule)."""
-        return {
+        out = {
             "offered_rate_hz": round(self.offered_rate_hz, 2),
             "duration_s": round(self.duration_s, 3),
             "num_samples": self.completed,
@@ -86,6 +92,18 @@ class LoadReport:
                 if self.achieved_qps is not None else None
             ),
         }
+        if self.per_replica_completed:
+            # String keys: this dict is JSON-facing (bench rows), and
+            # the row auditors walk keys as strings.
+            out["per_replica_completed"] = {
+                str(k): v
+                for k, v in sorted(self.per_replica_completed.items())
+            }
+        if self.per_fingerprint_completed:
+            out["per_fingerprint_completed"] = dict(
+                sorted(self.per_fingerprint_completed.items())
+            )
+        return out
 
 
 def run_open_loop(
@@ -102,12 +120,16 @@ def run_open_loop(
 
     ``make_request(i)`` produces the i-th request payload. Rejections
     (ServerOverloaded — at submit() or through the future) count as
-    ``rejected``; any other failure counts as ``failed``. Latency is
-    submit→completion (completion stamped by a done-callback on the
-    resolving thread)."""
+    ``rejected``; any other failure counts as ``failed``, including a
+    submit() that fails fast synchronously (ServerDegraded while a
+    breaker is open or every replica is down, ServerClosed) — the
+    storm must keep offering through a degraded window and account for
+    it, not crash with no report. Latency is submit→completion
+    (completion stamped by a done-callback on the resolving thread)."""
     arrivals = poisson_arrivals(rate_hz, duration_s, seed=seed)
     records = []  # (t_submitted, future, stamp_dict)
     rejected = 0
+    failed = 0
     t_start = time.perf_counter()
     for i, t_arr in enumerate(arrivals):
         delay = (t_start + t_arr) - time.perf_counter()
@@ -121,13 +143,17 @@ def run_open_loop(
         except ServerOverloaded:
             rejected += 1
             continue
+        except (ServerDegraded, ServerClosed):
+            failed += 1
+            continue
         fut.add_done_callback(
             lambda f, s=stamp: s.setdefault("t_done", time.perf_counter())
         )
         records.append((t_sub, fut, stamp))
 
     latencies: List[float] = []
-    failed = 0
+    per_replica: Dict[int, int] = {}
+    per_fingerprint: Dict[str, int] = {}
     for t_sub, fut, stamp in records:
         try:
             fut.result(timeout=result_timeout_s)
@@ -138,6 +164,14 @@ def run_open_loop(
             failed += 1
             continue
         latencies.append(stamp.get("t_done", time.perf_counter()) - t_sub)
+        # Replicated-plane attribution (absent on a standalone server):
+        # which replica completed it, under which plan fingerprint.
+        rep = getattr(fut, "replica_index", None)
+        if rep is not None:
+            per_replica[rep] = per_replica.get(rep, 0) + 1
+        fp = getattr(fut, "plan_fingerprint", None)
+        if fp is not None:
+            per_fingerprint[fp] = per_fingerprint.get(fp, 0) + 1
 
     pct = profiling.latency_percentiles(latencies)
     completed = len(latencies)
@@ -154,6 +188,8 @@ def run_open_loop(
         mean_latency_s=(sum(latencies) / completed) if completed else None,
         achieved_qps=(completed / wall) if completed and wall > 0 else None,
         latencies_s=latencies,
+        per_replica_completed=per_replica,
+        per_fingerprint_completed=per_fingerprint,
     )
 
 

@@ -1,0 +1,736 @@
+"""Replicated serving plane: N micro-batch replicas behind one
+admission-controlled front door (ROADMAP item 3, docs/serving.md).
+
+A single :class:`~keystone_tpu.serving.batcher.MicroBatchServer` is one
+worker thread driving one plan — a replica death or a model refresh is a
+full outage. This module composes PR 5's reliability ingredients
+(per-replica circuit breakers, the worker watchdog, deterministic fault
+sites) into the thing the north star actually requires: a serving plane
+that keeps meeting its SLO while replicas die and plans swap underneath
+live traffic.
+
+  - **One front door.** Submitters call
+    :meth:`ReplicatedServer.submit` exactly as they would a single
+    server and get the same ``Future`` contract (result, or a NAMED
+    error — nothing is ever silently dropped). Admission is decided at
+    the front: a request is admitted iff some in-rotation replica
+    admits it. The queue is logically one, physically partitioned per
+    replica worker — a single shared deque would serialize every worker
+    on one lock and put a cross-thread JAX handoff in the hot path;
+    partitioning keeps each worker's dispatch loop lock-local while the
+    admission decision (and its earliest-deadline-first shedding,
+    delegated to the chosen replica's bounded queue) stays global.
+  - **Least-loaded routing with per-replica breakers.** The replica
+    with the fewest outstanding requests wins. A replica whose breaker
+    is OPEN is removed from rotation entirely; when its cooldown
+    elapses (state ``half_open``) the router deliberately hands it the
+    next request as the recovery probe — without that, healthy replicas
+    would absorb all traffic and an opened breaker could never re-close.
+    If the chosen replica sheds or fails fast, the router FAILS OVER to
+    the next candidate; only when every in-rotation replica rejects
+    does the submitter see an error (``ServerOverloaded`` if anything
+    shed on load, else ``ServerDegraded``).
+  - **Replica watchdog + bounded restarts.** A background watchdog
+    (numpy/threading only — the jax-off-thread discipline) notices a
+    dead replica worker and respawns it from the SAME exported plan.
+    Each spawn attempt runs the ``serving.replica.spawn`` fault site
+    and burns one unit of the per-replica ``restart_budget``; past the
+    budget the replica is PERMANENTLY EVICTED — loudly: a warning log
+    and ``stats()["degraded"]``/``evicted_replicas`` flip, because a
+    plane quietly running at N-1 capacity is how the next death becomes
+    an outage.
+  - **Atomic zero-drop hot-swap.** :meth:`swap_plan` replaces the plan
+    under live traffic, one replica at a time: the new plan AOT-warms
+    at the same padding buckets *before* any capacity is taken out,
+    then each replica in turn leaves rotation, drains its in-flight
+    work to zero (queued requests finish — they are never failed), is
+    closed, and re-enters rotation wrapped around the new plan. Each
+    replica serves EXACTLY ONE plan version for the lifetime of its
+    worker, every response's future carries that version's fingerprint
+    (``fut.plan_fingerprint``), and no batch ever mixes versions — the
+    bit-identity contract of docs/reliability.md is stated per
+    fingerprint.
+  - **Chaos-provable.** ``serving.replica.execute`` is a loop-level
+    fault site on replica workers (outside the per-batch error guard —
+    an injected error there kills the whole worker, watchdog
+    territory); ``serving.replica.spawn`` fires per respawn attempt.
+    tests/test_chaos_replicas.py drives kill-mid-Poisson-storm and
+    swap-under-load through them.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from keystone_tpu.utils import faults, profiling
+
+from .batcher import (
+    MicroBatchServer,
+    ServerClosed,
+    ServerDegraded,
+    ServerOverloaded,
+)
+from .export import ExportedPlan
+
+__all__ = ["ReplicatedServer"]
+
+logger = logging.getLogger("keystone_tpu.serving")
+
+# Breaker states eligible for normal least-loaded routing.
+_ROUTABLE = ("closed", "disabled")
+
+
+class _ReplicaBatchServer(MicroBatchServer):
+    """A MicroBatchServer whose worker loop runs the
+    ``serving.replica.execute`` fault site OUTSIDE the per-batch error
+    guard: an injected error here propagates to the worker loop's
+    watchdog-of-last-resort and kills the whole replica (every in-flight
+    and queued future fails loudly with ServerDegraded) — modeling
+    whole-replica death rather than one bad batch. The per-batch
+    ``serving.execute`` site inside the guard still models plan/batch
+    failures."""
+
+    def _execute(self, batch) -> None:
+        faults.maybe_fail(faults.SITE_REPLICA_EXECUTE)
+        super()._execute(batch)
+
+
+class _Replica:
+    """One slot in the rotation: the current server generation, the
+    plan it wraps, and the lifecycle counters. ``outstanding`` counts
+    futures submitted through the front door and not yet resolved — the
+    load signal routing sorts by, and the drain signal hot-swap waits
+    on (mutated only under the ReplicatedServer lock / done-callbacks)."""
+
+    __slots__ = (
+        "index", "plan", "server", "outstanding", "restarts",
+        "evicted", "out_of_rotation", "busy",
+    )
+
+    def __init__(self, index: int, plan: ExportedPlan,
+                 server: MicroBatchServer):
+        self.index = index
+        self.plan = plan
+        self.server = server
+        self.outstanding = 0
+        self.restarts = 0
+        self.evicted = False
+        self.out_of_rotation = False
+        # Lifecycle ownership token (under the plane lock): exactly one
+        # actor — the watchdog's restart or a swap — may be replacing
+        # this replica's server generation at a time; without it a death
+        # DURING a swap could have both spawn a server and leak one.
+        self.busy = False
+
+
+class ReplicatedServer:
+    """Front N micro-batch replicas behind one admission-controlled
+    submit path (module docstring for the full design).
+
+    ``plans`` is one :class:`ExportedPlan` shared by every replica (the
+    N-workers-on-one-device shape — compiled executables are immutable
+    after export, so sharing is read-only), a sequence of N plans (one
+    copy per device), or a ``factory(replica_index) -> ExportedPlan``.
+    All plans must serve the same request signature (item shape/dtype).
+
+    Knobs beyond the per-replica ``MicroBatchServer`` surface:
+
+      - ``num_replicas``: rotation size (ignored when ``plans`` is a
+        sequence — its length wins).
+      - ``restart_budget``: spawn attempts per replica before permanent
+        eviction (0 = never restart, first death evicts).
+      - ``watchdog_interval_s``: dead-replica detection cadence — the
+        floor on restart latency, and therefore on how fast p99
+        recovers after a kill.
+      - ``drain_timeout_s``: hot-swap's bound on waiting for one
+        replica's in-flight work; on timeout the replica re-enters
+        rotation on its OLD plan and the swap raises (zero-drop is
+        preserved either way).
+    """
+
+    def __init__(
+        self,
+        plans: Union[ExportedPlan, Sequence[ExportedPlan],
+                     Callable[[int], ExportedPlan]],
+        num_replicas: int = 2,
+        max_batch: Optional[int] = None,
+        max_wait_ms: float = 2.0,
+        max_queue_depth: int = 1024,
+        span_log_len: int = 4096,
+        breaker_threshold: int = 5,
+        breaker_reset_s: float = 1.0,
+        restart_budget: int = 3,
+        watchdog_interval_s: float = 0.05,
+        drain_timeout_s: float = 30.0,
+    ):
+        factory, n = self._plan_factory(plans, num_replicas)
+        if n < 1:
+            raise ValueError(f"num_replicas must be >= 1, got {n}")
+        if restart_budget < 0:
+            raise ValueError("restart_budget must be >= 0")
+        self.num_replicas = n
+        self.restart_budget = int(restart_budget)
+        self.watchdog_interval_s = float(watchdog_interval_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._server_kwargs = dict(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue_depth=max_queue_depth, span_log_len=span_log_len,
+            breaker_threshold=breaker_threshold,
+            breaker_reset_s=breaker_reset_s,
+        )
+
+        self._lock = threading.Lock()
+        self._swap_lock = threading.Lock()  # serializes swap_plan calls
+        self._closed = False
+        self._replicas: List[_Replica] = []
+        self._item_shape: Optional[tuple] = None
+        self._dtype = None
+        try:
+            for i in range(n):
+                plan = factory(i)
+                self._check_signature(plan)
+                self._replicas.append(
+                    _Replica(i, plan, self._build_server(i, plan))
+                )
+        except BaseException:
+            # Replica servers start their worker threads at build; a
+            # half-constructed plane must not leak the ones already
+            # running when a later plan fails validation.
+            for rep in self._replicas:
+                rep.server.close(timeout=1.0)
+            raise
+
+        # Front-door accounting (all under _lock). Counters folded in
+        # from retired server generations live in _retired so restarts
+        # and swaps never lose history.
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.degraded_rejected = 0
+        self.restarts_total = 0
+        self.swaps_completed = 0
+        self._latencies_s: Deque[float] = deque(maxlen=span_log_len)
+        self._retired: Dict[str, int] = {
+            "completed": 0, "rejected": 0, "failed": 0, "breaker_opens": 0,
+        }
+
+        self._stop = threading.Event()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop,
+            name="keystone-serving-replica-watchdog", daemon=True,
+        )
+        self._watchdog.start()
+
+    # -- construction helpers ---------------------------------------------
+
+    @staticmethod
+    def _plan_factory(plans, num_replicas):
+        if isinstance(plans, ExportedPlan):
+            return (lambda i: plans), int(num_replicas)
+        if callable(plans):
+            return plans, int(num_replicas)
+        seq = list(plans)
+        if not seq:
+            raise ValueError("plans sequence is empty")
+        return (lambda i: seq[i]), len(seq)
+
+    def _check_signature(self, plan: ExportedPlan) -> None:
+        """Every replica must serve the same request signature — routing
+        is load-based, so any request must be servable by any replica."""
+        if self._item_shape is None:
+            self._item_shape = plan.item_shape
+            self._dtype = plan.dtype
+            return
+        if plan.item_shape != self._item_shape or plan.dtype != self._dtype:
+            raise ValueError(
+                f"replica plan signature {plan.item_shape}/{plan.dtype} != "
+                f"plane signature {self._item_shape}/{self._dtype} — every "
+                "replica must serve the same request shape and dtype"
+            )
+
+    def _build_server(self, index: int, plan: ExportedPlan):
+        return _ReplicaBatchServer(
+            plan, replica_index=index, **self._server_kwargs
+        )
+
+    # -- submit side -------------------------------------------------------
+
+    def submit(self, x, deadline_ms: Optional[float] = None) -> Future:
+        """Route one request to the best replica; returns its Future,
+        annotated with ``replica_index`` and ``plan_fingerprint`` (the
+        version of the plan that will serve it — fixed at admission,
+        because a replica's worker serves exactly one plan version for
+        its whole lifetime).
+
+        Raises :class:`ServerClosed` after close(); fails over across
+        replicas on shed/degraded rejections and raises only when EVERY
+        in-rotation replica rejected (:class:`ServerOverloaded` if any
+        rejection was load shedding, else :class:`ServerDegraded`)."""
+        t_sub = time.perf_counter()
+        x = np.asarray(x)
+        tried: set = set()
+        saw_overload = False
+        last_exc: Optional[BaseException] = None
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise ServerClosed("submit() after close()")
+                rep = self._pick_locked(tried)
+                if rep is None:
+                    break
+                # Reserve BEFORE the replica sees the request: hot-swap
+                # drains on this counter, and a request queued before
+                # its reservation is visible could be closed mid-swap.
+                rep.outstanding += 1
+            try:
+                fut = rep.server.submit(x, deadline_ms)
+            except (ServerOverloaded, ServerDegraded, ServerClosed) as e:
+                with self._lock:
+                    rep.outstanding -= 1
+                saw_overload = saw_overload or isinstance(e, ServerOverloaded)
+                last_exc = e
+                tried.add(rep.index)
+                continue
+            except BaseException:
+                # Anything else (e.g. a malformed deadline) is the
+                # caller's error, not a failover signal — but the
+                # reservation MUST still be released, or this replica
+                # reads permanently loaded and every later swap drain
+                # of it times out.
+                with self._lock:
+                    rep.outstanding -= 1
+                raise
+            fut.replica_index = rep.index
+            fut.plan_fingerprint = rep.server.plan.fingerprint
+            fut.add_done_callback(self._done_callback(rep, t_sub))
+            return fut
+        with self._lock:
+            if saw_overload:
+                self.rejected += 1
+            else:
+                self.degraded_rejected += 1
+        if saw_overload:
+            raise ServerOverloaded(
+                f"every in-rotation replica shed this request "
+                f"(last: {last_exc})"
+            )
+        raise ServerDegraded(
+            f"no replica available: all {self.num_replicas} replicas are "
+            f"open-breaker, restarting, evicted, or dead (last: {last_exc})"
+        )
+
+    def _pick_locked(self, tried: set) -> Optional[_Replica]:
+        """Routing policy (under _lock): a probe-ready half-open replica
+        first (it needs the next request as its recovery probe), else
+        the least-loaded replica whose breaker admits traffic. A
+        half-open replica whose probe is already IN FLIGHT is skipped
+        outright — its server fails every further submit fast, so
+        offering it traffic would only buy a reject/failover round-trip
+        per request for the whole probe-execution window."""
+        candidates = [
+            r for r in self._replicas
+            if not r.evicted and not r.out_of_rotation
+            and r.index not in tried
+        ]
+        probe_ready = None
+        routable = []
+        for r in candidates:
+            state, probe_free = r.server.routing_state
+            if state == "half_open":
+                if probe_free:
+                    probe_ready = probe_ready or r
+            elif state in _ROUTABLE:
+                routable.append(r)
+        if probe_ready is not None:
+            return probe_ready
+        if not routable:
+            return None
+        return min(routable, key=lambda r: (r.outstanding, r.index))
+
+    def _done_callback(self, rep: _Replica, t_sub: float):
+        def _cb(fut: Future) -> None:
+            t_done = time.perf_counter()
+            try:
+                exc = fut.exception()
+            except BaseException:  # noqa: BLE001 — client cancelled
+                with self._lock:
+                    rep.outstanding -= 1
+                return
+            with self._lock:
+                rep.outstanding -= 1
+                if exc is None:
+                    self.completed += 1
+                    self._latencies_s.append(t_done - t_sub)
+                elif isinstance(exc, ServerOverloaded):
+                    self.rejected += 1
+                else:
+                    self.failed += 1
+        return _cb
+
+    # -- watchdog / restart ------------------------------------------------
+
+    def _watchdog_loop(self) -> None:
+        while not self._stop.wait(self.watchdog_interval_s):
+            self._sweep_dead_replicas()
+
+    def _sweep_dead_replicas(self) -> None:
+        for rep in self._replicas:
+            with self._lock:
+                if self._closed:
+                    return
+                if rep.evicted or rep.out_of_rotation or rep.busy:
+                    continue
+                if not self._server_dead_locked(rep.server):
+                    continue
+                rep.busy = True
+                rep.out_of_rotation = True
+            try:
+                self._restart(rep)
+            finally:
+                with self._lock:
+                    rep.busy = False
+
+    @staticmethod
+    def _server_dead_locked(server: MicroBatchServer) -> bool:
+        return server._worker_dead or not server.is_alive
+
+    def _restart(self, rep: _Replica) -> None:
+        """Replace a dead replica's server generation from its exported
+        plan, within the restart budget; past it, evict permanently —
+        and loudly."""
+        self._retire_server(rep.server)
+        rep.server.close(timeout=1.0)  # dead worker: join is immediate
+        if self._try_spawn(rep, rep.plan):
+            with self._lock:
+                rep.out_of_rotation = False
+            logger.warning(
+                "serving replica %d worker died; restarted (%d/%d of the "
+                "restart budget used)", rep.index, rep.restarts,
+                self.restart_budget,
+            )
+
+    def _try_spawn(self, rep: _Replica, plan: ExportedPlan,
+                   count_restart: bool = True) -> bool:
+        """Spawn attempts through the ``serving.replica.spawn`` fault
+        site. Death restarts (``count_restart=True``) burn the
+        per-replica lifetime ``restart_budget``; planned swap spawns
+        track their own bounded attempts instead — a healthy plan
+        refresh must not eat the budget reserved for real deaths.
+        Returns True on success; False means the replica was
+        permanently evicted."""
+        swap_attempts = 0
+        while True:
+            with self._lock:
+                if self._closed:
+                    return False
+                if count_restart:
+                    if rep.restarts >= self.restart_budget:
+                        rep.evicted = True
+                        rep.out_of_rotation = True
+                        break
+                    rep.restarts += 1
+                    self.restarts_total += 1
+                else:
+                    # A swap gets at least one attempt even at budget 0.
+                    if swap_attempts >= max(1, self.restart_budget):
+                        rep.evicted = True
+                        rep.out_of_rotation = True
+                        break
+                    swap_attempts += 1
+            try:
+                faults.maybe_fail(faults.SITE_REPLICA_SPAWN)
+                server = self._build_server(rep.index, plan)
+            except BaseException as e:  # noqa: BLE001 — budget-bounded
+                attempt = rep.restarts if count_restart else swap_attempts
+                logger.warning(
+                    "serving replica %d spawn attempt %d failed: %r",
+                    rep.index, attempt, e,
+                )
+                # Pace the retry: a transient blip (fd exhaustion, a
+                # briefly busy device) must not burn the whole restart
+                # budget in microseconds and permanently evict a
+                # recoverable replica. Bounded exponential, and the
+                # close() event cuts the wait short.
+                if self._stop.wait(min(0.05 * (2 ** (attempt - 1)), 1.0)):
+                    return False
+                continue
+            with self._lock:
+                closed = self._closed
+                if not closed:
+                    rep.server = server
+                    rep.plan = plan
+            if closed:
+                # close() ran while we were building: installing now
+                # would leak a worker thread close() already iterated
+                # past. Tear the fresh generation down instead.
+                server.close(timeout=1.0)
+                return False
+            return True
+        logger.warning(
+            "serving replica %d PERMANENTLY EVICTED: restart budget "
+            "(%d) exhausted — the plane is degraded to %d replicas",
+            rep.index, self.restart_budget,
+            sum(1 for r in self._replicas if not r.evicted),
+        )
+        return False
+
+    # -- hot swap ----------------------------------------------------------
+
+    def swap_plan(
+        self,
+        new: Union[ExportedPlan, Sequence[ExportedPlan],
+                   Callable[[int], ExportedPlan], Any],
+        drain_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Atomically hot-swap every replica onto a new plan version
+        under live traffic, with ZERO dropped requests.
+
+        ``new`` is an :class:`ExportedPlan` (shared), a sequence /
+        ``factory(index)`` of per-replica plans, or a
+        ``FittedPipeline`` — the latter is exported here with the SAME
+        request signature, max_batch, and padding buckets as the
+        current plan, so the drain protocol below holds by construction.
+
+        Protocol, per replica in turn (rolling — capacity never drops
+        by more than one replica):
+
+          1. The new plan AOT-warms at the same padding buckets
+             (:meth:`ExportedPlan.warm` — a no-op for default exports)
+             BEFORE any capacity leaves rotation.
+          2. The replica leaves rotation: no new admissions.
+          3. Drain: every request already admitted to it completes (the
+             old plan finishes its in-flight batches; queued requests
+             are served, never failed).
+          4. The old server closes on an empty queue; a NEW worker
+             generation spawns around the new plan and re-enters
+             rotation.
+
+        Each worker generation serves exactly one plan version, so no
+        batch ever mixes versions and every response's
+        ``plan_fingerprint`` names the version that produced it —
+        bit-identical to that version's offline apply
+        (docs/reliability.md). Returns a per-replica swap report.
+        """
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        with self._swap_lock:
+            factory = self._resolve_swap_plans(new)
+            report: List[Dict[str, Any]] = []
+            for rep in self._replicas:
+                if rep.evicted:
+                    report.append({
+                        "replica": rep.index, "swapped": False,
+                        "reason": "evicted",
+                    })
+                    continue
+                new_plan = factory(rep.index)
+                self._check_signature(new_plan)
+                new_plan.warm()  # warm BEFORE taking capacity out
+                # Take lifecycle ownership: wait out a watchdog restart
+                # already replacing this replica's server generation.
+                own_deadline = time.perf_counter() + timeout
+                while True:
+                    with self._lock:
+                        if self._closed:
+                            raise ServerClosed("swap_plan() after close()")
+                        if rep.evicted:
+                            break
+                        if not rep.busy:
+                            rep.busy = True
+                            rep.out_of_rotation = True
+                            break
+                    if time.perf_counter() >= own_deadline:
+                        raise TimeoutError(
+                            f"replica {rep.index} is mid-restart and did "
+                            f"not settle within {timeout:.3g}s"
+                        )
+                    time.sleep(0.005)
+                if rep.evicted:  # evicted while we waited
+                    report.append({
+                        "replica": rep.index, "swapped": False,
+                        "reason": "evicted",
+                    })
+                    continue
+                try:
+                    try:
+                        t0 = time.perf_counter()
+                        self._drain(rep, timeout)
+                        drain_s = time.perf_counter() - t0
+                    except BaseException:
+                        with self._lock:  # zero-drop: old plan keeps serving
+                            rep.out_of_rotation = False
+                        raise
+                    old_fp = rep.server.plan.fingerprint
+                    self._retire_server(rep.server)
+                    rep.server.close()
+                    if not self._try_spawn(rep, new_plan,
+                                           count_restart=False):
+                        report.append({
+                            "replica": rep.index, "swapped": False,
+                            "reason": "spawn failed; replica evicted",
+                            "old_fingerprint": old_fp,
+                        })
+                        continue
+                    with self._lock:
+                        rep.out_of_rotation = False
+                    report.append({
+                        "replica": rep.index, "swapped": True,
+                        "old_fingerprint": old_fp,
+                        "new_fingerprint": new_plan.fingerprint,
+                        "drain_s": round(drain_s, 6),
+                    })
+                finally:
+                    with self._lock:
+                        rep.busy = False
+            with self._lock:
+                self.swaps_completed += 1
+            return {"replicas": report}
+
+    def _resolve_swap_plans(self, new) -> Callable[[int], ExportedPlan]:
+        # A freshly fitted pipeline: export with the current signature so
+        # the new plan warms at the same buckets the plane already runs.
+        # (Checked FIRST — FittedPipeline is itself callable, and the
+        # factory branch would otherwise apply it to the replica index.)
+        from keystone_tpu.workflow.pipeline import FittedPipeline
+
+        if isinstance(new, FittedPipeline):
+            from .export import export_plan
+
+            cur = self._replicas[0].plan
+            example = np.zeros(self._item_shape, np.dtype(self._dtype))
+            plan = export_plan(
+                new, example, max_batch=cur.max_batch, buckets=cur.buckets,
+            )
+            return lambda i: plan
+        if isinstance(new, ExportedPlan):
+            return lambda i: new
+        if isinstance(new, (list, tuple)):
+            seq = list(new)
+            if len(seq) != self.num_replicas:
+                raise ValueError(
+                    f"swap_plan got {len(seq)} plans for "
+                    f"{self.num_replicas} replicas"
+                )
+            return lambda i: seq[i]
+        if callable(new):
+            return new
+        raise TypeError(
+            f"swap_plan takes an ExportedPlan, a sequence/factory of "
+            f"them, or a FittedPipeline (got {type(new).__name__})"
+        )
+
+    def _drain(self, rep: _Replica, timeout: float) -> None:
+        """Wait until every request admitted to ``rep`` has resolved
+        (the batcher guarantees every future resolves — results, plan
+        errors, watchdog failures — so drain always terminates unless
+        the replica is genuinely wedged past ``timeout``)."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            with self._lock:
+                if rep.outstanding == 0:
+                    return
+            if time.perf_counter() >= deadline:
+                raise TimeoutError(
+                    f"replica {rep.index} failed to drain within "
+                    f"{timeout:.3g}s ({rep.outstanding} outstanding); "
+                    "it re-enters rotation on its OLD plan"
+                )
+            time.sleep(0.001)
+
+    # -- observability -----------------------------------------------------
+
+    def _retire_server(self, server: MicroBatchServer) -> None:
+        """Fold a closing server generation's counters into the plane's
+        history so restarts and swaps never lose completions."""
+        s = server.stats()
+        with self._lock:
+            for k in ("completed", "rejected", "failed", "breaker_opens"):
+                self._retired[k] += int(s.get(k) or 0)
+
+    def stats(self) -> Dict[str, Any]:
+        """Aggregate plane stats + per-replica attribution.
+
+        Front-door counters (completed / rejected / failed, end-to-end
+        p50/p99 over the rolling window) are accounted at the future,
+        so they survive replica restarts and swaps; ``replica_*``
+        blocks carry each LIVE worker generation's own stats() plus
+        lifecycle state, and ``span_summary_by_replica`` attributes
+        batch spans to the replica that executed them. ``degraded`` is
+        the loud flag: any replica evicted or currently dead."""
+        with self._lock:
+            lat = list(self._latencies_s)
+            reps = list(self._replicas)
+            out: Dict[str, Any] = {
+                "num_replicas": self.num_replicas,
+                "completed": self.completed,
+                "rejected": self.rejected,
+                "failed": self.failed,
+                "degraded_rejected": self.degraded_rejected,
+                "restarts_total": self.restarts_total,
+                "swaps_completed": self.swaps_completed,
+                "retired_generations": dict(self._retired),
+                "num_latency_samples": len(lat),
+            }
+            outstanding = {r.index: r.outstanding for r in reps}
+        pct = profiling.latency_percentiles(lat)
+        out["p50_latency_s"] = pct["p50"] if pct else None
+        out["p99_latency_s"] = pct["p99"] if pct else None
+
+        per_replica: Dict[int, Dict[str, Any]] = {}
+        span_by_rep: Dict[int, Dict[str, Any]] = {}
+        evicted: List[int] = []
+        healthy = 0
+        for r in reps:
+            s = r.server.stats()
+            s.update({
+                "outstanding": outstanding[r.index],
+                "restarts": r.restarts,
+                "evicted": r.evicted,
+                "in_rotation": not (r.evicted or r.out_of_rotation),
+                "plan_fingerprint": r.server.plan.fingerprint,
+            })
+            per_replica[r.index] = s
+            # Each server's span ring holds only its own spans, so the
+            # summary stats() already computed IS this replica's group —
+            # re-snapshotting the ring here would take the span lock a
+            # second time per replica on the serving hot path.
+            if s.get("span_summary"):
+                span_by_rep[r.index] = s["span_summary"]
+            if r.evicted:
+                evicted.append(r.index)
+            elif s["breaker_state"] not in ("dead",):
+                healthy += 1
+        out["per_replica"] = per_replica
+        out["span_summary_by_replica"] = span_by_rep
+        out["evicted_replicas"] = evicted
+        out["healthy_replicas"] = healthy
+        out["degraded"] = bool(evicted) or healthy < self.num_replicas
+        return out
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the plane: the watchdog joins, then every replica server
+        closes (in-flight batches complete, queued requests fail with
+        :class:`ServerClosed`). Idempotent."""
+        with self._lock:
+            already = self._closed
+            self._closed = True
+        self._stop.set()
+        if not already:
+            self._watchdog.join(timeout=timeout)
+        for rep in self._replicas:
+            rep.server.close(timeout=timeout)
+
+    def __enter__(self) -> "ReplicatedServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -18,6 +18,15 @@ Instrumented sites (each site counts its own calls, 0-based):
                         thread (``data/prefetch.py``).
   - ``serving.execute`` — one batch execution inside the micro-batch
                         server's worker (``serving/batcher.py``).
+  - ``serving.replica.execute`` — one batch execution on a replica
+                        worker OUTSIDE the per-batch error guard
+                        (``serving/replicas.py``): an injected error
+                        here kills the whole replica worker (watchdog
+                        + restart territory), not just one batch.
+  - ``serving.replica.spawn`` — one replica (re)spawn attempt in the
+                        replicated server's restart path; injected
+                        errors burn the restart budget toward
+                        permanent eviction.
 
 Activation is either lexical (``with plan.active():``) or ambient via
 the ``KEYSTONE_FAULT_PLAN`` env var (a JSON plan, or ``@/path/to.json``)
@@ -48,6 +57,8 @@ __all__ = [
     "FaultRule",
     "RetryPolicy",
     "SITE_PREFETCH_READ",
+    "SITE_REPLICA_EXECUTE",
+    "SITE_REPLICA_SPAWN",
     "SITE_SERVING_EXECUTE",
     "SITE_SHARD_LOAD",
     "active_plan",
@@ -62,6 +73,8 @@ __all__ = [
 SITE_SHARD_LOAD = "shard.load"
 SITE_PREFETCH_READ = "prefetch.read"
 SITE_SERVING_EXECUTE = "serving.execute"
+SITE_REPLICA_EXECUTE = "serving.replica.execute"
+SITE_REPLICA_SPAWN = "serving.replica.spawn"
 
 _KINDS = ("error", "corrupt", "latency")
 _EXC_TYPES: Dict[str, type] = {
@@ -463,12 +476,33 @@ class RetryPolicy:
         raise last  # pragma: no cover — loop always returns or raises
 
 
+def _env_number(name: str, default: str, cast, minimum):
+    """Parse a numeric env knob, failing at PARSE time with one clear
+    error naming the variable — a bad value must not surface as an
+    unrelated TypeError deep inside a shard read's retry loop."""
+    raw = os.environ.get(name, default)
+    try:
+        value = cast(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {cast.__name__} "
+            f"(unset it or set a number >= {minimum})"
+        ) from None
+    if value < minimum:
+        raise ValueError(
+            f"{name}={raw!r} must be >= {minimum}"
+        )
+    return value
+
+
 def default_retry_policy() -> RetryPolicy:
     """The data plane's shared default policy; knobs ride env vars so
     drills can tighten/loosen without code changes:
-    ``KEYSTONE_RETRY_ATTEMPTS`` (default 3) and
-    ``KEYSTONE_RETRY_BASE_S`` (default 0.02)."""
+    ``KEYSTONE_RETRY_ATTEMPTS`` (default 3, an int >= 1) and
+    ``KEYSTONE_RETRY_BASE_S`` (default 0.02, a float >= 0). Invalid
+    values raise one :class:`ValueError` naming the variable, here at
+    policy construction — never mid-read."""
     return RetryPolicy(
-        attempts=int(os.environ.get("KEYSTONE_RETRY_ATTEMPTS", "3")),
-        base_delay_s=float(os.environ.get("KEYSTONE_RETRY_BASE_S", "0.02")),
+        attempts=_env_number("KEYSTONE_RETRY_ATTEMPTS", "3", int, 1),
+        base_delay_s=_env_number("KEYSTONE_RETRY_BASE_S", "0.02", float, 0.0),
     )

@@ -135,6 +135,10 @@ class RequestSpan:
     batch_size: int
     bucket: int
     pad_fraction: float
+    # Which replica of a replicated serving plane executed the batch
+    # (None on a standalone MicroBatchServer) — per-replica span
+    # attribution for serving/replicas.py's aggregate stats.
+    replica: Optional[int] = None
 
 
 class SpanLog:
@@ -164,17 +168,23 @@ class SpanLog:
     def summary(self) -> Dict[str, float]:
         """Mean queue wait / exec / pad fraction over the retained window
         (empty dict when nothing has been served)."""
-        spans = self.snapshot()
-        if not spans:
-            return {}
-        n = float(len(spans))
-        return {
-            "num_spans": len(spans),
-            "mean_queue_wait_s": sum(s.queue_wait_s for s in spans) / n,
-            "mean_exec_s": sum(s.exec_s for s in spans) / n,
-            "mean_batch_size": sum(s.batch_size for s in spans) / n,
-            "mean_pad_fraction": sum(s.pad_fraction for s in spans) / n,
-        }
+        return summarize_spans(self.snapshot())
+
+
+def summarize_spans(spans: Sequence["RequestSpan"]) -> Dict[str, float]:
+    """The one summary shape for a span collection (SpanLog.summary, the
+    per-replica blocks, and callers holding an already-snapshotted list
+    — no second ring copy). Empty dict for no spans."""
+    if not spans:
+        return {}
+    n = float(len(spans))
+    return {
+        "num_spans": len(spans),
+        "mean_queue_wait_s": sum(s.queue_wait_s for s in spans) / n,
+        "mean_exec_s": sum(s.exec_s for s in spans) / n,
+        "mean_batch_size": sum(s.batch_size for s in spans) / n,
+        "mean_pad_fraction": sum(s.pad_fraction for s in spans) / n,
+    }
 
 
 def latency_percentiles(

@@ -1,0 +1,231 @@
+"""Replicated-plane chaos suite (ISSUE 7 acceptance): replica kill under
+open-loop Poisson load recovers within the restart budget with zero
+silently-dropped requests (every submitted future resolves with a result
+or a NAMED error), spawn faults burn the budget to loud permanent
+eviction, and hot-swap under sustained load drops nothing while every
+response stays bit-identical to offline apply under the plan fingerprint
+recorded on it.
+
+Driven by the deterministic fault harness's ``serving.replica.execute``
+(loop-level — kills the whole replica worker, not one batch) and
+``serving.replica.spawn`` (burns restart budget) sites. The Poisson
+storm legs are marked ``slow`` so the tier-1 wall is unchanged; run the
+full suite with ``pytest -m chaos``.
+"""
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.serving import (
+    ReplicatedServer,
+    ServerDegraded,
+    export_plan,
+    run_open_loop,
+)
+from keystone_tpu.utils.faults import FaultPlan, FaultRule
+
+from tests._serving_util import TINY_D_IN, fit_tiny_mnist
+
+pytestmark = pytest.mark.chaos
+
+
+def _plane(num_replicas=3, seed=0, **kw):
+    fitted, X = fit_tiny_mnist(seed=seed)
+    plan = export_plan(fitted, np.zeros(TINY_D_IN, np.float32), max_batch=8)
+    kw.setdefault("max_wait_ms", 0.5)
+    kw.setdefault("watchdog_interval_s", 0.01)
+    return fitted, plan, X, ReplicatedServer(
+        plan, num_replicas=num_replicas, **kw
+    )
+
+
+class TestReplicaKill:
+    def test_kill_restart_full_health(self):
+        """An injected loop-level error kills one replica worker; its
+        in-flight request fails with the NAMED ServerDegraded; the
+        watchdog restarts it from the exported plan and the plane
+        returns to full health with exactly one budget unit burned."""
+        _, plan, X, srv = _plane(num_replicas=3)
+        kill = FaultPlan([FaultRule("serving.replica.execute", "error",
+                                    calls=[0])])
+        named_errors = 0
+        try:
+            with kill:
+                for i in range(30):
+                    try:
+                        srv.submit(X[i % len(X)]).result(timeout=30)
+                    except (ServerDegraded, OSError):
+                        named_errors += 1
+                    time.sleep(0.01)
+            stats = srv.stats()
+            assert named_errors >= 1  # the killed worker's in-flight
+            assert stats["restarts_total"] == 1
+            assert stats["healthy_replicas"] == 3
+            assert not stats["degraded"]
+            assert stats["evicted_replicas"] == []
+            # Post-recovery the plane serves normally again.
+            srv.submit(X[0]).result(timeout=30)
+        finally:
+            srv.close()
+
+    def test_spawn_faults_exhaust_budget_to_loud_eviction(self):
+        """Every respawn attempt fails (injected at
+        serving.replica.spawn): the budget burns down and the replica is
+        PERMANENTLY evicted — visible in degraded stats — while the
+        surviving replica keeps serving."""
+        _, plan, X, srv = _plane(num_replicas=2, restart_budget=2)
+        chaos = FaultPlan([
+            FaultRule("serving.replica.execute", "error", calls=[0]),
+            FaultRule("serving.replica.spawn", "error", p=1.0),
+        ])
+        try:
+            with chaos:
+                try:
+                    srv.submit(X[0]).result(timeout=30)
+                except (ServerDegraded, OSError):
+                    pass
+                deadline = time.perf_counter() + 10.0
+                while (not srv.stats()["evicted_replicas"]
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.02)
+            stats = srv.stats()
+            assert len(stats["evicted_replicas"]) == 1
+            assert stats["degraded"]
+            assert stats["healthy_replicas"] == 1
+            evicted = stats["evicted_replicas"][0]
+            assert stats["per_replica"][evicted]["restarts"] == 2
+            # The survivor still serves.
+            out = srv.submit(X[0])
+            out.result(timeout=30)
+            assert out.replica_index != evicted
+        finally:
+            srv.close()
+
+    def test_zero_restart_budget_evicts_on_first_death(self):
+        _, plan, X, srv = _plane(num_replicas=2, restart_budget=0)
+        kill = FaultPlan([FaultRule("serving.replica.execute", "error",
+                                    calls=[0])])
+        try:
+            with kill:
+                try:
+                    srv.submit(X[0]).result(timeout=30)
+                except (ServerDegraded, OSError):
+                    pass
+                deadline = time.perf_counter() + 10.0
+                while (not srv.stats()["evicted_replicas"]
+                       and time.perf_counter() < deadline):
+                    time.sleep(0.02)
+            stats = srv.stats()
+            assert len(stats["evicted_replicas"]) == 1
+            assert stats["restarts_total"] == 0
+        finally:
+            srv.close()
+
+    @pytest.mark.slow
+    def test_kill_under_poisson_storm_recovers_with_zero_silent_drops(self):
+        """The acceptance drill: a replica dies mid-Poisson-storm. Every
+        offered request is accounted for (completed + rejected + failed
+        == offered — run_open_loop resolves every future), the handful
+        of failures are the killed worker's in-flight (named errors,
+        bounded), the watchdog restores full health, and the post-storm
+        plane's latency is back at steady state."""
+        _, plan, X, srv = _plane(num_replicas=3, max_queue_depth=4096)
+        # Kill whichever replica executes the ~40th batch of the storm.
+        kill = FaultPlan([FaultRule("serving.replica.execute", "error",
+                                    calls=[40])])
+        try:
+            with kill:
+                report = run_open_loop(
+                    srv.submit, lambda i: X[i % len(X)],
+                    rate_hz=300.0, duration_s=3.0, seed=11,
+                )
+            stats = srv.stats()
+            # ZERO silent drops: every future resolved one way.
+            assert (report.completed + report.rejected + report.failed
+                    == report.num_offered)
+            assert report.completed > 0.9 * report.num_offered
+            assert 1 <= report.failed <= 64  # the dead worker's in-flight
+            # Per-replica attribution covers every completion.
+            assert sum(report.per_replica_completed.values()) \
+                == report.completed
+            assert set(report.per_replica_completed) == {0, 1, 2}
+            # Recovered: restart happened, full health, nobody evicted.
+            assert stats["restarts_total"] >= 1
+            assert stats["healthy_replicas"] == 3
+            assert stats["evicted_replicas"] == []
+            # p99 degrades gracefully, not catastrophically: the storm's
+            # tail stays within the coalescing-window regime rather than
+            # the multi-second restart window.
+            assert report.p99_latency_s < 1.0
+        finally:
+            srv.close()
+
+
+class TestHotSwapUnderLoad:
+    @pytest.mark.slow
+    def test_swap_under_sustained_load_zero_drop_bit_identical(self):
+        """The acceptance drill: swap_plan under sustained submissions.
+        ZERO requests dropped (no errors of any kind), both plan
+        versions appear, and EVERY response is bit-identical to offline
+        apply under the fingerprint recorded on it — no mixed-plan
+        batches, by construction."""
+        fitted1, X = fit_tiny_mnist(seed=0)
+        fitted2, _ = fit_tiny_mnist(seed=42)
+        plan1 = export_plan(fitted1, np.zeros(TINY_D_IN, np.float32),
+                            max_batch=8)
+        plan2 = export_plan(fitted2, np.zeros(TINY_D_IN, np.float32),
+                            max_batch=8)
+        by_fp = {plan1.fingerprint: fitted1, plan2.fingerprint: fitted2}
+        assert plan1.fingerprint != plan2.fingerprint
+
+        srv = ReplicatedServer(plan1, num_replicas=3, max_wait_ms=0.5,
+                               drain_timeout_s=30.0)
+        swap_err = []
+
+        def _swap():
+            try:
+                srv.swap_plan(plan2)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                swap_err.append(e)
+
+        swapper = threading.Thread(target=_swap)
+        records = []  # (x, future)
+        try:
+            n = 400
+            for i in range(n):
+                x = X[i % len(X)]
+                records.append((x, srv.submit(x)))
+                if i == n // 3:
+                    swapper.start()  # swap rolls while load continues
+                time.sleep(0.002)
+            swapper.join(timeout=60)
+            assert not swapper.is_alive()
+            assert not swap_err, swap_err
+            outs = [f.result(timeout=30) for _, f in records]  # no errors
+        finally:
+            if swapper.ident is not None:
+                swapper.join(timeout=60)
+            srv.close()
+
+        fps = {f.plan_fingerprint for _, f in records}
+        assert fps == set(by_fp), fps  # both versions actually served
+        # Bit-identity per fingerprint: group responses by the version
+        # stamped on them, compare against THAT version's offline apply.
+        for fp, fitted in by_fp.items():
+            idx = [i for i, (_, f) in enumerate(records)
+                   if f.plan_fingerprint == fp]
+            served = np.stack([np.asarray(outs[i]) for i in idx])
+            batch = np.stack([records[i][0] for i in idx])
+            offline = np.asarray(
+                fitted.apply(Dataset.of(jnp.asarray(batch))).array
+            )
+            np.testing.assert_array_equal(served, offline)
+        stats = srv.stats()
+        assert stats["swaps_completed"] == 1
+        assert stats["failed"] == 0 and stats["rejected"] == 0
+        assert stats["completed"] == len(records)

@@ -209,3 +209,27 @@ class TestRetryPolicy:
     def test_attempts_must_be_positive(self):
         with pytest.raises(ValueError):
             RetryPolicy(attempts=0)
+
+    def test_env_knobs_validated_at_parse_time(self, monkeypatch):
+        """ISSUE 7 satellite: a bad retry knob must raise ONE clear
+        ValueError naming the variable at policy construction — never a
+        confusing failure deep inside a shard read."""
+        monkeypatch.setenv("KEYSTONE_RETRY_ATTEMPTS", "banana")
+        with pytest.raises(ValueError, match="KEYSTONE_RETRY_ATTEMPTS"):
+            faults.default_retry_policy()
+        monkeypatch.setenv("KEYSTONE_RETRY_ATTEMPTS", "-2")
+        with pytest.raises(ValueError, match="KEYSTONE_RETRY_ATTEMPTS"):
+            faults.default_retry_policy()
+        monkeypatch.setenv("KEYSTONE_RETRY_ATTEMPTS", "0")
+        with pytest.raises(ValueError, match="KEYSTONE_RETRY_ATTEMPTS"):
+            faults.default_retry_policy()
+        monkeypatch.delenv("KEYSTONE_RETRY_ATTEMPTS")
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "not-a-float")
+        with pytest.raises(ValueError, match="KEYSTONE_RETRY_BASE_S"):
+            faults.default_retry_policy()
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "-0.5")
+        with pytest.raises(ValueError, match="KEYSTONE_RETRY_BASE_S"):
+            faults.default_retry_policy()
+        # Valid boundary values still parse: base 0 disables backoff.
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0")
+        assert faults.default_retry_policy().base_delay_s == 0.0

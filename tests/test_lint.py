@@ -38,7 +38,35 @@ class TestPackageIsClean:
             "SITE_SHARD_LOAD": faults.SITE_SHARD_LOAD,
             "SITE_PREFETCH_READ": faults.SITE_PREFETCH_READ,
             "SITE_SERVING_EXECUTE": faults.SITE_SERVING_EXECUTE,
+            "SITE_REPLICA_EXECUTE": faults.SITE_REPLICA_EXECUTE,
+            "SITE_REPLICA_SPAWN": faults.SITE_REPLICA_SPAWN,
         }
+
+    def test_every_registered_fault_site_is_exercised_by_tests(self):
+        """ISSUE 7 satellite parity gate: every ``SITE_*`` in the faults
+        registry must be driven by at least one test in the repo — a
+        fault site nobody injects is a recovery path nobody has
+        executed, and new sites must not be able to land untested."""
+        tests_dir = Path(__file__).resolve().parent
+        this_file = Path(__file__).resolve()
+        corpus = "\n".join(
+            p.read_text()
+            for p in sorted(tests_dir.glob("test_*.py"))
+            if p != this_file  # this test must not satisfy itself
+        )
+        # Sites match only as QUOTED string literals: a raw substring
+        # check would let "serving.execute" be vacuously satisfied by
+        # any "serving.replica.execute" occurrence (prefix aliasing).
+        missing = [
+            f"{attr} ({site!r})"
+            for attr, site in sorted(fault_site_registry().items())
+            if f'"{site}"' not in corpus and f"'{site}'" not in corpus
+            and attr not in corpus
+        ]
+        assert not missing, (
+            "fault sites registered but never injected by any test: "
+            + ", ".join(missing)
+        )
 
 
 class TestJaxOffThreadRule:

@@ -173,3 +173,65 @@ class TestExportKnobs:
             plan.bucket_for(0)
         with pytest.raises(ValueError):
             plan.bucket_for(33)
+
+
+class TestPlanFingerprint:
+    def test_distinct_weights_distinct_fingerprints(self):
+        f1, _ = fit_tiny_mnist(seed=0)
+        f2, _ = fit_tiny_mnist(seed=1)
+        example = np.zeros(TINY_D_IN, np.float32)
+        p1 = export_plan(f1, example, max_batch=8, precompile=False)
+        p2 = export_plan(f2, example, max_batch=8, precompile=False)
+        assert p1.fingerprint != p2.fingerprint
+        # Same fitted state => same identity (stable across exports).
+        p1b = export_plan(f1, example, max_batch=8, precompile=False)
+        assert p1b.fingerprint == p1.fingerprint
+
+    def test_bucket_ladder_is_part_of_the_identity(self):
+        """Review regression: buckets are part of the served bits — an
+        explicit bucket-1 export serves singletons through XLA's batch-1
+        codepath (a ulp off every other batch size, the PR 4 finding),
+        so it must NOT share a fingerprint with the default-bucket
+        export of the same weights."""
+        f1, _ = fit_tiny_mnist(seed=0)
+        example = np.zeros(TINY_D_IN, np.float32)
+        default = export_plan(f1, example, max_batch=8, precompile=False)
+        singleton = export_plan(f1, example, max_batch=8,
+                                buckets=[1, 2, 4, 8], precompile=False)
+        assert default.fingerprint != singleton.fingerprint
+
+    def test_dict_valued_operator_state_reaches_fingerprint(self):
+        """Review regression: fingerprint_token degrades a dict to its
+        bare type name, so container-valued operator state (vocabulary
+        maps, feature spaces) must be recursed into by plan_fingerprint
+        itself — two plans differing ONLY in a dict attribute sharing a
+        fingerprint would void the per-fingerprint bit-identity
+        contract."""
+
+        class VocabScale(Transformer):
+            def __init__(self, vocab):
+                self.vocab = vocab  # dict state, no arrays
+
+            def apply(self, x):
+                return jnp.asarray(x) * float(len(self.vocab))
+
+            def device_fn(self):
+                scale = float(len(self.vocab))
+                return lambda X: X * scale
+
+        example = np.zeros(4, np.float32)
+
+        def fp(vocab):
+            fitted = fitted_from_transformer(VocabScale(vocab))
+            return export_plan(
+                fitted, example, max_batch=4, precompile=False
+            ).fingerprint
+
+        base = {"a": 0, "b": 1}
+        assert fp(base) != fp({"a": 0, "c": 1})
+        assert fp(base) != fp({"a": 0, "b": 1, "c": 2})
+        # Iteration order must NOT matter — only contents.
+        assert fp(base) == fp({"b": 1, "a": 0})
+        # Nested containers and sets recurse too.
+        assert fp({"a": {"x", "y"}}) != fp({"a": {"x", "z"}})
+        assert fp({"a": [1, {"k": 2}]}) != fp({"a": [1, {"k": 3}]})

@@ -1,0 +1,362 @@
+"""Replicated serving plane (ISSUE 7 tentpole): one front door over N
+micro-batch replicas — least-loaded routing with failover, per-replica
+breaker rotation (open = out, half-open probe = back in), fingerprint
+attribution on every response, zero-drop atomic hot-swap, and aggregate
+stats. The injected-fault forms (replica kill, spawn-budget eviction,
+storms) live in tests/test_chaos_replicas.py."""
+
+import threading
+import time
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.serving import (
+    ReplicatedServer,
+    ServerClosed,
+    ServerDegraded,
+    ServerOverloaded,
+    export_plan,
+)
+from keystone_tpu.workflow import Transformer
+
+from tests._serving_util import (
+    TINY_D_IN,
+    fit_tiny_mnist,
+    fitted_from_transformer,
+)
+
+
+class GatedScale(Transformer):
+    """Device-less x -> 3x with an Event gate (deterministic control of
+    when a replica's worker is busy) and a failure arm (deterministic
+    control of WHICH replica's plan fails — the per-replica breaker
+    tests need exactly one bad replica, which a global fault site can't
+    target)."""
+
+    def __init__(self):
+        self.gate = threading.Event()
+        self.gate.set()
+        self.arm = False
+        self.batches = 0
+
+    def apply(self, x):
+        return jnp.asarray(x) * 3.0
+
+    def batch_apply(self, ds):
+        self.gate.wait(timeout=10.0)
+        if self.arm:
+            raise ValueError("replica plan down")
+        self.batches += 1
+        return Dataset(jnp.asarray(ds.array) * 3.0, n=ds.n)
+
+
+def _gated_plans(n):
+    ops = [GatedScale() for _ in range(n)]
+    plans = [
+        export_plan(fitted_from_transformer(op), np.zeros(4, np.float32),
+                    max_batch=8)
+        for op in ops
+    ]
+    return ops, plans
+
+
+class TestRouting:
+    def test_bit_identity_and_attribution_across_replicas(self):
+        """Served outputs across whatever replicas the router picked are
+        bit-identical to offline apply, and every future names exactly
+        one replica and one plan fingerprint."""
+        fitted, _ = fit_tiny_mnist()
+        plan = export_plan(fitted, np.zeros(TINY_D_IN, np.float32),
+                           max_batch=8)
+        rng = np.random.default_rng(3)
+        X = rng.normal(size=(41, TINY_D_IN)).astype(np.float32)
+        offline = np.asarray(fitted.apply(Dataset.of(jnp.asarray(X))).array)
+        with ReplicatedServer(plan, num_replicas=3,
+                              max_wait_ms=1.0) as srv:
+            futs = [srv.submit(X[i]) for i in range(len(X))]
+            served = np.stack([f.result(timeout=30) for f in futs])
+            used = {f.replica_index for f in futs}
+            fps = {f.plan_fingerprint for f in futs}
+            stats = srv.stats()
+        np.testing.assert_array_equal(served, offline)
+        assert len(used) >= 2, "the router never spread load"
+        assert fps == {plan.fingerprint}
+        assert stats["completed"] == len(X)
+        assert stats["healthy_replicas"] == 3
+        assert not stats["degraded"]
+
+    def test_least_loaded_prefers_idle_replica(self):
+        ops, plans = _gated_plans(2)
+        srv = ReplicatedServer(plans, max_wait_ms=0.0)
+        try:
+            ops[0].gate.clear()  # replica 0's worker will block
+            first = srv.submit(np.ones(4, np.float32))
+            time.sleep(0.05)  # replica 0 now busy with it
+            # With replica 0 loaded (1 outstanding), each new request —
+            # submitted against an otherwise-idle plane — routes to the
+            # strictly less-loaded replica 1.
+            futs = []
+            for _ in range(4):
+                f = srv.submit(np.ones(4, np.float32))
+                f.result(timeout=10)
+                futs.append(f)
+            assert {f.replica_index for f in futs} == {1}
+            ops[0].gate.set()
+            first.result(timeout=10)
+        finally:
+            srv.close()
+
+    def test_failover_on_overload_then_aggregate_reject(self):
+        """A full replica fails over to the others; only when EVERY
+        in-rotation replica sheds does the submitter see
+        ServerOverloaded — and it is counted, never silent."""
+        ops, plans = _gated_plans(2)
+        srv = ReplicatedServer(plans, max_wait_ms=0.0, max_queue_depth=1)
+        futs = []
+        try:
+            for op in ops:
+                op.gate.clear()
+            # One in-flight batch per worker first (the sleep keeps the
+            # queue-fillers below out of these batches)...
+            for _ in range(2):
+                futs.append(srv.submit(np.ones(4, np.float32)))
+            time.sleep(0.05)
+            # ...then one queued request per replica (depth 1 each).
+            for _ in range(2):
+                futs.append(srv.submit(np.ones(4, np.float32)))
+            time.sleep(0.05)
+            # Every replica is now full: submits with a LOOSER shed key
+            # than the queued requests must aggregate-reject.
+            with pytest.raises(ServerOverloaded, match="every in-rotation"):
+                srv.submit(np.ones(4, np.float32), deadline_ms=0.1)
+            assert srv.stats()["rejected"] >= 1
+        finally:
+            for op in ops:
+                op.gate.set()
+            for f in futs:
+                try:
+                    f.result(timeout=10)
+                except ServerOverloaded:
+                    pass
+            srv.close()
+
+    def test_open_breaker_leaves_rotation_probe_readmits(self):
+        """Replica 0's plan fails until its breaker opens — traffic
+        keeps flowing through replica 1 with NO submitter-visible
+        errors. After the cooldown, the router hands replica 0 the next
+        request as its half-open probe; success re-closes the breaker
+        and re-admits it."""
+        ops, plans = _gated_plans(2)
+        srv = ReplicatedServer(
+            plans, max_wait_ms=0.0, breaker_threshold=2, breaker_reset_s=0.2,
+        )
+        try:
+            ops[0].arm = True
+            # Drive failures into replica 0: it is least-loaded while
+            # failing (failed batches clear instantly), so it keeps
+            # attracting traffic until the breaker opens.
+            failures = 0
+            deadline = time.perf_counter() + 10.0
+            while time.perf_counter() < deadline:
+                try:
+                    srv.submit(np.ones(4, np.float32)).result(timeout=10)
+                except ValueError:
+                    failures += 1
+                state = srv.stats()["per_replica"][0]["breaker_state"]
+                if state in ("open", "half_open"):
+                    break
+            assert failures >= 2
+            # OPEN: out of rotation — every request lands on replica 1.
+            futs = [srv.submit(np.ones(4, np.float32)) for _ in range(6)]
+            for f in futs:
+                f.result(timeout=10)
+            assert {f.replica_index for f in futs} == {1}
+            # Heal the plan, let the cooldown elapse: the NEXT request
+            # becomes replica 0's probe and re-closes its breaker.
+            ops[0].arm = False
+            time.sleep(0.25)
+            probe = srv.submit(np.ones(4, np.float32))
+            np.testing.assert_array_equal(
+                np.asarray(probe.result(timeout=10)), np.ones(4) * 3.0
+            )
+            assert probe.replica_index == 0
+            assert srv.stats()["per_replica"][0]["breaker_state"] == "closed"
+        finally:
+            srv.close()
+
+    def test_all_replicas_down_raises_degraded(self):
+        ops, plans = _gated_plans(2)
+        srv = ReplicatedServer(
+            plans, max_wait_ms=0.0, breaker_threshold=1, breaker_reset_s=60.0,
+        )
+        try:
+            for op in ops:
+                op.arm = True
+            deadline = time.perf_counter() + 10.0
+            while time.perf_counter() < deadline:
+                try:
+                    srv.submit(np.ones(4, np.float32)).result(timeout=10)
+                except ValueError:
+                    pass
+                except ServerDegraded:
+                    break
+                states = {
+                    i: s["breaker_state"]
+                    for i, s in srv.stats()["per_replica"].items()
+                }
+                if all(v == "open" for v in states.values()):
+                    break
+            with pytest.raises(ServerDegraded, match="no replica available"):
+                srv.submit(np.ones(4, np.float32))
+            assert srv.stats()["degraded_rejected"] >= 1
+        finally:
+            srv.close()
+
+
+class TestHotSwap:
+    def test_swap_changes_fingerprint_and_outputs(self):
+        fitted1, X = fit_tiny_mnist(seed=0)
+        fitted2, _ = fit_tiny_mnist(seed=42)
+        plan1 = export_plan(fitted1, np.zeros(TINY_D_IN, np.float32),
+                            max_batch=8)
+        with ReplicatedServer(plan1, num_replicas=2,
+                              max_wait_ms=0.0) as srv:
+            f_old = srv.submit(X[0])
+            old_out = np.asarray(f_old.result(timeout=30))
+            report = srv.swap_plan(fitted2)  # FittedPipeline form
+            assert all(r["swapped"] for r in report["replicas"])
+            assert all(
+                r["old_fingerprint"] != r["new_fingerprint"]
+                for r in report["replicas"]
+            )
+            f_new = srv.submit(X[0])
+            new_out = np.asarray(f_new.result(timeout=30))
+            assert f_new.plan_fingerprint != f_old.plan_fingerprint
+            # New plan genuinely serving: matches fitted2's offline
+            # apply bit for bit (and differs from the old model).
+            offline2 = np.asarray(
+                fitted2.apply(Dataset.of(jnp.asarray(X[:1]))).array
+            )[0]
+            np.testing.assert_array_equal(new_out, offline2)
+            assert not np.array_equal(new_out, old_out)
+            assert srv.stats()["swaps_completed"] == 1
+
+    def test_swap_drains_inflight_work_first(self):
+        """A request already admitted to a replica completes under the
+        OLD plan before the swap closes it — queued work is never
+        failed by a swap."""
+        ops, plans = _gated_plans(2)
+        new_ops, new_plans = _gated_plans(2)
+        srv = ReplicatedServer(plans, max_wait_ms=0.0, drain_timeout_s=10.0)
+        try:
+            ops[0].gate.clear()
+            stuck = srv.submit(np.ones(4, np.float32))
+            time.sleep(0.05)  # replica 0's worker is mid-batch
+            done = threading.Event()
+
+            def _swap():
+                srv.swap_plan(new_plans)
+                done.set()
+
+            t = threading.Thread(target=_swap)
+            t.start()
+            try:
+                time.sleep(0.1)
+                # Swap is blocked draining replica 0; the old request
+                # has NOT been failed.
+                assert not stuck.done()
+                ops[0].gate.set()
+                np.testing.assert_array_equal(
+                    np.asarray(stuck.result(timeout=10)), np.ones(4) * 3.0
+                )
+                assert done.wait(timeout=10)
+            finally:
+                t.join(timeout=10)
+            # Post-swap traffic runs the new plans.
+            out = srv.submit(np.ones(4, np.float32))
+            out.result(timeout=10)
+            assert out.plan_fingerprint in {p.fingerprint for p in new_plans}
+        finally:
+            for op in ops + new_ops:
+                op.gate.set()
+            srv.close()
+
+    def test_swap_rejects_signature_mismatch(self):
+        fitted, _ = fit_tiny_mnist()
+        plan = export_plan(fitted, np.zeros(TINY_D_IN, np.float32),
+                           max_batch=8)
+        _, other_plans = _gated_plans(1)  # 4-dim signature, not TINY_D_IN
+        with ReplicatedServer(plan, num_replicas=2,
+                              max_wait_ms=0.0) as srv:
+            with pytest.raises(ValueError, match="signature"):
+                srv.swap_plan(other_plans[0])
+
+    def test_swap_wrong_plan_count_and_type_rejected(self):
+        ops, plans = _gated_plans(2)
+        with ReplicatedServer(plans, max_wait_ms=0.0) as srv:
+            with pytest.raises(ValueError, match="2 replicas"):
+                srv.swap_plan(plans[:1])
+            with pytest.raises(TypeError, match="swap_plan takes"):
+                srv.swap_plan(object())
+
+
+class TestLifecycle:
+    def test_submit_after_close_raises_and_close_is_idempotent(self):
+        _, plans = _gated_plans(2)
+        srv = ReplicatedServer(plans, max_wait_ms=0.0)
+        srv.close()
+        srv.close()
+        with pytest.raises(ServerClosed):
+            srv.submit(np.zeros(4, np.float32))
+        assert not any(
+            t.name == "keystone-serving-replica-watchdog"
+            for t in threading.enumerate()
+        )
+        assert not any(
+            t.name == "keystone-serving-batcher" for t in threading.enumerate()
+        )
+
+    def test_constructor_validation(self):
+        _, plans = _gated_plans(1)
+        with pytest.raises(ValueError, match="num_replicas"):
+            ReplicatedServer(plans[0], num_replicas=0)
+        with pytest.raises(ValueError, match="restart_budget"):
+            ReplicatedServer(plans[0], num_replicas=1, restart_budget=-1)
+        with pytest.raises(ValueError, match="empty"):
+            ReplicatedServer([])
+        _, mismatched = _gated_plans(1)
+        fitted, _ = fit_tiny_mnist()
+        other = export_plan(fitted, np.zeros(TINY_D_IN, np.float32),
+                            max_batch=8)
+        with pytest.raises(ValueError, match="signature"):
+            ReplicatedServer([mismatched[0], other])
+        # Regression: the failed construction must CLOSE the replica
+        # servers it had already started — a half-built plane must not
+        # leak worker threads.
+        time.sleep(0.05)
+        assert not any(
+            t.name == "keystone-serving-batcher" for t in threading.enumerate()
+        )
+
+    def test_stats_aggregation_shape(self):
+        _, plans = _gated_plans(2)
+        with ReplicatedServer(plans, max_wait_ms=0.0) as srv:
+            futs = [srv.submit(np.ones(4, np.float32)) for _ in range(6)]
+            for f in futs:
+                f.result(timeout=10)
+            stats = srv.stats()
+        assert stats["completed"] == 6
+        assert stats["p99_latency_s"] >= stats["p50_latency_s"] > 0.0
+        assert set(stats["per_replica"]) == {0, 1}
+        for s in stats["per_replica"].values():
+            assert "p99_queue_wait_s" in s and "p99_exec_s" in s
+            assert s["in_rotation"] and not s["evicted"]
+            assert s["plan_fingerprint"]
+        # Span attribution: every span tagged with a real replica index.
+        assert set(stats["span_summary_by_replica"]) <= {0, 1}
+        assert sum(
+            v["num_spans"] for v in stats["span_summary_by_replica"].values()
+        ) == 6
