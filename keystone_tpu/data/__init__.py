@@ -4,6 +4,8 @@ checksummed, atomically written, and retry-wrapped (docs/reliability.md)."""
 
 from .dataset import Dataset, LabeledData, one_hot_pm1
 from .durable import CheckpointSpec, ShardCorrupted
+from .resident import CompressedCOOChunks
+from .runtime import DataPlaneRuntime, default_runtime
 from .prefetch import (
     COOShardSource,
     DenseShardSource,
@@ -19,7 +21,10 @@ from .shards import DiskCOOShards, DiskDenseShards, DiskDenseShardWriter
 
 __all__ = [
     "CheckpointSpec",
+    "CompressedCOOChunks",
+    "DataPlaneRuntime",
     "Dataset",
+    "default_runtime",
     "LabeledData",
     "ShardCorrupted",
     "one_hot_pm1",

@@ -30,10 +30,14 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import time
 import zlib
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from keystone_tpu.utils import faults
 
 __all__ = [
     "CheckpointSpec",
@@ -185,15 +189,116 @@ class CheckpointSpec:
     are fingerprinted by shape/dtype only: digesting gigabytes of live
     arrays per snapshot would dwarf the snapshot itself; disk sources
     are covered through their recorded per-tile checksums.)
+
+    **Write-behind (ISSUE 8):** snapshot writes go through the
+    data-plane runtime's ``checkpoint`` lane
+    (:mod:`keystone_tpu.data.runtime`) by default, so
+    :meth:`maybe_save` blocks the fold only for the device→host carry
+    transfer plus queue-submit time — the fsync of a ~1.2 GB carry at
+    Amazon geometry no longer stalls the fold loop. Durability is
+    unchanged: :meth:`save` is atomic and versioned either way, so a
+    kill DURING an in-flight async write leaves the previous complete
+    snapshot resumable (tests/test_chaos.py). Ordering is structural
+    (the lane is FIFO), every read-side entry point (:meth:`load` /
+    :meth:`restore` / :meth:`has_snapshot` / :meth:`clear`) flushes
+    pending writes first, and an async write failure surfaces LOUDLY at
+    the next :meth:`maybe_save` or :meth:`flush` — a fit never
+    completes thinking it was insured when it was not. ``runtime=False``
+    (or ``KEYSTONE_CHECKPOINT_SYNC=1``) restores synchronous writes.
     """
 
-    def __init__(self, directory: str, every_segments: int = 8):
+    def __init__(self, directory: str, every_segments: int = 8,
+                 runtime=None):
         if every_segments < 1:
             raise ValueError(
                 f"every_segments must be >= 1, got {every_segments}"
             )
         self.directory = str(directory)
         self.every_segments = int(every_segments)
+        # None -> the shared data-plane runtime (write-behind, the
+        # default); False -> synchronous writes; or an explicit
+        # DataPlaneRuntime.
+        self._runtime = runtime
+        self._pending: List[Any] = []  # outstanding write futures (FIFO)
+
+    def _rt(self):
+        if self._runtime is False:
+            return None
+        if os.environ.get("KEYSTONE_CHECKPOINT_SYNC", "").strip() in (
+            "1", "true", "on"
+        ):
+            return None
+        if self._runtime is None:
+            from keystone_tpu.data.runtime import default_runtime
+
+            return default_runtime()
+        return self._runtime
+
+    # -- write-behind plumbing --------------------------------------------
+
+    def flush(self, timeout: float = 120.0,
+              raise_errors: bool = True) -> None:
+        """Wait for every pending snapshot write and re-raise the first
+        failure — the loud-surface point of the write-behind contract.
+        Every read-side entry point calls this first, so observers never
+        race an in-flight write in the same process. ``raise_errors=
+        False`` (the post-completion :meth:`clear` path, where the
+        snapshot is about to be deleted anyway) demotes failures to a
+        warning instead of destroying a fit that already finished."""
+        futs, self._pending = self._pending, []
+        first: Optional[BaseException] = None
+        for i, fut in enumerate(futs):
+            try:
+                fut.result(timeout=timeout)
+            except FutureTimeoutError as e:
+                # The write is STILL RUNNING — dropping its future here
+                # would let a later clear() delete the fit dir and the
+                # stalled write resurrect a stale snapshot afterwards.
+                # Keep it (and everything behind it on the FIFO lane)
+                # pending and fail loudly regardless of raise_errors:
+                # "flushed" must mean "no write in flight".
+                self._pending = futs[i:] + self._pending
+                if first is not None:
+                    # An earlier write already FAILED and was consumed
+                    # from pending above; swallowing it under the
+                    # timeout would let a later flush succeed and the
+                    # fit complete uninsured. The failure outranks the
+                    # still-running write.
+                    raise first from e
+                raise
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                if first is None:
+                    first = e
+        if first is not None:
+            if raise_errors:
+                raise first
+            import logging
+
+            logging.getLogger("keystone_tpu.durable").warning(
+                "async checkpoint write failed (fit already complete; "
+                "snapshot being cleared): %s", first,
+            )
+
+    def _surface_pending_failure(self) -> None:
+        """Raise a COMPLETED pending write's failure without blocking on
+        ones still in flight (the per-maybe_save check: a dead
+        checkpoint disk fails the fit at the next snapshot boundary,
+        not at the end). Unfinished futures are retained — their
+        outcome surfaces at the next boundary or at flush. A surfaced
+        failure is CONSUMED (raised once, here) — re-raising the same
+        dead write at every later flush would mask the recovery path."""
+        still = []
+        first: Optional[BaseException] = None
+        for fut in self._pending:
+            if not fut.done():
+                still.append(fut)
+                continue
+            exc = fut.exception()
+            if exc is not None and first is None:
+                first = exc
+        self._pending = still
+        if first is not None:
+            raise first
 
     def _fit_dir(self, fingerprint: Dict[str, Any]) -> str:
         """The fingerprint-digest subdirectory this fit's snapshot lives
@@ -218,6 +323,9 @@ class CheckpointSpec:
         bytes) leaves either the previous complete checkpoint or the
         new one, never a meta describing the wrong data. Superseded
         data files are deleted only after the new meta is durable."""
+        # The chaos hook: fires once per snapshot write attempt — on the
+        # write-behind worker for async specs, inline for sync ones.
+        faults.maybe_fail(faults.SITE_CHECKPOINT_WRITE)
         fit_dir = self._fit_dir(fingerprint)
         os.makedirs(fit_dir, exist_ok=True)
         arrays = [np.asarray(a) for a in arrays]
@@ -292,6 +400,7 @@ class CheckpointSpec:
         digest collision — still checked). Corrupt data raises
         :class:`ShardCorrupted` — a bad checkpoint must never silently
         seed a fresh-looking fit."""
+        self.flush()
         fit_dir = self._fit_dir(fingerprint)
         meta_path = os.path.join(fit_dir, _CKPT_META)
         if not os.path.exists(meta_path):
@@ -336,26 +445,82 @@ class CheckpointSpec:
         segment: int,
         num_segments: int,
         fingerprint: Dict[str, Any],
+        stats=None,
     ) -> bool:
         """Shared snapshot cadence of the streamed solvers: after
         ``segment``, snapshot when the every-K boundary hits and it is
         not the final segment (a completed fit clears instead of
         snapshotting). ``np.asarray`` here is the device sync — the
         snapshot captures exactly the post-segment carry a resumed run
-        restores. Returns whether a snapshot was written."""
+        restores, and it MUST run on the calling (JAX-owner) thread:
+        the next fold donates these buffers. The disk write itself is
+        write-behind (class docstring) — the fold blocks for
+        sync + queue-submit only. Returns whether a snapshot was
+        written (submitted, for async specs).
+
+        ``stats``: optional :class:`~keystone_tpu.data.prefetch.
+        PrefetchStats`-like sink — the write's wall lands in
+        ``site_busy_s["checkpoint"]`` (worker-side for async specs) and
+        the fold-blocking share in ``site_wait_s["checkpoint"]``, so
+        the <5% recovery-overhead claim is auditable per site."""
         if (
             (segment + 1) % self.every_segments != 0
             or (segment + 1) >= num_segments
         ):
             return False
-        self.save([np.asarray(a) for a in arrays], segment + 1, fingerprint)
+        t0 = time.perf_counter()
+        host = [np.asarray(a) for a in arrays]
+        rt = self._rt()
+        if rt is None:
+            self.save(host, segment + 1, fingerprint)
+            dt = time.perf_counter() - t0
+            if stats is not None and hasattr(stats, "add_busy"):
+                stats.add_busy("checkpoint", dt)
+                stats.add_wait("checkpoint", dt)  # inline = fully waited
+            return True
+        # np.asarray of a device array can be a ZERO-COPY view of the
+        # device buffer (CPU backend), and the fold programs donate the
+        # carry — by the time the checkpoint worker serializes, XLA may
+        # have reused the memory, producing a self-consistent (checksummed
+        # at write time!) but WRONG snapshot. The async path must own its
+        # bytes before the fold is allowed to continue — but only copy
+        # when it doesn't already: a TPU-backend asarray is an owning
+        # device-to-host transfer, and doubling a ~GB carry copy in the
+        # fold-blocking window is exactly what write-behind exists to
+        # avoid. (`h is a` catches raw numpy input, where asarray
+        # returns the caller's own — mutable — array.)
+        host = [
+            h if (h is not a and h.flags.owndata)
+            else np.array(h, copy=True)
+            for h, a in zip(host, arrays)
+        ]
+        # A previously-submitted write that already failed must stop the
+        # fit HERE — snapshotting onto a dead disk forever, silently,
+        # is the one thing the insurance layer must never do.
+        self._surface_pending_failure()
+        self._pending.append(rt.submit(
+            "checkpoint", self._write_snapshot,
+            host, segment + 1, fingerprint, stats,
+        ))
+        if stats is not None and hasattr(stats, "add_wait"):
+            stats.add_wait("checkpoint", time.perf_counter() - t0)
         return True
+
+    def _write_snapshot(self, host_arrays, cursor, fingerprint, stats):
+        """The write-behind task body (runs on the runtime's
+        ``checkpoint`` worker): pure host IO — the arrays were already
+        device-synced by maybe_save on the owner thread."""
+        t0 = time.perf_counter()
+        self.save(host_arrays, cursor, fingerprint)
+        if stats is not None and hasattr(stats, "add_busy"):
+            stats.add_busy("checkpoint", time.perf_counter() - t0)
 
     def has_snapshot(
         self, fingerprint: Optional[Dict[str, Any]] = None
     ) -> bool:
         """Whether a snapshot exists — for ``fingerprint``'s fit, or for
         ANY fit in the directory when None (the drill/test probe)."""
+        self.flush()
         if fingerprint is not None:
             return os.path.exists(
                 os.path.join(self._fit_dir(fingerprint), _CKPT_META)
@@ -373,7 +538,10 @@ class CheckpointSpec:
         """Remove ``fingerprint``'s snapshot (called after a successful
         fit so a later fit with the same fingerprint starts fresh) —
         ONLY that fit's: other fits sharing the directory keep theirs.
-        With no fingerprint, every fit's snapshot is removed."""
+        With no fingerprint, every fit's snapshot is removed. Pending
+        write-behind snapshots are flushed first — a queued write must
+        not resurrect a snapshot after the clear."""
+        self.flush(raise_errors=False)
         if fingerprint is not None:
             dirs = [self._fit_dir(fingerprint)]
         else:

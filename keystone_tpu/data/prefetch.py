@@ -10,14 +10,18 @@ path here:
     and the memory-mapped :class:`~keystone_tpu.data.shards.DiskCOOShards`
     / :class:`~keystone_tpu.data.shards.DiskDenseShards` files: ordered
     segments of READY host buffers, delivered one at a time.
-  - :class:`Prefetcher` — a background reader thread that loads segment
-    k+1 (disk read + mmap-page copy into a contiguous host staging
-    buffer) while the consumer's ``jax.device_put`` + device fold for
-    segment k are in flight. Double-buffered with bounded depth and
-    backpressure: the reader owns its own queue (the graph executor is
-    documented non-thread-safe, so NOTHING JAX-side runs on the reader
-    thread — it hands finished numpy buffers across, and the consumer
-    thread does every device interaction).
+  - :class:`Prefetcher` — loads segment k+1 (disk read + mmap-page copy
+    into a contiguous host staging buffer) on the data-plane runtime's
+    ``read`` lane (:mod:`keystone_tpu.data.runtime`) while the
+    consumer's ``jax.device_put`` + device fold for segment k are in
+    flight. Double-buffered with bounded depth and backpressure: at most
+    ``depth`` load tasks are outstanding at once, and the runtime lane's
+    single worker guarantees they complete in submission order. The
+    graph executor is documented non-thread-safe, so NOTHING JAX-side
+    runs on the IO workers — they hand finished numpy buffers back
+    through futures, and the consumer thread does every device
+    interaction (the jax-off-thread lint rule walks every submitted
+    callable).
 
 The producer/consumer overlap is the same discipline as tf.data-style
 input pipelines and the async-dispatch throttling the streamed folds
@@ -28,13 +32,14 @@ of segment k+1 hides behind the fold of segment k.
 
 from __future__ import annotations
 
-import queue
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu.data import runtime as runtime_mod
 from keystone_tpu.utils import faults
 
 
@@ -385,7 +390,17 @@ class PrefetchStats:
     ``utils.profiling.prefetch_retry_counters``): ``retries`` counts
     transient read failures the reader recovered from, ``backoff_s``
     sums the backoff it slept — nonzero values mean the fit SUCCEEDED
-    over flaky IO and say how much wall that cost."""
+    over flaky IO and say how much wall that cost.
+
+    Per-SITE accounting (``site_busy_s`` / ``site_wait_s``, surfaced
+    through ``utils.profiling.overlap_report``): busy seconds a named
+    phase spent working (``read`` on an IO worker, ``verify`` inside the
+    shard checksum pass, ``checkpoint`` on the write-behind worker,
+    ``compute`` on the consumer's fold dispatch) and the seconds the
+    CONSUMER was blocked waiting on that phase — the per-site form of
+    the load/wait pair, so the 131.4 s fold-floor claim is auditable
+    phase by phase. Thread-safe: IO workers and the consumer thread
+    both report."""
 
     def __init__(self):
         self.load_s = 0.0
@@ -394,25 +409,44 @@ class PrefetchStats:
         self.prefetched = False
         self.retries = 0
         self.backoff_s = 0.0
+        self.site_busy_s: dict = {}
+        self.site_wait_s: dict = {}
+        self._site_lock = threading.Lock()
+
+    def add_busy(self, site: str, seconds: float) -> None:
+        with self._site_lock:
+            self.site_busy_s[site] = (
+                self.site_busy_s.get(site, 0.0) + float(seconds)
+            )
+
+    def add_wait(self, site: str, seconds: float) -> None:
+        with self._site_lock:
+            self.site_wait_s[site] = (
+                self.site_wait_s.get(site, 0.0) + float(seconds)
+            )
 
 
-class _ReaderDone:
-    pass
+class _Cancelled:
+    """Sentinel a load task returns when close() raced its start."""
 
 
 class Prefetcher:
     """Double-buffered background segment reader with bounded depth.
 
-    Iterating yields ``(s, payload)`` in strict segment order. The reader
-    thread runs ``source.load`` only (numpy/disk — never JAX) and blocks
-    once ``depth`` loaded segments sit unconsumed (backpressure: host
-    staging memory is bounded by depth × segment size). Clean shutdown is
-    part of the contract: closing (or breaking out of / raising inside
-    the consuming loop, via the context manager or generator finalizer)
-    stops the reader before it loads further segments. Reader exceptions
-    re-raise in the consumer at the segment that failed.
+    Iterating yields ``(s, payload)`` in strict segment order. Loads run
+    as tasks on the data-plane runtime's ``read`` lane
+    (:mod:`keystone_tpu.data.runtime` — one pooled worker per lane,
+    ``source.load`` touches numpy/disk, never JAX); at most ``depth``
+    load tasks are outstanding at once (backpressure: host staging
+    memory is bounded by depth × segment size), and the lane's FIFO
+    makes segment order structural. Clean shutdown is part of the
+    contract: closing (or breaking out of / raising inside the
+    consuming loop, via the context manager or generator finalizer)
+    cancels every queued load and waits out the in-flight one — no
+    task of this pass survives close(). Load exceptions re-raise in
+    the consumer at the segment that failed.
 
-    Transient read failures (``OSError``) retry on the reader thread
+    Transient read failures (``OSError``) retry on the IO worker
     with bounded exponential backoff (``retry_policy``, default
     :func:`keystone_tpu.utils.faults.default_retry_policy`): a single
     flaky IO no longer kills an hours-long fit. Exhaustion re-raises
@@ -424,19 +458,44 @@ class Prefetcher:
 
     def __init__(self, source: ShardSource, depth: int = 2,
                  stats: Optional[PrefetchStats] = None,
-                 retry_policy=None):
+                 retry_policy=None, runtime=None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.source = source
         self.depth = int(depth)
         self.stats = stats if stats is not None else PrefetchStats()
         self.retry_policy = retry_policy or faults.default_retry_policy()
-        self._queue: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        # None -> the process-wide shared runtime, resolved at iteration
+        # time (a test may close/replace the default between passes).
+        self.runtime = runtime
+        self._pending: "deque" = deque()  # outstanding load futures
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
         self._started = False
 
-    # -- reader side -------------------------------------------------------
+    # -- reader side (runs on the runtime's `read` worker) -----------------
+
+    def _load_segment(self, s: int):
+        """One load task: retry-wrapped ``source.load`` with busy/retry
+        accounting into this pass's stats. Host-only work — the
+        jax-off-thread lint rule walks this function as the submitted
+        target."""
+        if self._stop.is_set():
+            return _Cancelled()
+        try:
+            with faults.observing_retries(self.stats):
+                t0 = time.perf_counter()
+                payload = self._load_with_retry(s)
+        except BaseException:
+            # A load that exhausted its retries kills the PASS: queued
+            # sibling tasks short-circuit instead of burning their own
+            # retry budgets against the same dead disk (the failure cost
+            # stays one bounded retry cycle, as with the serial reader).
+            self._stop.set()
+            raise
+        dt = time.perf_counter() - t0
+        self.stats.load_s += dt
+        self.stats.add_busy("read", dt)
+        return payload
 
     def _load_with_retry(self, s: int):
         def on_retry(_attempt, delay_s, _exc):
@@ -462,64 +521,46 @@ class Prefetcher:
             attempt, key=f"prefetch:{s}", on_retry=on_retry
         )
 
-    def _reader(self):
-        try:
-            # Lower layers' retries (the shard classes' RetryPolicy)
-            # report into THIS fit's stats for the thread's lifetime.
-            with faults.observing_retries(self.stats):
-                for s in range(self.source.num_segments):
-                    if self._stop.is_set():
-                        return
-                    t0 = time.perf_counter()
-                    payload = self._load_with_retry(s)
-                    self.stats.load_s += time.perf_counter() - t0
-                    self._put((s, payload))
-            self._put(_ReaderDone())
-        except BaseException as e:  # noqa: BLE001 — re-raised consumer-side
-            self._put(e)
-
-    def _put(self, item):
-        """Queue.put with shutdown polling — a plain blocking put would
-        deadlock the reader if the consumer died without draining."""
-        while not self._stop.is_set():
-            try:
-                self._queue.put(item, timeout=0.1)
-                return
-            except queue.Full:
-                continue
-
     # -- consumer side -----------------------------------------------------
 
     def __iter__(self) -> Iterator[Tuple[int, Any]]:
-        # Single-use by contract: after close() the stop flag is set and a
-        # fresh reader would exit without ever queueing the done sentinel,
-        # hanging the consumer on get() — fail loud instead.
-        if self._started:
+        # Single-use by contract: after close() the stop flag is set and
+        # a fresh pass would see every task return the cancel sentinel,
+        # silently truncating the stream — fail loud instead (including
+        # close()-before-first-iteration, where _started is still False
+        # but every load would come back cancelled).
+        if self._started or self._stop.is_set():
             raise RuntimeError(
-                "Prefetcher is single-use; create a new one per pass"
+                "Prefetcher is single-use (and unusable once closed); "
+                "create a new one per pass"
             )
         self._started = True
         self.stats.prefetched = True
-        self._thread = threading.Thread(
-            target=self._reader, name="keystone-prefetch", daemon=True
-        )
-        self._thread.start()
-        expected = 0
+        rt = self.runtime or runtime_mod.default_runtime()
+        num = self.source.num_segments
+        next_submit = 0
         try:
-            while True:
-                t0 = time.perf_counter()
-                item = self._queue.get()
-                self.stats.wait_s += time.perf_counter() - t0
-                if isinstance(item, _ReaderDone):
-                    return
-                if isinstance(item, BaseException):
-                    raise item
-                s, payload = item
-                assert s == expected, (
-                    f"prefetch order violated: got segment {s}, "
-                    f"expected {expected}"
+            while next_submit < min(self.depth, num):
+                self._pending.append(
+                    rt.submit(runtime_mod.LANE_READ, self._load_segment,
+                              next_submit)
                 )
-                expected += 1
+                next_submit += 1
+            for s in range(num):
+                fut = self._pending.popleft()
+                t0 = time.perf_counter()
+                payload = fut.result()  # re-raises the load's exception
+                dt = time.perf_counter() - t0
+                self.stats.wait_s += dt
+                self.stats.add_wait("read", dt)
+                if isinstance(payload, _Cancelled):  # close() raced us
+                    return
+                if next_submit < num and not self._stop.is_set():
+                    self._pending.append(
+                        rt.submit(runtime_mod.LANE_READ,
+                                  self._load_segment, next_submit)
+                    )
+                    next_submit += 1
                 self.stats.segments += 1
                 yield s, payload
         finally:
@@ -531,29 +572,29 @@ class Prefetcher:
     def __exit__(self, *exc) -> None:
         self.close()
 
+    @property
+    def staged_count(self) -> int:
+        """Outstanding load tasks (staged or in flight) — zero after
+        close(); the shutdown regression tests' leak probe."""
+        return len(self._pending)
+
     def close(self) -> None:
-        """Stop the reader and join it. Idempotent; called automatically
-        when the consuming loop exits for ANY reason (completion, break,
-        or a consumer-side exception)."""
+        """Stop the pass: cancel every queued load, wait out the (at
+        most one) in-flight load, and release every staged payload.
+        Idempotent; called automatically when the consuming loop exits
+        for ANY reason (completion, break, or a consumer-side
+        exception). The runtime's pooled worker outlives this pass by
+        design — per-pass state does not."""
         self._stop.set()
-        if self._thread is not None:
-            # Drain so a put blocked on a full queue observes the stop.
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
-            self._thread.join(timeout=10.0)
-            self._thread = None
-            # A put already blocked when the stop flag went up may have
-            # landed one more payload AFTER the drain above — release it
-            # too, or its staging buffer lives until the prefetcher is
-            # garbage-collected (found by the depth>1 shutdown test).
-            try:
-                while True:
-                    self._queue.get_nowait()
-            except queue.Empty:
-                pass
+        while self._pending:
+            fut = self._pending.popleft()
+            if not fut.cancel():
+                # Already running (or done): bound the wait by one load;
+                # its error belongs to the pass that died — swallow.
+                try:
+                    fut.result(timeout=30.0)
+                except Exception:
+                    pass
 
 
 def iter_segments(
@@ -603,7 +644,13 @@ def iter_segments(
         if stats is not None:
             with faults.observing_retries(stats):
                 payload = source.load(s)
-            stats.load_s += time.perf_counter() - t0
+            dt = time.perf_counter() - t0
+            stats.load_s += dt
+            # Inline loads are fully waited-on by construction: busy ==
+            # wait, so the per-site report reads 0 overlap — the serial
+            # oracle leg must never look overlapped.
+            stats.add_busy("read", dt)
+            stats.add_wait("read", dt)
             stats.segments += 1
         else:
             payload = source.load(s)

@@ -1,0 +1,213 @@
+"""Compressed-resident COO tier: int16 indices + bf16 values, chunk-tiled
+(ISSUE 8 tentpole — the r05 probe promoted to a real storage class).
+
+The Amazon working set at padded-COO int32+f32 is 8 bytes per stored
+cell — 43 GB at n=65e6, far past one chip's HBM. The r05 bench probe
+showed the same data lives at **4 bytes/cell** (int16 index + bf16
+value) with the decode *fused into the fold*: the gram fold's densify
+step already casts indices to int32 and values to the fold's
+``val_dtype`` inside the compiled program
+(``ops/sparse.py::sparse_gram_fold``), so compressed chunks cost ZERO
+extra passes — the "decompression" is the cast the fold was doing
+anyway. This module makes that encoding a first-class tier:
+
+  - :class:`CompressedCOOChunks` — host-side encode/decode with the
+    overflow boundary enforced (an index that does not fit int16
+    raises; it must never wrap silently) and a stated value-drift
+    policy, plus chunk-tiled device operands in exactly the
+    ``_resident_chunk_fn`` contract of
+    ``ops/learning/lbfgs.py::run_lbfgs_gram_streamed``.
+  - The cost model (``ops/learning/cost.py``) prices this as a third
+    storage class between HBM-raw and disk:
+    :data:`COMPRESSED_BYTES_PER_NNZ` (4.0) vs the raw 8.0, feasible
+    only while :func:`compressible_dim` holds — so ``Pipeline.fit``
+    routes a working set chip-resident whenever the compressed form
+    fits and streams only what truly cannot.
+
+**Value-drift policy** (stated, tested — tests/test_resident.py):
+indices round-trip EXACTLY or :meth:`CompressedCOOChunks.encode`
+raises — index quantization is never lossy. Values quantize f32→bf16
+with round-to-nearest-even: values already bf16-representable (±1
+labels, the intercept's 1.0, anything with ≤8 significant mantissa
+bits) round-trip exactly; general f32 values drift by at most 2⁻⁸
+relative (one bf16 ulp). This is the SAME quantization the
+``gram_dtype="bf16"`` fold applies transiently inside its densify — a
+compressed-resident fit is bit-identical to the bf16-engine streamed
+fit over the same rows, which is how the tier's correctness is pinned.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "COMPRESSED_BYTES_PER_NNZ",
+    "CompressedCOOChunks",
+    "INT16_MAX_INDEX",
+    "compressible_dim",
+]
+
+# int16 index (2 B) + bf16 value (2 B) per stored cell — the storage
+# class cost.py prices between HBM-raw (8 B: int32+f32) and disk.
+COMPRESSED_BYTES_PER_NNZ = 4.0
+# Largest column index an int16 lane can carry. The append-ones
+# intercept column lives at index d, so a d-wide problem with intercept
+# needs d <= INT16_MAX_INDEX.
+INT16_MAX_INDEX = np.iinfo(np.int16).max  # 32767
+
+
+def compressible_dim(d: int) -> bool:
+    """Whether a feature width fits the int16 index encoding (indices
+    0..d-1; callers appending an intercept lane at index d must pass
+    d+1). Past it the compressed tier is infeasible — cost.py prices it
+    at infinity rather than wrapping indices."""
+    return int(d) - 1 <= INT16_MAX_INDEX
+
+
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes  # jax dependency; host-side bfloat16
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+class CompressedCOOChunks:
+    """Padded-COO rows encoded int16+bf16 and tiled into fold chunks.
+
+    ``idx_t (nchunks, chunk_rows, w) int16`` (−1 = inactive lane),
+    ``val_t (nchunks, chunk_rows, w) bf16``,
+    ``y_t (nchunks, chunk_rows, k) f32`` — exactly the operand triple
+    ``ops/learning/lbfgs.py::_resident_chunk_fn`` slices, so a
+    compressed set rides ``run_lbfgs_gram_streamed(operands=
+    chunks.operands(), val_dtype=jnp.bfloat16)`` with no solver
+    changes: the fold's densify casts int16→int32 / upcasts bf16 in
+    the compiled program (the fused decode).
+    """
+
+    def __init__(self, idx_t: np.ndarray, val_t: np.ndarray,
+                 y_t: np.ndarray, n_true: int, d: int):
+        self.idx_t = idx_t
+        self.val_t = val_t
+        self.y_t = y_t
+        self.n_true = int(n_true)
+        self.d = int(d)
+
+    # -- encode ------------------------------------------------------------
+
+    @classmethod
+    def encode(
+        cls,
+        indices,
+        values,
+        labels,
+        chunk_rows: int,
+        d: Optional[int] = None,
+        n_true: Optional[int] = None,
+    ) -> "CompressedCOOChunks":
+        """Encode (n, w) padded-COO rows + (n, k) labels.
+
+        Raises :class:`ValueError` at the int16 overflow boundary (any
+        active index > :data:`INT16_MAX_INDEX`) — the one failure mode
+        that must be impossible to hit silently: a wrapped index would
+        scatter a value into the wrong Gramian row and corrupt the fit
+        without a single NaN. Values quantize f32→bf16 per the module's
+        drift policy. The ragged tail pads with inactive (−1) lanes and
+        zero labels to whole chunks.
+        """
+        indices = np.asarray(indices)
+        values = np.asarray(values)
+        labels = np.asarray(labels)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+        n, w = indices.shape
+        n_true = n if n_true is None else int(n_true)
+        max_idx = int(indices.max()) if indices.size else -1
+        d = max_idx + 1 if d is None else int(d)
+        if max_idx > INT16_MAX_INDEX:
+            raise ValueError(
+                f"index {max_idx} does not fit the int16 encoding (max "
+                f"{INT16_MAX_INDEX}); the compressed-resident tier is "
+                f"infeasible at this width — use the raw int32 tier or "
+                f"the streamed path (a wrapped index would silently "
+                f"corrupt the Gramian)"
+            )
+        if indices.size and int(indices.min()) < -1:
+            raise ValueError(
+                f"index {int(indices.min())} < -1: only -1 marks an "
+                f"inactive lane"
+            )
+        idx16 = indices.astype(np.int16)
+        # The boundary check above makes this structural; assert the
+        # round-trip anyway — index quantization is NEVER allowed loss.
+        assert (idx16.astype(indices.dtype) == indices).all()
+        val_bf = values.astype(_bf16_dtype())
+        c = int(chunk_rows)
+        nchunks = max(-(-n // c), 1)
+        idx_t = np.full((nchunks * c, w), -1, np.int16)
+        idx_t[:n] = idx16
+        val_t = np.zeros((nchunks * c, w), _bf16_dtype())
+        val_t[:n] = val_bf
+        y_t = np.zeros((nchunks * c, labels.shape[1]), np.float32)
+        y_t[:n] = labels.astype(np.float32)
+        return cls(
+            idx_t.reshape(nchunks, c, w),
+            val_t.reshape(nchunks, c, w),
+            y_t.reshape(nchunks, c, labels.shape[1]),
+            n_true=n_true, d=d,
+        )
+
+    # -- decode (the round-trip oracle) ------------------------------------
+
+    def decode(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """Back to (n, w) int32 indices / f32 values / (n, k) labels —
+        what the fold's in-program casts produce, host-side, for the
+        round-trip equality tests (indices exact; values exact iff the
+        input was bf16-representable)."""
+        _, c, w = self.idx_t.shape
+        rows = self.num_chunks * c
+        keep = min(rows, self.n_true) if self.n_true else rows
+        idx = self.idx_t.reshape(-1, w).astype(np.int32)
+        val = self.val_t.reshape(-1, w).astype(np.float32)
+        y = self.y_t.reshape(rows, -1)
+        return idx[:keep], val[:keep], np.asarray(y[:keep], np.float32)
+
+    # -- capacity / device views -------------------------------------------
+
+    @property
+    def num_chunks(self) -> int:
+        return int(self.idx_t.shape[0])
+
+    @property
+    def chunk_rows(self) -> int:
+        return int(self.idx_t.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        """Resident footprint of the compressed operands (indices +
+        values + labels) — what cost.py's capacity cut prices."""
+        return int(self.idx_t.nbytes + self.val_t.nbytes + self.y_t.nbytes)
+
+    @property
+    def bytes_per_nnz(self) -> float:
+        return float(self.idx_t.dtype.itemsize + self.val_t.dtype.itemsize)
+
+    def operands(self):
+        """Device operand triple for ``run_lbfgs_gram_streamed(
+        _resident_chunk_fn, ...)`` — placed as jnp arrays (int16/bf16
+        stay compressed in HBM; the fold's densify is the decode)."""
+        import jax.numpy as jnp
+
+        return (
+            jnp.asarray(self.idx_t),
+            jnp.asarray(self.val_t),
+            jnp.asarray(self.y_t),
+        )
+
+    @staticmethod
+    def value_drift(values) -> float:
+        """Max absolute bf16 quantization error over ``values`` — the
+        drift-policy audit helper (0.0 for bf16-representable input)."""
+        values = np.asarray(values, np.float32)
+        q = values.astype(_bf16_dtype()).astype(np.float32)
+        return float(np.max(np.abs(q - values))) if values.size else 0.0

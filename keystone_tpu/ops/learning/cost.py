@@ -192,7 +192,9 @@ class TransformerLabelEstimatorChain(LabelEstimator):
 class LeastSquaresEstimator(OptimizableLabelEstimator):
     """Auto-selecting least-squares solver (LeastSquaresEstimator.scala:26-87).
 
-    Candidates: DenseLBFGS, Sparsify->SparseLBFGS, Densify->BlockLS(1000, 3),
+    Candidates: DenseLBFGS, Sparsify->SparseLBFGS (gather, gram, and
+    compressed-resident gram — the int16+bf16 4 B/nnz storage class of
+    ``data/resident.py``), Densify->BlockLS(1000, 3),
     Densify->Exact normal equations, the STREAMING tier
     (StreamingLeastSquaresChoice — featurize-inside-the-fit, bound to the
     upstream featurizer by the optimizer's StreamedFitFusionRule), and
@@ -263,6 +265,20 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         sparse_gram = SparseLBFGSwithL2(
             lam=lam, num_iterations=20, solver="gram"
         )
+        # The compressed-resident storage class (data/resident.py,
+        # ISSUE 8): the SAME gram iterates over int16+bf16 operands at
+        # 4 B/nnz — half the raw COO's residency, feasible only while
+        # every index (intercept lane included) fits int16. Priced as a
+        # third tier between HBM-raw and disk: identical cost model
+        # (the fold runs the same bf16 slabs), so selection is driven
+        # by the capacity cut — raw-infeasible, compressed-feasible
+        # working sets stay chip-resident instead of streaming, with no
+        # flag (tests/test_cost_replay.py replays the Amazon n=30e6
+        # geometry).
+        sparse_gram_compressed = SparseLBFGSwithL2(
+            lam=lam, num_iterations=20, solver="gram",
+            compress="int16_bf16",
+        )
         block = BlockLeastSquaresEstimator(block_size, block_iters, lam=lam)
         exact = LinearMapEstimator(lam)
         streaming = StreamingLeastSquaresChoice(
@@ -275,6 +291,11 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             (dense_lbfgs, dense_lbfgs),
             (sparse_lbfgs, TransformerLabelEstimatorChain(Sparsify(), sparse_lbfgs)),
             (sparse_gram, TransformerLabelEstimatorChain(Sparsify(), sparse_gram)),
+            # Listed AFTER the raw gram engine: equal cost when both fit
+            # (argmin takes the first), so compression only engages when
+            # raw residency is the binding constraint.
+            (sparse_gram_compressed,
+             TransformerLabelEstimatorChain(Sparsify(), sparse_gram_compressed)),
             (block, TransformerLabelEstimatorChain(Densify(), block)),
             (exact, TransformerLabelEstimatorChain(Densify(), exact)),
             # The streaming choice is its own graph operator (no Densify

@@ -24,6 +24,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.stats import StandardScaler
@@ -346,6 +347,30 @@ def _resident_chunk_fn(cid, idx_t, val_t, Y_t):
     return idx_t[cid], val_t[cid], Y_t[cid]
 
 
+def _fold_stepper(throttle, prefetch_stats):
+    """One owner for the per-segment fold step's accounting: transfer +
+    fold dispatch + the inflight throttle's blocking, stamped into the
+    ``compute`` site of the per-site overlap report
+    (``utils.profiling.overlap_report``). Both streamed entry points —
+    :func:`run_lbfgs_gram_streamed` and :func:`run_lbfgs_gram_hybrid`
+    (which swaps fold programs between its resident and tail legs) —
+    fold through this, so the timing/throttle wiring cannot diverge."""
+    import time as _time
+
+    def step(fold, carry, cid0, ops):
+        t0 = _time.perf_counter()
+        carry = fold(
+            carry, jnp.asarray(cid0, jnp.int32),
+            tuple(jnp.asarray(o) for o in ops),
+        )
+        throttle.admit(carry[2])
+        if prefetch_stats is not None:
+            prefetch_stats.add_busy("compute", _time.perf_counter() - t0)
+        return carry
+
+    return step
+
+
 def run_lbfgs_gram_streamed(
     chunk_fn,
     num_chunks: int,
@@ -528,18 +553,16 @@ def run_lbfgs_gram_streamed(
     if carry is None:
         carry = sparse_gram_init(d, k, val_dtype)
     throttle = BoundedInflight(inflight)
+    step = _fold_stepper(throttle, prefetch_stats)
 
     def folded(cid0, ops):
         nonlocal carry
-        carry = fold(
-            carry, jnp.asarray(cid0, jnp.int32),
-            tuple(jnp.asarray(o) for o in ops),
-        )
-        throttle.admit(carry[2])
+        carry = step(fold, carry, cid0, ops)
 
     def maybe_snapshot(s):
         if checkpoint is not None:
-            checkpoint.maybe_save(carry, s, num_segs, fingerprint)
+            checkpoint.maybe_save(carry, s, num_segs, fingerprint,
+                                  stats=prefetch_stats)
 
     def finish():
         result = solve(carry)
@@ -566,6 +589,121 @@ def run_lbfgs_gram_streamed(
         folded(cid0, ops)
         maybe_snapshot(s)
     return finish()
+
+
+def run_lbfgs_gram_hybrid(
+    resident_chunk_fn,
+    num_resident_chunks: int,
+    resident_operands,
+    num_chunks: int,
+    d: int,
+    k: int,
+    *,
+    lam: float = 0.0,
+    num_iterations: int = 100,
+    convergence_tol: float = 1e-4,
+    n: Optional[int] = None,
+    use_pallas: bool = False,
+    val_dtype=jnp.float32,
+    max_chunks_per_dispatch: int = 8,
+    chunk_fn=None,
+    segment_source=None,
+    prefetch_depth: int = 2,
+    prefetch_stats=None,
+    pipeline: bool = True,
+    inflight: int = 2,
+):
+    """Hybrid resident+streamed sparse gram fit — the compressed tier's
+    full-working-set form (ISSUE 8): chunks ``[0, num_resident_chunks)``
+    fold from device-RESIDENT operands (the int16+bf16 compressed COO of
+    ``data/resident.py`` — ``resident_chunk_fn(cid, *operands)`` slices
+    them; ``pipeline=False`` for this leg, since there is no regen work
+    to overlap and no slab headroom beside the resident buffers), and
+    chunks ``[num_resident_chunks, num_chunks)`` — the part that truly
+    cannot fit — stream exactly as in :func:`run_lbfgs_gram_streamed`:
+    either ``chunk_fn(cid)`` regenerated per scan step, or a
+    ``segment_source`` ShardSource whose segment ``s`` carries the
+    SEGMENT-RELATIVE operands for chunks ``num_resident_chunks +
+    [s·seg, (s+1)·seg)``, read ahead on the data-plane runtime
+    (``prefetch_depth``; ``prefetch_stats`` collects the per-site
+    overlap accounting). One solve runs on the combined G.
+
+    Bit-identity contract: same chunk order, same per-chunk densify +
+    fold arithmetic, same carry — the result equals a single streamed
+    fit over all ``num_chunks`` chunks with the same ``val_dtype`` and
+    per-leg pipeline flags (tests/test_resident.py pins it).
+    """
+    if n is None:
+        raise ValueError("hybrid streamed fit needs the true row count n")
+    if num_resident_chunks > num_chunks:
+        raise ValueError(
+            f"num_resident_chunks {num_resident_chunks} > num_chunks "
+            f"{num_chunks}"
+        )
+    from keystone_tpu.data.prefetch import is_shard_source, iter_segments
+    from keystone_tpu.ops.sparse import sparse_gram_init
+    from keystone_tpu.parallel.streaming import BoundedInflight
+
+    seg = int(max_chunks_per_dispatch)
+    carry = sparse_gram_init(d, k, val_dtype)
+    throttle = BoundedInflight(inflight)
+    step = _fold_stepper(throttle, prefetch_stats)
+
+    def folded(fold, cid0, ops):
+        nonlocal carry
+        carry = step(fold, carry, cid0, ops)
+
+    if num_resident_chunks:
+        # Phantom ids in a ragged final resident segment are masked dead
+        # (live = cid < num_resident_chunks); the SAME chunk ids then
+        # fold live through the streamed tail — no chunk is ever folded
+        # twice or skipped.
+        fold_res = _gram_fold_program(
+            resident_chunk_fn, int(num_resident_chunks), int(d), int(k),
+            seg, bool(use_pallas), jnp.dtype(val_dtype), False,
+        )
+        ops_res = tuple(jnp.asarray(o) for o in resident_operands)
+        for cid0 in range(0, int(num_resident_chunks), seg):
+            folded(fold_res, cid0, ops_res)
+
+    tail = int(num_chunks) - int(num_resident_chunks)
+    if tail > 0:
+        if segment_source is not None:
+            if not is_shard_source(segment_source):
+                raise TypeError(
+                    "hybrid segment_source must be a ShardSource whose "
+                    f"segments carry {seg} segment-relative chunks; got "
+                    f"{type(segment_source).__name__}"
+                )
+            if chunk_fn is None:
+                chunk_fn = _resident_chunk_fn
+            fold_tail = _gram_fold_program_rel(
+                chunk_fn, int(num_chunks), int(d), int(k), seg,
+                bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline),
+            )
+            for s, ops in iter_segments(
+                segment_source, prefetch_depth=prefetch_depth,
+                stats=prefetch_stats,
+            ):
+                folded(fold_tail, int(num_resident_chunks) + s * seg, ops)
+        else:
+            if chunk_fn is None:
+                raise ValueError(
+                    "a streamed tail needs chunk_fn or segment_source"
+                )
+            fold_tail = _gram_fold_program(
+                chunk_fn, int(num_chunks), int(d), int(k), seg,
+                bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline),
+            )
+            for cid0 in range(int(num_resident_chunks), int(num_chunks),
+                              seg):
+                folded(fold_tail, cid0, ())
+
+    solve = _gram_solve_program(
+        int(d), int(k), float(lam), int(num_iterations),
+        float(convergence_tol), int(n), jnp.dtype(val_dtype),
+    )
+    return solve(carry)
 
 
 @functools.lru_cache(maxsize=16)
@@ -706,6 +844,19 @@ class SparseLBFGSwithL2(LabelEstimator):
         G at one small GEMM per iteration. ~10x faster end-to-end at
         Amazon geometry when iterations > ~2, at the cost of a (d_pad)²
         f32 Gramian in HBM — prefer it whenever d ≲ 40k.
+
+    ``compress`` (gram solver only) selects the COMPRESSED-RESIDENT
+    storage class (``data/resident.py``, ISSUE 8): ``"int16_bf16"``
+    encodes the padded-COO operands at 4 bytes/nnz (int16 index + bf16
+    value) before the fold, with the decode fused into the fold's
+    densify casts — the same iterates as ``gram_dtype="bf16"`` (the
+    fold quantizes values to bf16 either way, so results are
+    bit-identical), at HALF the resident operand. This is a capacity
+    play: the cost model prices it as a third tier between HBM-raw and
+    disk, so working sets that bust HBM raw but fit compressed stay
+    chip-resident with no flag. Requires every index (including the
+    intercept lane at d) to fit int16 — encode raises at the overflow
+    boundary rather than ever wrapping.
     """
 
     def __init__(
@@ -717,6 +868,7 @@ class SparseLBFGSwithL2(LabelEstimator):
         solver: str = "gather",
         gram_chunk_rows: int = 65536,
         gram_dtype: Optional[str] = None,
+        compress: Optional[str] = None,
     ):
         if solver not in ("gather", "gram"):
             raise ValueError(f'solver must be "gather" or "gram", got {solver!r}')
@@ -724,11 +876,28 @@ class SparseLBFGSwithL2(LabelEstimator):
             raise ValueError(
                 f'gram_dtype must be None, "f32" or "bf16", got {gram_dtype!r}'
             )
+        if compress not in (None, "int16_bf16"):
+            raise ValueError(
+                f'compress must be None or "int16_bf16", got {compress!r}'
+            )
+        if compress is not None and solver != "gram":
+            raise ValueError(
+                'compress requires solver="gram" (the gather engine reads '
+                "COO lanes directly and has no densify to fuse the decode "
+                "into)"
+            )
+        if compress is not None and gram_dtype == "f32":
+            raise ValueError(
+                'compress="int16_bf16" stores bf16 values — an exact-f32 '
+                "fold over them would be paying full precision for "
+                "already-quantized data; drop one of the two"
+            )
         self.lam = lam
         self.num_iterations = num_iterations
         self.convergence_tol = convergence_tol
         self.num_features = num_features
         self.solver = solver
+        self.compress = compress
         self.gram_chunk_rows = gram_chunk_rows
         # Densified-slab dtype for the gram fold. None follows the input
         # values' dtype; "bf16" folds f32 inputs through bf16 slabs — the
@@ -796,19 +965,33 @@ class SparseLBFGSwithL2(LabelEstimator):
     def _fit_gram(self, idx1, val1, B, d1: int, n: int):
         """Gram-engine fit over RESIDENT padded-COO buffers: pre-chunk the
         rows host-side (padding chunks with inactive lanes), fold G once,
-        iterate on it. Values may be bf16 and indices int16 — the
-        compressed-COO resident format at 4 bytes/nnz."""
+        iterate on it. With ``compress="int16_bf16"`` the operands are
+        encoded through the compressed-resident tier
+        (``data/resident.py``) first — 4 bytes/nnz resident, decode
+        fused into the fold's densify casts."""
         c = min(self.gram_chunk_rows, idx1.shape[0])
         npad = idx1.shape[0]
-        nchunks = -(-npad // c)
-        pad = nchunks * c - npad
-        idx_t = jnp.pad(
-            idx1, ((0, pad), (0, 0)), constant_values=-1
-        ).reshape(nchunks, c, idx1.shape[1])
-        val_t = jnp.pad(val1, ((0, pad), (0, 0))).reshape(
-            nchunks, c, val1.shape[1]
-        )
-        Y_t = jnp.pad(B, ((0, pad), (0, 0))).reshape(nchunks, c, B.shape[1])
+        if self.compress == "int16_bf16":
+            from keystone_tpu.data.resident import CompressedCOOChunks
+
+            chunks = CompressedCOOChunks.encode(
+                np.asarray(idx1), np.asarray(val1), np.asarray(B),
+                chunk_rows=c, d=d1, n_true=n,
+            )
+            idx_t, val_t, Y_t = chunks.operands()
+            nchunks = chunks.num_chunks
+        else:
+            nchunks = -(-npad // c)
+            pad = nchunks * c - npad
+            idx_t = jnp.pad(
+                idx1, ((0, pad), (0, 0)), constant_values=-1
+            ).reshape(nchunks, c, idx1.shape[1])
+            val_t = jnp.pad(val1, ((0, pad), (0, 0))).reshape(
+                nchunks, c, val1.shape[1]
+            )
+            Y_t = jnp.pad(B, ((0, pad), (0, 0))).reshape(
+                nchunks, c, B.shape[1]
+            )
 
         from keystone_tpu.ops import pallas_ops
 
@@ -817,7 +1000,11 @@ class SparseLBFGSwithL2(LabelEstimator):
             # slabs upcast losslessly and the syrk runs the exact 6-pass
             # recipe (the caller is paying for precision on purpose).
             val_dtype = jnp.float32
-        elif self.gram_dtype == "bf16" or val1.dtype == jnp.bfloat16:
+        elif (
+            self.compress is not None
+            or self.gram_dtype == "bf16"
+            or val1.dtype == jnp.bfloat16
+        ):
             val_dtype = jnp.bfloat16
         else:
             val_dtype = jnp.float32
@@ -881,10 +1068,21 @@ class SparseLBFGSwithL2(LabelEstimator):
         return self.num_iterations * per_iter
 
     def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
-        """Capacity model: padded-COO operand (int32 index + f32 value per
-        stored cell), labels, history pairs; the gram engine adds its
-        (d_pad)^2 f32 Gramian."""
-        coo = 8.0 * n * d * sparsity / num_machines
+        """Capacity model: padded-COO operand (int32 index + f32 value
+        per stored cell — or the compressed tier's 4 B/nnz int16+bf16
+        encoding when ``compress`` is set, infeasible past the int16
+        index boundary), labels, history pairs; the gram engine adds
+        its (d_pad)^2 f32 Gramian."""
+        if self.compress is not None:
+            from keystone_tpu.data import resident as resident_mod
+
+            # +1: the append-ones intercept lane lives at index d.
+            if not resident_mod.compressible_dim(d + 1):
+                return float("inf")
+            bytes_per_nnz = resident_mod.COMPRESSED_BYTES_PER_NNZ
+        else:
+            bytes_per_nnz = 8.0
+        coo = bytes_per_nnz * n * d * sparsity / num_machines
         gram = 4.0 * d * d if self.solver == "gram" else 0.0
         return (
             coo

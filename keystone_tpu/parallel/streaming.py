@@ -641,6 +641,8 @@ def streaming_bcd_fit_segments(
         if arrays is not None:
             carry = tuple(jnp.asarray(a) for a in arrays)
     throttle = BoundedInflight(inflight)
+    import time as _time
+
     for s, (X_seg, Y_seg, valid_rows) in iter_segments(
         segment_source, num_segments=num_segments,
         prefetch_depth=prefetch_depth, stats=prefetch_stats, start=start,
@@ -654,6 +656,7 @@ def streaming_bcd_fit_segments(
                 jnp.zeros((d_feat,), jnp.float32),
                 jnp.zeros((k,), jnp.float32),
             )
+        t0 = _time.perf_counter()
         carry = _dense_segment_fold(
             carry, jnp.asarray(X_seg), jnp.asarray(Y_seg),
             jnp.asarray(int(valid_rows), jnp.int32), bank_params,
@@ -661,8 +664,16 @@ def streaming_bcd_fit_segments(
             use_pallas=use_pallas,
         )
         throttle.admit(carry[2])
+        if prefetch_stats is not None:
+            # The `compute` site: transfer + fold dispatch + the inflight
+            # throttle's blocking — the denominator phase of the per-site
+            # overlap report (utils.profiling.overlap_report).
+            prefetch_stats.add_busy(
+                "compute", _time.perf_counter() - t0
+            )
         if checkpoint is not None:
-            checkpoint.maybe_save(carry, s, num_segments, fingerprint)
+            checkpoint.maybe_save(carry, s, num_segments, fingerprint,
+                                  stats=prefetch_stats)
     G, FY, yty, fsum, ysum = carry
     G = jnp.triu(G) + jnp.triu(G, 1).T
     # The accumulated moments ride into the shared jitted solve either

@@ -9,17 +9,25 @@ test over the whole package (``tests/test_lint.py``):
 ``jax-off-thread``
     No ``jax``/``jnp`` usage reachable from a background-thread target —
     the ``data/prefetch.py`` / ``serving/batcher.py`` discipline: reader
-    threads own disk+numpy ONLY; exactly one thread owns JAX. Reachability
-    is per-module and depth-limited: the target function plus the local
+    threads own disk+numpy ONLY; exactly one thread owns JAX. Covers
+    BOTH spawn forms: ``threading.Thread(target=...)`` AND tasks
+    submitted to the data-plane runtime's worker pool
+    (``data/runtime.py`` — any ``x.submit("<site>", fn, ...)`` whose
+    first argument is a string lane name walks ``fn`` exactly like a
+    Thread target; a lambda is walked in place). Reachability is
+    per-module and depth-limited: the target function plus the local
     / same-class helpers it calls. A function that IS the designated JAX
     owner opts out with a ``# lint: jax-owner-thread`` marker on its
-    ``def`` line.
+    ``def`` line — there is exactly ONE such designation per worker
+    pool (the serving batcher's worker).
 
 ``thread-join``
     Every scope (class or function) that ``.start()``s a
     ``threading.Thread`` must also ``.join()`` one on its shutdown path —
-    the "close() joins the worker" contract both Prefetcher and
-    MicroBatchServer document and test.
+    the "close() joins the worker" contract Prefetcher,
+    MicroBatchServer, and the data-plane runtime's lane pool
+    (``data/runtime.py`` — every pooled worker joins on ``close()``)
+    document and test.
 
 ``retry-transient``
     ``RetryPolicy(transient=...)`` tuples must never include
@@ -193,6 +201,52 @@ def _thread_targets(scope: ast.AST) -> List[Tuple[ast.Call, Optional[str]]]:
     return out
 
 
+def _runtime_submit_targets(
+    scope: ast.AST,
+) -> List[Tuple[ast.Call, Optional[str], Optional[ast.Lambda]]]:
+    """``x.submit("<site>", fn, ...)`` calls — the data-plane runtime's
+    task submission (``data/runtime.py``): the callable runs on a pooled
+    IO worker, so the jax-off-thread rule walks it exactly like a Thread
+    target. Matched only when the FIRST argument names a lane — a string
+    literal or a ``LANE_*`` constant (``rt.submit(runtime.LANE_READ,
+    fn, ...)`` is the production prefetcher's form) — so the serving
+    batcher's ``submit(request)`` — data, not a task — never
+    false-positives. Returns (call, local name of the submitted fn when
+    resolvable, the lambda node when the task is a lambda)."""
+
+    def _is_lane_arg(site: ast.AST) -> bool:
+        if isinstance(site, ast.Constant) and isinstance(site.value, str):
+            return True
+        name = (
+            site.id if isinstance(site, ast.Name)
+            else site.attr if isinstance(site, ast.Attribute)
+            else None
+        )
+        return name is not None and name.startswith("LANE_")
+
+    out: List[Tuple[ast.Call, Optional[str], Optional[ast.Lambda]]] = []
+    for sub in ast.walk(scope):
+        if not isinstance(sub, ast.Call) or _call_name(sub.func) != "submit":
+            continue
+        if len(sub.args) < 2:
+            continue
+        if not _is_lane_arg(sub.args[0]):
+            continue
+        tgt = sub.args[1]
+        name: Optional[str] = None
+        lam: Optional[ast.Lambda] = None
+        if isinstance(tgt, ast.Name):
+            name = tgt.id
+        elif isinstance(tgt, ast.Attribute) and isinstance(
+            tgt.value, ast.Name
+        ) and tgt.value.id in ("self", "cls"):
+            name = tgt.attr
+        elif isinstance(tgt, ast.Lambda):
+            lam = tgt
+        out.append((sub, name, lam))
+    return out
+
+
 def _thread_binding_names(members: Sequence[ast.AST]) -> Set[str]:
     """Names a ``threading.Thread(...)`` result is bound to within a
     scope's members: ``self._thread = Thread(...)`` → ``_thread``,
@@ -246,9 +300,13 @@ def _check_thread_rules(
         else:
             members = scope.body
         threads = []
+        submits: List[
+            Tuple[ast.Call, Optional[str], Optional[ast.Lambda]]
+        ] = []
         for m in members:
             threads.extend(_thread_targets(m))
-        if not threads:
+            submits.extend(_runtime_submit_targets(m))
+        if not threads and not submits:
             continue
 
         # Names threads are bound to in this scope (``self._thread =
@@ -273,36 +331,62 @@ def _check_thread_rules(
                 return name in thread_names
             return name is not None
 
-        started = any(
-            isinstance(sub, ast.Call)
-            and _call_name(sub.func) == "start"
-            for m in members
-            for sub in ast.walk(m)
-        )
-        joined = any(
-            isinstance(sub, ast.Call)
-            and _call_name(sub.func) == "join"
-            and _join_receiver_ok(sub)
-            for m in members
-            for sub in ast.walk(m)
-        )
-        if started and not joined:
-            line = threads[0][0].lineno
-            where = f"class {scope.name}" if in_class else "module scope"
-            findings.append(Finding(
-                path, line, "thread-join",
-                f"{where} starts a threading.Thread but never joins it — "
-                "every started thread needs a join on the close()/shutdown "
-                "path (the Prefetcher/MicroBatchServer contract)",
-            ))
+        if threads:
+            started = any(
+                isinstance(sub, ast.Call)
+                and _call_name(sub.func) == "start"
+                for m in members
+                for sub in ast.walk(m)
+            )
+            joined = any(
+                isinstance(sub, ast.Call)
+                and _call_name(sub.func) == "join"
+                and _join_receiver_ok(sub)
+                for m in members
+                for sub in ast.walk(m)
+            )
+            if started and not joined:
+                line = threads[0][0].lineno
+                where = (
+                    f"class {scope.name}" if in_class else "module scope"
+                )
+                findings.append(Finding(
+                    path, line, "thread-join",
+                    f"{where} starts a threading.Thread but never joins "
+                    "it — every started thread needs a join on the "
+                    "close()/shutdown path (the Prefetcher/"
+                    "MicroBatchServer/runtime-lane contract)",
+                ))
 
-        # jax-off-thread: walk each resolvable target transitively
-        # through same-scope helpers.
-        for call, target_name in threads:
-            if target_name is None or target_name not in fns:
-                continue
+        # jax-off-thread: walk each resolvable worker target (Thread
+        # target OR runtime-submitted task) transitively through
+        # same-scope helpers.
+        targets = [
+            (call, name, None) for call, name in threads
+        ] + submits
+        for call, target_name, lam in targets:
             seen: Set[str] = set()
-            frontier = [target_name]
+            if lam is not None:
+                if _is_owner_marked(lam, source_lines):
+                    continue
+                hit = _uses_jax(lam)
+                if hit is not None:
+                    findings.append(Finding(
+                        path, getattr(hit, "lineno", lam.lineno),
+                        "jax-off-thread",
+                        f"lambda submitted to an IO worker (submit at "
+                        f"line {call.lineno}) touches jax/jnp — runtime "
+                        "workers own disk+numpy only; one thread owns "
+                        "JAX (data/runtime.py discipline). Mark the "
+                        "designated owner with "
+                        f"`# {_OWNER_MARK}` if intended",
+                    ))
+                    continue
+                frontier = list(_called_local_names(lam))
+            elif target_name is not None and target_name in fns:
+                frontier = [target_name]
+            else:
+                continue
             depth = 0
             while frontier and depth < _CALL_DEPTH:
                 nxt: List[str] = []
@@ -324,12 +408,13 @@ def _check_thread_rules(
                             path, getattr(hit, "lineno", fn.lineno),
                             "jax-off-thread",
                             f"function {name!r} runs on a background "
-                            f"thread (Thread target at line {call.lineno}) "
-                            "but touches jax/jnp — background threads own "
-                            "disk+numpy only; one thread owns JAX "
-                            "(data/prefetch.py discipline). Mark the "
-                            "designated owner with "
-                            f"`# {_OWNER_MARK}` if intended",
+                            f"worker (target at line {call.lineno}) "
+                            "but touches jax/jnp — background threads "
+                            "and runtime IO workers own disk+numpy "
+                            "only; one thread owns JAX "
+                            "(data/prefetch.py + data/runtime.py "
+                            "discipline). Mark the designated owner "
+                            f"with `# {_OWNER_MARK}` if intended",
                         ))
                         continue
                     nxt.extend(_called_local_names(fn))

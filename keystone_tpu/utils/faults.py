@@ -27,6 +27,12 @@ Instrumented sites (each site counts its own calls, 0-based):
                         replicated server's restart path; injected
                         errors burn the restart budget toward
                         permanent eviction.
+  - ``checkpoint.write`` — one snapshot write inside
+                        ``CheckpointSpec.save`` (``data/durable.py``)
+                        — fires on the write-behind runtime worker
+                        (or inline for a synchronous spec), so chaos
+                        tests can kill/fail/delay a snapshot while the
+                        fold keeps running.
 
 Activation is either lexical (``with plan.active():``) or ambient via
 the ``KEYSTONE_FAULT_PLAN`` env var (a JSON plan, or ``@/path/to.json``)
@@ -56,6 +62,7 @@ __all__ = [
     "FaultPlan",
     "FaultRule",
     "RetryPolicy",
+    "SITE_CHECKPOINT_WRITE",
     "SITE_PREFETCH_READ",
     "SITE_REPLICA_EXECUTE",
     "SITE_REPLICA_SPAWN",
@@ -65,6 +72,7 @@ __all__ = [
     "corrupt_array",
     "install",
     "maybe_fail",
+    "observe_busy",
     "observe_retry",
     "observing_retries",
     "uninstall",
@@ -75,6 +83,7 @@ SITE_PREFETCH_READ = "prefetch.read"
 SITE_SERVING_EXECUTE = "serving.execute"
 SITE_REPLICA_EXECUTE = "serving.replica.execute"
 SITE_REPLICA_SPAWN = "serving.replica.spawn"
+SITE_CHECKPOINT_WRITE = "checkpoint.write"
 
 _KINDS = ("error", "corrupt", "latency")
 _EXC_TYPES: Dict[str, type] = {
@@ -405,6 +414,18 @@ def observe_retry(delay_s: float) -> None:
     if stats is not None:
         stats.retries += 1
         stats.backoff_s += float(delay_s)
+
+
+def observe_busy(site: str, seconds: float) -> None:
+    """Report per-site busy seconds into the thread's observer (the
+    same thread-local channel as :func:`observe_retry`) — how the shard
+    layer's checksum pass attributes its ``verify`` time to the
+    consuming fit's :class:`~keystone_tpu.data.prefetch.PrefetchStats`
+    without holding a stats handle. No-op without an observer, or for
+    observers without per-site accounting (``add_busy``)."""
+    stats = getattr(_RETRY_TLS, "stats", None)
+    if stats is not None and hasattr(stats, "add_busy"):
+        stats.add_busy(site, float(seconds))
 
 
 class RetryPolicy:

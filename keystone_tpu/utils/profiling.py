@@ -104,6 +104,46 @@ def prefetch_overlap_fraction(stats) -> Optional[float]:
     return min(max((load_s - wait_s) / load_s, 0.0), 1.0)
 
 
+def overlap_report(stats) -> Dict[str, Dict[str, Optional[float]]]:
+    """Per-SITE overlap report of one streamed fit (ISSUE 8 satellite):
+    the per-phase form of :func:`prefetch_overlap_fraction`, built from
+    the ``site_busy_s`` / ``site_wait_s`` accounting the data-plane
+    runtime's consumers fill in one
+    :class:`~keystone_tpu.data.prefetch.PrefetchStats`:
+
+      - ``read`` — segment loads on the runtime's ``read`` worker
+        (busy) vs consumer queue waits (wait);
+      - ``verify`` — the shard layer's CRC pass (rides inside read's
+        wall, attributed via ``faults.observe_busy``);
+      - ``checkpoint`` — write-behind snapshot writes (busy, worker
+        side) vs the fold-blocking sync+submit share (wait);
+      - ``compute`` — the consumer's transfer + fold dispatch + device
+        throttle, the denominator phase everything else hides behind.
+
+    Per site: ``busy_s`` (wall the phase worked), ``wait_s`` (wall the
+    CONSUMER blocked on it), ``hidden_s = max(busy − wait, 0)`` and
+    ``overlap = hidden/busy`` (None when the site did no work) — 1.0
+    means the phase ran entirely behind compute, 0.0 fully serial. A
+    serial ``prefetch_depth=0`` leg records busy == wait for ``read``,
+    so the oracle path reads 0 overlap by construction. This is what
+    makes a fold-floor claim (the Amazon 131.4 s) auditable per phase:
+    wall − compute.busy must be accounted for by the visible waits."""
+    busy = dict(getattr(stats, "site_busy_s", {}) or {})
+    wait = dict(getattr(stats, "site_wait_s", {}) or {})
+    report: Dict[str, Dict[str, Optional[float]]] = {}
+    for site in sorted(set(busy) | set(wait)):
+        b = float(busy.get(site, 0.0))
+        w = float(wait.get(site, 0.0))
+        hidden = max(b - w, 0.0)
+        report[site] = {
+            "busy_s": b,
+            "wait_s": w,
+            "hidden_s": hidden,
+            "overlap": (min(hidden / b, 1.0) if b > 0.0 else None),
+        }
+    return report
+
+
 def prefetch_retry_counters(stats) -> Dict[str, float]:
     """Reliability accounting of one streamed fit's ingestion
     (docs/reliability.md): how many transient read failures the retry

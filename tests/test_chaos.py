@@ -176,6 +176,126 @@ class TestKillResume:
             )
 
 
+class TestAsyncCheckpoint:
+    """ISSUE 8 satellite: snapshot writes are write-behind through the
+    data-plane runtime — the fold blocks for device-sync + queue-submit
+    only, a kill DURING an in-flight async write still resumes
+    bit-identically (the versioned atomic write leaves the previous
+    complete snapshot), and an async write FAILURE surfaces loudly at
+    the next snapshot boundary instead of silently voiding the
+    insurance."""
+
+    def test_maybe_save_never_blocks_longer_than_submit(self, tmp_path):
+        """With a slow disk (injected latency at checkpoint.write), the
+        fold-facing maybe_save must return in submit time while the
+        write completes behind it; a synchronous spec eats the full
+        latency — the A/B that prices the write-behind."""
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=1)
+        arrays = [np.arange(64, dtype=np.float32)]
+        fp = {"kind": "drill", "n": 64}
+        slow = FaultPlan([FaultRule("checkpoint.write", "latency",
+                                    calls=[0, 1], latency_s=0.3)])
+        with slow:
+            t0 = time.perf_counter()
+            assert ck.maybe_save(arrays, 0, 4, fp)
+            submit_wall = time.perf_counter() - t0
+            ck.flush()
+        assert submit_wall < 0.25, submit_wall  # sync would be >= 0.3
+        assert ck.has_snapshot(fp)
+        loaded, cursor = ck.load(fp)
+        np.testing.assert_array_equal(loaded[0], arrays[0])
+        assert cursor == 1
+        ck.clear(fp)
+        sync = CheckpointSpec(str(tmp_path / "ck"), every_segments=1,
+                              runtime=False)
+        with slow:
+            t0 = time.perf_counter()
+            assert sync.maybe_save(arrays, 0, 4, fp)
+            sync_wall = time.perf_counter() - t0
+        assert sync_wall >= 0.3, sync_wall
+
+    @pytest.mark.slow
+    def test_kill_during_inflight_async_snapshot_resumes_bit_identical(
+        self, tmp_path
+    ):
+        """The acceptance clause: the fit dies while a snapshot write is
+        STILL IN FLIGHT on the checkpoint worker (latency-injected); the
+        versioned atomic write means whatever state the kill leaves —
+        previous snapshot or the new one — resumes bit-identically."""
+        shards, fit = _dense_problem(tmp_path)
+        assert shards.num_segments >= 5
+        W0, fm0, ym0, loss0 = fit()  # uninterrupted reference
+
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=2)
+        plan = FaultPlan([
+            # Snapshot 2 (cursor 4) stalls on the checkpoint worker...
+            FaultRule("checkpoint.write", "latency", calls=[1],
+                      latency_s=0.4),
+            # ...while the fold dies right after submitting it.
+            FaultRule("prefetch.read", "error", calls=[4, 5, 6]),
+        ])
+        with plan:
+            with pytest.raises(OSError):
+                fit(checkpoint=ck)
+        # has_snapshot flushes the in-flight write first — deterministic.
+        assert ck.has_snapshot()
+        W1, fm1, ym1, loss1 = fit(checkpoint=ck)  # resume, no faults
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+        np.testing.assert_array_equal(np.asarray(fm0), np.asarray(fm1))
+        np.testing.assert_array_equal(np.asarray(ym0), np.asarray(ym1))
+        assert float(loss0) == float(loss1)
+        assert not ck.has_snapshot()
+
+    def test_async_write_failure_surfaces_loudly_at_flush(self, tmp_path):
+        """A FAILED async write re-raises at flush() (and at any later
+        snapshot boundary once known) — never silently voided."""
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=1)
+        fp = {"kind": "drill3"}
+        dead_disk = FaultPlan([FaultRule("checkpoint.write", "error",
+                                         calls=[0])])
+        with dead_disk:
+            assert ck.maybe_save([np.ones(8, np.float32)], 0, 4, fp)
+            with pytest.raises(faults.FaultError):
+                ck.flush()
+        assert not ck.has_snapshot(fp)
+
+    def test_async_write_failure_fails_the_fit_and_previous_resumes(
+        self, tmp_path
+    ):
+        """Mid-fit: a failed async write fails the fit loudly at a later
+        snapshot boundary (reads latency-paced so the failure is KNOWN
+        by then — a fit that outruns its insurance finishes and the
+        failure demotes to a clear-time warning instead), and the
+        previous durable snapshot still resumes bit-identically."""
+        shards, fit = _dense_problem(tmp_path)
+        W0, *_ = fit()
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=1)
+        plan = FaultPlan([
+            FaultRule("checkpoint.write", "error", calls=[1]),
+            # Pace the stream so snapshot 1's failure is done before the
+            # next boundary checks pending futures.
+            FaultRule("prefetch.read", "latency", p=1.0, latency_s=0.1),
+        ])
+        with plan:
+            with pytest.raises(faults.FaultError):
+                fit(checkpoint=ck)
+        assert ck.has_snapshot()  # snapshot 0 (cursor 1) is durable
+        W1, *_ = fit(checkpoint=ck)
+        np.testing.assert_array_equal(np.asarray(W0), np.asarray(W1))
+
+    def test_clear_waits_out_pending_writes(self, tmp_path):
+        """A queued write must never resurrect a snapshot after clear —
+        clear flushes the lane first."""
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=1)
+        fp = {"kind": "drill2"}
+        slow = FaultPlan([FaultRule("checkpoint.write", "latency",
+                                    calls=[0], latency_s=0.15)])
+        with slow:
+            ck.maybe_save([np.ones(8, np.float32)], 0, 4, fp)
+            ck.clear(fp)  # flushes the in-flight write, THEN deletes
+        assert not ck.has_snapshot(fp)
+
+
 class TestFlakyIO:
     """Transient faults UNDER the retry budget are absorbed — results
     stay bit-identical to the healthy run, and the recovery is visible

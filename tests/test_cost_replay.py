@@ -178,6 +178,77 @@ class TestReplayAmazonSparse:
         assert 0.5 < c_gram / 1.805 < 2.0, c_gram
 
 
+class TestReplayAmazonCompressedResident:
+    # BENCH_FULL_r05 resident probe, promoted to a tier (ISSUE 8): the
+    # compressed int16+bf16 COO at n=30e6 is 9.8 GB measured on-chip
+    # (fit-path folds ran from it in place), while the raw int32+f32
+    # operand at the same n is 19.7 GB — past any 16 GB budget. The
+    # selector must route this geometry CHIP-RESIDENT through the
+    # compressed gram engine, not stream it.
+    N, D, NNZ, K = 30_000_000, 16_384, 82, 2
+
+    def _sample(self):
+        rng = np.random.default_rng(8)
+        idx = rng.integers(0, self.D, size=(24, self.NNZ)).astype(np.int32)
+        idx[0, 0] = self.D - 1
+        s = Dataset(
+            {"indices": jnp.asarray(idx),
+             "values": jnp.asarray(
+                 rng.normal(size=(24, self.NNZ)).astype(np.float32))},
+            n=24,
+        )
+        s.total_n = self.N
+        s.source_row_bytes = self.NNZ * 4.0
+        ls = Dataset.of(rng.normal(size=(24, self.K)).astype(np.float32))
+        return s, ls
+
+    def test_compressed_resident_selected_over_streamed(self):
+        est = LeastSquaresEstimator(
+            lam=1e-3, hbm_bytes=16 << 30, num_machines=1,
+            host_budget_bytes=64 << 30,
+        )
+        s, ls = self._sample()
+        chosen = est.optimize(s, ls)
+        assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2)
+        assert inner.solver == "gram" and inner.compress == "int16_bf16"
+
+    def test_feasibility_is_what_flips_the_choice(self):
+        # The storage classes at this geometry, priced directly: raw COO
+        # (8 B/nnz) busts the budget, compressed (4 B/nnz) fits — the
+        # cost model is identical, so the capacity cut IS the decision.
+        est = LeastSquaresEstimator(
+            lam=1e-3, hbm_bytes=16 << 30, num_machines=1,
+            host_budget_bytes=64 << 30,
+        )
+        budget = (16 << 30) * est.hbm_utilization
+        sparsity = self.NNZ / self.D
+        raw = SparseLBFGSwithL2(lam=1e-3, num_iterations=20, solver="gram")
+        comp = SparseLBFGSwithL2(lam=1e-3, num_iterations=20,
+                                 solver="gram", compress="int16_bf16")
+        rb_raw = raw.resident_bytes(self.N, self.D, self.K, sparsity, 1)
+        rb_comp = comp.resident_bytes(self.N, self.D, self.K, sparsity, 1)
+        assert rb_raw > budget, (rb_raw, budget)
+        assert rb_comp <= budget, (rb_comp, budget)
+        c_raw = _cost_of(est, raw, self.N, self.D, self.K, sparsity)
+        c_comp = _cost_of(est, comp, self.N, self.D, self.K, sparsity)
+        assert c_raw == c_comp  # same engine, same model — capacity play
+
+    def test_raw_still_wins_ties_when_both_fit(self):
+        # At n=500k (the amazon_sparse row) both storage classes fit:
+        # equal cost, and the selector keeps the raw engine (listed
+        # first) — compression engages only when residency binds.
+        est = LeastSquaresEstimator(
+            lam=1e-3, hbm_bytes=16 << 30, num_machines=1
+        )
+        s, ls = TestReplayAmazonSparse()._sample()
+        chosen = est.optimize(s, ls)
+        inner = chosen.estimator
+        assert isinstance(inner, SparseLBFGSwithL2)
+        assert inner.solver == "gram" and inner.compress is None
+
+
 class TestWeightFamilySwitch:
     def test_tpu_active_by_default(self, monkeypatch):
         monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)

@@ -40,6 +40,7 @@ class TestPackageIsClean:
             "SITE_SERVING_EXECUTE": faults.SITE_SERVING_EXECUTE,
             "SITE_REPLICA_EXECUTE": faults.SITE_REPLICA_EXECUTE,
             "SITE_REPLICA_SPAWN": faults.SITE_REPLICA_SPAWN,
+            "SITE_CHECKPOINT_WRITE": faults.SITE_CHECKPOINT_WRITE,
         }
 
     def test_every_registered_fault_site_is_exercised_by_tests(self):
@@ -106,6 +107,72 @@ class Reader:
             "    def _reader(self):  # lint: jax-owner-thread",
         )
         assert not _lint_snippet(tmp_path, marked)
+
+    # -- the runtime worker-pool form (ISSUE 8 satellite) ------------------
+
+    RUNTIME_VIOLATION = """
+import jax.numpy as jnp
+
+class Loader:
+    def __init__(self, runtime):
+        self.runtime = runtime
+
+    def _load_segment(self, s):
+        return jnp.zeros((4,))  # JAX on the pooled IO worker
+
+    def kick(self, s):
+        return self.runtime.submit("read", self._load_segment, s)
+"""
+
+    def test_fires_on_jax_in_runtime_submitted_task(self, tmp_path):
+        findings = _lint_snippet(tmp_path, self.RUNTIME_VIOLATION)
+        assert _codes(findings) == ["jax-off-thread"]
+        assert "_load_segment" in findings[0].message
+
+    def test_numpy_only_runtime_task_is_clean(self, tmp_path):
+        clean = self.RUNTIME_VIOLATION.replace(
+            "import jax.numpy as jnp", "import numpy as np"
+        ).replace("jnp.zeros", "np.zeros")
+        assert not _lint_snippet(tmp_path, clean)
+
+    def test_runtime_owner_marker_opts_out(self, tmp_path):
+        marked = self.RUNTIME_VIOLATION.replace(
+            "    def _load_segment(self, s):",
+            "    def _load_segment(self, s):  # lint: jax-owner-thread",
+        )
+        assert not _lint_snippet(tmp_path, marked)
+
+    def test_fires_on_jax_in_submitted_lambda(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+import jax.numpy as jnp
+
+def kick(runtime, x):
+    return runtime.submit("checkpoint", lambda: jnp.sum(x))
+""")
+        assert _codes(findings) == ["jax-off-thread"]
+        assert "lambda" in findings[0].message
+
+    def test_fires_on_lane_constant_site(self, tmp_path):
+        # The production prefetcher submits with runtime.LANE_READ, not
+        # a string literal — the rule must walk that form too (it is
+        # the call site the rule was written to police).
+        findings = _lint_snippet(tmp_path, self.RUNTIME_VIOLATION.replace(
+            'self.runtime.submit("read", ',
+            "self.runtime.submit(runtime_mod.LANE_READ, ",
+        ))
+        assert _codes(findings) == ["jax-off-thread"]
+        assert "_load_segment" in findings[0].message
+
+    def test_data_submit_without_string_site_is_not_a_task(self, tmp_path):
+        # The serving batcher's submit(request) takes DATA, not a task:
+        # no string lane name in the first position, so the rule must
+        # not walk anything.
+        assert not _lint_snippet(tmp_path, """
+import jax.numpy as jnp
+
+def serve(server, x):
+    return server.submit(jnp.asarray(x), deadline_s=1.0)
+""")
 
 
 class TestThreadJoinRule:

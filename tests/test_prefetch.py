@@ -130,10 +130,12 @@ class TestPrefetcher:
         assert stats.load_s >= 6 * 0.01
 
     def test_consumer_error_depth_gt_1_slow_reader_joins_promptly(self):
-        """ISSUE 5 satellite regression: the depth-1 shutdown test left
-        the depth>1 + slow-reader stop path uncovered — a consumer that
-        raises while the reader is mid-load with a FULL queue must still
-        join the reader promptly and release every queued buffer."""
+        """ISSUE 5 satellite regression (runtime form, ISSUE 8): the
+        depth-1 shutdown test left the depth>1 + slow-reader stop path
+        uncovered — a consumer that raises while a load is mid-flight
+        with every slot staged must still stop the pass promptly and
+        release every staged payload (futures cancelled/drained, not
+        leaked)."""
         src = CountingSource(1000, delay=0.02)  # slow reader
         p = Prefetcher(src, depth=3)
         t0 = time.perf_counter()
@@ -143,14 +145,15 @@ class TestPrefetcher:
                     time.sleep(0.12)  # let the reader fill all 3 slots
                     raise RuntimeError("consumer boom")
         join_wall = time.perf_counter() - t0
-        # close() (via the generator finalizer) joined the reader: no
-        # thread leaked, the join did not ride out the 1000-segment
-        # stream, and the staged buffers were drained, not leaked.
+        # close() (via the generator finalizer) stopped the pass: no
+        # per-pass thread exists (the pooled runtime worker persists by
+        # design), the stop did not ride out the 1000-segment stream,
+        # and the staged payloads were released, not leaked.
         assert not any(
             t.name == "keystone-prefetch" for t in threading.enumerate()
         )
         assert join_wall < 5.0
-        assert p._queue.qsize() == 0
+        assert p.staged_count == 0
         assert len(src.loaded) < 20
 
     def test_reader_retries_transient_errors_into_stats(self, monkeypatch):

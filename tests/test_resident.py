@@ -1,0 +1,262 @@
+"""Compressed-resident COO tier (ISSUE 8): encode/decode round-trip
+equality (indices exact — the int16 overflow boundary raises, never
+wraps), the stated bf16 value-drift policy, fold equivalence with the
+bf16 gram engine (bit-identical — the fold quantized to bf16 already),
+and the hybrid resident+streamed fold's bit-identity to a single
+streamed fit."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from keystone_tpu.data.prefetch import PrefetchStats, ShardSource
+from keystone_tpu.data.resident import (
+    COMPRESSED_BYTES_PER_NNZ,
+    INT16_MAX_INDEX,
+    CompressedCOOChunks,
+    compressible_dim,
+)
+from keystone_tpu.data.runtime import DataPlaneRuntime
+from keystone_tpu.ops.learning.lbfgs import (
+    SparseLBFGSwithL2,
+    _resident_chunk_fn,
+    run_lbfgs_gram_hybrid,
+    run_lbfgs_gram_streamed,
+)
+
+
+def _coo(n=700, d=96, w=5, k=2, seed=3, bf16_exact=False):
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, d, size=(n, w)).astype(np.int32)
+    if bf16_exact:
+        # Values with <= 8 significant mantissa bits round-trip bf16
+        # exactly (the drift policy's exact class).
+        val = (rng.integers(-128, 128, size=(n, w)) / 64.0).astype(
+            np.float32
+        )
+    else:
+        val = rng.normal(size=(n, w)).astype(np.float32)
+    y = rng.normal(size=(n, k)).astype(np.float32)
+    return idx, val, y
+
+
+class TestEncodeDecode:
+    def test_round_trip_exact_for_bf16_representable_values(self):
+        idx, val, y = _coo(bf16_exact=True)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+        idx2, val2, y2 = chunks.decode()
+        np.testing.assert_array_equal(idx2, idx)  # indices ALWAYS exact
+        np.testing.assert_array_equal(val2, val)  # exact for this class
+        np.testing.assert_array_equal(y2, y)      # labels stay f32
+
+    def test_int16_overflow_boundary_raises_never_wraps(self):
+        idx, val, y = _coo(d=64)
+        assert compressible_dim(INT16_MAX_INDEX + 1)
+        assert not compressible_dim(INT16_MAX_INDEX + 2)
+        # The boundary itself is fine...
+        idx[0, 0] = INT16_MAX_INDEX
+        CompressedCOOChunks.encode(idx, val, y, chunk_rows=128,
+                                   d=INT16_MAX_INDEX + 1)
+        # ...one past it must raise loudly (a wrapped index would
+        # scatter into the wrong Gramian row with no NaN anywhere).
+        idx[0, 0] = INT16_MAX_INDEX + 1
+        with pytest.raises(ValueError, match="int16"):
+            CompressedCOOChunks.encode(idx, val, y, chunk_rows=128,
+                                       d=INT16_MAX_INDEX + 2)
+
+    def test_negative_indices_only_minus_one(self):
+        idx, val, y = _coo()
+        idx[0, 0] = -1  # inactive lane: fine
+        CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+        idx[0, 0] = -2
+        with pytest.raises(ValueError, match="-1"):
+            CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+
+    def test_value_drift_policy_bounded_and_rtne(self):
+        idx, val, y = _coo()
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+        _, val2, _ = chunks.decode()
+        # Stated policy: round-to-nearest-even f32->bf16 — identical to
+        # the quantization jnp's bf16 cast (and therefore the
+        # gram_dtype="bf16" fold) applies.
+        expect = np.asarray(
+            jnp.asarray(val).astype(jnp.bfloat16).astype(jnp.float32)
+        )
+        np.testing.assert_array_equal(val2, expect)
+        # ...and bounded: one bf16 ulp = 2^-8 relative.
+        nz = val != 0
+        rel = np.abs(val2[nz] - val[nz]) / np.abs(val[nz])
+        assert rel.max() <= 2.0 ** -8
+        assert CompressedCOOChunks.value_drift(val) == np.abs(
+            val2 - val
+        ).max()
+        assert CompressedCOOChunks.value_drift(
+            (np.arange(8) / 4.0).astype(np.float32)
+        ) == 0.0
+
+    def test_capacity_arithmetic(self):
+        idx, val, y = _coo(n=256, w=5, k=2)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+        assert chunks.bytes_per_nnz == COMPRESSED_BYTES_PER_NNZ == 4.0
+        assert chunks.num_chunks == 2 and chunks.chunk_rows == 128
+        # indices + values at 4 B/lane plus f32 labels.
+        assert chunks.nbytes == 256 * 5 * 4 + 256 * 2 * 4
+
+    def test_ragged_tail_pads_inactive(self):
+        idx, val, y = _coo(n=100)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=64)
+        assert chunks.num_chunks == 2
+        assert (chunks.idx_t[1, 100 - 64:] == -1).all()
+        assert (np.asarray(chunks.val_t[1, 100 - 64:],
+                           np.float32) == 0).all()
+
+
+class TestCompressedGramEngine:
+    """compress="int16_bf16" is the SAME fold the bf16 gram engine runs
+    (quantize-at-encode == quantize-in-densify, both RTNE): fits are
+    bit-identical, at half the resident operand bytes."""
+
+    def _fit(self, **kw):
+        from keystone_tpu.data import Dataset
+
+        n, d, w, k = 600, 96, 5, 2
+        idx, val, y = _coo(n=n, d=d, w=w, k=k, seed=9)
+        ds = Dataset(
+            {"indices": jnp.asarray(idx), "values": jnp.asarray(val)}, n=n
+        )
+        est = SparseLBFGSwithL2(
+            lam=1e-3, num_iterations=12, num_features=d, solver="gram",
+            gram_chunk_rows=128, **kw,
+        )
+        return est.fit(ds, Dataset.of(jnp.asarray(y)))
+
+    def test_bit_identical_to_bf16_gram_engine(self):
+        m_bf16 = self._fit(gram_dtype="bf16")
+        m_comp = self._fit(compress="int16_bf16")
+        np.testing.assert_array_equal(
+            np.asarray(m_bf16.x), np.asarray(m_comp.x)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(m_bf16.b_opt), np.asarray(m_comp.b_opt)
+        )
+
+    def test_construction_contract(self):
+        with pytest.raises(ValueError, match="gram"):
+            SparseLBFGSwithL2(solver="gather", compress="int16_bf16")
+        with pytest.raises(ValueError, match="compress"):
+            SparseLBFGSwithL2(solver="gram", compress="zstd")
+        with pytest.raises(ValueError, match="f32"):
+            SparseLBFGSwithL2(solver="gram", compress="int16_bf16",
+                              gram_dtype="f32")
+
+    def test_resident_bytes_half_of_raw_and_inf_past_boundary(self):
+        raw = SparseLBFGSwithL2(solver="gram", num_iterations=20)
+        comp = SparseLBFGSwithL2(solver="gram", num_iterations=20,
+                                 compress="int16_bf16")
+        n, d, k, sp = 1_000_000, 16_384, 2, 82 / 16_384
+        rb_raw = raw.resident_bytes(n, d, k, sp, 1)
+        rb_comp = comp.resident_bytes(n, d, k, sp, 1)
+        # The COO term halves (8 -> 4 B/nnz); the shared terms (labels,
+        # history, Gramian) are identical.
+        assert rb_raw - rb_comp == pytest.approx(4.0 * n * d * sp)
+        # Past the int16 boundary the tier is infeasible, not wrapped.
+        assert comp.resident_bytes(n, 40_000, k, sp, 1) == float("inf")
+        assert np.isfinite(raw.resident_bytes(n, 40_000, k, sp, 1))
+
+
+class _TailSource(ShardSource):
+    """Segment-relative operand triples for the hybrid fold's streamed
+    tail: segment s carries chunks [first + s*seg, first + (s+1)*seg)
+    of the backing chunked arrays."""
+
+    def __init__(self, idx_t, val_t, y_t, first_chunk, seg, n_true):
+        self._arrs = (idx_t, val_t, y_t)
+        self.first = int(first_chunk)
+        self.seg = int(seg)
+        tail = idx_t.shape[0] - self.first
+        self.num_segments = -(-tail // self.seg)
+        self.n_true = int(n_true)
+
+    def load(self, s):
+        lo = self.first + s * self.seg
+        hi = lo + self.seg
+        idx_t, val_t, y_t = self._arrs
+        out = []
+        for a, fill in ((idx_t, -1), (val_t, 0), (y_t, 0)):
+            seg = np.asarray(a[lo:hi])
+            pad = self.seg - seg.shape[0]
+            if pad:
+                filler = np.full((pad,) + a.shape[1:], fill, a.dtype)
+                seg = np.concatenate([seg, filler])
+            out.append(seg)
+        return tuple(out)
+
+
+class TestHybridFold:
+    def test_hybrid_bit_identical_to_single_streamed_fold(self):
+        n, d, k, w, chunk = 900, 96, 2, 5, 128
+        idx, val, y = _coo(n=n, d=d, w=w, k=k, seed=5)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=chunk,
+                                            d=d, n_true=n)
+        idx_t = np.asarray(chunks.idx_t)
+        val_t = np.asarray(chunks.val_t)
+        y_t = np.asarray(chunks.y_t)
+        nchunks = chunks.num_chunks
+        assert nchunks == 8
+        operands = chunks.operands()
+
+        W_full, loss_full = run_lbfgs_gram_streamed(
+            _resident_chunk_fn, nchunks, d, k, lam=1e-2,
+            num_iterations=10, n=n, val_dtype=jnp.bfloat16,
+            operands=operands, max_chunks_per_dispatch=2, pipeline=False,
+        )
+
+        stats = PrefetchStats()
+        with DataPlaneRuntime() as rt:
+            del rt  # the tail prefetches through the default runtime
+            W_h, loss_h = run_lbfgs_gram_hybrid(
+                _resident_chunk_fn, 4, operands, nchunks, d, k,
+                lam=1e-2, num_iterations=10, n=n,
+                val_dtype=jnp.bfloat16, max_chunks_per_dispatch=2,
+                segment_source=_TailSource(idx_t, val_t, y_t, 4, 2, n),
+                prefetch_stats=stats, pipeline=False,
+            )
+        np.testing.assert_array_equal(np.asarray(W_full), np.asarray(W_h))
+        assert float(loss_full) == float(loss_h)
+        # The hybrid's tail streamed through the runtime with per-site
+        # accounting — the bench row's overlap surface.
+        assert stats.site_busy_s.get("read", 0) > 0
+        assert stats.site_busy_s.get("compute", 0) > 0
+
+    def test_hybrid_with_device_regenerated_tail(self):
+        n, d, k, w, chunk = 640, 64, 1, 4, 128
+        idx, val, y = _coo(n=n, d=d, w=w, k=k, seed=6)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=chunk,
+                                            d=d, n_true=n)
+        operands = chunks.operands()
+        nchunks = chunks.num_chunks
+        idx_j, val_j, y_j = operands
+
+        def tail_fn(cid):
+            return idx_j[cid], val_j[cid], y_j[cid]
+
+        W_full, _ = run_lbfgs_gram_streamed(
+            _resident_chunk_fn, nchunks, d, k, lam=1e-2,
+            num_iterations=8, n=n, val_dtype=jnp.bfloat16,
+            operands=operands, max_chunks_per_dispatch=2, pipeline=False,
+        )
+        W_h, _ = run_lbfgs_gram_hybrid(
+            _resident_chunk_fn, 2, operands, nchunks, d, k,
+            lam=1e-2, num_iterations=8, n=n, val_dtype=jnp.bfloat16,
+            max_chunks_per_dispatch=2, chunk_fn=tail_fn, pipeline=False,
+        )
+        np.testing.assert_array_equal(np.asarray(W_full), np.asarray(W_h))
+
+    def test_hybrid_validates_inputs(self):
+        with pytest.raises(ValueError, match="row count n"):
+            run_lbfgs_gram_hybrid(_resident_chunk_fn, 0, (), 2, 8, 1)
+        with pytest.raises(ValueError, match="num_resident_chunks"):
+            run_lbfgs_gram_hybrid(_resident_chunk_fn, 3, (), 2, 8, 1, n=16)
+        with pytest.raises(ValueError, match="chunk_fn or segment_source"):
+            run_lbfgs_gram_hybrid(_resident_chunk_fn, 0, (), 2, 8, 1, n=16)
