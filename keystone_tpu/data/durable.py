@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from keystone_tpu import obs
 from keystone_tpu.utils import faults
 
 __all__ = [
@@ -44,6 +45,7 @@ __all__ = [
     "ShardCorrupted",
     "atomic_write_json",
     "checksum_algo",
+    "corrupted",
     "crc_of_array",
     "fingerprint_token",
     "fsync_file",
@@ -56,7 +58,20 @@ class ShardCorrupted(RuntimeError):
     """On-disk bytes failed checksum verification (torn write, bit flip,
     or injected corruption). Deliberately NOT an OSError: corruption is
     persistent state — the retry layer must never spin on it, and no
-    caller may silently fold the data."""
+    caller may silently fold the data. Raise through :func:`corrupted`
+    so the postmortem flight record rides the log beside it."""
+
+
+def corrupted(message: str) -> ShardCorrupted:
+    """Build a :class:`ShardCorrupted` to raise, dumping the obs flight
+    record beside it (ISSUE 9): corruption surfaces consumer-side, far
+    from the reads and checkpoint writes that preceded it, so the
+    postmortem block naming the recent spans and the ones in flight
+    rides the log next to the exception. A factory at the raise sites —
+    not an ``__init__`` side effect — so constructing/re-wrapping/
+    unpickling the exception stays pure."""
+    obs.flight.dump_flight_record("ShardCorrupted: " + message)
+    return ShardCorrupted(message)
 
 
 try:  # pragma: no cover - container has no crc32c wheel
@@ -86,11 +101,11 @@ def _crc_named(algo: str):
     if algo == "crc32":
         return lambda data, value=0: zlib.crc32(data, value) & 0xFFFFFFFF
     if algo == "crc32c":
-        raise ShardCorrupted(
+        raise corrupted(
             "metadata was written with crc32c but no crc32c module is "
             "available to verify it"
         )
-    raise ShardCorrupted(f"unknown checksum algorithm {algo!r}")
+    raise corrupted(f"unknown checksum algorithm {algo!r}")
 
 
 def crc_of_array(arr: np.ndarray, algo: Optional[str] = None) -> int:
@@ -106,7 +121,7 @@ def verify_array(
 ) -> None:
     got = crc_of_array(arr, algo)
     if got != int(expected):
-        raise ShardCorrupted(
+        raise corrupted(
             f"{what}: checksum mismatch ({algo} {got:#010x} != recorded "
             f"{int(expected):#010x}) — torn write or bit corruption; "
             f"re-ingest the shard directory"
@@ -417,7 +432,7 @@ class CheckpointSpec:
         for ent in meta["arrays"]:
             raw = blob[ent["offset"]: ent["offset"] + ent["nbytes"]]
             if len(raw) != ent["nbytes"] or crc_fn(raw) != ent["crc"]:
-                raise ShardCorrupted(
+                raise corrupted(
                     f"checkpoint array {ent['index']} in "
                     f"{fit_dir}: checksum mismatch — discard the "
                     f"checkpoint directory and restart the fit"
@@ -472,7 +487,9 @@ class CheckpointSpec:
         host = [np.asarray(a) for a in arrays]
         rt = self._rt()
         if rt is None:
-            self.save(host, segment + 1, fingerprint)
+            with obs.span("checkpoint.write", cursor=segment + 1,
+                          sync=True):
+                self.save(host, segment + 1, fingerprint)
             dt = time.perf_counter() - t0
             if stats is not None and hasattr(stats, "add_busy"):
                 stats.add_busy("checkpoint", dt)
@@ -498,10 +515,11 @@ class CheckpointSpec:
         # fit HERE — snapshotting onto a dead disk forever, silently,
         # is the one thing the insurance layer must never do.
         self._surface_pending_failure()
-        self._pending.append(rt.submit(
-            "checkpoint", self._write_snapshot,
-            host, segment + 1, fingerprint, stats,
-        ))
+        with obs.span("checkpoint.submit", cursor=segment + 1):
+            self._pending.append(rt.submit(
+                "checkpoint", self._write_snapshot,
+                host, segment + 1, fingerprint, stats,
+            ))
         if stats is not None and hasattr(stats, "add_wait"):
             stats.add_wait("checkpoint", time.perf_counter() - t0)
         return True
@@ -509,9 +527,12 @@ class CheckpointSpec:
     def _write_snapshot(self, host_arrays, cursor, fingerprint, stats):
         """The write-behind task body (runs on the runtime's
         ``checkpoint`` worker): pure host IO — the arrays were already
-        device-synced by maybe_save on the owner thread."""
+        device-synced by maybe_save on the owner thread. The span covers
+        exactly the region the busy counter covers (the
+        trace-correctness contract)."""
         t0 = time.perf_counter()
-        self.save(host_arrays, cursor, fingerprint)
+        with obs.span("checkpoint.write", cursor=cursor, sync=False):
+            self.save(host_arrays, cursor, fingerprint)
         if stats is not None and hasattr(stats, "add_busy"):
             stats.add_busy("checkpoint", time.perf_counter() - t0)
 

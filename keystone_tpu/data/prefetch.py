@@ -39,7 +39,17 @@ from typing import Any, Callable, Iterator, Optional, Tuple
 
 import numpy as np
 
+from keystone_tpu import obs
 from keystone_tpu.data import runtime as runtime_mod
+from keystone_tpu.obs.metrics import (
+    METRIC_PREFETCH_BACKOFF_S,
+    METRIC_PREFETCH_LOAD_S,
+    METRIC_PREFETCH_RETRIES,
+    METRIC_PREFETCH_SEGMENTS,
+    METRIC_PREFETCH_WAIT_S,
+    METRIC_SITE_BUSY_S,
+    METRIC_SITE_WAIT_S,
+)
 from keystone_tpu.utils import faults
 
 
@@ -400,30 +410,86 @@ class PrefetchStats:
     CONSUMER was blocked waiting on that phase — the per-site form of
     the load/wait pair, so the 131.4 s fold-floor claim is auditable
     phase by phase. Thread-safe: IO workers and the consumer thread
-    both report."""
+    both report.
+
+    The store is a :class:`~keystone_tpu.obs.metrics.MetricsRegistry`
+    (ISSUE 9: the ad-hoc attribute counters became named, registered
+    metrics — ``registry.snapshot()`` is the flat view bench rows and
+    the profiling report functions read). The historical attribute
+    surface (``stats.load_s += dt`` and friends) is preserved as
+    properties over the registered counters, so every existing call
+    site and test reads/writes the same numbers through either door."""
 
     def __init__(self):
-        self.load_s = 0.0
-        self.wait_s = 0.0
-        self.segments = 0
+        self.registry = obs.MetricsRegistry()
+        self._load_s = self.registry.counter(METRIC_PREFETCH_LOAD_S)
+        self._wait_s = self.registry.counter(METRIC_PREFETCH_WAIT_S)
+        self._segments = self.registry.counter(METRIC_PREFETCH_SEGMENTS)
+        self._retries = self.registry.counter(METRIC_PREFETCH_RETRIES)
+        self._backoff_s = self.registry.counter(METRIC_PREFETCH_BACKOFF_S)
         self.prefetched = False
-        self.retries = 0
-        self.backoff_s = 0.0
-        self.site_busy_s: dict = {}
-        self.site_wait_s: dict = {}
-        self._site_lock = threading.Lock()
+
+    # -- attribute compatibility over the registry -------------------------
+
+    @property
+    def load_s(self) -> float:
+        return self._load_s.value
+
+    @load_s.setter
+    def load_s(self, v: float) -> None:
+        self._load_s.set_(v)
+
+    @property
+    def wait_s(self) -> float:
+        return self._wait_s.value
+
+    @wait_s.setter
+    def wait_s(self, v: float) -> None:
+        self._wait_s.set_(v)
+
+    @property
+    def segments(self) -> int:
+        return int(self._segments.value)
+
+    @segments.setter
+    def segments(self, v: int) -> None:
+        self._segments.set_(v)
+
+    @property
+    def retries(self) -> int:
+        return int(self._retries.value)
+
+    @retries.setter
+    def retries(self, v: int) -> None:
+        self._retries.set_(v)
+
+    @property
+    def backoff_s(self) -> float:
+        return self._backoff_s.value
+
+    @backoff_s.setter
+    def backoff_s(self, v: float) -> None:
+        self._backoff_s.set_(v)
+
+    @property
+    def site_busy_s(self) -> dict:
+        """``{site: seconds}`` view of the labeled busy counters (the
+        shape ``utils.profiling.overlap_report`` documents)."""
+        return self.registry.values_by_label(METRIC_SITE_BUSY_S, "site")
+
+    @property
+    def site_wait_s(self) -> dict:
+        return self.registry.values_by_label(METRIC_SITE_WAIT_S, "site")
 
     def add_busy(self, site: str, seconds: float) -> None:
-        with self._site_lock:
-            self.site_busy_s[site] = (
-                self.site_busy_s.get(site, 0.0) + float(seconds)
-            )
+        self.registry.counter(METRIC_SITE_BUSY_S, site=site).add(
+            float(seconds)
+        )
 
     def add_wait(self, site: str, seconds: float) -> None:
-        with self._site_lock:
-            self.site_wait_s[site] = (
-                self.site_wait_s.get(site, 0.0) + float(seconds)
-            )
+        self.registry.counter(METRIC_SITE_WAIT_S, site=site).add(
+            float(seconds)
+        )
 
 
 class _Cancelled:
@@ -458,11 +524,15 @@ class Prefetcher:
 
     def __init__(self, source: ShardSource, depth: int = 2,
                  stats: Optional[PrefetchStats] = None,
-                 retry_policy=None, runtime=None):
+                 retry_policy=None, runtime=None, segment_offset: int = 0):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.source = source
         self.depth = int(depth)
+        # Trace-label offset only (a resumed fit hands us a source
+        # rebased to its checkpoint cursor): spans must name ABSOLUTE
+        # segment ids, matching the serial leg's s + start labels.
+        self.segment_offset = int(segment_offset)
         self.stats = stats if stats is not None else PrefetchStats()
         self.retry_policy = retry_policy or faults.default_retry_policy()
         # None -> the process-wide shared runtime, resolved at iteration
@@ -482,9 +552,16 @@ class Prefetcher:
         if self._stop.is_set():
             return _Cancelled()
         try:
-            with faults.observing_retries(self.stats):
+            # The trace span covers EXACTLY the region the busy counter
+            # covers (retry-wrapped load), so per-site busy totals and
+            # span sums agree — the trace-correctness contract
+            # tests/test_obs_trace.py audits.
+            with faults.observing_retries(self.stats), \
+                    obs.span("prefetch.read",
+                             segment=s + self.segment_offset):
                 t0 = time.perf_counter()
                 payload = self._load_with_retry(s)
+                dt = time.perf_counter() - t0
         except BaseException:
             # A load that exhausted its retries kills the PASS: queued
             # sibling tasks short-circuit instead of burning their own
@@ -492,7 +569,6 @@ class Prefetcher:
             # stays one bounded retry cycle, as with the serial reader).
             self._stop.set()
             raise
-        dt = time.perf_counter() - t0
         self.stats.load_s += dt
         self.stats.add_busy("read", dt)
         return payload
@@ -549,7 +625,9 @@ class Prefetcher:
             for s in range(num):
                 fut = self._pending.popleft()
                 t0 = time.perf_counter()
-                payload = fut.result()  # re-raises the load's exception
+                with obs.span("prefetch.wait",
+                              segment=s + self.segment_offset):
+                    payload = fut.result()  # re-raises the load's error
                 dt = time.perf_counter() - t0
                 self.stats.wait_s += dt
                 self.stats.add_wait("read", dt)
@@ -636,13 +714,17 @@ def iter_segments(
         source.load_retries_transients = base.load_retries_transients
     if prefetch_depth and source.num_segments > 1:
         for s, payload in Prefetcher(source, depth=prefetch_depth,
-                                     stats=stats):
+                                     stats=stats, segment_offset=start):
             yield s + start, payload
         return
     for s in range(source.num_segments):
         t0 = time.perf_counter()
         if stats is not None:
-            with faults.observing_retries(stats):
+            # Serial leg: the same span name as the prefetched reader so
+            # the trace's read-site sum matches site_busy_s either way.
+            with faults.observing_retries(stats), \
+                    obs.span("prefetch.read", segment=s + start,
+                             serial=True):
                 payload = source.load(s)
             dt = time.perf_counter() - t0
             stats.load_s += dt

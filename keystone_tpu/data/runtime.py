@@ -47,7 +47,12 @@ busy/wait seconds are attributed to the owning fit's
 layer, because one runtime serves many fits and a per-runtime counter
 could not say whose wall was hidden. The runtime's own :meth:`stats`
 reports per-lane lifetime totals (tasks, busy seconds, errors, queue
-depth) — the ops view, not the per-fit roofline.
+depth) — the ops view, not the per-fit roofline — held in a
+:class:`~keystone_tpu.obs.metrics.MetricsRegistry` (ISSUE 9: named,
+registered metrics instead of ad-hoc attributes), and every task runs
+under a ``runtime.task`` span when the obs plane is tracing (one
+branch when it is not — ``keystone_tpu/obs``, which imports no jax
+either).
 """
 
 from __future__ import annotations
@@ -58,6 +63,14 @@ import threading
 import time
 from concurrent.futures import Future
 from typing import Any, Callable, Dict, Optional
+
+from keystone_tpu import obs
+from keystone_tpu.obs.metrics import (
+    METRIC_RUNTIME_LANE_BUSY_S,
+    METRIC_RUNTIME_LANE_ERRORS,
+    METRIC_RUNTIME_LANE_QUEUED,
+    METRIC_RUNTIME_LANE_TASKS,
+)
 
 __all__ = [
     "DataPlaneRuntime",
@@ -77,24 +90,41 @@ _SENTINEL = object()
 
 
 class _Lane:
-    """One named worker thread + its bounded FIFO queue."""
+    """One named worker thread + its bounded FIFO queue. Lifetime
+    counters are registered metrics on the owning runtime's
+    :class:`~keystone_tpu.obs.metrics.MetricsRegistry` (labeled by
+    ``site``) — the single store :meth:`DataPlaneRuntime.stats` reads."""
 
-    def __init__(self, site: str, depth: int):
+    def __init__(self, site: str, depth: int, metrics):
         self.site = site
         self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
-        self.tasks = 0
-        self.errors = 0
-        self.busy_s = 0.0
+        self._tasks = metrics.counter(METRIC_RUNTIME_LANE_TASKS, site=site)
+        self._errors = metrics.counter(METRIC_RUNTIME_LANE_ERRORS, site=site)
+        self._busy_s = metrics.counter(METRIC_RUNTIME_LANE_BUSY_S, site=site)
+        self._queued = metrics.gauge(METRIC_RUNTIME_LANE_QUEUED, site=site)
         # Set (before the sentinel is enqueued) by the runtime's
         # close(); submit() re-checks it AFTER its put so a task that
         # raced behind the sentinel is cancelled loudly, never stranded
         # unresolved on a queue no worker reads.
         self.closed = False
-        self._stats_lock = threading.Lock()
         self._thread = threading.Thread(
             target=self._worker, name=f"keystone-io-{site}", daemon=True
         )
         self._thread.start()
+
+    # Legacy attribute views (the pre-registry stats shape — tests and
+    # dashboards read these through snapshot()).
+    @property
+    def tasks(self) -> int:
+        return int(self._tasks.value)
+
+    @property
+    def errors(self) -> int:
+        return int(self._errors.value)
+
+    @property
+    def busy_s(self) -> float:
+        return self._busy_s.value
 
     def _worker(self):
         """Drain the lane FIFO. Runs submitted host work only — no jax
@@ -118,29 +148,33 @@ class _Lane:
             if not fut.set_running_or_notify_cancel():
                 continue  # cancelled before it started
             t0 = time.perf_counter()
-            try:
-                result = fn(*args, **kwargs)
-            except BaseException as e:  # noqa: BLE001 — delivered via future
-                with self._stats_lock:
-                    self.errors += 1
-                fut.set_exception(e)
-            else:
-                fut.set_result(result)
-            finally:
-                dt = time.perf_counter() - t0
-                with self._stats_lock:
-                    self.tasks += 1
-                    self.busy_s += dt
+            # The lane-task span: every pooled-IO task is visible in the
+            # trace on its worker's own track (one no-op branch when
+            # tracing is off). The submitted fn keeps its own deeper
+            # spans (prefetch.read, checkpoint.write) as children.
+            with obs.span("runtime.task", lane=self.site,
+                          fn=getattr(fn, "__name__", type(fn).__name__)):
+                try:
+                    result = fn(*args, **kwargs)
+                except BaseException as e:  # noqa: BLE001 — via future
+                    self._errors.add(1)
+                    fut.set_exception(e)
+                else:
+                    fut.set_result(result)
+                finally:
+                    dt = time.perf_counter() - t0
+                    self._tasks.add(1)
+                    self._busy_s.add(dt)
 
     def snapshot(self) -> Dict[str, Any]:
-        with self._stats_lock:
-            return {
-                "tasks": self.tasks,
-                "errors": self.errors,
-                "busy_s": self.busy_s,
-                "queued": self.queue.qsize(),
-                "alive": self._thread.is_alive(),
-            }
+        self._queued.set(self.queue.qsize())
+        return {
+            "tasks": self.tasks,
+            "errors": self.errors,
+            "busy_s": self.busy_s,
+            "queued": self.queue.qsize(),
+            "alive": self._thread.is_alive(),
+        }
 
     def close(self, timeout: float) -> None:
         self.queue.put(_SENTINEL)
@@ -180,13 +214,19 @@ class DataPlaneRuntime:
         in-flight ones, and joins every worker thread.
     """
 
-    def __init__(self, queue_depth: int = 64):
+    def __init__(self, queue_depth: int = 64, metrics=None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self._depth = int(queue_depth)
         self._lanes: Dict[str, _Lane] = {}
         self._lock = threading.Lock()
         self._closed = False
+        # The runtime's lifetime counters live in ONE registry (ISSUE
+        # 9): stats() is a projection of it, and ops tooling can read
+        # the flat snapshot() directly.
+        self.metrics = metrics if metrics is not None else (
+            obs.MetricsRegistry()
+        )
 
     # -- submission --------------------------------------------------------
 
@@ -200,7 +240,7 @@ class DataPlaneRuntime:
                 )
             lane = self._lanes.get(site)
             if lane is None:
-                lane = _Lane(site, self._depth)
+                lane = _Lane(site, self._depth, self.metrics)
                 self._lanes[site] = lane
             return lane
 
@@ -212,6 +252,12 @@ class DataPlaneRuntime:
         lane = self._lane(site)
         fut: Future = Future()
         lane.queue.put((fut, fn, args, kwargs))
+        if obs.enabled():
+            # Counter track: queue depth per lane at every submit — the
+            # backpressure picture in the Perfetto view. Guarded so the
+            # disabled path pays one branch, not an f-string.
+            obs.counter_track(f"runtime.{site}.queued",
+                              lane.queue.qsize())
         # close() may have run between _lane()'s check and our put: it
         # marks the lane closed BEFORE draining/sentinel, so re-checking
         # here catches every interleaving. If the cancel wins (the task
@@ -249,10 +295,18 @@ class DataPlaneRuntime:
         """Per-lane lifetime counters: tasks run, errors, busy seconds,
         current queue depth, worker liveness. The ops view — per-FIT
         overlap accounting rides PrefetchStats instead (module
-        docstring)."""
+        docstring). A projection of :attr:`metrics`
+        (``metrics.snapshot()`` is the same data flat)."""
         with self._lock:
             lanes = dict(self._lanes)
         return {site: lane.snapshot() for site, lane in lanes.items()}
+
+    def metrics_snapshot(self) -> Dict[str, Any]:
+        """The flat registry view of the same counters (``name{site=...}``
+        keys) — what dashboards and bench rows read."""
+        for lane in list(self._lanes.values()):
+            lane.snapshot()  # refresh queue-depth gauges
+        return self.metrics.snapshot()
 
     @property
     def closed(self) -> bool:

@@ -45,6 +45,7 @@ from keystone_tpu.data.durable import (
     ShardCorrupted,
     atomic_write_json,
     checksum_algo,
+    corrupted,
     crc_of_array,
     fsync_file,
     verify_array,
@@ -132,7 +133,7 @@ class DiskCOOShards:
         with open(os.path.join(directory, _META)) as f:
             meta = json.load(f)
         if meta.get("building"):
-            raise ShardCorrupted(
+            raise corrupted(
                 f"{self.directory}: shard directory was never sealed "
                 f"(writer killed mid-build, or DiskCOOShards.seal() not "
                 f"called after an incremental fill)"

@@ -1,0 +1,91 @@
+"""The unified run-trace + metrics plane (ISSUE 9 tentpole).
+
+Until this package, the evidence for *why* the system did anything lived
+in disconnected fragments: ``PhaseTimer`` blocks inside solvers, per-fit
+``PrefetchStats``, per-server ``stats()`` dicts, and bench-row ``detail``
+blobs — none of them correlated after the fact. This package is the one
+causally-linked record:
+
+  - :mod:`~keystone_tpu.obs.tracer` — a process-wide :class:`Tracer`
+    with nested, thread-safe spans carrying one ``run_id`` and parent
+    links, instrumented at the load-bearing seams (``Pipeline.fit``
+    phases, optimizer rules, verifier pre-passes, cost-model decisions,
+    fold chunk steps, data-plane runtime lane tasks, prefetch waits,
+    checkpoint write-behind, serving requests). The whole plane is a
+    **no-op guarded by one branch** when tracing is off — hooks cost one
+    global read — and cheap when on (the ``observability_overhead``
+    bench row holds the enabled cost to <=2% of the disk-streamed fold).
+  - :mod:`~keystone_tpu.obs.metrics` — :class:`MetricsRegistry`
+    (counters / gauges / histograms with a flat ``snapshot()``), the
+    single store behind ``DataPlaneRuntime.stats()``, the serving
+    breaker counters, and ``PrefetchStats`` site accounting. Every
+    metric name comes from the parsed ``METRIC_*`` catalogue
+    (``tools/lint.py``'s ``metric-name`` rule — dashboards cannot
+    silently fork names).
+  - :mod:`~keystone_tpu.obs.export` — Chrome-trace/Perfetto JSON
+    exporter (one track per thread, counter tracks) plus a compact
+    JSONL event log; ``tools/trace.py`` / ``bin/trace`` summarize it.
+  - :mod:`~keystone_tpu.obs.flight` — the flight recorder: a bounded
+    ring of recent events that chaos/fault paths (worker death, breaker
+    opens, shard corruption, watchdog evictions) dump alongside the
+    exception, so a postmortem names the spans in flight at death.
+
+Activation (docs/observability.md): ``KEYSTONE_TRACE=dir`` env knob,
+``run.py --trace=dir``, or ``with obs.tracing(dir):`` in code. This
+package imports no jax — the data-plane runtime (which must stay
+jax-free) reports into it from its IO workers.
+"""
+
+from keystone_tpu.obs.export import (
+    load_events,
+    to_chrome_trace,
+    validate_chrome_trace,
+    write_trace_dir,
+)
+from keystone_tpu.obs.flight import (
+    FlightRecorder,
+    flight_note,
+    flight_snapshot,
+    render_flight_record,
+)
+from keystone_tpu.obs.metrics import (  # noqa: F401 — METRIC_* re-exported
+    MetricsRegistry,
+)
+from keystone_tpu.obs.metrics import __all__ as _metrics_all
+from keystone_tpu.obs.metrics import *  # noqa: F401,F403 — the catalogue
+from keystone_tpu.obs.tracer import (
+    CostDecision,
+    Span,
+    Tracer,
+    active_tracer,
+    counter_track,
+    enabled,
+    event,
+    record_cost_decision,
+    span,
+    tracing,
+    tracing_from_env,
+)
+
+__all__ = [
+    "CostDecision",
+    "FlightRecorder",
+    "MetricsRegistry",
+    "Span",
+    "Tracer",
+    "active_tracer",
+    "counter_track",
+    "enabled",
+    "event",
+    "flight_note",
+    "flight_snapshot",
+    "load_events",
+    "record_cost_decision",
+    "render_flight_record",
+    "span",
+    "to_chrome_trace",
+    "tracing",
+    "tracing_from_env",
+    "validate_chrome_trace",
+    "write_trace_dir",
+] + list(_metrics_all)

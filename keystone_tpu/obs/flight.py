@@ -1,0 +1,128 @@
+"""Flight recorder: the bounded ring of recent events a postmortem reads.
+
+Chaos taught this repo that the exception alone rarely names the cause:
+a serving worker dies and the interesting fact is which batch was in
+flight and whether the breaker had been flapping; a ``ShardCorrupted``
+surfaces consumer-side and the interesting fact is which segment reads
+and checkpoint writes preceded it. The flight recorder keeps a bounded,
+always-on ring of recent notes — span completions (when tracing is on),
+cost decisions, fault-path events — and the fault paths
+(``MicroBatchServer._worker_died``, breaker opens, shard-corruption
+raises, replica watchdog evictions) dump it alongside the exception via
+:func:`dump_flight_record`, so the log names the spans in flight at
+death instead of just the stack.
+
+Always-on is safe because the steady-state cost is zero: fault paths are
+the only unconditional writers, and span notes fire only while a tracer
+is active. No jax, no numpy (imported by the runtime's IO workers and
+the serving worker)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = [
+    "FlightRecorder",
+    "default_flight_recorder",
+    "dump_flight_record",
+    "flight_note",
+    "flight_snapshot",
+    "render_flight_record",
+]
+
+logger = logging.getLogger("keystone_tpu.obs.flight")
+
+
+class FlightRecorder:
+    """Thread-safe bounded ring of ``(ts, kind, name, attrs)`` notes."""
+
+    def __init__(self, maxlen: int = 256):
+        self._ring: "deque[Dict[str, Any]]" = deque(maxlen=maxlen)
+        self._lock = threading.Lock()
+
+    def note(self, kind: str, name: str, **attrs) -> None:
+        rec = {"ts": time.time(), "kind": kind, "name": name}
+        if attrs:
+            rec["attrs"] = {k: v for k, v in attrs.items() if v is not None}
+        with self._lock:
+            self._ring.append(rec)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+
+_DEFAULT = FlightRecorder()
+
+
+def default_flight_recorder() -> FlightRecorder:
+    return _DEFAULT
+
+
+def flight_note(kind: str, name: str, **attrs) -> None:
+    """Append one note to the process flight ring (fault paths call this
+    unconditionally; the tracer mirrors span completions here while
+    active)."""
+    _DEFAULT.note(kind, name, **attrs)
+
+
+def flight_snapshot() -> List[Dict[str, Any]]:
+    return _DEFAULT.snapshot()
+
+
+def render_flight_record(limit: int = 25) -> str:
+    """Human-readable postmortem block: the last ``limit`` ring notes
+    (oldest first) plus every span currently OPEN on the active tracer —
+    what was in flight at the moment of death."""
+    lines: List[str] = []
+    notes = _DEFAULT.snapshot()[-limit:]
+    t_ref = notes[-1]["ts"] if notes else time.time()
+    for rec in notes:
+        attrs = rec.get("attrs") or {}
+        suffix = " ".join(f"{k}={v}" for k, v in attrs.items())
+        lines.append(
+            f"  {rec['ts'] - t_ref:+8.3f}s [{rec['kind']}] {rec['name']}"
+            + (f" {suffix}" if suffix else "")
+        )
+    from keystone_tpu.obs import tracer as tracer_mod
+
+    t = tracer_mod.active_tracer()
+    if t is not None:
+        for sp in t.inflight():
+            parent = sp.get("parent_id")
+            lines.append(
+                f"  IN FLIGHT: {sp['name']} (span {sp['span_id']}"
+                + (f" < {parent}" if parent else "")
+                + f", thread {sp['thread']})"
+            )
+    if not lines:
+        return "flight record: (empty)"
+    return "flight record (most recent last):\n" + "\n".join(lines)
+
+
+def dump_flight_record(
+    context: str, exc: Optional[BaseException] = None,
+    log: Optional[logging.Logger] = None, limit: int = 25,
+) -> str:
+    """The fault-path hook: render the ring (+ in-flight spans), log it
+    loudly with the failure context, note the dump itself, and return
+    the rendered block (callers that can attach it to a report do).
+    Never raises — a postmortem aid must not kill the path it serves."""
+    try:
+        rendered = render_flight_record(limit=limit)
+        flight_note("dump", context, error=repr(exc) if exc else None)
+        (log or logger).warning(
+            "%s%s\n%s", context,
+            f": {exc!r}" if exc is not None else "", rendered,
+        )
+        return rendered
+    except Exception:  # pragma: no cover - last-resort guard
+        return "flight record: (unavailable)"

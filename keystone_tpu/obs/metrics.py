@@ -1,0 +1,253 @@
+"""Named, registered metrics: counters / gauges / histograms.
+
+Before this module, operational counters were ad-hoc attributes: the
+data-plane runtime's per-lane ``tasks/errors/busy_s``, the serving
+breaker's ``completed/rejected/failed/breaker_opens``, the per-fit
+``PrefetchStats`` site accounting. Each grew its own locking, its own
+snapshot shape, and its own (unchecked) names. A :class:`MetricsRegistry`
+replaces that plumbing: one get-or-create API, one flat ``snapshot()``
+shape every ``stats()``/bench reader consumes, and every name drawn from
+the ``METRIC_*`` catalogue below.
+
+The catalogue is the contract: ``tools/lint.py``'s ``metric-name`` rule
+PARSES (never imports) this module for ``METRIC_*`` assignments — the
+same discipline as the fault-site registry — and rejects any
+register/lookup site whose dotted name is not in it, so dashboards can't
+silently fork names. Labels (``site=``, ``lane=``) carry the
+per-instance dimension; snapshot keys render as ``name{k=v}``.
+
+No jax, no numpy: the registry is imported by ``data/runtime.py``
+(which must stay jax-free) and updated from IO worker threads.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "METRIC_PREFETCH_BACKOFF_S",
+    "METRIC_PREFETCH_LOAD_S",
+    "METRIC_PREFETCH_RETRIES",
+    "METRIC_PREFETCH_SEGMENTS",
+    "METRIC_PREFETCH_WAIT_S",
+    "METRIC_RUNTIME_LANE_BUSY_S",
+    "METRIC_RUNTIME_LANE_ERRORS",
+    "METRIC_RUNTIME_LANE_QUEUED",
+    "METRIC_RUNTIME_LANE_TASKS",
+    "METRIC_SERVING_BREAKER_OPENS",
+    "METRIC_SERVING_COMPLETED",
+    "METRIC_SERVING_DEGRADED_REJECTED",
+    "METRIC_SERVING_FAILED",
+    "METRIC_SERVING_LATENCY_S",
+    "METRIC_SERVING_QUEUE_DEPTH",
+    "METRIC_SERVING_REJECTED",
+    "METRIC_SITE_BUSY_S",
+    "METRIC_SITE_WAIT_S",
+]
+
+# ---------------------------------------------------------------------------
+# Metric catalogue — the ONLY names a register/lookup site may use
+# (parsed, not imported, by tools/lint.py's metric-name rule; the docs
+# table in docs/observability.md mirrors this list).
+# ---------------------------------------------------------------------------
+
+# Data-plane runtime, per lane (label: site=<lane>) — DataPlaneRuntime.stats()
+METRIC_RUNTIME_LANE_TASKS = "runtime.lane.tasks"
+METRIC_RUNTIME_LANE_ERRORS = "runtime.lane.errors"
+METRIC_RUNTIME_LANE_BUSY_S = "runtime.lane.busy_s"
+METRIC_RUNTIME_LANE_QUEUED = "runtime.lane.queued"
+
+# Per-fit ingestion (PrefetchStats) — overlap + retry accounting
+METRIC_PREFETCH_LOAD_S = "prefetch.load_s"
+METRIC_PREFETCH_WAIT_S = "prefetch.wait_s"
+METRIC_PREFETCH_SEGMENTS = "prefetch.segments"
+METRIC_PREFETCH_RETRIES = "prefetch.retries"
+METRIC_PREFETCH_BACKOFF_S = "prefetch.backoff_s"
+# Per-site overlap accounting (label: site=read/verify/checkpoint/compute)
+METRIC_SITE_BUSY_S = "overlap.site_busy_s"
+METRIC_SITE_WAIT_S = "overlap.site_wait_s"
+
+# Serving (MicroBatchServer) — the breaker/throughput counters stats() reads
+METRIC_SERVING_COMPLETED = "serving.completed"
+METRIC_SERVING_REJECTED = "serving.rejected"
+METRIC_SERVING_FAILED = "serving.failed"
+METRIC_SERVING_BREAKER_OPENS = "serving.breaker_opens"
+METRIC_SERVING_DEGRADED_REJECTED = "serving.degraded_rejected"
+METRIC_SERVING_LATENCY_S = "serving.latency_s"
+METRIC_SERVING_QUEUE_DEPTH = "serving.queue_depth"
+
+
+class Counter:
+    """Monotonic-by-convention accumulator (float). ``set_()`` exists
+    only for the attribute-compatibility shims that migrated legacy
+    ``stats.load_s += dt`` call sites onto the registry."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def add(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def set_(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Point-in-time value (queue depth, liveness)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Bounded-reservoir distribution: keeps the most recent ``maxlen``
+    observations (the rolling-window convention the serving stats
+    already used) plus lifetime count/sum. Percentiles are exact over
+    the retained window, computed by linear interpolation (the same
+    convention as numpy's default, so ``latency_percentiles`` agrees)."""
+
+    __slots__ = ("_lock", "_window", "count", "total")
+
+    def __init__(self, maxlen: int = 4096):
+        self._lock = threading.Lock()
+        self._window: "deque[float]" = deque(maxlen=maxlen)
+        self.count = 0
+        self.total = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        with self._lock:
+            self._window.append(v)
+            self.count += 1
+            self.total += v
+
+    def snapshot_values(self) -> list:
+        with self._lock:
+            return list(self._window)
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock:
+            vals = sorted(self._window)
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return vals[0]
+        pos = (q / 100.0) * (len(vals) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(vals) - 1)
+        frac = pos - lo
+        return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+class MetricsRegistry:
+    """Get-or-create registry of named metrics.
+
+    ``counter(name, **labels)`` / ``gauge(...)`` / ``histogram(...)``
+    are both registration and lookup — the same call shape at the
+    definition site and every reader, so there is nothing to keep in
+    sync. A name re-used at a different type raises (one name, one
+    meaning). ``snapshot()`` flattens everything to one dict —
+    ``name`` or ``name{k=v,...}`` keys — which is the ONE shape
+    ``stats()`` methods and bench rows read.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], Any] = {}
+
+    @staticmethod
+    def _key(name: str, labels: Dict[str, Any]):
+        return name, tuple(sorted((k, str(v)) for k, v in labels.items()))
+
+    def _get_or_create(self, cls, name: str, labels, **kw):
+        key = self._key(name, labels)
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(**kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r}{labels or ''} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}"
+                )
+            return m
+
+    def counter(self, name: str, **labels) -> Counter:
+        return self._get_or_create(Counter, name, labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        return self._get_or_create(Gauge, name, labels)
+
+    def histogram(self, name: str, maxlen: int = 4096, **labels) -> Histogram:
+        return self._get_or_create(Histogram, name, labels, maxlen=maxlen)
+
+    def labels_of(self, name: str) -> list:
+        """The label-sets registered under ``name`` (e.g. every lane a
+        runtime has created), as dicts."""
+        with self._lock:
+            return [
+                dict(lbls) for (n, lbls) in self._metrics if n == name
+            ]
+
+    def values_by_label(self, name: str, label: str) -> Dict[str, float]:
+        """``{label_value: metric_value}`` for one labeled counter/gauge
+        family — the shape the per-site overlap dicts are built from."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for (n, lbls), m in items:
+            d = dict(lbls)
+            if n == name and label in d and hasattr(m, "value"):
+                out[d[label]] = m.value
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Flat dict of every registered metric. Counters/gauges map to
+        their value; histograms expand to ``.count`` / ``.sum`` /
+        ``.p50`` / ``.p99`` sub-keys."""
+        with self._lock:
+            items = list(self._metrics.items())
+        out: Dict[str, Any] = {}
+        for (name, lbls), m in items:
+            key = name
+            if lbls:
+                key += "{" + ",".join(f"{k}={v}" for k, v in lbls) + "}"
+            if isinstance(m, Histogram):
+                out[key + ".count"] = m.count
+                out[key + ".sum"] = m.total
+                out[key + ".p50"] = m.percentile(50.0)
+                out[key + ".p99"] = m.percentile(99.0)
+            else:
+                out[key] = m.value
+        return out

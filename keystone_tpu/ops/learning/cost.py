@@ -22,6 +22,7 @@ from typing import Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from keystone_tpu import obs
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.sparse import Densify, Sparsify, is_sparse_dataset
 from keystone_tpu.workflow import LabelEstimator, Transformer
@@ -142,6 +143,20 @@ def sparse_gather_overhead() -> float:
     if os.environ.get("KEYSTONE_COST_WEIGHTS", "").lower() == "ec2":
         return EC2_SPARSE_GATHER_OVERHEAD
     return TPU_SPARSE_GATHER_OVERHEAD
+
+
+def candidate_label(est) -> str:
+    """Stable human-readable label of one solver candidate — the name a
+    :class:`~keystone_tpu.obs.tracer.CostDecision` event records and the
+    replay tests assert against. Disambiguates the engine/storage-class
+    variants of one estimator type (``solver=``/``compress=``)."""
+    name = type(est).__name__
+    qual = [
+        str(v) for v in (
+            getattr(est, "solver", None), getattr(est, "compress", None)
+        ) if v
+    ]
+    return name + (f"[{','.join(qual)}]" if qual else "")
 
 
 class CostModel:
@@ -438,6 +453,39 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             [f"{type(o[0]).__name__}={c:.3g}" for o, c in
              zip(self.options, costs)],
         )
+
+        def emit_decision(winner, reason: str) -> None:
+            # The structured audit event (obs plane, ISSUE 9): candidate
+            # set, predicted costs, feasibility verdicts, winner —
+            # tests/test_cost_replay.py's trace-backed audit leg asserts
+            # the recorded winner matches every replay assertion.
+            obs.record_cost_decision(obs.CostDecision(
+                decision="least_squares_solver",
+                winner=candidate_label(winner),
+                candidates=[
+                    {
+                        "label": candidate_label(o[0]),
+                        "cost_s": (None if c == float("inf") else float(c)),
+                        "feasible": c != float("inf"),
+                        "resident_bytes": float(resident(o)),
+                        "host_ok": host_ok(o),
+                    }
+                    for o, c in zip(self.options, costs)
+                ],
+                reason=reason,
+                context={
+                    "n": int(n), "d": int(d), "k": int(k),
+                    "sparsity": float(sparsity), "machines": int(machines),
+                    "hbm_budget_bytes": float(budget),
+                    "host_budget_bytes": float(host_budget),
+                    "shard_backed": shard_backed,
+                    "weights": {
+                        "cpu": self.cpu_weight, "mem": self.mem_weight,
+                        "network": self.network_weight,
+                    },
+                },
+            ))
+
         if all(c == float("inf") for c in costs):
             # Nothing fits the budget model: take the least-resident
             # candidate (in practice the streaming tier) rather than a
@@ -448,5 +496,8 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 "selecting least-resident %s",
                 budget / 2**30, n, d, type(best[0]).__name__,
             )
+            emit_decision(best[0], "least_resident_fallback")
             return best[1]
-        return self.options[int(np.argmin(costs))][1]
+        chosen = self.options[int(np.argmin(costs))]
+        emit_decision(chosen[0], "argmin")
+        return chosen[1]

@@ -357,13 +357,21 @@ def _fold_stepper(throttle, prefetch_stats):
     fold through this, so the timing/throttle wiring cannot diverge."""
     import time as _time
 
+    from keystone_tpu import obs
+
     def step(fold, carry, cid0, ops):
         t0 = _time.perf_counter()
-        carry = fold(
-            carry, jnp.asarray(cid0, jnp.int32),
-            tuple(jnp.asarray(o) for o in ops),
-        )
-        throttle.admit(carry[2])
+        # The fold chunk span (obs plane, ISSUE 9) covers EXACTLY the
+        # region the `compute` busy counter covers — transfer + fold
+        # dispatch + throttle block — so trace sums and
+        # PrefetchStats.site_busy_s agree (tests/test_obs_trace.py).
+        # One no-op branch when tracing is off.
+        with obs.span("fold.segment", chunk0=int(cid0)):
+            carry = fold(
+                carry, jnp.asarray(cid0, jnp.int32),
+                tuple(jnp.asarray(o) for o in ops),
+            )
+            throttle.admit(carry[2])
         if prefetch_stats is not None:
             prefetch_stats.add_busy("compute", _time.perf_counter() - t0)
         return carry

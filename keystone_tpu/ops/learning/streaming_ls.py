@@ -654,7 +654,32 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         return pick_block_size(d_feat, hint)
 
     def build_estimator(self, featurize, d_feat: int):
-        if self._gram_tier_ok(d_feat):
+        from keystone_tpu import obs
+
+        gram_ok = self._gram_tier_ok(d_feat)
+
+        def emit(winner: str, reason: str) -> None:
+            # The streaming tier's own cost-model decision, audited like
+            # the solver selection (obs plane, ISSUE 9).
+            obs.record_cost_decision(obs.CostDecision(
+                decision="streaming_tier",
+                winner=winner,
+                candidates=[
+                    {"label": "gram", "feasible": gram_ok},
+                    {"label": "block",
+                     "feasible": isinstance(
+                         featurize, CosineBankFeaturize)},
+                ],
+                reason=reason,
+                context={
+                    "d_feat": int(d_feat),
+                    "budget_bytes": self.budget_bytes,
+                    "featurize": type(featurize).__name__,
+                },
+            ))
+
+        if gram_ok:
+            emit("gram", "gramian_fits_budget")
             bs = pick_block_size(d_feat, self.block_size_hint)
             return StreamingFeaturizedLeastSquares(
                 featurize, d_feat=d_feat, block_size=bs,
@@ -676,6 +701,7 @@ class StreamingLeastSquaresChoice(LabelEstimator):
                 "(got %s); falling back to the gram tier — the fit may "
                 "not fit device memory", d_feat, type(featurize).__name__,
             )
+            emit("gram", "block_needs_bank_featurizer")
             return StreamingFeaturizedLeastSquares(
                 featurize, d_feat=d_feat,
                 block_size=pick_block_size(d_feat, self.block_size_hint),
@@ -684,6 +710,7 @@ class StreamingLeastSquaresChoice(LabelEstimator):
                     d_feat, 4, slab_bytes=self.slab_bytes
                 ),
             )
+        emit("block", "gramian_exceeds_budget")
         return BlockStreamedLeastSquares(
             featurize, d_feat=d_feat, block_size=self._block_tier_bs(d_feat),
             num_iter=self.num_iter, lam=self.lam, center=self.center,

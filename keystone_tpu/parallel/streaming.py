@@ -643,6 +643,8 @@ def streaming_bcd_fit_segments(
     throttle = BoundedInflight(inflight)
     import time as _time
 
+    from keystone_tpu import obs as _obs
+
     for s, (X_seg, Y_seg, valid_rows) in iter_segments(
         segment_source, num_segments=num_segments,
         prefetch_depth=prefetch_depth, stats=prefetch_stats, start=start,
@@ -657,13 +659,16 @@ def streaming_bcd_fit_segments(
                 jnp.zeros((k,), jnp.float32),
             )
         t0 = _time.perf_counter()
-        carry = _dense_segment_fold(
-            carry, jnp.asarray(X_seg), jnp.asarray(Y_seg),
-            jnp.asarray(int(valid_rows), jnp.int32), bank_params,
-            bank_type=bank_type, bank_key=bank_key, tile_rows=tile_rows,
-            use_pallas=use_pallas,
-        )
-        throttle.admit(carry[2])
+        # Fold chunk span (obs plane): same region as the `compute` busy
+        # counter below, so the trace audits the fold floor per segment.
+        with _obs.span("fold.segment", segment=int(s)):
+            carry = _dense_segment_fold(
+                carry, jnp.asarray(X_seg), jnp.asarray(Y_seg),
+                jnp.asarray(int(valid_rows), jnp.int32), bank_params,
+                bank_type=bank_type, bank_key=bank_key, tile_rows=tile_rows,
+                use_pallas=use_pallas,
+            )
+            throttle.admit(carry[2])
         if prefetch_stats is not None:
             # The `compute` site: transfer + fold dispatch + the inflight
             # throttle's blocking — the denominator phase of the per-site

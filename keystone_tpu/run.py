@@ -19,6 +19,13 @@ Global reliability flags (any pipeline, and serve — docs/reliability.md):
 fold carry there (and resume from it on re-run, bit-identically);
 ``--fault-plan=JSON|@file.json`` installs a deterministic fault-injection
 plan (``utils/faults.py``) for manual chaos drills.
+
+Observability (any pipeline, and serve — docs/observability.md):
+``--trace=DIR`` runs the invocation under the obs plane's tracer and
+writes ``DIR/trace.json`` (Perfetto-loadable), ``DIR/events.jsonl``
+(the compact log ``bin/trace`` summarizes), and ``DIR/meta.json`` —
+one correlated record of optimizer decisions, fold chunks, IO lane
+tasks, checkpoint writes, and serving requests under one ``run_id``.
 """
 
 from __future__ import annotations
@@ -288,10 +295,13 @@ def resolve(name: str) -> Callable:
 #       segmented streamed fits snapshot + resume their fold carry)
 #   --fault-plan=JSON|@f   -> KEYSTONE_FAULT_PLAN (faults.py: install a
 #       deterministic fault-injection plan for manual chaos drills)
+#   --trace=DIR            -> KEYSTONE_TRACE (obs: run under the tracer,
+#       write the Perfetto trace + event log to DIR)
 _GLOBAL_FLAGS = {
     "--host-budget-bytes=": "KEYSTONE_HOST_BUDGET_BYTES",
     "--checkpoint-dir=": "KEYSTONE_CHECKPOINT_DIR",
     "--fault-plan=": "KEYSTONE_FAULT_PLAN",
+    "--trace=": "KEYSTONE_TRACE",
 }
 
 
@@ -322,10 +332,17 @@ def main(argv=None):
         print("Pipelines:", ", ".join(sorted(PIPELINES)))
         return 0
     _enable_compile_cache()
-    if argv[0] in ("serve", "--serve"):
-        return _serve(argv[1:])
-    runner = resolve(argv[0])
-    runner(argv[1:])
+    # The whole invocation runs under the obs tracer when KEYSTONE_TRACE
+    # (or --trace=DIR above) names a directory — one flag turns any
+    # pipeline or serve run into a Perfetto-loadable causal record
+    # (docs/observability.md); a no-op context otherwise.
+    from keystone_tpu import obs
+
+    with obs.tracing_from_env():
+        if argv[0] in ("serve", "--serve"):
+            return _serve(argv[1:])
+        runner = resolve(argv[0])
+        runner(argv[1:])
     return 0
 
 

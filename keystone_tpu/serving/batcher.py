@@ -56,6 +56,16 @@ from typing import Any, Deque, Dict, List, Optional
 
 import numpy as np
 
+from keystone_tpu import obs
+from keystone_tpu.obs.metrics import (
+    METRIC_SERVING_BREAKER_OPENS,
+    METRIC_SERVING_COMPLETED,
+    METRIC_SERVING_DEGRADED_REJECTED,
+    METRIC_SERVING_FAILED,
+    METRIC_SERVING_LATENCY_S,
+    METRIC_SERVING_QUEUE_DEPTH,
+    METRIC_SERVING_REJECTED,
+)
 from keystone_tpu.utils import faults, profiling
 
 __all__ = [
@@ -188,16 +198,28 @@ class MicroBatchServer:
         self._breaker_opened_t = 0.0
         self._breaker_probing = False  # ONE half-open probe in flight
         self._worker_dead = False
-        self.breaker_opens = 0
-        self.degraded_rejected = 0
 
-        # Rolling observability state. Deques bound memory; counters are
-        # cumulative. All mutated under _lock (worker + submitters).
+        # Rolling observability state. The counters and the latency
+        # window are REGISTERED metrics (ISSUE 9 — obs.MetricsRegistry
+        # is the single store stats() reads; the legacy attribute names
+        # stay as properties below). The span ring keeps its own
+        # SpanLog shape — it carries structured RequestSpans, not
+        # scalars — and bridges into the tracer when one is active.
         self.span_log = profiling.SpanLog(maxlen=span_log_len)
-        self._latencies_s: Deque[float] = deque(maxlen=span_log_len)
-        self.completed = 0
-        self.rejected = 0
-        self.failed = 0
+        self.metrics = obs.MetricsRegistry()
+        self._completed = self.metrics.counter(METRIC_SERVING_COMPLETED)
+        self._rejected = self.metrics.counter(METRIC_SERVING_REJECTED)
+        self._failed = self.metrics.counter(METRIC_SERVING_FAILED)
+        self._breaker_opens = self.metrics.counter(
+            METRIC_SERVING_BREAKER_OPENS
+        )
+        self._degraded_rejected = self.metrics.counter(
+            METRIC_SERVING_DEGRADED_REJECTED
+        )
+        self._latencies = self.metrics.histogram(
+            METRIC_SERVING_LATENCY_S, maxlen=span_log_len
+        )
+        self._queue_depth = self.metrics.gauge(METRIC_SERVING_QUEUE_DEPTH)
         self._first_done_t: Optional[float] = None
         self._last_done_t: Optional[float] = None
 
@@ -205,6 +227,28 @@ class MicroBatchServer:
             target=self._worker, name="keystone-serving-batcher", daemon=True
         )
         self._thread.start()
+
+    # -- legacy counter attributes (now registry-backed) -------------------
+
+    @property
+    def completed(self) -> int:
+        return int(self._completed.value)
+
+    @property
+    def rejected(self) -> int:
+        return int(self._rejected.value)
+
+    @property
+    def failed(self) -> int:
+        return int(self._failed.value)
+
+    @property
+    def breaker_opens(self) -> int:
+        return int(self._breaker_opens.value)
+
+    @property
+    def degraded_rejected(self) -> int:
+        return int(self._degraded_rejected.value)
 
     # -- submit side -------------------------------------------------------
 
@@ -242,7 +286,7 @@ class MicroBatchServer:
                     # probe slot with no probe in flight).
                     req.is_probe = True
                 else:
-                    self.degraded_rejected += 1
+                    self._degraded_rejected.add(1)
                     raise ServerDegraded(
                         f"circuit breaker open: the plan failed "
                         f"{self._consecutive_failures} consecutive "
@@ -264,7 +308,7 @@ class MicroBatchServer:
                         self._breaker_probing = False
                     shed = victim
                 else:
-                    self.rejected += 1
+                    self._rejected.add(1)
                     raise ServerOverloaded(
                         f"queue full ({self.max_queue_depth}) and this "
                         f"request holds the earliest deadline"
@@ -275,7 +319,15 @@ class MicroBatchServer:
             if req.deadline_t != math.inf:
                 self._finite_deadlines += 1
             if shed is not None:
-                self.rejected += 1
+                self._rejected.add(1)
+            self._queue_depth.set(len(self._pending))
+            if obs.enabled():
+                # Counter track: queued depth at every admission — the
+                # load picture in the Perfetto view (same name as the
+                # registered gauge, sampled over time instead of
+                # point-in-time).
+                obs.counter_track(METRIC_SERVING_QUEUE_DEPTH,
+                                  len(self._pending))
             self._cond.notify()
         if shed is not None:
             shed.resolve(exc=ServerOverloaded(
@@ -311,6 +363,13 @@ class MicroBatchServer:
             self._pending.clear()
             self._finite_deadlines = 0
             self._cond.notify_all()
+        # The postmortem block: recent spans + cost decisions + whatever
+        # was in flight when the worker died, dumped beside the
+        # exception (obs flight recorder, ISSUE 9).
+        obs.flight.dump_flight_record(
+            f"serving worker thread died (replica={self.replica_index}, "
+            f"inflight={len(inflight)}, queued={len(drained)})", exc,
+        )
         err = ServerDegraded(f"serving worker thread died: {exc!r}")
         err.__cause__ = exc
         for r in inflight + drained:
@@ -357,8 +416,9 @@ class MicroBatchServer:
             faults.maybe_fail(faults.SITE_SERVING_EXECUTE)
             outs, info = self.plan.apply_batch_info([r.x for r in batch])
         except BaseException as e:  # noqa: BLE001 — re-raised submitter-side
+            opened = False
             with self._lock:
-                self.failed += len(batch)
+                self._failed.add(len(batch))
                 if self.breaker_threshold:
                     self._consecutive_failures += 1
                     if self._breaker_probing and any(
@@ -376,14 +436,25 @@ class MicroBatchServer:
                         self._breaker_probing = False
                         self._breaker_open = True
                         self._breaker_opened_t = time.perf_counter()
-                        self.breaker_opens += 1
+                        self._breaker_opens.add(1)
+                        opened = True
                     elif (
                         self._consecutive_failures >= self.breaker_threshold
                         and not self._breaker_open
                     ):
                         self._breaker_open = True
                         self._breaker_opened_t = time.perf_counter()
-                        self.breaker_opens += 1
+                        self._breaker_opens.add(1)
+                        opened = True
+            if opened:
+                # Postmortem context rides the log beside the open: the
+                # recent spans/decisions and anything still in flight
+                # (obs flight recorder, ISSUE 9).
+                obs.flight.dump_flight_record(
+                    f"serving circuit breaker OPENED (replica="
+                    f"{self.replica_index}, consecutive_failures="
+                    f"{self._consecutive_failures})", e,
+                )
             for r in batch:
                 r.resolve(exc=e)
             return
@@ -395,6 +466,18 @@ class MicroBatchServer:
             self._breaker_probing = False
         t1 = time.perf_counter()
         exec_s = t1 - t0
+        # Bridge into the run trace (one branch when disabled): one span
+        # per request (enqueue -> completion, the end-to-end latency the
+        # SLO gates) on the serving worker's track, plus a batch span.
+        # The rolling SpanLog/stats() machinery keeps working unchanged
+        # — the tracer is the correlated view, not a replacement.
+        tracer = obs.active_tracer()
+        if tracer is not None:
+            tracer.add_span(
+                "serving.batch", t0, t1, batch_size=info.batch_size,
+                bucket=info.bucket, pad_fraction=info.pad_fraction,
+                replica=self.replica_index,
+            )
         for i, r in enumerate(batch):
             self.span_log.record(profiling.RequestSpan(
                 queue_wait_s=t0 - r.enqueue_t,
@@ -404,9 +487,15 @@ class MicroBatchServer:
                 pad_fraction=info.pad_fraction,
                 replica=self.replica_index,
             ))
+            if tracer is not None:
+                tracer.add_span(
+                    "serving.request", r.enqueue_t, t1,
+                    queue_wait_s=t0 - r.enqueue_t, exec_s=exec_s,
+                    bucket=info.bucket, replica=self.replica_index,
+                )
             with self._lock:
-                self._latencies_s.append(t1 - r.enqueue_t)
-                self.completed += 1
+                self._latencies.observe(t1 - r.enqueue_t)
+                self._completed.add(1)
                 if self._first_done_t is None:
                     self._first_done_t = t1
                 self._last_done_t = t1
@@ -434,7 +523,7 @@ class MicroBatchServer:
         ``max_wait_ms``/``max_queue_depth`` (or another replica), exec
         blowing up wants a smaller ``max_batch`` or a faster plan."""
         with self._lock:
-            lat = list(self._latencies_s)
+            lat = self._latencies.snapshot_values()
             completed, rejected, failed = (
                 self.completed, self.rejected, self.failed
             )

@@ -69,6 +69,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
+from keystone_tpu import obs
 from keystone_tpu.utils import faults, profiling
 
 from .batcher import (
@@ -478,6 +479,14 @@ class ReplicatedServer:
             "(%d) exhausted — the plane is degraded to %d replicas",
             rep.index, self.restart_budget,
             sum(1 for r in self._replicas if not r.evicted),
+        )
+        # Watchdog eviction is a postmortem moment: dump the flight
+        # record (recent spans, breaker events, in-flight work) beside
+        # the eviction so the degradation has a causal trail (ISSUE 9).
+        obs.flight.dump_flight_record(
+            f"serving replica {rep.index} permanently evicted "
+            f"(restart budget {self.restart_budget} exhausted)",
+            log=logger,
         )
         return False
 

@@ -48,6 +48,15 @@ test over the whole package (``tests/test_lint.py``):
     ``{"metric": ..., "value": ..., "detail": ...}`` dict literal bypasses
     every convention check.
 
+``metric-name``
+    Every :class:`~keystone_tpu.obs.metrics.MetricsRegistry`
+    register/lookup site (``*.counter(...)`` / ``*.gauge(...)`` /
+    ``*.histogram(...)``) must use a dotted name present in the
+    ``METRIC_*`` catalogue of :mod:`keystone_tpu.obs.metrics` — parsed,
+    never imported, exactly like the fault-site registry. A metric name
+    invented at a call site silently forks the dashboard namespace; the
+    catalogue is the one place names exist.
+
 Findings are ``path:line: [rule] message``; the CLI exits 1 on any.
 """
 
@@ -67,6 +76,7 @@ RULES = (
     "retry-transient",
     "fault-site",
     "bench-row",
+    "metric-name",
 )
 
 _JAX_NAMES = {"jax", "jnp"}
@@ -95,22 +105,43 @@ def _faults_module_path() -> Path:
     return Path(__file__).resolve().parent.parent / "utils" / "faults.py"
 
 
-def fault_site_registry(path: Optional[Path] = None) -> Dict[str, str]:
-    """``{SITE_ATTR_NAME: "site.string"}`` parsed from faults.py."""
-    src = (path or _faults_module_path()).read_text()
-    tree = ast.parse(src)
+def _metrics_module_path() -> Path:
+    return Path(__file__).resolve().parent.parent / "obs" / "metrics.py"
+
+
+def _parse_prefixed_constants(path: Path, prefix: str) -> Dict[str, str]:
+    """``{ATTR_NAME: "string value"}`` for top-level ``PREFIX_* = "..."``
+    assignments — the shared not-imported parsing both registries
+    (fault sites, metric names) use, so the linter works on a broken
+    tree."""
+    tree = ast.parse(path.read_text())
     registry: Dict[str, str] = {}
     for node in tree.body:
         if (
             isinstance(node, ast.Assign)
             and len(node.targets) == 1
             and isinstance(node.targets[0], ast.Name)
-            and node.targets[0].id.startswith("SITE_")
+            and node.targets[0].id.startswith(prefix)
             and isinstance(node.value, ast.Constant)
             and isinstance(node.value.value, str)
         ):
             registry[node.targets[0].id] = node.value.value
     return registry
+
+
+def fault_site_registry(path: Optional[Path] = None) -> Dict[str, str]:
+    """``{SITE_ATTR_NAME: "site.string"}`` parsed from faults.py."""
+    return _parse_prefixed_constants(
+        path or _faults_module_path(), "SITE_"
+    )
+
+
+def metric_name_registry(path: Optional[Path] = None) -> Dict[str, str]:
+    """``{METRIC_ATTR_NAME: "dotted.name"}`` parsed from
+    obs/metrics.py — never imported, exactly like the fault sites."""
+    return _parse_prefixed_constants(
+        path or _metrics_module_path(), "METRIC_"
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -503,6 +534,64 @@ def _check_fault_sites(
 
 
 # ---------------------------------------------------------------------------
+# Rule: metric-name
+# ---------------------------------------------------------------------------
+
+_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+
+
+def _check_metric_names(
+    tree: ast.Module, path: str, registry: Dict[str, str]
+) -> List[Finding]:
+    """Every ``*.counter(name, ...)`` / ``*.gauge(...)`` /
+    ``*.histogram(...)`` whose first argument is a string literal or a
+    ``METRIC_*`` reference must resolve into the parsed catalogue. A
+    first argument that is neither (a variable, an f-string) is left
+    alone — only literal names can be checked statically, and those are
+    the overwhelming call-site form."""
+    findings: List[Finding] = []
+    names = set(registry)
+    values = set(registry.values())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # Only attribute calls: bare ``counter(...)`` (e.g. a local
+        # helper, itertools.count-style factories) is not a registry
+        # lookup; every registry site reads ``<registry>.counter``.
+        if not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _REGISTRY_METHODS:
+            continue
+        if not node.args:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in values:
+                findings.append(Finding(
+                    path, node.lineno, "metric-name",
+                    f"metric name {arg.value!r} is not in the METRIC_* "
+                    "catalogue of keystone_tpu/obs/metrics.py — register "
+                    "it there (one place names exist) instead of "
+                    "inventing it at the call site",
+                ))
+        else:
+            ref = (
+                arg.attr if isinstance(arg, ast.Attribute)
+                else arg.id if isinstance(arg, ast.Name)
+                else None
+            )
+            if ref is not None and ref.startswith("METRIC_") \
+                    and ref not in names:
+                findings.append(Finding(
+                    path, node.lineno, "metric-name",
+                    f"{ref} is not defined in keystone_tpu/obs/"
+                    f"metrics.py (known: {len(names)} catalogue "
+                    "entries)",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Rule: bench-row
 # ---------------------------------------------------------------------------
 
@@ -561,11 +650,14 @@ def lint_file(
     path: Path,
     registry: Optional[Dict[str, str]] = None,
     rules: Optional[Sequence[str]] = None,
+    metric_registry: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
     """Lint one file; returns findings (parse failures are findings too —
     a file the linter cannot read is a file nothing checks)."""
     if registry is None:
         registry = fault_site_registry()
+    if metric_registry is None:
+        metric_registry = metric_name_registry()
     src = path.read_text()
     try:
         tree = ast.parse(src)
@@ -588,6 +680,13 @@ def lint_file(
             findings.extend(_check_fault_sites(tree, sp, registry))
     if "bench-row" in enabled:
         findings.extend(_check_bench_rows(tree, sp))
+    if "metric-name" in enabled:
+        # obs/metrics.py itself defines the catalogue; skip it (parity
+        # with the faults.py exemption above).
+        if not (path.name == "metrics.py" and path.parent.name == "obs"):
+            findings.extend(
+                _check_metric_names(tree, sp, metric_registry)
+            )
     return findings
 
 
@@ -604,11 +703,15 @@ def lint_paths(
     rules: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     registry = fault_site_registry()
+    metric_registry = metric_name_registry()
     findings: List[Finding] = []
     for f in _iter_py(paths):
         if "__pycache__" in f.parts:
             continue
-        findings.extend(lint_file(f, registry=registry, rules=rules))
+        findings.extend(lint_file(
+            f, registry=registry, rules=rules,
+            metric_registry=metric_registry,
+        ))
     return findings
 
 

@@ -1,0 +1,192 @@
+"""Trace-dir summarizer CLI: ``python -m keystone_tpu.tools.trace <dir>``
+(wrapped by ``bin/trace``).
+
+Reads the compact ``events.jsonl`` a traced run wrote
+(``KEYSTONE_TRACE=dir`` / ``run.py --trace=dir`` / ``obs.tracing(dir)``)
+and prints the three views a postmortem starts from:
+
+  - **Top spans by self-time**: per span name, total wall minus the wall
+    of same-thread children — where time actually went, not where it
+    was merely enclosed.
+  - **Per-lane occupancy**: busy fraction of each IO lane
+    (``runtime.task`` spans grouped by their ``lane`` attr) over the
+    trace's wall — the overlap picture at a glance.
+  - **Cost-decision table**: every ``cost.decision`` event — decision
+    kind, winner, reason, and the feasible/infeasible candidate split —
+    the audit trail for "why did the optimizer run THIS engine".
+
+``--perfetto OUT.json`` (re-)emits the Chrome-trace projection from the
+JSONL rows (e.g. after post-processing, or when only the event log was
+shipped off-box). Exits non-zero on an unreadable/invalid trace dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional, Sequence
+
+from keystone_tpu.obs.export import (
+    load_events,
+    to_chrome_trace,
+    validate_chrome_trace,
+)
+
+__all__ = ["main", "summarize"]
+
+
+def _self_times(spans: List[Dict[str, Any]]) -> Dict[str, Dict[str, float]]:
+    """Per span NAME: count, total wall, total SELF wall (dur minus
+    same-thread children's dur)."""
+    child_dur: Dict[Any, int] = defaultdict(int)
+    for s in spans:
+        if s.get("parent_id") is not None:
+            child_dur[s["parent_id"]] += s.get("dur_us", 0)
+    agg: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"count": 0, "total_s": 0.0, "self_s": 0.0}
+    )
+    for s in spans:
+        dur = s.get("dur_us", 0)
+        row = agg[s["name"]]
+        row["count"] += 1
+        row["total_s"] += dur / 1e6
+        row["self_s"] += max(dur - child_dur.get(s["span_id"], 0), 0) / 1e6
+    return dict(agg)
+
+
+def _lane_occupancy(
+    spans: List[Dict[str, Any]], wall_s: float
+) -> Dict[str, Dict[str, float]]:
+    lanes: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"busy_s": 0.0, "tasks": 0}
+    )
+    for s in spans:
+        if s["name"] != "runtime.task":
+            continue
+        lane = (s.get("args") or {}).get("lane", "?")
+        lanes[lane]["busy_s"] += s.get("dur_us", 0) / 1e6
+        lanes[lane]["tasks"] += 1
+    for row in lanes.values():
+        row["occupancy"] = (row["busy_s"] / wall_s) if wall_s > 0 else 0.0
+    return dict(lanes)
+
+
+def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """The structured summary the CLI renders (and tests assert on)."""
+    spans = [r for r in records if r.get("type") == "span"]
+    events = [r for r in records if r.get("type") == "event"]
+    run_ids = sorted({r["run_id"] for r in records if r.get("run_id")})
+    if spans:
+        t0 = min(s["ts_us"] for s in spans)
+        t1 = max(s["ts_us"] + s.get("dur_us", 0) for s in spans)
+        wall_s = (t1 - t0) / 1e6
+    else:
+        wall_s = 0.0
+    return {
+        "run_ids": run_ids,
+        "wall_s": wall_s,
+        "num_spans": len(spans),
+        "num_events": len(events),
+        "self_times": _self_times(spans),
+        "lanes": _lane_occupancy(spans, wall_s),
+        "cost_decisions": [
+            e.get("args", {}) for e in events
+            if e.get("name") == "cost.decision"
+        ],
+    }
+
+
+def _render(summary: Dict[str, Any], top: int) -> str:
+    lines: List[str] = []
+    lines.append(
+        f"run {', '.join(summary['run_ids']) or '?'}: "
+        f"{summary['num_spans']} spans, {summary['num_events']} events, "
+        f"wall {summary['wall_s']:.3f}s"
+    )
+    lines.append("")
+    lines.append(f"top {top} spans by self-time:")
+    lines.append(f"  {'name':<32} {'count':>6} {'total_s':>9} {'self_s':>9}")
+    ranked = sorted(
+        summary["self_times"].items(),
+        key=lambda kv: kv[1]["self_s"], reverse=True,
+    )[:top]
+    for name, row in ranked:
+        lines.append(
+            f"  {name:<32} {row['count']:>6} {row['total_s']:>9.3f} "
+            f"{row['self_s']:>9.3f}"
+        )
+    if summary["lanes"]:
+        lines.append("")
+        lines.append("per-lane occupancy (runtime.task):")
+        for lane, row in sorted(summary["lanes"].items()):
+            lines.append(
+                f"  {lane:<12} tasks={int(row['tasks']):>5} "
+                f"busy={row['busy_s']:.3f}s "
+                f"occupancy={row['occupancy']:.1%}"
+            )
+    decisions = summary["cost_decisions"]
+    if decisions:
+        lines.append("")
+        lines.append("cost decisions:")
+        for d in decisions:
+            cands = d.get("candidates", [])
+            feas = sum(1 for c in cands if c.get("feasible"))
+            lines.append(
+                f"  {d.get('decision', '?'):<24} winner="
+                f"{d.get('winner', '?')} reason={d.get('reason', '?')} "
+                f"({feas}/{len(cands)} candidates feasible)"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "keystone-trace", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("trace_dir", help="directory a traced run wrote")
+    parser.add_argument("--top", type=int, default=12,
+                        help="span names in the self-time table")
+    parser.add_argument("--perfetto", default="",
+                        help="also (re-)emit the Chrome-trace JSON here")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+    try:
+        records = load_events(args.trace_dir)
+    except OSError as e:
+        print(f"trace: cannot read {args.trace_dir!r}: {e}",
+              file=sys.stderr)
+        return 1
+    if not records:
+        print(f"trace: {args.trace_dir!r} holds no events",
+              file=sys.stderr)
+        return 1
+    print(_render(summarize(records), args.top))
+    if args.perfetto:
+        doc = to_chrome_trace(records)
+        problems = validate_chrome_trace(doc)
+        if problems:
+            print("trace: refusing to emit an invalid Chrome trace:",
+                  file=sys.stderr)
+            for p in problems[:10]:
+                print(f"  {p}", file=sys.stderr)
+            return 1
+        out_dir = os.path.dirname(os.path.abspath(args.perfetto))
+        os.makedirs(out_dir, exist_ok=True)
+        with open(args.perfetto, "w") as f:
+            json.dump(doc, f)
+        print(f"\nperfetto trace written: {args.perfetto} "
+              f"(load at https://ui.perfetto.dev)")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # Piping the summary through `head` is the normal postmortem
+        # workflow; a closed pipe is not an error worth a traceback.
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

@@ -127,9 +127,13 @@ def overlap_report(stats) -> Dict[str, Dict[str, Optional[float]]]:
     serial ``prefetch_depth=0`` leg records busy == wait for ``read``,
     so the oracle path reads 0 overlap by construction. This is what
     makes a fold-floor claim (the Amazon 131.4 s) auditable per phase:
-    wall − compute.busy must be accounted for by the visible waits."""
-    busy = dict(getattr(stats, "site_busy_s", {}) or {})
-    wait = dict(getattr(stats, "site_wait_s", {}) or {})
+    wall − compute.busy must be accounted for by the visible waits.
+
+    Reads the ``MetricsRegistry`` a real :class:`~keystone_tpu.data.
+    prefetch.PrefetchStats` carries (ISSUE 9 — the registry is the
+    single store); plain objects exposing ``site_busy_s``/``site_wait_s``
+    dicts still work through a deprecated attribute shim."""
+    busy, wait = _site_dicts(stats)
     report: Dict[str, Dict[str, Optional[float]]] = {}
     for site in sorted(set(busy) | set(wait)):
         b = float(busy.get(site, 0.0))
@@ -144,6 +148,41 @@ def overlap_report(stats) -> Dict[str, Dict[str, Optional[float]]]:
     return report
 
 
+def _site_dicts(stats):
+    """(busy, wait) per-site dicts: from the stats object's
+    ``MetricsRegistry`` when it carries one (the PrefetchStats form —
+    the single store), else the deprecated bare-attribute shim for
+    plain objects (kept so pre-registry callers and tests keep
+    working)."""
+    reg = getattr(stats, "registry", None)
+    if reg is not None and hasattr(reg, "values_by_label"):
+        from keystone_tpu.obs.metrics import (
+            METRIC_SITE_BUSY_S,
+            METRIC_SITE_WAIT_S,
+        )
+
+        return (
+            reg.values_by_label(METRIC_SITE_BUSY_S, "site"),
+            reg.values_by_label(METRIC_SITE_WAIT_S, "site"),
+        )
+    _warn_legacy_stats("overlap_report")
+    return (
+        dict(getattr(stats, "site_busy_s", {}) or {}),
+        dict(getattr(stats, "site_wait_s", {}) or {}),
+    )
+
+
+def _warn_legacy_stats(fn_name: str) -> None:
+    import warnings
+
+    warnings.warn(
+        f"{fn_name}: reading bare stats attributes is deprecated — pass "
+        "a PrefetchStats (whose MetricsRegistry is the single metrics "
+        "store, keystone_tpu/obs) instead of a plain object",
+        DeprecationWarning, stacklevel=3,
+    )
+
+
 def prefetch_retry_counters(stats) -> Dict[str, float]:
     """Reliability accounting of one streamed fit's ingestion
     (docs/reliability.md): how many transient read failures the retry
@@ -152,7 +191,25 @@ def prefetch_retry_counters(stats) -> Dict[str, float]:
     :class:`~keystone_tpu.data.prefetch.PrefetchStats`. Zero/zero on a
     healthy run — the steady-state cost of the retry layer is nothing
     but the counters themselves. Nonzero values mean the fit SUCCEEDED
-    over flaky IO; alert on them before they become exhaustions."""
+    over flaky IO; alert on them before they become exhaustions.
+
+    Reads the stats object's ``MetricsRegistry`` when it carries one
+    (ISSUE 9); bare attributes remain as a deprecated shim."""
+    reg = getattr(stats, "registry", None)
+    if reg is not None and hasattr(reg, "snapshot"):
+        from keystone_tpu.obs.metrics import (
+            METRIC_PREFETCH_BACKOFF_S,
+            METRIC_PREFETCH_RETRIES,
+        )
+
+        snap = reg.snapshot()
+        return {
+            "retries": int(snap.get(METRIC_PREFETCH_RETRIES, 0) or 0),
+            "backoff_s": float(
+                snap.get(METRIC_PREFETCH_BACKOFF_S, 0.0) or 0.0
+            ),
+        }
+    _warn_legacy_stats("prefetch_retry_counters")
     return {
         "retries": int(getattr(stats, "retries", 0) or 0),
         "backoff_s": float(getattr(stats, "backoff_s", 0.0) or 0.0),
@@ -214,17 +271,34 @@ class SpanLog:
 def summarize_spans(spans: Sequence["RequestSpan"]) -> Dict[str, float]:
     """The one summary shape for a span collection (SpanLog.summary, the
     per-replica blocks, and callers holding an already-snapshotted list
-    — no second ring copy). Empty dict for no spans."""
+    — no second ring copy). Empty dict for no spans — EXPLICITLY: the
+    empty case is a contract, not a numpy mean-of-empty-slice warning
+    (ISSUE 9 satellite). Non-finite span fields raise ValueError naming
+    the field: a NaN queue wait silently poisons every mean downstream,
+    and numpy would only warn."""
+    spans = list(spans)
     if not spans:
         return {}
     n = float(len(spans))
-    return {
-        "num_spans": len(spans),
-        "mean_queue_wait_s": sum(s.queue_wait_s for s in spans) / n,
-        "mean_exec_s": sum(s.exec_s for s in spans) / n,
-        "mean_batch_size": sum(s.batch_size for s in spans) / n,
-        "mean_pad_fraction": sum(s.pad_fraction for s in spans) / n,
-    }
+    sums = {"mean_queue_wait_s": 0.0, "mean_exec_s": 0.0,
+            "mean_batch_size": 0.0, "mean_pad_fraction": 0.0}
+    for i, s in enumerate(spans):
+        for key, v in (
+            ("mean_queue_wait_s", s.queue_wait_s),
+            ("mean_exec_s", s.exec_s),
+            ("mean_batch_size", s.batch_size),
+            ("mean_pad_fraction", s.pad_fraction),
+        ):
+            v = float(v)
+            if v != v or v in (float("inf"), float("-inf")):
+                raise ValueError(
+                    f"summarize_spans: span {i} has non-finite "
+                    f"{key.replace('mean_', '')} ({v}) — refusing to "
+                    "fold it into the means"
+                )
+            sums[key] += v
+    return {"num_spans": len(spans),
+            **{k: v / n for k, v in sums.items()}}
 
 
 def latency_percentiles(
@@ -232,12 +306,43 @@ def latency_percentiles(
 ) -> Optional[Dict[str, float]]:
     """p-th percentile latencies in SECONDS keyed ``p50``/``p99``/...;
     None for an empty sample (a server that has completed nothing has no
-    percentiles — callers must not report zeros as measurements)."""
+    percentiles — callers must not report zeros as measurements).
+
+    Edge cases are explicit contracts, not numpy warnings (ISSUE 9
+    satellite): a single sample IS every percentile (p50 == p99 ==
+    the sample — documented, tested); an out-of-range ``q`` raises
+    ValueError naming it (numpy's own message names neither the value
+    nor the caller); a NaN/inf sample raises ValueError instead of
+    propagating NaN percentiles under a RuntimeWarning; an empty ``qs``
+    raises rather than returning a vacuous ``{}`` that reads as "no
+    latency problem". Accepts any iterable (a generator no longer
+    TypeErrors on ``len``)."""
+    import math
+
     import numpy as np
 
-    if not len(latencies_s):
+    samples = [float(v) for v in latencies_s]
+    if not samples:
         return None
-    arr = np.asarray(list(latencies_s), dtype=np.float64)
+    qs = list(qs)
+    if not qs:
+        raise ValueError(
+            "latency_percentiles: qs is empty — an empty percentile "
+            "request is a caller bug, not a measurement"
+        )
+    for q in qs:
+        if not 0.0 <= float(q) <= 100.0:
+            raise ValueError(
+                f"latency_percentiles: q={q!r} outside [0, 100]"
+            )
+    bad = [v for v in samples if not math.isfinite(v)]
+    if bad:
+        raise ValueError(
+            f"latency_percentiles: {len(bad)} non-finite sample(s) "
+            f"(first: {bad[0]!r}) — percentiles over NaN/inf are not "
+            "measurements"
+        )
+    arr = np.asarray(samples, dtype=np.float64)
     return {f"p{int(q) if float(q).is_integer() else q}": float(v)
             for q, v in zip(qs, np.percentile(arr, list(qs)))}
 
@@ -246,7 +351,13 @@ def latency_percentiles(
 def trace(log_dir: str):
     """Emit a jax.profiler trace (TensorBoard 'profile' plugin format) for
     everything run inside the context. No-op if the profiler cannot start
-    (e.g. a second concurrent trace)."""
+    (e.g. a second concurrent trace).
+
+    This is the XLA device-timeline leg of the obs plane (ISSUE 9
+    satellite — previously orphaned): ``obs.tracing(dir,
+    xla_profile=True)`` wraps the traced block in it, writing under
+    ``dir/xla`` beside the Perfetto span trace, so the deep-dive XLA
+    view and the host-side span view come from ONE activation."""
     started = False
     try:
         jax.profiler.start_trace(log_dir)

@@ -42,7 +42,15 @@ class GraphExecutor:
     def _ensure_optimized(self) -> Graph:
         if self._optimized_graph is None:
             if self.optimize:
-                graph, prefixes = PipelineEnv.get_or_create().optimizer.execute(self.graph, {})
+                from keystone_tpu import obs
+
+                # The lazy-path analog of Pipeline.fit's fit.optimize
+                # span: pipelines driven through .get()/apply() optimize
+                # HERE, and the optimizer.rule.* spans need this parent
+                # to read as one phase in the trace.
+                with obs.span("executor.optimize",
+                              nodes=len(self.graph.operators)):
+                    graph, prefixes = PipelineEnv.get_or_create().optimizer.execute(self.graph, {})
             else:
                 graph, prefixes = self.graph, self._prefixes or {}
             self._optimized_graph = graph
@@ -80,12 +88,37 @@ class GraphExecutor:
             expression = operator.execute(dep_exprs)
             self._observe(graph, graph_id, operator, dep_exprs, expression)
             self._annotate_failures(graph_id, operator, dep_exprs, expression)
+            self._trace_node(graph_id, operator, expression)
             # Publish results the optimizer marked for prefix-state reuse.
             if self._prefixes and graph_id in self._prefixes:
                 PipelineEnv.get_or_create().state[self._prefixes[graph_id]] = expression
 
         self._execution_state[graph_id] = expression
         return expression
+
+    def _trace_node(self, graph_id, operator, expression) -> None:
+        """Wrap the node's thunk in an ``executor.node`` span (obs
+        plane): lazy pipelines do their real work at first force, on
+        whatever thread demands the value, and deps force inside the
+        thunk — so spans nest into the causal tree the executor actually
+        ran. Wrapped OUTSIDE _observe/_annotate_failures so the span
+        covers the node's full forced wall. One no-op branch per force
+        when tracing is off; ExpressionOperator splices are skipped
+        (their value was computed elsewhere — a span would misattribute
+        it)."""
+        if isinstance(operator, ExpressionOperator):
+            return
+        orig = getattr(expression, "_thunk", None)
+        if orig is None:  # already computed (shared expression)
+            return
+        from keystone_tpu import obs
+
+        def traced():
+            with obs.span("executor.node", node=graph_id.id,
+                          operator=type(operator).__name__):
+                return orig()
+
+        expression._thunk = traced
 
     def _annotate_failures(self, graph_id, operator, dep_exprs, expression) -> None:
         """Wrap the node's thunk so a runtime failure carries the same

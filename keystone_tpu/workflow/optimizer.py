@@ -60,14 +60,31 @@ class RuleExecutor:
     def execute(self, plan: Graph, prefixes: Dict[NodeId, Prefix]) -> Plan:
         cur: Plan = (plan, dict(prefixes))
 
+        from keystone_tpu import obs
+
         for batch in self.batches:
             batch_start = cur
             iteration = 1
             last = cur
             while True:
                 for rule in batch.rules:
-                    result = rule.apply(cur[0], cur[1])
-                    if not _plans_equal(result, cur):
+                    # One span per rule application (obs plane, ISSUE
+                    # 9): the trace shows where optimization wall went
+                    # and which rules changed the plan. The f-string
+                    # name and kwargs are built only when tracing is on
+                    # — the disabled fixpoint loop pays one branch.
+                    if obs.enabled():
+                        with obs.span(
+                            f"optimizer.rule.{rule.rule_name}",
+                            batch=batch.name, iteration=iteration,
+                        ) as sp:
+                            result = rule.apply(cur[0], cur[1])
+                            changed = not _plans_equal(result, cur)
+                            sp.set(changed=changed)
+                    else:
+                        result = rule.apply(cur[0], cur[1])
+                        changed = not _plans_equal(result, cur)
+                    if changed:
                         logger.debug(
                             "=== Applying Rule %s ===\n%s\n%s",
                             rule.rule_name,

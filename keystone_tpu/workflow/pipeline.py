@@ -181,32 +181,56 @@ class Pipeline(Chainable[A, B]):
         API would have raised — fails HERE with node-level coordinates,
         not deep inside an estimator fit. ``KEYSTONE_VERIFY=off``
         disables the pre-pass."""
+        from keystone_tpu import obs
+
         from .env import PipelineEnv
         from .rules import UnusedBranchRemovalRule
         from .verify import verify_fit_graph
 
-        verify_fit_graph(self.executor.graph, context="Pipeline.fit plan")
-        optimized, prefixes = PipelineEnv.get_or_create().optimizer.execute(
-            self.executor.graph, {}
-        )
+        with obs.span("pipeline.fit",
+                      nodes=len(self.executor.graph.operators)):
+            with obs.span("fit.verify"):
+                verify_fit_graph(
+                    self.executor.graph, context="Pipeline.fit plan"
+                )
+            with obs.span("fit.optimize"):
+                optimized, prefixes = (
+                    PipelineEnv.get_or_create().optimizer.execute(
+                        self.executor.graph, {}
+                    )
+                )
 
-        # Publish fitted state into the prefix table so later pipelines reuse it.
-        fitting_executor = GraphExecutor(optimized, optimize=False, prefixes=prefixes)
-        delegating_nodes = [
-            n for n, op in optimized.operators.items() if isinstance(op, DelegatingOperator)
-        ]
+            # Publish fitted state into the prefix table so later
+            # pipelines reuse it.
+            fitting_executor = GraphExecutor(
+                optimized, optimize=False, prefixes=prefixes
+            )
+            delegating_nodes = [
+                n for n, op in optimized.operators.items()
+                if isinstance(op, DelegatingOperator)
+            ]
 
-        graph = optimized
-        for node in delegating_nodes:
-            deps = optimized.get_dependencies(node)
-            estimator_dep = deps[0]
-            transformer = fitting_executor.execute(estimator_dep).get()
-            if not isinstance(transformer, TransformerOperator):
-                raise TypeError("Estimator fit did not produce a TransformerOperator")
-            graph = graph.set_operator(node, transformer).set_dependencies(node, deps[1:])
+            graph = optimized
+            for node in delegating_nodes:
+                deps = optimized.get_dependencies(node)
+                estimator_dep = deps[0]
+                est_op = optimized.get_operator(estimator_dep)
+                with obs.span("fit.estimator", node=estimator_dep.id,
+                              operator=type(est_op).__name__):
+                    transformer = (
+                        fitting_executor.execute(estimator_dep).get()
+                    )
+                if not isinstance(transformer, TransformerOperator):
+                    raise TypeError(
+                        "Estimator fit did not produce a TransformerOperator"
+                    )
+                graph = graph.set_operator(node, transformer) \
+                    .set_dependencies(node, deps[1:])
 
-        graph, _ = UnusedBranchRemovalRule().apply(graph, {})
-        return FittedPipeline(TransformerGraph.from_graph(graph), self.source, self.sink)
+            graph, _ = UnusedBranchRemovalRule().apply(graph, {})
+            return FittedPipeline(
+                TransformerGraph.from_graph(graph), self.source, self.sink
+            )
 
     @staticmethod
     def gather(branches: Sequence["Pipeline[A, B]"]) -> "Pipeline[A, List[B]]":

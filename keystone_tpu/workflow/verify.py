@@ -814,13 +814,18 @@ def verify_fit_graph(graph: Graph, context: str = "pipeline plan") -> None:
     """The default pre-pass ``Pipeline.fit`` and ``Optimizer.execute``
     run: verify, raise :class:`PlanVerificationError` on error-severity
     findings, log warnings. Honors ``KEYSTONE_VERIFY``."""
+    from keystone_tpu import obs
+
     mode = verification_mode()
     if mode == "off":
         return
     if _recently_verified(graph):
         return
-    report = verify_graph(graph, strict=(mode == "strict"))
-    report.raise_if_errors(context)
+    with obs.span("verify.pre_pass", context=context, mode=mode,
+                  nodes=len(graph.operators)) as sp:
+        report = verify_graph(graph, strict=(mode == "strict"))
+        sp.set(warnings=len(report.warnings), errors=len(report.errors))
+        report.raise_if_errors(context)
     # Memoize only CLEAN graphs (fit hands the same object straight to
     # the optimizer pre-pass): a failed verification must re-run if the
     # caller retries.

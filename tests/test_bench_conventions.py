@@ -27,6 +27,7 @@ class TestMakeRow:
         assert bench.VALID_TIMING == {
             "min_of_N_warm", "single_run_cold", "single_run_warm",
             "host_only", "open_loop_latency", "recovery_overhead",
+            "overhead_fraction",
         }
 
     def test_row_carries_timing_in_detail(self):

@@ -25,6 +25,7 @@ import pytest
 
 import jax.numpy as jnp
 
+from keystone_tpu import obs
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.learning.cost import (
     EC2_CPU_WEIGHT,
@@ -36,6 +37,7 @@ from keystone_tpu.ops.learning.cost import (
     TPU_NETWORK_WEIGHT,
     TransformerLabelEstimatorChain,
     active_weights,
+    candidate_label,
     sparse_gather_overhead,
 )
 from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
@@ -67,6 +69,32 @@ def _cost_of(est, opt, n, d, k, sparsity=1.0, machines=1):
     )
 
 
+def _optimize_audited(est, s, ls):
+    """Run the selection under tracing and return (chosen, the ONE
+    ``least_squares_solver`` CostDecision event) — the trace-backed
+    audit leg (ISSUE 9): every replay assertion below also asserts the
+    recorded winner matches what the selector returned."""
+    with obs.tracing() as t:
+        chosen = est.optimize(s, ls)
+    decisions = [
+        e for e in t.events
+        if e["type"] == "event" and e["name"] == "cost.decision"
+        and e["args"]["decision"] == "least_squares_solver"
+    ]
+    assert len(decisions) == 1, decisions
+    args = decisions[0]["args"]
+    # The event is self-consistent evidence: every candidate priced,
+    # the winner present in the candidate set, geometry recorded.
+    labels = [c["label"] for c in args["candidates"]]
+    assert args["winner"] in labels
+    assert len(labels) == len(est.options)
+    return chosen, args
+
+
+def _audit_winner(args, expected_estimator) -> None:
+    assert args["winner"] == candidate_label(expected_estimator), args
+
+
 class TestReplayTimitResident:
     # BENCH_r05 timit_resident_262k: device 0.327 s, block BCD, bf16
     # features. The capacity models price conservative f32 (+ centered
@@ -84,11 +112,13 @@ class TestReplayTimitResident:
             lam=1e-4, hbm_bytes=48 << 30, num_machines=1
         )
         s, ls = _dense_sample(self.N, self.D, self.K)
-        chosen = est.optimize(s, ls)
+        chosen, audit = _optimize_audited(est, s, ls)
         assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
         assert isinstance(chosen.estimator, BlockLeastSquaresEstimator), (
             type(chosen.estimator).__name__
         )
+        _audit_winner(audit, chosen.estimator)
+        assert audit["reason"] == "argmin"
 
     def test_measured_orderings_reproduced(self):
         est = LeastSquaresEstimator(
@@ -117,8 +147,15 @@ class TestReplayTimitFullN:
             lam=1e-4, hbm_bytes=16 << 30, num_machines=1
         )
         s, ls = _dense_sample(2_200_000, 16_384, 147)
-        chosen = est.optimize(s, ls)
+        chosen, audit = _optimize_audited(est, s, ls)
         assert isinstance(chosen, StreamingLeastSquaresChoice), chosen
+        _audit_winner(audit, chosen)
+        # The audit records WHY: every resident candidate priced
+        # infeasible at this geometry, the streamed tier feasible.
+        feas = {c["label"]: c["feasible"] for c in audit["candidates"]}
+        assert feas[candidate_label(chosen)]
+        assert not feas["DenseLBFGSwithL2"]
+        assert not feas["BlockLeastSquaresEstimator"]
 
 
 class TestReplayAmazonSparse:
@@ -146,10 +183,11 @@ class TestReplayAmazonSparse:
             lam=1e-3, hbm_bytes=16 << 30, num_machines=1
         )
         s, ls = self._sample()
-        chosen = est.optimize(s, ls)
+        chosen, audit = _optimize_audited(est, s, ls)
         assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
         inner = chosen.estimator
         assert isinstance(inner, SparseLBFGSwithL2) and inner.solver == "gram"
+        _audit_winner(audit, inner)  # "SparseLBFGSwithL2[gram]"
         sparsity = self.NNZ / self.D
         gather = SparseLBFGSwithL2(
             lam=1e-3, num_iterations=20, solver="gather"
@@ -208,11 +246,17 @@ class TestReplayAmazonCompressedResident:
             host_budget_bytes=64 << 30,
         )
         s, ls = self._sample()
-        chosen = est.optimize(s, ls)
+        chosen, audit = _optimize_audited(est, s, ls)
         assert isinstance(chosen, TransformerLabelEstimatorChain), chosen
         inner = chosen.estimator
         assert isinstance(inner, SparseLBFGSwithL2)
         assert inner.solver == "gram" and inner.compress == "int16_bf16"
+        _audit_winner(audit, inner)  # "SparseLBFGSwithL2[gram,int16_bf16]"
+        # The audit shows the capacity cut doing the work: the raw gram
+        # engine priced infeasible, the compressed storage class feasible.
+        feas = {c["label"]: c["feasible"] for c in audit["candidates"]}
+        assert not feas["SparseLBFGSwithL2[gram]"]
+        assert feas["SparseLBFGSwithL2[gram,int16_bf16]"]
 
     def test_feasibility_is_what_flips_the_choice(self):
         # The storage classes at this geometry, priced directly: raw COO
@@ -243,10 +287,11 @@ class TestReplayAmazonCompressedResident:
             lam=1e-3, hbm_bytes=16 << 30, num_machines=1
         )
         s, ls = TestReplayAmazonSparse()._sample()
-        chosen = est.optimize(s, ls)
+        chosen, audit = _optimize_audited(est, s, ls)
         inner = chosen.estimator
         assert isinstance(inner, SparseLBFGSwithL2)
         assert inner.solver == "gram" and inner.compress is None
+        _audit_winner(audit, inner)  # raw engine wins the tie on record
 
 
 class TestWeightFamilySwitch:

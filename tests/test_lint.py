@@ -12,6 +12,7 @@ from keystone_tpu.tools.lint import (
     fault_site_registry,
     lint_file,
     lint_paths,
+    metric_name_registry,
 )
 
 
@@ -330,6 +331,75 @@ def read():
         root = default_paths()[0].parent
         findings = lint_file(root / "tests" / "test_faults.py")
         assert not findings
+
+
+class TestMetricNameRule:
+    def test_fires_on_invented_string_name(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.obs.metrics import MetricsRegistry
+
+reg = MetricsRegistry()
+reg.counter("my.forked.metric").add(1)
+""")
+        assert _codes(findings) == ["metric-name"]
+        assert "my.forked.metric" in findings[0].message
+
+    def test_fires_on_unknown_metric_attribute(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu import obs
+from keystone_tpu.obs.metrics import METRIC_DOES_NOT_EXIST
+
+reg = obs.MetricsRegistry()
+reg.gauge(METRIC_DOES_NOT_EXIST).set(1)
+""")
+        assert _codes(findings) == ["metric-name"]
+        assert "METRIC_DOES_NOT_EXIST" in findings[0].message
+
+    def test_catalogue_names_are_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+from keystone_tpu import obs
+from keystone_tpu.obs.metrics import METRIC_PREFETCH_RETRIES
+
+reg = obs.MetricsRegistry()
+reg.counter(METRIC_PREFETCH_RETRIES).add(1)
+reg.counter("overlap.site_busy_s", site="read").add(0.5)
+reg.histogram("serving.latency_s").observe(0.1)
+""")
+
+    def test_dynamic_names_are_not_checked(self, tmp_path):
+        # Only literal names can be checked statically; a variable or
+        # f-string first argument passes through (the tracer's counter
+        # TRACKS — e.g. f"runtime.{site}.queued" — are a different
+        # namespace from registry metrics).
+        assert not _lint_snippet(tmp_path, """
+def track(reg, site):
+    reg.counter(f"runtime.{site}.queued")
+    name = "runtime.lane.tasks"
+    reg.counter(name)
+""")
+
+    def test_non_registry_calls_are_ignored(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import numpy as np
+
+def stats(x):
+    return np.histogram(x, bins=4)
+""")
+
+    def test_registry_matches_obs_metrics_module(self):
+        from keystone_tpu.obs import metrics as obs_metrics
+
+        parsed = metric_name_registry()
+        imported = {
+            name: value for name, value in vars(obs_metrics).items()
+            if name.startswith("METRIC_") and isinstance(value, str)
+        }
+        assert parsed == imported
+        # Dotted-name discipline: every catalogue entry is lowercase
+        # dotted (dashboard-safe) and unique.
+        assert len(set(parsed.values())) == len(parsed)
+        for v in parsed.values():
+            assert "." in v and v == v.lower(), v
 
 
 class TestBenchRowRule:

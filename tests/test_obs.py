@@ -1,0 +1,452 @@
+"""Unit coverage of the obs plane (ISSUE 9): tracer semantics, the
+zero-cost-when-disabled contract, the metrics registry, Chrome-trace
+export + schema validation, and the flight recorder."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu import obs
+from keystone_tpu.obs import tracer as tracer_mod
+from keystone_tpu.obs.metrics import (
+    METRIC_RUNTIME_LANE_TASKS,
+    METRIC_SERVING_LATENCY_S,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer():
+    """A test that dies inside obs.tracing must not leave the process
+    tracer active for the rest of the suite."""
+    yield
+    tracer_mod._ACTIVE = None
+
+
+class TestTracerSpans:
+    def test_nesting_and_parent_links(self):
+        with obs.tracing() as t:
+            with obs.span("outer", a=1):
+                with obs.span("inner"):
+                    pass
+            with obs.span("sibling"):
+                pass
+        outer = t.spans("outer")[0]
+        inner = t.spans("inner")[0]
+        sibling = t.spans("sibling")[0]
+        assert inner["parent_id"] == outer["span_id"]
+        assert outer["parent_id"] is None
+        assert sibling["parent_id"] is None
+        assert outer["args"] == {"a": 1}
+
+    def test_one_run_id_stamps_every_record(self):
+        with obs.tracing() as t:
+            with obs.span("s"):
+                pass
+            obs.event("e", x=1)
+            obs.counter_track("c", 2.0)
+        assert {r["run_id"] for r in t.events if "run_id" in r} == {
+            t.run_id
+        }
+
+    def test_thread_spans_record_own_thread_and_no_cross_parent(self):
+        with obs.tracing() as t:
+            with obs.span("main.outer"):
+                th = threading.Thread(
+                    target=lambda: obs.span("worker.task").__enter__()
+                    .__exit__(None, None, None),
+                    name="obs-test-worker",
+                )
+                th.start()
+                th.join()
+        worker = t.spans("worker.task")[0]
+        assert worker["thread"] == "obs-test-worker"
+        # A worker-thread span does NOT parent onto another thread's
+        # open span — nesting is per thread.
+        assert worker["parent_id"] is None
+
+    def test_span_set_and_error_capture(self):
+        with obs.tracing() as t:
+            with pytest.raises(ValueError):
+                with obs.span("failing") as sp:
+                    sp.set(extra=7)
+                    raise ValueError("boom")
+        rec = t.spans("failing")[0]
+        assert rec["args"]["extra"] == 7
+        assert "ValueError: boom" in rec["error"]
+
+    def test_inflight_names_open_spans(self):
+        with obs.tracing() as t:
+            with obs.span("held"):
+                names = [s["name"] for s in t.inflight()]
+                assert names == ["held"]
+            assert t.inflight() == []
+
+    def test_add_span_retroactive(self):
+        with obs.tracing() as t:
+            t0 = time.perf_counter()
+            t.add_span("served", t0, t0 + 0.25, bucket=4)
+        rec = t.spans("served")[0]
+        assert 240_000 <= rec["dur_us"] <= 260_000
+        assert rec["args"]["bucket"] == 4
+
+    def test_bounded_records_roll_off_oldest_and_count(self):
+        # A traced long-lived process (serve under load) must not grow
+        # memory without bound: at capacity the OLDEST records roll off
+        # and the drop is counted, never silent.
+        t = tracer_mod.Tracer(max_records=4)
+        tracer_mod._ACTIVE = t
+        try:
+            for i in range(7):
+                with obs.span(f"s{i}"):
+                    pass
+        finally:
+            tracer_mod._ACTIVE = None
+        names = [r["name"] for r in t.events]
+        assert names == ["s3", "s4", "s5", "s6"]
+        assert t.dropped == 3
+
+    def test_nested_activation_raises(self):
+        with obs.tracing():
+            with pytest.raises(RuntimeError, match="already active"):
+                with obs.tracing():
+                    pass
+
+    def test_tracing_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_TRACE", str(tmp_path / "tr"))
+        with obs.tracing_from_env():
+            with obs.span("env.span"):
+                pass
+        events = obs.load_events(str(tmp_path / "tr"))
+        assert [e["name"] for e in events] == ["env.span"]
+        monkeypatch.delenv("KEYSTONE_TRACE")
+        with obs.tracing_from_env():
+            assert not obs.enabled()  # unset env -> no-op context
+
+
+class TestDisabledIsFree:
+    def test_disabled_span_is_the_shared_noop(self):
+        # One branch, one shared object: the disabled hook allocates no
+        # span, no timestamps, takes no lock.
+        assert not obs.enabled()
+        assert obs.span("a") is obs.span("b", attr=1)
+        obs.event("nothing")   # no tracer: swallowed
+        obs.counter_track("nothing", 1.0)
+        assert obs.active_tracer() is None
+
+    def test_disabled_hook_cost_is_sub_microsecond_scale(self):
+        """The streamed-fold regression leg: a fold step's hook budget.
+        The disabled path must cost so little per call that the fold
+        loop (ms-scale dispatches) cannot measure it. Bound generously
+        for a noisy CI box — the contract is 'no measurable overhead',
+        pinned here as a per-hook ceiling of 20µs min-of-5 over 20k
+        calls (two orders of magnitude below one fold dispatch)."""
+        assert not obs.enabled()
+
+        def hooked_loop(reps):
+            t0 = time.perf_counter()
+            for i in range(reps):
+                with obs.span("fold.segment", chunk0=i):
+                    pass
+            return time.perf_counter() - t0
+
+        best = min(hooked_loop(20_000) for _ in range(5))
+        assert best / 20_000 < 20e-6, f"{best / 20_000 * 1e6:.2f}us/hook"
+
+    def test_disabled_fold_matches_hookless_fold(self):
+        """Tracing OFF adds no measurable overhead to the streamed-fold
+        regression path: the same tiny segment fold with the obs hooks
+        live (disabled) vs monkey-bypassed entirely, min-of-5 each,
+        within generous CI noise tolerance."""
+        from keystone_tpu.data.prefetch import (
+            PrefetchStats,
+            ResidentDenseSource,
+        )
+        from keystone_tpu.ops.learning.streaming_ls import (
+            CosineBankFeaturize,
+        )
+        from keystone_tpu.parallel import streaming
+
+        rng = np.random.default_rng(0)
+        n, d_in, d_feat, k = 2048, 16, 128, 3
+        X = rng.normal(size=(n, d_in)).astype(np.float32)
+        Y = rng.normal(size=(n, k)).astype(np.float32)
+        src = ResidentDenseSource(X, Y, tile_rows=256, tiles_per_segment=2)
+        bank = CosineBankFeaturize(
+            rng.normal(size=(d_feat, d_in)).astype(np.float32) * 0.3,
+            rng.uniform(0, 6, d_feat).astype(np.float32),
+        )
+
+        def fit():
+            W, _, _, loss = streaming.streaming_bcd_fit_segments(
+                src, bank=bank, d_feat=d_feat, block_size=32, lam=1e-3,
+                num_iter=1, center=False, prefetch_depth=0,
+                prefetch_stats=PrefetchStats(),
+            )
+            return float(loss)
+
+        fit()  # compile + warm
+        with_hooks = min(self._timed(fit) for _ in range(5))
+        # Bypass every hook: span() returns the noop without even the
+        # one branch — "a build without the hooks". Patch the PACKAGE
+        # attribute (`obs.span`), because that is what every
+        # instrumented seam resolves at call time (`from keystone_tpu
+        # import obs; obs.span(...)`) — patching tracer_mod.span would
+        # leave the hooks live and compare two identical runs.
+        real_span = obs.span
+        assert obs.span is tracer_mod.span  # the seam we bypass below
+        try:
+            obs.span = lambda *a, **kw: tracer_mod._NOOP
+            fit()
+            without = min(self._timed(fit) for _ in range(5))
+        finally:
+            obs.span = real_span
+        # Generous bound: CI wall noise on a ~10ms fit dwarfs the ns of
+        # branch cost; the assertion exists to catch an accidental
+        # always-on allocation (which shows up as 2x+, not 1.5x).
+        assert with_hooks < without * 1.5 + 0.01, (with_hooks, without)
+
+    @staticmethod
+    def _timed(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+
+class TestMetricsRegistry:
+    def test_counter_gauge_histogram_snapshot(self):
+        r = obs.MetricsRegistry()
+        c = r.counter(METRIC_RUNTIME_LANE_TASKS, site="read")
+        c.add(2)
+        c.add()
+        r.gauge("runtime.lane.queued", site="read").set(5)
+        h = r.histogram(METRIC_SERVING_LATENCY_S)
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        snap = r.snapshot()
+        assert snap["runtime.lane.tasks{site=read}"] == 3.0
+        assert snap["runtime.lane.queued{site=read}"] == 5.0
+        assert snap["serving.latency_s.count"] == 3
+        assert snap["serving.latency_s.p50"] == pytest.approx(0.2)
+
+    def test_get_or_create_is_lookup(self):
+        r = obs.MetricsRegistry()
+        assert r.counter("prefetch.retries") is r.counter("prefetch.retries")
+        assert r.counter("prefetch.retries", site="a") is not r.counter(
+            "prefetch.retries", site="b"
+        )
+
+    def test_type_conflict_raises(self):
+        r = obs.MetricsRegistry()
+        r.counter("prefetch.retries")
+        with pytest.raises(TypeError, match="already registered"):
+            r.gauge("prefetch.retries")
+
+    def test_values_by_label(self):
+        r = obs.MetricsRegistry()
+        r.counter("overlap.site_busy_s", site="read").add(1.5)
+        r.counter("overlap.site_busy_s", site="compute").add(2.5)
+        assert r.values_by_label("overlap.site_busy_s", "site") == {
+            "read": 1.5, "compute": 2.5,
+        }
+
+    def test_histogram_edges(self):
+        h = obs.MetricsRegistry().histogram("serving.latency_s")
+        assert h.percentile(99.0) is None  # empty -> None, no warning
+        h.observe(0.7)
+        assert h.percentile(50.0) == 0.7  # single sample IS every pct
+        assert h.percentile(99.0) == 0.7
+        with pytest.raises(ValueError, match=r"\[0, 100\]"):
+            h.percentile(101.0)
+
+    def test_thread_safety_smoke(self):
+        r = obs.MetricsRegistry()
+
+        def work():
+            for _ in range(1000):
+                r.counter("prefetch.retries").add(1)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert r.snapshot()["prefetch.retries"] == 4000.0
+
+
+class TestChromeTraceExport:
+    def _traced(self):
+        with obs.tracing() as t:
+            with obs.span("outer"):
+                with obs.span("inner", k=1):
+                    pass
+            obs.event("cost.decision", winner="x")
+            obs.counter_track("runtime.read.queued", 2)
+        return t
+
+    def test_valid_document(self):
+        t = self._traced()
+        doc = obs.to_chrome_trace(t.events)
+        assert obs.validate_chrome_trace(doc) == []
+        phases = {e["ph"] for e in doc["traceEvents"]}
+        assert {"M", "X", "i", "C"} <= phases
+        assert doc["otherData"]["run_id"] == t.run_id
+
+    def test_span_event_carries_ids_and_args(self):
+        t = self._traced()
+        doc = obs.to_chrome_trace(t.events)
+        inner = [e for e in doc["traceEvents"]
+                 if e.get("name") == "inner"][0]
+        assert inner["args"]["k"] == 1
+        assert inner["args"]["run_id"] == t.run_id
+        assert "parent_id" in inner["args"]
+
+    def test_validator_rejects_malformed(self):
+        assert obs.validate_chrome_trace([]) != []
+        assert obs.validate_chrome_trace({"traceEvents": "nope"}) != []
+        bad_phase = {"traceEvents": [
+            {"name": "x", "ph": "Z", "pid": 1, "tid": 1, "ts": 0}
+        ]}
+        assert any("phase" in v for v in
+                   obs.validate_chrome_trace(bad_phase))
+        no_dur = {"traceEvents": [
+            {"name": "x", "ph": "X", "pid": 1, "tid": 1, "ts": 0}
+        ]}
+        assert any("dur" in v for v in obs.validate_chrome_trace(no_dur))
+        bad_counter = {"traceEvents": [
+            {"name": "c", "ph": "C", "pid": 1, "tid": 0, "ts": 0,
+             "args": {"value": "high"}}
+        ]}
+        assert any("numeric" in v for v in
+                   obs.validate_chrome_trace(bad_counter))
+
+    def test_write_and_load_roundtrip(self, tmp_path):
+        d = str(tmp_path / "trace")
+        with obs.tracing(d) as t:
+            with obs.span("s", n=3):
+                pass
+        events = obs.load_events(d)
+        assert [e["name"] for e in events] == ["s"]
+        assert events[0]["run_id"] == t.run_id
+        doc = json.loads((tmp_path / "trace" / "trace.json").read_text())
+        assert obs.validate_chrome_trace(doc) == []
+        meta = json.loads((tmp_path / "trace" / "meta.json").read_text())
+        assert meta["run_id"] == t.run_id
+        assert meta["counts"]["span"] == 1
+
+
+class TestCostDecisionEvents:
+    def test_recorded_under_tracing(self):
+        with obs.tracing() as t:
+            obs.record_cost_decision(obs.CostDecision(
+                decision="least_squares_solver",
+                winner="BlockLeastSquaresEstimator",
+                candidates=[
+                    {"label": "BlockLeastSquaresEstimator",
+                     "cost_s": 0.3, "feasible": True},
+                    {"label": "DenseLBFGSwithL2", "cost_s": 2.0,
+                     "feasible": True},
+                ],
+                reason="argmin",
+                context={"n": 10, "d": 4},
+            ))
+        evs = [e for e in t.events if e["name"] == "cost.decision"]
+        assert len(evs) == 1
+        args = evs[0]["args"]
+        assert args["winner"] == "BlockLeastSquaresEstimator"
+        assert args["n"] == 10
+        assert len(args["candidates"]) == 2
+
+    def test_noop_when_disabled(self):
+        obs.record_cost_decision(obs.CostDecision(
+            decision="d", winner="w", candidates=[],
+        ))  # must not raise with no tracer active
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self):
+        fr = obs.FlightRecorder(maxlen=4)
+        for i in range(10):
+            fr.note("fault", f"site{i}")
+        names = [r["name"] for r in fr.snapshot()]
+        assert names == ["site6", "site7", "site8", "site9"]
+
+    def test_render_includes_inflight_spans(self):
+        with obs.tracing():
+            with obs.span("long.running"):
+                rendered = obs.render_flight_record()
+                assert "IN FLIGHT: long.running" in rendered
+
+    def test_dump_logs_and_returns_block(self, caplog):
+        import logging
+
+        obs.flight_note("fault", "unit.test", detail="x")
+        with caplog.at_level(logging.WARNING,
+                             logger="keystone_tpu.obs.flight"):
+            block = obs.flight.dump_flight_record(
+                "unit-test death", ValueError("boom")
+            )
+        assert "unit.test" in block
+        assert any("unit-test death" in r.message for r in caplog.records)
+
+    def test_shard_corruption_dumps_flight_record(self, caplog):
+        import logging
+
+        from keystone_tpu.data.durable import ShardCorrupted, verify_array
+
+        with caplog.at_level(logging.WARNING,
+                             logger="keystone_tpu.obs.flight"):
+            with pytest.raises(ShardCorrupted):
+                verify_array(np.zeros(4, np.float32), expected=1,
+                             algo="crc32", what="tile 3")
+        assert any("ShardCorrupted" in r.message for r in caplog.records)
+
+    def test_shard_corrupted_construction_is_pure(self, caplog):
+        # Re-wrapping / unpickling a ShardCorrupted must NOT fire a
+        # second postmortem dump — only the raise-site factory dumps.
+        import logging
+
+        from keystone_tpu.data.durable import ShardCorrupted
+
+        with caplog.at_level(logging.WARNING,
+                             logger="keystone_tpu.obs.flight"):
+            ShardCorrupted("constructed, not raised")
+        assert not caplog.records
+
+    def test_worker_death_dumps_flight_record(self, caplog):
+        import logging
+
+        from keystone_tpu.serving.batcher import MicroBatchServer
+        from keystone_tpu.serving.export import export_plan
+        from tests._serving_util import fitted_from_transformer
+        from keystone_tpu.workflow import Transformer
+
+        class Scale3(Transformer):
+            def apply(self, x):
+                import jax.numpy as jnp
+
+                return jnp.asarray(x) * 3.0
+
+        plan = export_plan(
+            fitted_from_transformer(Scale3()), np.zeros(4, np.float32),
+            max_batch=8,
+        )
+        srv = MicroBatchServer(plan, max_wait_ms=1.0)
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="keystone_tpu.obs.flight"):
+                # Kill the worker loop itself (not a plan error): poison
+                # _take_batch so the NEXT loop pass raises outside
+                # _execute. The first submit may still be served by the
+                # in-flight _take_batch call; the death lands right
+                # after it.
+                srv._take_batch = None  # worker loop TypeErrors
+                srv.submit(np.zeros(4, np.float32))
+                srv._thread.join(timeout=5.0)
+                assert srv._worker_dead
+        finally:
+            srv.close()
+        assert any("worker thread died" in r.message
+                   for r in caplog.records)

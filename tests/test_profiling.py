@@ -75,6 +75,134 @@ class TestTrace:
         assert found, f"trace produced no files under {d}"
 
 
+def _span(queue_wait_s=0.01, exec_s=0.02, batch_size=4,
+          pad_fraction=0.5, bucket=8):
+    return profiling.RequestSpan(
+        queue_wait_s=queue_wait_s, exec_s=exec_s, batch_size=batch_size,
+        bucket=bucket, pad_fraction=pad_fraction,
+    )
+
+
+class TestSummarizeSpansEdges:
+    """ISSUE 9 satellite: empty-input and bad-sample cases are explicit
+    contracts, not numpy mean-of-empty-slice warnings."""
+
+    def test_empty_is_empty_dict_without_warning(self, recwarn):
+        assert profiling.summarize_spans([]) == {}
+        assert not [w for w in recwarn.list
+                    if issubclass(w.category, RuntimeWarning)]
+
+    def test_single_span_means_are_the_span(self):
+        s = profiling.summarize_spans([_span(0.25, 0.5, 3, 0.125)])
+        assert s["num_spans"] == 1
+        assert s["mean_queue_wait_s"] == 0.25
+        assert s["mean_exec_s"] == 0.5
+        assert s["mean_batch_size"] == 3.0
+        assert s["mean_pad_fraction"] == 0.125
+
+    def test_generator_input_accepted(self):
+        s = profiling.summarize_spans(_span() for _ in range(3))
+        assert s["num_spans"] == 3
+
+    def test_non_finite_field_raises_naming_the_field(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="queue_wait_s"):
+            profiling.summarize_spans(
+                [_span(), _span(queue_wait_s=float("nan"))]
+            )
+        with pytest.raises(ValueError, match="exec_s"):
+            profiling.summarize_spans([_span(exec_s=float("inf"))])
+
+
+class TestLatencyPercentilesEdges:
+    """ISSUE 9 satellite: edge cases raise/return explicitly instead of
+    surfacing as numpy warnings or NaN percentiles."""
+
+    def test_empty_sample_is_none_without_warning(self, recwarn):
+        assert profiling.latency_percentiles([]) is None
+        assert profiling.latency_percentiles(iter(())) is None
+        assert not [w for w in recwarn.list
+                    if issubclass(w.category, RuntimeWarning)]
+
+    def test_single_sample_is_every_percentile(self):
+        p = profiling.latency_percentiles([0.7])
+        assert p == {"p50": 0.7, "p99": 0.7}
+
+    def test_generator_input_accepted(self):
+        p = profiling.latency_percentiles(
+            (v for v in (0.1, 0.2, 0.3)), qs=(50.0,)
+        )
+        assert p["p50"] == 0.2
+
+    def test_out_of_range_q_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="101"):
+            profiling.latency_percentiles([0.1], qs=(50.0, 101.0))
+        with pytest.raises(ValueError, match="-1"):
+            profiling.latency_percentiles([0.1], qs=(-1.0,))
+
+    def test_empty_qs_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="qs is empty"):
+            profiling.latency_percentiles([0.1], qs=())
+
+    def test_non_finite_sample_raises(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="non-finite"):
+            profiling.latency_percentiles([0.1, float("nan")])
+        with pytest.raises(ValueError, match="non-finite"):
+            profiling.latency_percentiles([float("inf")])
+
+
+class TestRegistryBackedReports:
+    """ISSUE 9 satellite: overlap_report / prefetch_retry_counters read
+    the PrefetchStats MetricsRegistry; bare-attribute objects still work
+    through the deprecation shim."""
+
+    def test_overlap_report_reads_registry(self):
+        from keystone_tpu.data.prefetch import PrefetchStats
+
+        stats = PrefetchStats()
+        stats.add_busy("read", 2.0)
+        stats.add_wait("read", 0.5)
+        report = profiling.overlap_report(stats)
+        assert report["read"]["busy_s"] == 2.0
+        assert report["read"]["wait_s"] == 0.5
+        assert report["read"]["hidden_s"] == 1.5
+        assert report["read"]["overlap"] == 0.75
+
+    def test_retry_counters_read_registry(self):
+        from keystone_tpu.data.prefetch import PrefetchStats
+
+        stats = PrefetchStats()
+        stats.retries = 3
+        stats.backoff_s = 0.25
+        assert profiling.prefetch_retry_counters(stats) == {
+            "retries": 3, "backoff_s": 0.25,
+        }
+
+    def test_plain_object_shim_warns_deprecation(self):
+        import pytest
+
+        class Legacy:
+            site_busy_s = {"read": 1.0}
+            site_wait_s = {"read": 0.25}
+            retries = 1
+            backoff_s = 0.1
+
+        with pytest.warns(DeprecationWarning, match="overlap_report"):
+            report = profiling.overlap_report(Legacy())
+        assert report["read"]["busy_s"] == 1.0
+        with pytest.warns(DeprecationWarning,
+                          match="prefetch_retry_counters"):
+            counters = profiling.prefetch_retry_counters(Legacy())
+        assert counters == {"retries": 1, "backoff_s": 0.1}
+
+
 class TestPrefetchOverlapFraction:
     """ISSUE 3 satellite: the Prefetcher's achieved-overlap fraction is a
     profiling-level primitive (one-run accounting), not bench-row ad-hoc
