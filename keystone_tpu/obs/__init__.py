@@ -48,14 +48,24 @@ from keystone_tpu.obs.flight import (
     flight_snapshot,
     render_flight_record,
 )
+from keystone_tpu.obs.live import LiveExporter, render_prometheus
 from keystone_tpu.obs.metrics import (  # noqa: F401 — METRIC_* re-exported
+    BucketedHistogram,
     MetricsRegistry,
 )
 from keystone_tpu.obs.metrics import __all__ as _metrics_all
 from keystone_tpu.obs.metrics import *  # noqa: F401,F403 — the catalogue
+from keystone_tpu.obs.slo import (
+    STATE_BREACH,
+    STATE_OK,
+    STATE_WARN,
+    SLOObjective,
+    SLOTracker,
+)
 from keystone_tpu.obs.tracer import (
     CostDecision,
     Span,
+    TailSampler,
     Tracer,
     active_tracer,
     counter_track,
@@ -70,8 +80,15 @@ from keystone_tpu.obs.tracer import (
 __all__ = [
     "CostDecision",
     "FlightRecorder",
+    "LiveExporter",
     "MetricsRegistry",
+    "STATE_BREACH",
+    "STATE_OK",
+    "STATE_WARN",
+    "SLOObjective",
+    "SLOTracker",
     "Span",
+    "TailSampler",
     "Tracer",
     "active_tracer",
     "counter_track",
@@ -82,6 +99,7 @@ __all__ = [
     "load_events",
     "record_cost_decision",
     "render_flight_record",
+    "render_prometheus",
     "span",
     "to_chrome_trace",
     "tracing",

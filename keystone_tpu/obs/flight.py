@@ -19,7 +19,9 @@ the serving worker)."""
 
 from __future__ import annotations
 
+import itertools
 import logging
+import os
 import threading
 import time
 from collections import deque
@@ -32,9 +34,21 @@ __all__ = [
     "flight_note",
     "flight_snapshot",
     "render_flight_record",
+    "set_dump_dir",
 ]
 
 logger = logging.getLogger("keystone_tpu.obs.flight")
+
+# Optional on-disk dumps: when a directory is configured (set_dump_dir()
+# or the env knob), every dump_flight_record ALSO writes its rendered
+# block to a UNIQUE file there. Uniqueness is load-bearing: two replicas
+# dying in the same tick dump concurrently, and a timestamp-only name
+# would let the second clobber the first — the postmortem of the death
+# that explains the other one. pid + an atomic per-process sequence +
+# O_EXCL creation make collisions structurally impossible.
+DUMP_DIR_ENV = "KEYSTONE_FLIGHT_DUMPS"
+_DUMP_DIR: Optional[str] = None
+_DUMP_SEQ = itertools.count(1)
 
 
 class FlightRecorder:
@@ -108,20 +122,76 @@ def render_flight_record(limit: int = 25) -> str:
     return "flight record (most recent last):\n" + "\n".join(lines)
 
 
+def set_dump_dir(directory: Optional[str]) -> None:
+    """Configure (or clear, with None) the on-disk flight-dump
+    directory; ``KEYSTONE_FLIGHT_DUMPS=dir`` is the env form."""
+    global _DUMP_DIR
+    _DUMP_DIR = directory
+
+
+def _dump_dir() -> Optional[str]:
+    return _DUMP_DIR or os.environ.get(DUMP_DIR_ENV, "").strip() or None
+
+
+def _write_dump_file(context: str, exc: Optional[BaseException],
+                     rendered: str) -> Optional[str]:
+    """Write one dump to a UNIQUE file under the configured dump dir
+    (None when no dir is configured). ``O_EXCL`` creation: concurrent
+    dumps — two replicas dying in the same tick — can NEVER clobber
+    each other; a (theoretical) name collision retries with the next
+    sequence number instead of truncating an existing postmortem."""
+    directory = _dump_dir()
+    if not directory:
+        return None
+    # The file is an AUGMENTATION of the loud log line, never a
+    # precondition: an unwritable dump dir / full disk must not
+    # propagate into dump_flight_record's last-resort guard and
+    # swallow the warning the dump exists to emit.
+    try:
+        os.makedirs(directory, exist_ok=True)
+        body = (
+            f"context: {context}\n"
+            + (f"exception: {exc!r}\n" if exc is not None else "")
+            + rendered + "\n"
+        )
+        for _ in range(8):
+            name = (
+                f"flight-{time.time_ns()}-{os.getpid()}"
+                f"-{next(_DUMP_SEQ):06d}.txt"
+            )
+            path = os.path.join(directory, name)
+            try:
+                fd = os.open(
+                    path, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o644
+                )
+            except FileExistsError:  # pragma: no cover - seq is unique
+                continue
+            with os.fdopen(fd, "w") as f:
+                f.write(body)
+            return path
+    except OSError:
+        return None
+    return None  # pragma: no cover - 8 collisions cannot happen
+
+
 def dump_flight_record(
     context: str, exc: Optional[BaseException] = None,
     log: Optional[logging.Logger] = None, limit: int = 25,
 ) -> str:
     """The fault-path hook: render the ring (+ in-flight spans), log it
-    loudly with the failure context, note the dump itself, and return
-    the rendered block (callers that can attach it to a report do).
-    Never raises — a postmortem aid must not kill the path it serves."""
+    loudly with the failure context, note the dump itself, write it to
+    a unique file when a dump directory is configured (set_dump_dir /
+    ``KEYSTONE_FLIGHT_DUMPS``), and return the rendered block (callers
+    that can attach it to a report do). Never raises — a postmortem aid
+    must not kill the path it serves."""
     try:
         rendered = render_flight_record(limit=limit)
         flight_note("dump", context, error=repr(exc) if exc else None)
+        path = _write_dump_file(context, exc, rendered)
         (log or logger).warning(
-            "%s%s\n%s", context,
+            "%s%s\n%s%s", context,
             f": {exc!r}" if exc is not None else "", rendered,
+            f"\nflight dump written: {path}" if path else "",
         )
         return rendered
     except Exception:  # pragma: no cover - last-resort guard

@@ -22,15 +22,20 @@ No jax, no numpy: the registry is imported by ``data/runtime.py``
 
 from __future__ import annotations
 
+import math
 import threading
 from collections import deque
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, List, Optional, Tuple
 
 __all__ = [
+    "BucketedHistogram",
     "Counter",
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "METRIC_EXPORTER_ERRORS",
+    "METRIC_EXPORTER_PUBLISHES",
+    "METRIC_EXPORTER_PUBLISH_S",
     "METRIC_PREFETCH_BACKOFF_S",
     "METRIC_PREFETCH_LOAD_S",
     "METRIC_PREFETCH_RETRIES",
@@ -49,6 +54,11 @@ __all__ = [
     "METRIC_SERVING_REJECTED",
     "METRIC_SITE_BUSY_S",
     "METRIC_SITE_WAIT_S",
+    "METRIC_SLO_BUDGET_SPENT",
+    "METRIC_SLO_BURN_FAST",
+    "METRIC_SLO_BURN_SLOW",
+    "METRIC_SLO_STATE",
+    "METRIC_SLO_TRANSITIONS",
 ]
 
 # ---------------------------------------------------------------------------
@@ -81,6 +91,18 @@ METRIC_SERVING_BREAKER_OPENS = "serving.breaker_opens"
 METRIC_SERVING_DEGRADED_REJECTED = "serving.degraded_rejected"
 METRIC_SERVING_LATENCY_S = "serving.latency_s"
 METRIC_SERVING_QUEUE_DEPTH = "serving.queue_depth"
+
+# Live SLO plane (obs/slo.py), per declared objective (label: objective=)
+METRIC_SLO_BURN_FAST = "slo.burn_rate_fast"
+METRIC_SLO_BURN_SLOW = "slo.burn_rate_slow"
+METRIC_SLO_BUDGET_SPENT = "slo.budget_spent_fraction"
+METRIC_SLO_STATE = "slo.state"  # 0=OK 1=WARN 2=BREACH
+METRIC_SLO_TRANSITIONS = "slo.transitions"
+
+# Live exporter (obs/live.py) — the publisher thread's own accounting
+METRIC_EXPORTER_PUBLISHES = "exporter.publishes"
+METRIC_EXPORTER_ERRORS = "exporter.errors"
+METRIC_EXPORTER_PUBLISH_S = "exporter.publish_s"
 
 
 class Counter:
@@ -127,6 +149,23 @@ class Gauge:
             return self._value
 
 
+def _interp_percentile(vals: "List[float]", q: float) -> Optional[float]:
+    """Linear-interpolation percentile over SORTED values (numpy's
+    default convention): None when empty, the sample itself when
+    single. The one implementation behind ``Histogram.percentile`` and
+    ``Histogram.stats_snapshot`` — the empty/single-sample contract is
+    pinned by tests and must not fork."""
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return vals[0]
+    pos = (q / 100.0) * (len(vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
 class Histogram:
     """Bounded-reservoir distribution: keeps the most recent ``maxlen``
     observations (the rolling-window convention the serving stats
@@ -158,15 +197,167 @@ class Histogram:
             raise ValueError(f"percentile q must be in [0, 100], got {q}")
         with self._lock:
             vals = sorted(self._window)
-        if not vals:
+        return _interp_percentile(vals, q)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """count/sum/p50/p99 read under ONE lock acquisition, so a
+        snapshot raced against concurrent ``observe()`` calls is a
+        consistent point-in-time view (count can never read AHEAD of the
+        window the percentiles were computed from)."""
+        with self._lock:
+            count, total = self.count, self.total
+            vals = sorted(self._window)
+        return {"count": count, "sum": total,
+                "p50": _interp_percentile(vals, 50.0),
+                "p99": _interp_percentile(vals, 99.0)}
+
+
+class BucketedHistogram:
+    """Mergeable log-bucketed distribution: fixed exponential buckets,
+    O(1) memory for unbounded runs, EXACT cross-replica merge.
+
+    This is the latency-metric store for long-lived serving processes.
+    The 4096-sample ring (:class:`Histogram`) keeps only the most recent
+    window, which silently biases a multi-hour serve's p99 toward the
+    last few seconds; log buckets keep the WHOLE run at bounded memory
+    and merge exactly across replicas (bucket counts add — there is no
+    resampling step to lose tail mass in). The price is resolution: a
+    percentile is reported as its bucket's geometric midpoint, so it is
+    exact only to within one bucket width (``growth`` per bucket,
+    default 8%/bucket — tests pin the merged-vs-concatenated bound).
+
+    Contracts shared with the sample-ring class (PR-9 conventions,
+    pinned in tests): an EMPTY histogram's ``percentile`` is ``None``
+    (never a fabricated zero); a SINGLE sample IS every percentile
+    (returned exactly — the observed min/max clamp makes the one-sample
+    bucket estimate collapse to the sample itself); an out-of-range
+    ``q`` raises ValueError naming the bound.
+
+    ``observe(value, exemplar=...)`` optionally attaches a trace
+    reference to the value's bucket (latest wins, one per bucket —
+    bounded): the bucket→trace-id exemplar map that links a p99 breach
+    to the offending request traces (:meth:`exemplars_at_or_above`).
+    """
+
+    # Shared bucket geometry: every instance merges with every other.
+    _LO = 1e-6       # values at/below 1µs share the underflow bucket
+    _GROWTH = 1.08   # ~8% relative resolution per bucket
+
+    __slots__ = ("_lock", "_buckets", "_exemplars", "count", "total",
+                 "_min", "_max")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: Dict[int, int] = {}
+        self._exemplars: Dict[int, str] = {}
+        self.count = 0
+        self.total = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+
+    @classmethod
+    def bucket_index(cls, value: float) -> int:
+        if value <= cls._LO:
+            return 0
+        return 1 + int(math.log(value / cls._LO) / math.log(cls._GROWTH))
+
+    @classmethod
+    def bucket_bounds(cls, index: int) -> Tuple[float, float]:
+        """(lo, hi] value bounds of one bucket (lo == 0 for the
+        underflow bucket)."""
+        if index <= 0:
+            return 0.0, cls._LO
+        return (cls._LO * cls._GROWTH ** (index - 1),
+                cls._LO * cls._GROWTH ** index)
+
+    def observe(self, value: float, exemplar: Optional[str] = None) -> None:
+        v = float(value)
+        # NaN would silently poison count/sum/percentiles; +/-inf would
+        # escape bucket_index as a raw OverflowError — one named error.
+        if not math.isfinite(v):
+            raise ValueError(
+                f"BucketedHistogram.observe: value must be finite, "
+                f"got {v}"
+            )
+        idx = self.bucket_index(v)
+        with self._lock:
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            self.count += 1
+            self.total += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            if exemplar is not None:
+                self._exemplars[idx] = exemplar
+
+    def merge(self, other: "BucketedHistogram") -> "BucketedHistogram":
+        """Fold ``other``'s buckets into self (exact: counts add). The
+        cross-replica aggregation step — merged percentiles equal the
+        percentile of the concatenated observation stream to within one
+        bucket width (property-tested)."""
+        with other._lock:
+            buckets = dict(other._buckets)
+            exemplars = dict(other._exemplars)
+            count, total = other.count, other.total
+            mn, mx = other._min, other._max
+        with self._lock:
+            for idx, c in buckets.items():
+                self._buckets[idx] = self._buckets.get(idx, 0) + c
+            self._exemplars.update(exemplars)
+            self.count += count
+            self.total += total
+            self._min = min(self._min, mn)
+            self._max = max(self._max, mx)
+        return self
+
+    def _percentile_locked(self, q: float) -> Optional[float]:
+        if not self.count:
             return None
-        if len(vals) == 1:
-            return vals[0]
-        pos = (q / 100.0) * (len(vals) - 1)
-        lo = int(pos)
-        hi = min(lo + 1, len(vals) - 1)
-        frac = pos - lo
-        return vals[lo] * (1.0 - frac) + vals[hi] * frac
+        # Nearest-rank walk over cumulative bucket counts; the estimate
+        # is the bucket's geometric midpoint clamped into the OBSERVED
+        # [min, max] — which makes a single-sample histogram return the
+        # sample exactly (min == max == the value).
+        rank = max(int(math.ceil((q / 100.0) * self.count)), 1)
+        seen = 0
+        for idx in sorted(self._buckets):
+            seen += self._buckets[idx]
+            if seen >= rank:
+                lo, hi = self.bucket_bounds(idx)
+                mid = math.sqrt(lo * hi) if lo > 0.0 else hi / 2.0
+                return min(max(mid, self._min), self._max)
+        return self._max  # pragma: no cover - rank <= count always hits
+
+    def percentile(self, q: float) -> Optional[float]:
+        if not 0.0 <= q <= 100.0:
+            raise ValueError(f"percentile q must be in [0, 100], got {q}")
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def stats_snapshot(self) -> Dict[str, Any]:
+        """count/sum/p50/p99 under ONE lock acquisition (the same
+        consistent-view contract as :meth:`Histogram.stats_snapshot`)."""
+        with self._lock:
+            return {
+                "count": self.count, "sum": self.total,
+                "p50": self._percentile_locked(50.0),
+                "p99": self._percentile_locked(99.0),
+            }
+
+    def exemplars_at_or_above(self, q: float, limit: int = 4) -> List[str]:
+        """Trace references attached to the buckets at or above the
+        q-th percentile's bucket (worst first) — the p99→trace link a
+        breach investigation starts from."""
+        with self._lock:
+            p = self._percentile_locked(q)
+            if p is None or not self._exemplars:
+                return []
+            cut = self.bucket_index(p)
+            return [
+                self._exemplars[idx]
+                for idx in sorted(self._exemplars, reverse=True)
+                if idx >= cut
+            ][:limit]
 
 
 class MetricsRegistry:
@@ -212,6 +403,13 @@ class MetricsRegistry:
     def histogram(self, name: str, maxlen: int = 4096, **labels) -> Histogram:
         return self._get_or_create(Histogram, name, labels, maxlen=maxlen)
 
+    def bucketed_histogram(self, name: str, **labels) -> BucketedHistogram:
+        """The mergeable log-bucketed form — the right store for
+        LONG-LIVED latency metrics (serving): O(1) memory over unbounded
+        runs, exact cross-replica merge. Short-lived fit phases keep the
+        exact sample-ring :meth:`histogram`."""
+        return self._get_or_create(BucketedHistogram, name, labels)
+
     def labels_of(self, name: str) -> list:
         """The label-sets registered under ``name`` (e.g. every lane a
         runtime has created), as dicts."""
@@ -234,8 +432,12 @@ class MetricsRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """Flat dict of every registered metric. Counters/gauges map to
-        their value; histograms expand to ``.count`` / ``.sum`` /
-        ``.p50`` / ``.p99`` sub-keys."""
+        their value; histograms (ring and bucketed) expand to ``.count``
+        / ``.sum`` / ``.p50`` / ``.p99`` sub-keys. Safe against
+        concurrent ``observe()``/``add()`` from worker threads: each
+        histogram's four sub-keys come from ONE ``stats_snapshot()``
+        lock acquisition, so the expanded values are mutually consistent
+        and counters read monotonically across successive snapshots."""
         with self._lock:
             items = list(self._metrics.items())
         out: Dict[str, Any] = {}
@@ -243,11 +445,12 @@ class MetricsRegistry:
             key = name
             if lbls:
                 key += "{" + ",".join(f"{k}={v}" for k, v in lbls) + "}"
-            if isinstance(m, Histogram):
-                out[key + ".count"] = m.count
-                out[key + ".sum"] = m.total
-                out[key + ".p50"] = m.percentile(50.0)
-                out[key + ".p99"] = m.percentile(99.0)
+            if isinstance(m, (Histogram, BucketedHistogram)):
+                st = m.stats_snapshot()
+                out[key + ".count"] = st["count"]
+                out[key + ".sum"] = st["sum"]
+                out[key + ".p50"] = st["p50"]
+                out[key + ".p99"] = st["p99"]
             else:
                 out[key] = m.value
         return out

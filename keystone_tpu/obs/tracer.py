@@ -47,6 +47,7 @@ logger = logging.getLogger("keystone_tpu.obs.tracer")
 __all__ = [
     "CostDecision",
     "Span",
+    "TailSampler",
     "Tracer",
     "active_tracer",
     "counter_track",
@@ -59,6 +60,11 @@ __all__ = [
 ]
 
 TRACE_ENV = "KEYSTONE_TRACE"
+# Tail-sampling knobs for serving spans under a long-lived traced serve:
+# head-sample rate (keep 1-in-round(1/rate)) and the slow threshold in
+# milliseconds past which a request span is ALWAYS kept.
+TRACE_SAMPLE_ENV = "KEYSTONE_TRACE_SAMPLE"
+TRACE_SLOW_MS_ENV = "KEYSTONE_TRACE_SLOW_MS"
 
 
 class _NoopSpan:
@@ -160,6 +166,74 @@ class Span:
         return False
 
 
+class TailSampler:
+    """Keep-if policy for high-volume serving spans, evaluated at span
+    CLOSE (when the duration and outcome are known — the whole point of
+    tail over head sampling):
+
+      - ``flagged`` spans (errors, sheds, breaker-adjacent requests)
+        are ALWAYS kept;
+      - spans at least ``slow_s`` long are always kept (the tail the
+        p99 is made of);
+      - everything else is head-sampled at ``head_rate``, implemented
+        as a deterministic keep-every-Nth (N = round(1/rate)) so a
+        traced bench leg is reproducible — there is no RNG to seed.
+
+    ``head_rate=1.0`` keeps everything (the default when no sampler is
+    installed); ``head_rate=0.0`` keeps only flagged/slow spans.
+    ``stats()`` reports kept/sampled-out counts per reason — the bound
+    on tracing overhead under sustained load is auditable, not assumed.
+    """
+
+    __slots__ = ("head_rate", "slow_s", "_modulus", "_lock", "_seq",
+                 "_kept", "_dropped")
+
+    def __init__(self, head_rate: float = 0.01,
+                 slow_s: Optional[float] = None):
+        if not 0.0 <= head_rate <= 1.0:
+            raise ValueError(f"head_rate must be in [0, 1], got {head_rate}")
+        if slow_s is not None and slow_s <= 0:
+            raise ValueError(f"slow_s must be > 0, got {slow_s}")
+        self.head_rate = float(head_rate)
+        self.slow_s = slow_s
+        self._modulus = (
+            max(int(round(1.0 / head_rate)), 1) if head_rate > 0 else 0
+        )
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._kept: Dict[str, int] = {}
+        self._dropped = 0
+
+    def keep(self, dur_s: float, flagged: bool = False
+             ) -> "tuple[bool, Optional[str]]":
+        """(keep?, reason) — reason is ``flagged``/``slow``/``head``
+        (None when sampled out)."""
+        with self._lock:
+            if flagged:
+                reason = "flagged"
+            elif self.slow_s is not None and dur_s >= self.slow_s:
+                reason = "slow"
+            else:
+                self._seq += 1
+                if self._modulus and (self._seq % self._modulus) == 0:
+                    reason = "head"
+                else:
+                    self._dropped += 1
+                    return False, None
+            self._kept[reason] = self._kept.get(reason, 0) + 1
+            return True, reason
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "kept": dict(self._kept),
+                "kept_total": sum(self._kept.values()),
+                "sampled_out": self._dropped,
+                "head_rate": self.head_rate,
+                "slow_s": self.slow_s,
+            }
+
+
 class Tracer:
     """Collects span/event/counter records for one traced run.
 
@@ -167,11 +241,19 @@ class Tracer:
     record even when spans come from many threads (fold consumer,
     runtime IO workers, serving worker). Use through
     :func:`tracing` / the module-level hooks, not directly.
+
+    ``serving_sampler``: an optional :class:`TailSampler` applied to the
+    retroactive serving request spans (:meth:`add_serving_span`) — a
+    long-lived traced serve keeps every slow/error/shed span but only a
+    head sample of the healthy fast ones. Fit-path spans are never
+    sampled (their volume is bounded by the fold, not the traffic).
     """
 
     def __init__(self, run_id: Optional[str] = None,
-                 max_records: int = 1_000_000):
+                 max_records: int = 1_000_000,
+                 serving_sampler: Optional[TailSampler] = None):
         self.run_id = run_id or uuid.uuid4().hex[:12]
+        self.serving_sampler = serving_sampler
         # Map perf_counter to wall-clock microseconds once, so every
         # record's ts_us is an epoch time Perfetto renders as absolute.
         self._epoch_us_at_zero = (
@@ -267,11 +349,13 @@ class Tracer:
     def span(self, name: str, **attrs) -> Span:
         return Span(self, name, dict(attrs))
 
-    def add_span(self, name: str, t0: float, t1: float, **attrs) -> None:
+    def add_span(self, name: str, t0: float, t1: float, **attrs) -> int:
         """Record a span retroactively from perf_counter endpoints — the
         serving bridge: the micro-batcher knows a request's
         enqueue/complete times only after the fact, and its rolling
-        ``RequestSpan``/``SpanLog`` stats must keep working unchanged."""
+        ``RequestSpan``/``SpanLog`` stats must keep working unchanged.
+        Returns the span id (the exemplar reference a histogram bucket
+        can carry)."""
         th = threading.current_thread()
         with self._lock:
             sid = next(self._ids)
@@ -283,6 +367,25 @@ class Tracer:
                 "span_id": sid, "parent_id": None,
                 "run_id": self.run_id, "args": dict(attrs),
             })
+        return sid
+
+    def add_serving_span(self, name: str, t0: float, t1: float,
+                         flagged: bool = False, **attrs) -> Optional[int]:
+        """The tail-sampled form of :meth:`add_span` for per-request
+        serving spans: the keep-if policy runs HERE, at close, when
+        duration and outcome are known. ``flagged`` marks spans the
+        policy must never drop (errors, sheds, breaker-adjacent
+        requests). Returns the span id when kept (→ the
+        ``run_id/span_id`` exemplar ref), None when sampled out.
+        No sampler installed = keep everything."""
+        s = self.serving_sampler
+        if s is not None:
+            kept, reason = s.keep(t1 - t0, flagged=flagged)
+            if not kept:
+                return None
+            if reason != "head":
+                attrs["keep"] = reason
+        return self.add_span(name, t0, t1, **attrs)
 
     def event(self, name: str, **attrs) -> None:
         th = threading.current_thread()
@@ -374,7 +477,8 @@ def record_cost_decision(decision: CostDecision) -> None:
 
 @contextlib.contextmanager
 def tracing(directory: Optional[str] = None, run_id: Optional[str] = None,
-            xla_profile: bool = False):
+            xla_profile: bool = False,
+            serving_sampler: Optional[TailSampler] = None):
     """Activate tracing for the dynamic extent of the block.
 
     ``directory`` (optional): on exit the trace is written there —
@@ -389,6 +493,10 @@ def tracing(directory: Optional[str] = None, run_id: Optional[str] = None,
     ``directory/xla``; requires a directory. Imported lazily so this
     module stays jax-free.
 
+    ``serving_sampler``: a :class:`TailSampler` for the per-request
+    serving spans — a traced long-lived serve keeps every slow/error/
+    shed span, head-samples the rest (docs/observability.md).
+
     Nested activation raises: one trace is one run's record.
     """
     global _ACTIVE
@@ -398,7 +506,7 @@ def tracing(directory: Optional[str] = None, run_id: Optional[str] = None,
                 "tracing is already active; one trace per run "
                 "(nest work under the active tracer instead)"
             )
-        t = Tracer(run_id=run_id)
+        t = Tracer(run_id=run_id, serving_sampler=serving_sampler)
         _ACTIVE = t
     xla_cm = contextlib.nullcontext()
     if xla_profile:
@@ -425,8 +533,35 @@ def tracing_from_env():
     run writing to ``dir``; unset — or a tracer already active — yields
     a no-op context. This is what ``run.py`` wraps every pipeline and
     serve invocation in, so tracing any production entry point is one
-    flag, zero code."""
+    flag, zero code.
+
+    ``KEYSTONE_TRACE_SAMPLE=<rate>`` (and optionally
+    ``KEYSTONE_TRACE_SLOW_MS=<ms>``) installs a :class:`TailSampler`
+    over the serving request spans — the knob a traced long-lived serve
+    needs so its trace buffer holds hours of tail, not seconds of
+    everything."""
     directory = os.environ.get(TRACE_ENV, "").strip()
     if not directory or _ACTIVE is not None:
         return contextlib.nullcontext()
-    return tracing(directory)
+    sampler = None
+    rate = os.environ.get(TRACE_SAMPLE_ENV, "").strip()
+    if rate:
+        # Validate-at-parse with the error naming the VARIABLE (the
+        # utils.faults env-knob discipline): a typo'd rate must not
+        # surface as a bare float() error or an internal parameter
+        # name the operator never set.
+        from keystone_tpu.utils.faults import _env_number
+
+        head_rate = _env_number(TRACE_SAMPLE_ENV, rate, float, 0.0)
+        if head_rate > 1.0:
+            raise ValueError(
+                f"{TRACE_SAMPLE_ENV}={rate!r} must be a keep rate "
+                "in [0, 1]"
+            )
+        slow_ms = os.environ.get(TRACE_SLOW_MS_ENV, "").strip()
+        slow_s = None
+        if slow_ms:
+            slow_s = _env_number(TRACE_SLOW_MS_ENV, slow_ms, float, 0.0)
+            slow_s = slow_s / 1e3 if slow_s > 0 else None
+        sampler = TailSampler(head_rate=head_rate, slow_s=slow_s)
+    return tracing(directory, serving_sampler=sampler)

@@ -26,6 +26,12 @@ writes ``DIR/trace.json`` (Perfetto-loadable), ``DIR/events.jsonl``
 (the compact log ``bin/trace`` summarizes), and ``DIR/meta.json`` —
 one correlated record of optimizer decisions, fold chunks, IO lane
 tasks, checkpoint writes, and serving requests under one ``run_id``.
+Serve additionally has the LIVE plane: ``--slo-p99-ms`` declares a p99
+latency SLO (the summary line prints the OK/WARN/BREACH verdict and
+budget spent), ``--metrics-port``/``--metrics-dir`` publish Prometheus
+text + atomic JSON snapshots while the server runs (``bin/slo`` renders
+them), and ``KEYSTONE_TRACE_SAMPLE``/``KEYSTONE_TRACE_SLOW_MS``
+tail-sample traced serving spans.
 """
 
 from __future__ import annotations
@@ -143,10 +149,28 @@ def _serve(argv):
                         help="offered Poisson rate (requests/s)")
     parser.add_argument("--duration-s", type=float, default=5.0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--slo-p99-ms", type=float, default=0.0,
+                        help="declare a p99 latency SLO objective at this "
+                        "bound (plus an availability objective); the "
+                        "summary line then carries the live verdict and "
+                        "budget spent (0 = no SLO)")
+    parser.add_argument("--slo-target", type=float, default=0.99,
+                        help="good-fraction target of the latency "
+                        "objective (error budget = 1 - target)")
+    parser.add_argument("--metrics-port", type=int, default=-1,
+                        help="serve Prometheus text-format + JSON "
+                        "snapshots over HTTP on this port (0 = ephemeral, "
+                        "-1 = off) — docs/observability.md live plane")
+    parser.add_argument("--metrics-dir", default="",
+                        help="write atomic live_metrics.json snapshots "
+                        "here every --metrics-interval-s (scrape-less "
+                        "environments; bin/slo reads them)")
+    parser.add_argument("--metrics-interval-s", type=float, default=1.0)
     args = parser.parse_args(argv)
 
     import numpy as np
 
+    from keystone_tpu import obs
     from keystone_tpu.serving import (
         MicroBatchServer,
         ReplicatedServer,
@@ -176,24 +200,66 @@ def _serve(argv):
     rng = np.random.default_rng(args.seed + 1)
     pool = rng.normal(size=(256, d_in)).astype(np.float32)
 
+    # Live SLO objectives (docs/observability.md): a p99 latency bound
+    # plus availability, publishing slo.state/burn gauges into their
+    # own registry so the exporter renders them beside the serving
+    # counters (the verdict block additionally carries the numeric
+    # state_level the Prometheus renderer keeps).
+    slo_tracker = None
+    slo_registry = None
+    if args.slo_p99_ms > 0:
+        slo_registry = obs.MetricsRegistry()
+        slo_tracker = obs.SLOTracker([
+            obs.SLOObjective(
+                "latency", kind="latency",
+                threshold_s=args.slo_p99_ms / 1e3, target=args.slo_target,
+            ),
+            obs.SLOObjective(
+                "availability", kind="availability", target=0.999,
+            ),
+        ], metrics=slo_registry)
     if args.replicas > 1:
         server = ReplicatedServer(
             plan, num_replicas=args.replicas, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, max_queue_depth=args.queue_depth,
-            restart_budget=args.restart_budget,
+            restart_budget=args.restart_budget, slo=slo_tracker,
         )
     else:
         server = MicroBatchServer(
             plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
-            max_queue_depth=args.queue_depth,
+            max_queue_depth=args.queue_depth, slo=slo_tracker,
         )
+    exporter = None
     try:
+        # Inside the try: an exporter construction failure (e.g. the
+        # metrics port already bound) must still close() the server —
+        # the replicated plane's workers are already running.
+        if args.metrics_port >= 0 or args.metrics_dir:
+            from keystone_tpu.data.runtime import default_runtime
+
+            sources = {
+                "metrics": server.metrics,
+                "serving": server.stats,
+                "runtime": default_runtime().stats,
+            }
+            if slo_registry is not None:
+                sources["slo_metrics"] = slo_registry
+            exporter = obs.LiveExporter(
+                sources=sources,
+                slo=slo_tracker,
+                snapshot_dir=args.metrics_dir or None,
+                port=args.metrics_port if args.metrics_port >= 0 else None,
+                interval_s=args.metrics_interval_s,
+            )
         report = run_open_loop(
             server.submit, lambda i: pool[i % len(pool)],
             rate_hz=args.rate, duration_s=args.duration_s, seed=args.seed,
+            slo=slo_tracker,
         )
         stats = server.stats()
     finally:
+        if exporter is not None:
+            exporter.close()
         server.close()
     summary = report.to_row_dict()
     summary.update({
@@ -203,6 +269,18 @@ def _serve(argv):
         "max_wait_ms": args.max_wait_ms,
         "plan_fingerprint": plan.fingerprint,
     })
+    if slo_tracker is not None:
+        # The verdict and the budget, on the one line an operator reads.
+        verdict = report.slo or slo_tracker.verdict()
+        summary.update({
+            "slo_state": verdict["state"],
+            "slo_budget_spent_fraction": max(
+                o["budget_spent_fraction"]
+                for o in verdict["objectives"].values()
+            ),
+        })
+    if exporter is not None and exporter.port is not None:
+        summary["metrics_port"] = exporter.port
     if args.replicas > 1:
         summary.update({
             "replicas": args.replicas,

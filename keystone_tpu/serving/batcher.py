@@ -41,8 +41,20 @@ that something:
 
 Observability: per-request spans (queue wait / pad fraction / batch exec
 time) are recorded through :class:`keystone_tpu.utils.profiling.SpanLog`,
-and :meth:`stats` exposes rolling p50/p99 latency plus throughput
-counters computed over completions.
+and :meth:`stats` exposes p50/p99 latency plus throughput counters
+computed over completions. End-to-end latency lives in a MERGEABLE
+log-bucketed histogram (ISSUE 10 — ``obs.BucketedHistogram``): O(1)
+memory over an unbounded serve and percentiles over the WHOLE run, not
+the last few seconds of ring window; the queue-wait/exec split keeps
+the exact sample ring (its window is the span log, a deliberate
+recent-window view). When an :class:`~keystone_tpu.obs.slo.SLOTracker`
+is attached (``slo=``), every completion/shed/failure feeds it — the
+server itself is the SLI source, so the OK/WARN/BREACH verdict is live,
+not a post-hoc loadgen artifact. Under tracing, per-request spans are
+TAIL-SAMPLED when the tracer carries a sampler (errors/sheds/slow
+requests always kept), and kept spans attach ``run_id/span_id``
+exemplars to their latency bucket — a p99 breach links directly to
+offending traces.
 """
 
 from __future__ import annotations
@@ -148,6 +160,9 @@ class MicroBatchServer:
         fast with :class:`ServerDegraded`), and the cooldown before a
         half-open probe is admitted. ``breaker_threshold=0`` disables
         the breaker (pre-reliability behavior).
+      - ``slo``: an :class:`~keystone_tpu.obs.slo.SLOTracker` fed one
+        outcome per request — completions with their end-to-end
+        latency, sheds/breaker rejects/failures as bad events.
     """
 
     def __init__(
@@ -160,6 +175,7 @@ class MicroBatchServer:
         breaker_threshold: int = 5,
         breaker_reset_s: float = 1.0,
         replica_index: Optional[int] = None,
+        slo=None,
     ):
         if max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
@@ -200,11 +216,15 @@ class MicroBatchServer:
         self._worker_dead = False
 
         # Rolling observability state. The counters and the latency
-        # window are REGISTERED metrics (ISSUE 9 — obs.MetricsRegistry
+        # histogram are REGISTERED metrics (ISSUE 9 — obs.MetricsRegistry
         # is the single store stats() reads; the legacy attribute names
         # stay as properties below). The span ring keeps its own
         # SpanLog shape — it carries structured RequestSpans, not
         # scalars — and bridges into the tracer when one is active.
+        # End-to-end latency is a BUCKETED histogram (ISSUE 10): the old
+        # 4096-sample ring silently biased a multi-hour serve's p99
+        # toward the last few seconds; log buckets keep the whole run at
+        # O(1) memory and merge exactly across replicas.
         self.span_log = profiling.SpanLog(maxlen=span_log_len)
         self.metrics = obs.MetricsRegistry()
         self._completed = self.metrics.counter(METRIC_SERVING_COMPLETED)
@@ -216,10 +236,11 @@ class MicroBatchServer:
         self._degraded_rejected = self.metrics.counter(
             METRIC_SERVING_DEGRADED_REJECTED
         )
-        self._latencies = self.metrics.histogram(
-            METRIC_SERVING_LATENCY_S, maxlen=span_log_len
+        self._latencies = self.metrics.bucketed_histogram(
+            METRIC_SERVING_LATENCY_S
         )
         self._queue_depth = self.metrics.gauge(METRIC_SERVING_QUEUE_DEPTH)
+        self._slo = slo
         self._first_done_t: Optional[float] = None
         self._last_done_t: Optional[float] = None
 
@@ -257,7 +278,17 @@ class MicroBatchServer:
         output row for it. Raises :class:`ServerClosed` after close();
         raises :class:`ServerOverloaded` when the queue is full and this
         request is the shedding victim (otherwise the victim's future
-        receives it)."""
+        receives it). Every shed/degraded rejection feeds the attached
+        SLO tracker as a bad event — admission control spends error
+        budget, visibly."""
+        try:
+            return self._submit(x, deadline_ms)
+        except (ServerOverloaded, ServerDegraded):
+            if self._slo is not None:
+                self._slo.observe(ok=False)
+            raise
+
+    def _submit(self, x, deadline_ms: Optional[float] = None) -> Future:
         now = time.perf_counter()
         deadline_t = (
             now + float(deadline_ms) / 1e3 if deadline_ms is not None
@@ -334,6 +365,18 @@ class MicroBatchServer:
                 f"shed (earliest deadline first) at queue depth "
                 f"{self.max_queue_depth}"
             ))
+            # A shed victim is a bad SLI event and an always-keep trace
+            # span (tail sampling never drops sheds): the overload story
+            # must survive into both the budget ledger and the trace.
+            if self._slo is not None:
+                self._slo.observe(ok=False)
+            tracer = obs.active_tracer()
+            if tracer is not None:
+                tracer.add_serving_span(
+                    "serving.request", shed.enqueue_t, time.perf_counter(),
+                    flagged=True, outcome="shed",
+                    replica=self.replica_index,
+                )
         return req.future
 
     # -- worker side -------------------------------------------------------
@@ -455,8 +498,21 @@ class MicroBatchServer:
                     f"{self.replica_index}, consecutive_failures="
                     f"{self._consecutive_failures})", e,
                 )
+            # Failed requests: always-keep trace spans (errors are never
+            # tail-sampled out) and bad SLI events for the budget ledger.
+            t_err = time.perf_counter()
+            tracer = obs.active_tracer()
             for r in batch:
+                if tracer is not None:
+                    tracer.add_serving_span(
+                        "serving.request", r.enqueue_t, t_err,
+                        flagged=True, outcome="error",
+                        error=f"{type(e).__name__}: {e}",
+                        replica=self.replica_index,
+                    )
                 r.resolve(exc=e)
+                if self._slo is not None:
+                    self._slo.observe(ok=False)
             return
         with self._lock:
             # Any successful batch (including the half-open probe)
@@ -487,19 +543,31 @@ class MicroBatchServer:
                 pad_fraction=info.pad_fraction,
                 replica=self.replica_index,
             ))
+            lat = t1 - r.enqueue_t
+            exemplar = None
             if tracer is not None:
-                tracer.add_span(
+                # Tail-sampled: the tracer's sampler (when installed)
+                # head-samples healthy fast requests but always keeps
+                # slow ones and breaker probes. A KEPT span's id becomes
+                # the exemplar its latency bucket carries — the
+                # p99-breach→trace link.
+                sid = tracer.add_serving_span(
                     "serving.request", r.enqueue_t, t1,
+                    flagged=r.is_probe,
                     queue_wait_s=t0 - r.enqueue_t, exec_s=exec_s,
                     bucket=info.bucket, replica=self.replica_index,
                 )
+                if sid is not None:
+                    exemplar = f"{tracer.run_id}/{sid}"
             with self._lock:
-                self._latencies.observe(t1 - r.enqueue_t)
+                self._latencies.observe(lat, exemplar=exemplar)
                 self._completed.add(1)
                 if self._first_done_t is None:
                     self._first_done_t = t1
                 self._last_done_t = t1
             r.resolve(outs[i])
+            if self._slo is not None:
+                self._slo.observe(latency_s=lat, ok=True)
 
     # -- observability -----------------------------------------------------
 
@@ -511,9 +579,12 @@ class MicroBatchServer:
             return len(self._pending)
 
     def stats(self) -> Dict[str, Any]:
-        """Rolling latency percentiles + throughput counters. Percentiles
-        are over the retained completion window (span_log_len); None
-        until something completes.
+        """Latency percentiles + throughput counters; None until
+        something completes. End-to-end ``p50/p99_latency_s`` come from
+        the WHOLE-RUN bucketed histogram (exact to within one ~8%
+        bucket — a multi-hour serve's p99 is the run's p99, not the
+        last ring window's), while the queue-wait/exec split below
+        stays exact over the span-log window.
 
         End-to-end latency is reported SPLIT into its two sides —
         ``p50/p99_queue_wait_s`` (time queued before the batch
@@ -523,7 +594,6 @@ class MicroBatchServer:
         ``max_wait_ms``/``max_queue_depth`` (or another replica), exec
         blowing up wants a smaller ``max_batch`` or a faster plan."""
         with self._lock:
-            lat = self._latencies.snapshot_values()
             completed, rejected, failed = (
                 self.completed, self.rejected, self.failed
             )
@@ -535,7 +605,10 @@ class MicroBatchServer:
             breaker_opens = self.breaker_opens
             degraded_rejected = self.degraded_rejected
             consecutive_failures = self._consecutive_failures
-        pct = profiling.latency_percentiles(lat)
+        # One consistent histogram read (count + sum + percentiles under
+        # a single lock acquisition — the snapshot-vs-observe race the
+        # registry regression test pins).
+        lat = self._latencies.stats_snapshot()
         # ONE ring copy: the wait/exec percentiles and the summary all
         # derive from the same snapshot (stats() polls contend the span
         # lock with the worker's record() on the serving hot path).
@@ -553,15 +626,15 @@ class MicroBatchServer:
             "breaker_opens": breaker_opens,
             "degraded_rejected": degraded_rejected,
             "consecutive_failures": consecutive_failures,
-            "p50_latency_s": pct["p50"] if pct else None,
-            "p99_latency_s": pct["p99"] if pct else None,
+            "p50_latency_s": lat["p50"],
+            "p99_latency_s": lat["p99"],
             # The two sides of end-to-end latency, separately (over the
             # span_log window — admission-control tuning reads these).
             "p50_queue_wait_s": wait_pct["p50"] if wait_pct else None,
             "p99_queue_wait_s": wait_pct["p99"] if wait_pct else None,
             "p50_exec_s": exec_pct["p50"] if exec_pct else None,
             "p99_exec_s": exec_pct["p99"] if exec_pct else None,
-            "num_latency_samples": len(lat),
+            "num_latency_samples": lat["count"],
             # completions/second across the observed completion span;
             # needs >= 2 completions to bound a span.
             "achieved_qps": (

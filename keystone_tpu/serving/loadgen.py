@@ -68,6 +68,11 @@ class LoadReport:
     # against a standalone MicroBatchServer.
     per_replica_completed: Dict[int, int] = field(default_factory=dict)
     per_fingerprint_completed: Dict[str, int] = field(default_factory=dict)
+    # The SLO verdict at the end of the run (an SLOTracker.verdict()
+    # dict — states, burn rates, budget ledger), populated when the
+    # storm is handed the tracker the serving plane feeds. None when no
+    # SLO is declared.
+    slo: Optional[Dict[str, Any]] = None
 
     def to_row_dict(self) -> Dict[str, Any]:
         """The bench-facing dict: percentiles WITH their sample count and
@@ -103,6 +108,25 @@ class LoadReport:
             out["per_fingerprint_completed"] = dict(
                 sorted(self.per_fingerprint_completed.items())
             )
+        if self.slo is not None:
+            # Compact verdict for the row: states + burn rates + budget
+            # per objective. The full transition log / ledger stays on
+            # ``report.slo`` for rows that publish the whole story.
+            out["slo"] = {
+                "state": self.slo.get("state"),
+                "objectives": {
+                    name: {
+                        "state": o.get("state"),
+                        "burn_fast": o.get("burn_fast"),
+                        "burn_slow": o.get("burn_slow"),
+                        "budget_spent_fraction": o.get(
+                            "budget_spent_fraction"
+                        ),
+                        "num_transitions": len(o.get("transitions") or []),
+                    }
+                    for name, o in (self.slo.get("objectives") or {}).items()
+                },
+            }
         return out
 
 
@@ -113,6 +137,7 @@ def run_open_loop(
     duration_s: float,
     seed: int = 0,
     result_timeout_s: float = 60.0,
+    slo=None,
 ) -> LoadReport:
     """Drive ``submit`` (e.g. ``server.submit``) with Poisson arrivals at
     ``rate_hz`` for ``duration_s``; block until every outstanding future
@@ -125,7 +150,14 @@ def run_open_loop(
     breaker is open or every replica is down, ServerClosed) — the
     storm must keep offering through a degraded window and account for
     it, not crash with no report. Latency is submit→completion
-    (completion stamped by a done-callback on the resolving thread)."""
+    (completion stamped by a done-callback on the resolving thread).
+
+    ``slo``: the :class:`~keystone_tpu.obs.slo.SLOTracker` the serving
+    plane under test FEEDS (``MicroBatchServer(slo=...)`` /
+    ``ReplicatedServer(slo=...)``); the storm does not feed it — it
+    evaluates it once at the end and attaches the verdict block (state,
+    burn rates, budget ledger) to the report, so an open-loop run's
+    latency claim and its SLO verdict come from the same window."""
     arrivals = poisson_arrivals(rate_hz, duration_s, seed=seed)
     records = []  # (t_submitted, future, stamp_dict)
     rejected = 0
@@ -176,6 +208,10 @@ def run_open_loop(
     pct = profiling.latency_percentiles(latencies)
     completed = len(latencies)
     wall = time.perf_counter() - t_start
+    verdict = None
+    if slo is not None:
+        slo.evaluate()  # one final pass on the post-storm clock
+        verdict = slo.verdict()
     return LoadReport(
         offered_rate_hz=rate_hz,
         duration_s=duration_s,
@@ -190,6 +226,7 @@ def run_open_loop(
         latencies_s=latencies,
         per_replica_completed=per_replica,
         per_fingerprint_completed=per_fingerprint,
+        slo=verdict,
     )
 
 

@@ -63,14 +63,14 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Deque, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
 from keystone_tpu import obs
-from keystone_tpu.utils import faults, profiling
+from keystone_tpu.obs.metrics import METRIC_SERVING_LATENCY_S
+from keystone_tpu.utils import faults
 
 from .batcher import (
     MicroBatchServer,
@@ -154,6 +154,10 @@ class ReplicatedServer:
         replica's in-flight work; on timeout the replica re-enters
         rotation on its OLD plan and the swap raises (zero-drop is
         preserved either way).
+      - ``slo``: an :class:`~keystone_tpu.obs.slo.SLOTracker` fed at
+        the FRONT DOOR (one outcome per admitted/rejected request, at
+        future resolution) — the verdict survives replica restarts and
+        swaps exactly like the front-door counters do.
     """
 
     def __init__(
@@ -170,6 +174,7 @@ class ReplicatedServer:
         restart_budget: int = 3,
         watchdog_interval_s: float = 0.05,
         drain_timeout_s: float = 30.0,
+        slo=None,
     ):
         factory, n = self._plan_factory(plans, num_replicas)
         if n < 1:
@@ -210,14 +215,21 @@ class ReplicatedServer:
 
         # Front-door accounting (all under _lock). Counters folded in
         # from retired server generations live in _retired so restarts
-        # and swaps never lose history.
+        # and swaps never lose history. End-to-end latency lives in the
+        # plane's own registry as a MERGEABLE bucketed histogram (ISSUE
+        # 10): whole-run percentiles at O(1) memory, and the live
+        # exporter renders the registry directly.
         self.completed = 0
         self.failed = 0
         self.rejected = 0
         self.degraded_rejected = 0
         self.restarts_total = 0
         self.swaps_completed = 0
-        self._latencies_s: Deque[float] = deque(maxlen=span_log_len)
+        self.metrics = obs.MetricsRegistry()
+        self._latencies = self.metrics.bucketed_histogram(
+            METRIC_SERVING_LATENCY_S
+        )
+        self._slo = slo
         self._retired: Dict[str, int] = {
             "completed": 0, "rejected": 0, "failed": 0, "breaker_opens": 0,
         }
@@ -317,6 +329,11 @@ class ReplicatedServer:
                 self.rejected += 1
             else:
                 self.degraded_rejected += 1
+        if self._slo is not None:
+            # A request EVERY replica rejected is a front-door bad event
+            # — the degraded window spends error budget even though no
+            # replica ever queued it.
+            self._slo.observe(ok=False)
         if saw_overload:
             raise ServerOverloaded(
                 f"every in-rotation replica shed this request "
@@ -364,15 +381,24 @@ class ReplicatedServer:
                 with self._lock:
                     rep.outstanding -= 1
                 return
+            lat = t_done - t_sub
             with self._lock:
                 rep.outstanding -= 1
                 if exc is None:
                     self.completed += 1
-                    self._latencies_s.append(t_done - t_sub)
+                    self._latencies.observe(lat)
                 elif isinstance(exc, ServerOverloaded):
                     self.rejected += 1
                 else:
                     self.failed += 1
+            # SLO feed OUTSIDE the plane lock (a transition may dump the
+            # flight record — rendering under the routing lock would
+            # stall every submit behind a postmortem).
+            if self._slo is not None:
+                if exc is None:
+                    self._slo.observe(latency_s=lat, ok=True)
+                else:
+                    self._slo.observe(ok=False)
         return _cb
 
     # -- watchdog / restart ------------------------------------------------
@@ -673,8 +699,8 @@ class ReplicatedServer:
         lifecycle state, and ``span_summary_by_replica`` attributes
         batch spans to the replica that executed them. ``degraded`` is
         the loud flag: any replica evicted or currently dead."""
+        lat = self._latencies.stats_snapshot()
         with self._lock:
-            lat = list(self._latencies_s)
             reps = list(self._replicas)
             out: Dict[str, Any] = {
                 "num_replicas": self.num_replicas,
@@ -685,12 +711,11 @@ class ReplicatedServer:
                 "restarts_total": self.restarts_total,
                 "swaps_completed": self.swaps_completed,
                 "retired_generations": dict(self._retired),
-                "num_latency_samples": len(lat),
+                "num_latency_samples": lat["count"],
             }
             outstanding = {r.index: r.outstanding for r in reps}
-        pct = profiling.latency_percentiles(lat)
-        out["p50_latency_s"] = pct["p50"] if pct else None
-        out["p99_latency_s"] = pct["p99"] if pct else None
+        out["p50_latency_s"] = lat["p50"]
+        out["p99_latency_s"] = lat["p99"]
 
         per_replica: Dict[int, Dict[str, Any]] = {}
         span_by_rep: Dict[int, Dict[str, Any]] = {}

@@ -25,9 +25,10 @@ test over the whole package (``tests/test_lint.py``):
     Every scope (class or function) that ``.start()``s a
     ``threading.Thread`` must also ``.join()`` one on its shutdown path —
     the "close() joins the worker" contract Prefetcher,
-    MicroBatchServer, and the data-plane runtime's lane pool
-    (``data/runtime.py`` — every pooled worker joins on ``close()``)
-    document and test.
+    MicroBatchServer, the data-plane runtime's lane pool
+    (``data/runtime.py`` — every pooled worker joins on ``close()``),
+    and the obs live exporter's publisher + HTTP threads
+    (``obs/live.py``) document and test.
 
 ``retry-transient``
     ``RetryPolicy(transient=...)`` tuples must never include
@@ -51,11 +52,13 @@ test over the whole package (``tests/test_lint.py``):
 ``metric-name``
     Every :class:`~keystone_tpu.obs.metrics.MetricsRegistry`
     register/lookup site (``*.counter(...)`` / ``*.gauge(...)`` /
-    ``*.histogram(...)``) must use a dotted name present in the
-    ``METRIC_*`` catalogue of :mod:`keystone_tpu.obs.metrics` — parsed,
-    never imported, exactly like the fault-site registry. A metric name
-    invented at a call site silently forks the dashboard namespace; the
-    catalogue is the one place names exist.
+    ``*.histogram(...)`` / ``*.bucketed_histogram(...)``) must use a
+    dotted name present in the ``METRIC_*`` catalogue of
+    :mod:`keystone_tpu.obs.metrics` — parsed, never imported, exactly
+    like the fault-site registry. A metric name invented at a call site
+    silently forks the dashboard namespace; the catalogue is the one
+    place names exist. Covers the live-plane names (``slo.*``,
+    ``exporter.*``) the ISSUE-10 exporter publishes.
 
 Findings are ``path:line: [rule] message``; the CLI exits 1 on any.
 """
@@ -537,7 +540,11 @@ def _check_fault_sites(
 # Rule: metric-name
 # ---------------------------------------------------------------------------
 
-_REGISTRY_METHODS = ("counter", "gauge", "histogram")
+# Every registry register/lookup door, including the ISSUE-10 mergeable
+# bucketed form (the live serving plane's latency store) — a name
+# invented at a bucketed_histogram site forks the dashboard namespace
+# exactly like the ring form would.
+_REGISTRY_METHODS = ("counter", "gauge", "histogram", "bucketed_histogram")
 
 
 def _check_metric_names(

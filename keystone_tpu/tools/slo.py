@@ -1,0 +1,144 @@
+"""SLO snapshot renderer CLI: ``python -m keystone_tpu.tools.slo <dir>``
+(wrapped by ``bin/slo``).
+
+Reads the atomic ``live_metrics.json`` snapshot the live exporter
+writes (``obs/live.py`` — ``run.py serve --metrics-dir=DIR``, or any
+:class:`~keystone_tpu.obs.live.LiveExporter` with a ``snapshot_dir``)
+and renders the operator view of the live plane:
+
+  - per-objective SLO table: state, fast/slow burn rates, budget
+    spent/remaining, good/bad totals;
+  - the transition log (when a breach happened and at what burn);
+  - the error-budget ledger (which state interval spent what);
+  - a one-line serving summary when the snapshot carries a
+    ``serving`` section (completed/rejected/failed + p99).
+
+Scrape-less by design: no HTTP, no server — a file read, so it works
+over ssh/cron exactly like ``bin/trace`` works on a trace dir. Exits
+non-zero on an unreadable/empty snapshot.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from keystone_tpu.obs.live import SNAPSHOT_FILE
+
+__all__ = ["main", "render"]
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Accept the snapshot file itself or the directory holding it."""
+    if os.path.isdir(path):
+        path = os.path.join(path, SNAPSHOT_FILE)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _fmt_burn(v: Any) -> str:
+    return f"{v:.2f}x" if isinstance(v, (int, float)) else "?"
+
+
+def render(doc: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    ts = doc.get("ts")
+    age = f", {time.time() - ts:.1f}s old" if isinstance(ts, (int, float)) \
+        else ""
+    lines.append(f"live snapshot seq={doc.get('seq', '?')}{age}")
+    slo = doc.get("slo") or {}
+    objectives: Dict[str, Dict[str, Any]] = slo.get("objectives") or {}
+    if objectives:
+        lines.append("")
+        lines.append(f"SLO verdict: {slo.get('state', '?')}")
+        lines.append(
+            f"  {'objective':<16} {'state':<7} {'burn_fast':>9} "
+            f"{'burn_slow':>9} {'budget_spent':>12} {'remaining':>10} "
+            f"{'good':>8} {'bad':>6}"
+        )
+        for name, o in sorted(objectives.items()):
+            spent = o.get("budget_spent_fraction")
+            remaining = o.get("budget_remaining_fraction")
+            spent_s = f"{spent:.1%}" if isinstance(spent, (int, float)) \
+                else "?"
+            rem_s = f"{remaining:.1%}" \
+                if isinstance(remaining, (int, float)) else "?"
+            lines.append(
+                f"  {name:<16} {o.get('state', '?'):<7} "
+                f"{_fmt_burn(o.get('burn_fast')):>9} "
+                f"{_fmt_burn(o.get('burn_slow')):>9} "
+                f"{spent_s:>12} {rem_s:>10} "
+                f"{o.get('good_total', 0):>8} {o.get('bad_total', 0):>6}"
+            )
+        for name, o in sorted(objectives.items()):
+            transitions = o.get("transitions") or []
+            if transitions:
+                lines.append("")
+                lines.append(f"  {name} transitions:")
+                for t in transitions:
+                    lines.append(
+                        f"    t+{t.get('t_s', 0):.3f}s "
+                        f"{t.get('from', '?')} -> {t.get('to', '?')} "
+                        f"(burn_fast {_fmt_burn(t.get('burn_fast'))}, "
+                        f"budget {t.get('budget_spent_fraction', 0):.1%} "
+                        f"spent)"
+                    )
+            ledger = o.get("ledger") or []
+            if len(ledger) > 1:
+                lines.append(f"  {name} budget ledger:")
+                for e in ledger:
+                    t_end = e.get("t_end")
+                    end_s = f"{t_end:.3f}s" if isinstance(
+                        t_end, (int, float)) else "now"
+                    lines.append(
+                        f"    [{e.get('state', '?'):<7}] "
+                        f"t+{e.get('t_start', 0):.3f}s..{end_s}  "
+                        f"good={e.get('good', 0)} bad={e.get('bad', 0)}"
+                    )
+    else:
+        lines.append("(no SLO objectives in this snapshot)")
+    serving = doc.get("serving") or {}
+    if serving:
+        p99 = serving.get("p99_latency_s")
+        p99_s = f"{p99 * 1e3:.2f}ms" if isinstance(p99, (int, float)) \
+            else "?"
+        lines.append("")
+        lines.append(
+            f"serving: completed={serving.get('completed', '?')} "
+            f"rejected={serving.get('rejected', '?')} "
+            f"failed={serving.get('failed', '?')} p99={p99_s}"
+            + (f" healthy_replicas={serving['healthy_replicas']}"
+               if "healthy_replicas" in serving else "")
+        )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "keystone-slo", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "snapshot",
+        help=f"snapshot dir (holding {SNAPSHOT_FILE}) or the file itself",
+    )
+    args = parser.parse_args(list(argv) if argv is not None else None)
+    try:
+        doc = load_snapshot(args.snapshot)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"slo: cannot read {args.snapshot!r}: {e}", file=sys.stderr)
+        return 1
+    if not doc:
+        print(f"slo: {args.snapshot!r} holds an empty snapshot",
+              file=sys.stderr)
+        return 1
+    print(render(doc))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

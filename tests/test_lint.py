@@ -164,6 +164,35 @@ def kick(runtime, x):
         assert _codes(findings) == ["jax-off-thread"]
         assert "_load_segment" in findings[0].message
 
+    # -- the live-exporter publisher form (ISSUE 10 satellite) -------------
+
+    EXPORTER_VIOLATION = """
+import threading
+import jax.numpy as jnp
+
+class Exporter:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self._doc = {"sum": float(jnp.zeros((4,)).sum())}  # JAX on tick
+
+    def close(self):
+        self._thread.join(timeout=5)
+"""
+
+    def test_fires_on_jax_in_exporter_publisher_target(self, tmp_path):
+        findings = _lint_snippet(tmp_path, self.EXPORTER_VIOLATION)
+        assert _codes(findings) == ["jax-off-thread"]
+        assert "_loop" in findings[0].message
+
+    def test_numpy_only_exporter_publisher_is_clean(self, tmp_path):
+        clean = self.EXPORTER_VIOLATION.replace(
+            "import jax.numpy as jnp", "import numpy as np"
+        ).replace("jnp.zeros", "np.zeros")
+        assert not _lint_snippet(tmp_path, clean)
+
     def test_data_submit_without_string_site_is_not_a_task(self, tmp_path):
         # The serving batcher's submit(request) takes DATA, not a task:
         # no string lane name in the first position, so the rule must
@@ -247,6 +276,56 @@ class Server:
         self._other.join()  # joins something, but not the thread binding
 """)
         assert _codes(findings) == ["thread-join"]
+
+    def test_fires_on_exporter_shaped_class_without_join(self, tmp_path):
+        """ISSUE 10 satellite: the live exporter's publisher/HTTP thread
+        shape (started in __init__, daemonized) is still held to the
+        close-joins contract — daemon=True is not an exemption."""
+        findings = _lint_snippet(tmp_path, """
+import threading
+
+class Exporter:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self._http_thread = threading.Thread(target=self._serve,
+                                             daemon=True)
+        self._http_thread.start()
+
+    def _loop(self):
+        pass
+
+    def _serve(self):
+        pass
+
+    def close(self):
+        pass  # forgot both joins
+""")
+        assert _codes(findings) == ["thread-join"]
+        assert "class Exporter" in findings[0].message
+
+    def test_exporter_joining_both_threads_is_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import threading
+
+class Exporter:
+    def __init__(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self._http_thread = threading.Thread(target=self._serve,
+                                             daemon=True)
+        self._http_thread.start()
+
+    def _loop(self):
+        pass
+
+    def _serve(self):
+        pass
+
+    def close(self):
+        self._thread.join(timeout=5)
+        self._http_thread.join(timeout=5)
+""")
 
     def test_module_level_thread_needs_join(self, tmp_path):
         findings = _lint_snippet(tmp_path, """
@@ -364,6 +443,36 @@ reg = obs.MetricsRegistry()
 reg.counter(METRIC_PREFETCH_RETRIES).add(1)
 reg.counter("overlap.site_busy_s", site="read").add(0.5)
 reg.histogram("serving.latency_s").observe(0.1)
+""")
+
+    def test_fires_on_invented_bucketed_histogram_name(self, tmp_path):
+        """ISSUE 10 satellite: the mergeable bucketed form is a
+        registry door like any other — an invented name there forks
+        the dashboard namespace identically."""
+        findings = _lint_snippet(tmp_path, """
+from keystone_tpu.obs.metrics import MetricsRegistry
+
+reg = MetricsRegistry()
+reg.bucketed_histogram("my.forked.latency").observe(0.1)
+""")
+        assert _codes(findings) == ["metric-name"]
+        assert "my.forked.latency" in findings[0].message
+
+    def test_live_plane_catalogue_names_are_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+from keystone_tpu import obs
+from keystone_tpu.obs.metrics import (
+    METRIC_EXPORTER_PUBLISHES,
+    METRIC_SERVING_LATENCY_S,
+    METRIC_SLO_STATE,
+)
+
+reg = obs.MetricsRegistry()
+reg.bucketed_histogram(METRIC_SERVING_LATENCY_S).observe(0.1)
+reg.gauge(METRIC_SLO_STATE, objective="latency").set(0)
+reg.gauge("slo.burn_rate_fast", objective="latency").set(0.5)
+reg.counter(METRIC_EXPORTER_PUBLISHES).add(1)
+reg.histogram("exporter.publish_s").observe(0.001)
 """)
 
     def test_dynamic_names_are_not_checked(self, tmp_path):

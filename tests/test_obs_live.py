@@ -1,0 +1,938 @@
+"""Live serving observability plane (ISSUE 10): mergeable log-bucketed
+histograms (exact cross-replica merge, empty/single-sample contract),
+the SLO burn-rate state machine + error-budget ledger, tail-sampled
+request tracing with bucket exemplars, the live exporter (Prometheus +
+atomic JSON snapshots, thread-join discipline), concurrent flight-dump
+uniqueness, the registry snapshot-vs-observe race, and the ``bin/slo``
+renderer.
+"""
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from keystone_tpu import obs
+from keystone_tpu.obs import flight as flight_mod
+from keystone_tpu.obs import tracer as tracer_mod
+from keystone_tpu.obs.metrics import (
+    METRIC_PREFETCH_LOAD_S,
+    METRIC_RUNTIME_LANE_TASKS,
+    METRIC_SERVING_LATENCY_S,
+    METRIC_SLO_STATE,
+    BucketedHistogram,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_tracer_or_dump_dir():
+    """Tests that die inside obs.tracing / with a flight dump dir set
+    must not leak process state into the rest of the suite."""
+    yield
+    tracer_mod._ACTIVE = None
+    flight_mod.set_dump_dir(None)
+
+
+# ---------------------------------------------------------------------------
+# BucketedHistogram: the mergeable latency store
+# ---------------------------------------------------------------------------
+
+
+class TestBucketedHistogram:
+    def test_empty_and_single_sample_contract(self):
+        """PR-9 conventions, pinned for the bucketed form: empty ->
+        None (never a fabricated zero), a single sample IS every
+        percentile (returned exactly via the min/max clamp), and an
+        out-of-range q raises naming the bound."""
+        h = BucketedHistogram()
+        assert h.percentile(50.0) is None
+        assert h.percentile(99.0) is None
+        snap = h.stats_snapshot()
+        assert snap == {"count": 0, "sum": 0.0, "p50": None, "p99": None}
+        h.observe(0.7)
+        for q in (0.0, 50.0, 99.0, 100.0):
+            assert h.percentile(q) == 0.7
+        with pytest.raises(ValueError, match=r"\[0, 100\]"):
+            h.percentile(101.0)
+        with pytest.raises(ValueError, match=r"\[0, 100\]"):
+            h.percentile(-1.0)
+
+    def test_non_finite_is_rejected_loudly(self):
+        h = BucketedHistogram()
+        for bad in (float("nan"), float("inf"), float("-inf")):
+            with pytest.raises(ValueError, match="finite"):
+                h.observe(bad)
+        assert h.count == 0
+
+    def test_underflow_bucket_and_zero(self):
+        h = BucketedHistogram()
+        h.observe(0.0)
+        assert h.percentile(50.0) == 0.0  # clamped to observed min/max
+        h.observe(1e-9)
+        assert 0.0 <= h.percentile(99.0) <= BucketedHistogram._LO
+
+    def test_count_sum_snapshot(self):
+        h = BucketedHistogram()
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        snap = h.stats_snapshot()
+        assert snap["count"] == 3
+        assert snap["sum"] == pytest.approx(0.6)
+
+    def test_merge_is_exact_and_matches_concatenated_stream(self):
+        """The acceptance property: merged p50/p99 over a pair of
+        replica histograms (1) EXACTLY equals a histogram built from
+        the concatenated stream (bucket counts add — no resampling),
+        and (2) is within one bucket width of the true nearest-rank
+        percentile of the raw concatenated values."""
+        rng = np.random.default_rng(0)
+        a_vals = rng.lognormal(mean=-4.0, sigma=1.0, size=700)
+        b_vals = rng.lognormal(mean=-2.5, sigma=0.6, size=300)
+
+        ha, hb, hcat = (
+            BucketedHistogram(), BucketedHistogram(), BucketedHistogram()
+        )
+        for v in a_vals:
+            ha.observe(v)
+            hcat.observe(v)
+        for v in b_vals:
+            hb.observe(v)
+            hcat.observe(v)
+        merged = BucketedHistogram()
+        merged.merge(ha).merge(hb)
+
+        both = np.sort(np.concatenate([a_vals, b_vals]))
+        assert merged.count == hcat.count == len(both)
+        assert merged.total == pytest.approx(hcat.total)
+        growth = BucketedHistogram._GROWTH
+        for q in (10.0, 50.0, 90.0, 99.0):
+            est = merged.percentile(q)
+            # (1) exact merge: identical to the concatenated histogram.
+            assert est == hcat.percentile(q), q
+            # (2) within one bucket width of the true percentile.
+            rank = max(int(math.ceil((q / 100.0) * len(both))), 1)
+            true = both[rank - 1]
+            assert true / (growth * 1.001) <= est <= true * growth * 1.001, (
+                q, est, true
+            )
+
+    def test_merge_carries_min_max_and_exemplars(self):
+        a, b = BucketedHistogram(), BucketedHistogram()
+        a.observe(0.001, exemplar="run/1")
+        b.observe(1.0, exemplar="run/2")
+        a.merge(b)
+        assert a.percentile(0.0) >= 0.001 * (1 / a._GROWTH)
+        # p100 lands in the merged max's bucket (min/max merged too).
+        assert 1.0 / a._GROWTH <= a.percentile(100.0) <= 1.0
+        assert "run/2" in a.exemplars_at_or_above(99.0)
+
+    def test_exemplars_link_tail_buckets_worst_first(self):
+        h = BucketedHistogram()
+        for i in range(100):
+            h.observe(0.001, exemplar=f"run/fast{i}")
+        h.observe(5.0, exemplar="run/slow")
+        tail = h.exemplars_at_or_above(99.0)
+        assert tail[0] == "run/slow"
+        assert h.exemplars_at_or_above(99.0, limit=1) == ["run/slow"]
+        assert BucketedHistogram().exemplars_at_or_above(99.0) == []
+
+    def test_registry_form_and_snapshot_surface(self):
+        """`snapshot()` keeps the `.count/.sum/.p50/.p99` sub-key
+        surface for the bucketed form — dashboards don't care which
+        store backs a latency metric."""
+        r = obs.MetricsRegistry()
+        h = r.bucketed_histogram(METRIC_SERVING_LATENCY_S)
+        assert r.bucketed_histogram(METRIC_SERVING_LATENCY_S) is h
+        for v in (0.1, 0.2, 0.4):
+            h.observe(v)
+        snap = r.snapshot()
+        assert snap["serving.latency_s.count"] == 3
+        assert snap["serving.latency_s.p50"] == pytest.approx(0.2, rel=0.09)
+        with pytest.raises(TypeError, match="already registered"):
+            r.histogram(METRIC_SERVING_LATENCY_S)
+
+
+# ---------------------------------------------------------------------------
+# Registry snapshot raced against concurrent observe()/add()
+# ---------------------------------------------------------------------------
+
+
+class TestRegistrySnapshotRace:
+    def test_snapshot_never_throws_and_counters_never_regress(self):
+        """ISSUE 10 satellite: lane workers hammer observe()/add()
+        while an exporter thread snapshots — every snapshot must
+        succeed, counters and histogram counts must read monotonically
+        across successive snapshots, and each histogram's four sub-keys
+        must be mutually consistent (one lock acquisition)."""
+        r = obs.MetricsRegistry()
+        stop = threading.Event()
+        errors = []
+
+        def worker(site):
+            c = r.counter(METRIC_RUNTIME_LANE_TASKS, site=site)
+            ring = r.histogram(METRIC_PREFETCH_LOAD_S)
+            bucketed = r.bucketed_histogram(METRIC_SERVING_LATENCY_S)
+            i = 0
+            try:
+                while not stop.is_set():
+                    c.add(1)
+                    ring.observe(0.001 * (i % 7 + 1))
+                    bucketed.observe(0.001 * (i % 5 + 1))
+                    i += 1
+            except Exception as e:  # noqa: BLE001 — reported below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=worker, args=(s,), daemon=True)
+            for s in ("read", "verify", "checkpoint")
+        ]
+        for th in threads:
+            th.start()
+        try:
+            prev_counter = 0.0
+            prev_ring = prev_bucketed = 0
+            for _ in range(300):
+                snap = r.snapshot()  # must never throw
+                total = sum(
+                    v for k, v in snap.items()
+                    if k.startswith("runtime.lane.tasks{")
+                )
+                assert total >= prev_counter
+                prev_counter = total
+                ring_count = snap.get("prefetch.load_s.count", 0)
+                assert ring_count >= prev_ring
+                prev_ring = ring_count
+                b_count = snap.get("serving.latency_s.count", 0)
+                assert b_count >= prev_bucketed
+                prev_bucketed = b_count
+                if b_count:
+                    assert snap["serving.latency_s.p99"] is not None
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=10)
+        assert not errors
+
+
+# ---------------------------------------------------------------------------
+# SLO objectives, burn rates, the state machine, the budget ledger
+# ---------------------------------------------------------------------------
+
+
+def _fake_clock():
+    now = [0.0]
+
+    def clock():
+        return now[0]
+
+    return now, clock
+
+
+def _latency_objective(**kw):
+    kw.setdefault("threshold_s", 0.1)
+    kw.setdefault("target", 0.9)
+    kw.setdefault("fast_window_s", 1.0)
+    kw.setdefault("slow_window_s", 4.0)
+    kw.setdefault("warn_burn", 1.0)
+    kw.setdefault("breach_burn", 5.0)
+    return obs.SLOObjective("latency", kind="latency", **kw)
+
+
+class TestSLOObjectiveValidation:
+    def test_kind_threshold_target_window_and_burn_order(self):
+        with pytest.raises(ValueError, match="kind"):
+            obs.SLOObjective("x", kind="throughput")
+        with pytest.raises(ValueError, match="threshold_s"):
+            obs.SLOObjective("x", kind="latency", threshold_s=None)
+        with pytest.raises(ValueError, match="target"):
+            obs.SLOObjective("x", kind="availability", target=1.0)
+        with pytest.raises(ValueError, match="fast_window_s"):
+            obs.SLOObjective(
+                "x", kind="availability", fast_window_s=10.0,
+                slow_window_s=5.0,
+            )
+        with pytest.raises(ValueError, match="breach_burn"):
+            obs.SLOObjective(
+                "x", kind="availability", warn_burn=3.0, breach_burn=1.0,
+            )
+        with pytest.raises(ValueError, match="min_events"):
+            obs.SLOObjective("x", kind="availability", min_events=0)
+
+    def test_tracker_rejects_empty_and_duplicate_objectives(self):
+        with pytest.raises(ValueError, match="at least one"):
+            obs.SLOTracker([])
+        with pytest.raises(ValueError, match="duplicate"):
+            obs.SLOTracker([
+                obs.SLOObjective("a", kind="availability"),
+                obs.SLOObjective("a", kind="availability"),
+            ])
+
+
+class TestSLOStateMachine:
+    def test_breach_and_recovery_with_budget_ledger(self):
+        """The acceptance sequence, deterministic under a fake clock:
+        healthy traffic -> OK; a failure storm -> BREACH (fast-window
+        burn over the page threshold); the storm ages out of the slow
+        window -> recovery to OK — with the error-budget ledger
+        attributing the bad events to the degraded interval."""
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([_latency_objective()], clock=clock)
+
+        for _ in range(50):
+            tr.observe(latency_s=0.01)
+        assert tr.states() == {"latency": "OK"}
+
+        now[0] = 1.0  # the fast window ages the healthy phase out
+        for _ in range(20):
+            tr.observe(latency_s=2.0)  # way past threshold_s
+        assert tr.states() == {"latency": "BREACH"}
+        assert tr.worst_state() == "BREACH"
+
+        # Recovery: healthy traffic after both windows pass the storm.
+        now[0] = 6.0
+        for _ in range(50):
+            tr.observe(latency_s=0.01)
+        assert tr.states() == {"latency": "OK"}
+
+        v = tr.verdict()
+        assert v["state"] == "OK"
+        o = v["objectives"]["latency"]
+        # The storm escalates (possibly via WARN as the slow window
+        # dilutes) to exactly one BREACH, and the run ends recovered.
+        tos = [t["to"] for t in o["transitions"]]
+        assert tos[-2:] == ["BREACH", "OK"]
+        assert tos.count("BREACH") == 1
+        assert o["good_total"] == 100
+        assert o["bad_total"] == 20
+        # Budget: 20 bad / 120 total against a 10% budget.
+        assert o["budget_spent_fraction"] == pytest.approx(
+            (20 / 120) / 0.1, abs=1e-3
+        )
+        # The ledger attributes the storm to the degraded intervals:
+        # escalation fires on the min_events-th bad observation (which
+        # is charged to the interval it arrived in), and every bad
+        # event after the BREACH transition lands on the breach entry.
+        states = [e["state"] for e in o["ledger"]]
+        assert states[0] == "OK" and states[-1] == "OK"
+        breach = [e for e in o["ledger"] if e["state"] == "BREACH"]
+        assert len(breach) == 1
+        assert breach[0]["bad"] == 10 and breach[0]["good"] <= 1
+        assert breach[0]["t_end"] is not None
+        assert o["ledger"][-1]["t_end"] is None  # the open interval
+
+    def test_min_events_gates_escalation_not_decay(self):
+        """Regression (seen on the chaos bench's first cold batch): ONE
+        slow request in an otherwise-empty fast window is a 100% bad
+        fraction — burn = 1/budget — and must NOT page. Escalation
+        waits for min_events; de-escalation never does."""
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker(
+            [_latency_objective(min_events=10)], clock=clock
+        )
+        tr.observe(latency_s=2.0)  # the cold first request, slow
+        assert tr.states() == {"latency": "OK"}
+        for _ in range(8):
+            tr.observe(latency_s=2.0)
+        assert tr.states() == {"latency": "OK"}  # 9 events: still gated
+        tr.observe(latency_s=2.0)
+        assert tr.states() == {"latency": "BREACH"}  # 10th: real storm
+
+    def test_idle_decay_via_evaluate(self):
+        """A breach with NO follow-up traffic must still clear: the
+        exporter's periodic evaluate() re-runs the windows on the
+        current clock."""
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([_latency_objective()], clock=clock)
+        for _ in range(10):
+            tr.observe(latency_s=2.0)
+        assert tr.states() == {"latency": "BREACH"}
+        now[0] = 10.0
+        assert tr.evaluate() == {"latency": "OK"}
+
+    def test_warn_between_ok_and_breach_and_hysteresis(self):
+        """A slow-window burn above warn_burn WARNs without paging; a
+        breach only clears when the fast burn is back under warn_burn
+        (not merely under breach_burn — no flapping)."""
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker(
+            [_latency_objective(warn_burn=1.0, breach_burn=8.0)],
+            clock=clock,
+        )
+        # 3 bad / 20 total in both windows: burn = 0.15/0.1 = 1.5 —
+        # above warn, far below breach.
+        for _ in range(17):
+            tr.observe(latency_s=0.01)
+        for _ in range(3):
+            tr.observe(latency_s=2.0)
+        assert tr.states() == {"latency": "WARN"}
+
+        # Storm to BREACH (83 bad / 100 total -> burn 8.3), then dilute
+        # the fast window to burn ~2 (>= warn, < breach): hysteresis
+        # holds the breach.
+        for _ in range(80):
+            tr.observe(latency_s=2.0)
+        assert tr.states() == {"latency": "BREACH"}
+        now[0] = 1.0
+        for _ in range(8):
+            tr.observe(latency_s=2.0)
+        for _ in range(32):
+            tr.observe(latency_s=0.01)
+        # fast window (0,1]: 8/40 bad -> burn 2.0: under breach_burn but
+        # over warn_burn -> still BREACH (hysteresis).
+        assert tr.states() == {"latency": "BREACH"}
+        now[0] = 6.0
+        tr.observe(latency_s=0.01)
+        assert tr.states() == {"latency": "OK"}
+
+    def test_availability_objective_counts_rejects(self):
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([
+            obs.SLOObjective(
+                "availability", kind="availability", target=0.5,
+                fast_window_s=1.0, slow_window_s=2.0, breach_burn=1.9,
+            ),
+        ], clock=clock)
+        tr.observe(latency_s=0.01)  # good
+        tr.observe(ok=False)        # shed/reject/failure
+        v = tr.verdict()["objectives"]["availability"]
+        assert v["good_total"] == 1 and v["bad_total"] == 1
+
+    def test_latency_objective_ignores_ok_without_latency(self):
+        """ok=True with no measured latency is not a latency SLI (but
+        still a good availability event)."""
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([
+            _latency_objective(),
+            obs.SLOObjective("availability", kind="availability"),
+        ], clock=clock)
+        tr.observe(ok=True)
+        v = tr.verdict()["objectives"]
+        assert v["latency"]["good_total"] == 0
+        assert v["availability"]["good_total"] == 1
+
+    def test_transitions_are_traced_and_breach_dumps_flight(self, caplog):
+        import logging
+
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([_latency_objective()], clock=clock)
+        with caplog.at_level(
+            logging.WARNING, logger="keystone_tpu.obs.flight"
+        ):
+            with obs.tracing() as t:
+                for _ in range(10):
+                    tr.observe(latency_s=2.0)
+        evs = [r for r in t.events if r.get("name") == "slo.transition"]
+        assert len(evs) == 1
+        assert evs[0]["args"]["to"] == "BREACH"
+        assert any("SLO BREACH" in r.message for r in caplog.records)
+
+    def test_states_publish_into_registry(self):
+        now, clock = _fake_clock()
+        reg = obs.MetricsRegistry()
+        tr = obs.SLOTracker(
+            [_latency_objective()], metrics=reg, clock=clock
+        )
+        for _ in range(10):
+            tr.observe(latency_s=2.0)
+        # Gauges refresh on evaluate() (the exporter tick), not on the
+        # per-request hot path — the transition counter is the
+        # exception (transitions are rare and must never be missed).
+        assert reg.snapshot()["slo.state{objective=latency}"] == 0.0
+        tr.evaluate()
+        snap = reg.snapshot()
+        assert snap["slo.state{objective=latency}"] == 2.0  # BREACH
+        assert snap["slo.burn_rate_fast{objective=latency}"] >= 5.0
+        assert snap["slo.transitions{objective=latency}"] == 1.0
+
+
+# ---------------------------------------------------------------------------
+# Tail-sampled request tracing + exemplars
+# ---------------------------------------------------------------------------
+
+
+class TestTailSampler:
+    def test_validation(self):
+        with pytest.raises(ValueError, match=r"\[0, 1\]"):
+            obs.TailSampler(head_rate=1.5)
+        with pytest.raises(ValueError, match="slow_s"):
+            obs.TailSampler(slow_s=0.0)
+
+    def test_flagged_and_slow_always_kept(self):
+        s = obs.TailSampler(head_rate=0.0, slow_s=0.5)
+        assert s.keep(0.001, flagged=True) == (True, "flagged")
+        assert s.keep(0.9) == (True, "slow")
+        assert s.keep(0.001) == (False, None)
+        st = s.stats()
+        assert st["kept"] == {"flagged": 1, "slow": 1}
+        assert st["kept_total"] == 2 and st["sampled_out"] == 1
+
+    def test_head_rate_keeps_every_nth_deterministically(self):
+        s = obs.TailSampler(head_rate=0.25)
+        kept = [s.keep(0.001)[0] for _ in range(20)]
+        assert sum(kept) == 5
+        assert kept == ([False, False, False, True] * 5)
+
+    def test_rate_one_keeps_everything(self):
+        s = obs.TailSampler(head_rate=1.0)
+        assert all(s.keep(0.0)[0] for _ in range(10))
+        assert s.stats()["sampled_out"] == 0
+
+    def test_tracer_applies_sampler_to_serving_spans_only(self):
+        sampler = obs.TailSampler(head_rate=0.0, slow_s=0.5)
+        with obs.tracing(serving_sampler=sampler) as t:
+            t0 = time.perf_counter()
+            # Healthy fast span: sampled out.
+            assert t.add_serving_span("serving.request", t0, t0 + 0.01) \
+                is None
+            # Error span: always kept, reason stamped.
+            sid = t.add_serving_span(
+                "serving.request", t0, t0 + 0.01, flagged=True,
+                outcome="error",
+            )
+            assert sid is not None
+            # Slow span: always kept.
+            assert t.add_serving_span(
+                "serving.request", t0, t0 + 0.9
+            ) is not None
+            # Fit-path spans are never sampled.
+            assert t.add_span("fold.chunk", t0, t0 + 0.001) is not None
+        kept = t.spans("serving.request")
+        assert len(kept) == 2
+        assert {s["args"].get("keep") for s in kept} == {"flagged", "slow"}
+
+    def test_no_sampler_keeps_everything(self):
+        with obs.tracing() as t:
+            t0 = time.perf_counter()
+            assert t.add_serving_span("serving.request", t0, t0 + 0.001) \
+                is not None
+
+    def test_sampler_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_TRACE", str(tmp_path / "tr"))
+        monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "0.5")
+        monkeypatch.setenv("KEYSTONE_TRACE_SLOW_MS", "250")
+        with obs.tracing_from_env():
+            t = obs.active_tracer()
+            assert t.serving_sampler is not None
+            assert t.serving_sampler.head_rate == 0.5
+            assert t.serving_sampler.slow_s == pytest.approx(0.25)
+
+    def test_env_knob_parse_errors_name_the_variable(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.setenv("KEYSTONE_TRACE", str(tmp_path / "tr"))
+        monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "1%")
+        with pytest.raises(ValueError, match="KEYSTONE_TRACE_SAMPLE"):
+            obs.tracing_from_env()
+        monkeypatch.setenv("KEYSTONE_TRACE_SAMPLE", "2")
+        with pytest.raises(ValueError, match="KEYSTONE_TRACE_SAMPLE"):
+            obs.tracing_from_env()
+
+
+# ---------------------------------------------------------------------------
+# The live exporter
+# ---------------------------------------------------------------------------
+
+
+def _sources():
+    reg = obs.MetricsRegistry()
+    reg.counter(METRIC_RUNTIME_LANE_TASKS, site="read").add(3)
+    reg.bucketed_histogram(METRIC_SERVING_LATENCY_S).observe(0.02)
+    return reg
+
+
+class TestLiveExporter:
+    def test_publish_collects_renders_and_snapshots(self, tmp_path):
+        reg = _sources()
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([_latency_objective()], clock=clock)
+        tr.observe(latency_s=0.01)
+        ex = obs.LiveExporter(
+            sources={"metrics": reg, "serving": lambda: {"completed": 7}},
+            slo=tr, snapshot_dir=str(tmp_path), interval_s=60.0,
+        )
+        try:
+            doc = ex.publish_now()
+            assert doc["serving"]["completed"] == 7
+            assert doc["slo"]["state"] == "OK"
+            assert doc["metrics"]["serving.latency_s.count"] == 1
+            assert doc["exporter"]["exporter.publishes"] >= 0
+            # Atomic JSON snapshot on disk, loadable.
+            with open(tmp_path / "live_metrics.json") as f:
+                on_disk = json.load(f)
+            assert on_disk["serving"]["completed"] == 7
+            # Prometheus text: labeled registry keys + flattened dicts.
+            text = ex.last_prometheus()
+            assert 'keystone_metrics_runtime_lane_tasks{site="read"} 3' \
+                in text
+            assert "keystone_serving_completed 7" in text
+            assert 'keystone_slo_objectives_latency_burn_fast' in text
+            # The ALERTABLE numeric projection: the string state is
+            # JSON-only, state_level is what a Prometheus alert reads.
+            assert "keystone_slo_state_level 0" in text
+            assert "keystone_slo_objectives_latency_state_level 0" in text
+        finally:
+            ex.close()
+
+    def test_http_endpoints(self):
+        reg = _sources()
+        ex = obs.LiveExporter(
+            sources={"metrics": reg}, port=0, interval_s=60.0,
+        )
+        try:
+            ex.publish_now()
+            base = f"http://127.0.0.1:{ex.port}"
+            with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+                assert r.read() == b"ok\n"
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                assert b"keystone_metrics_runtime_lane_tasks" in r.read()
+            with urllib.request.urlopen(
+                base + "/snapshot.json", timeout=5
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["metrics"]["runtime.lane.tasks{site=read}"] == 3.0
+            with pytest.raises(urllib.error.HTTPError):
+                urllib.request.urlopen(base + "/nope", timeout=5)
+        finally:
+            ex.close()
+
+    def test_close_joins_both_threads_and_is_idempotent(self):
+        ex = obs.LiveExporter(sources={}, port=0, interval_s=60.0)
+        ex.close()
+        ex.close()
+        assert not ex._thread.is_alive()
+        assert not ex._http_thread.is_alive()
+
+    def test_final_publish_on_close(self, tmp_path):
+        calls = []
+        ex = obs.LiveExporter(
+            sources={"s": lambda: calls.append(1) or {"n": len(calls)}},
+            snapshot_dir=str(tmp_path), interval_s=60.0,
+        )
+        ex.close()
+        assert calls  # close() publishes once even if no tick elapsed
+        with open(tmp_path / "live_metrics.json") as f:
+            assert json.load(f)["s"]["n"] == len(calls)
+
+    def test_publisher_loop_ticks(self):
+        reg = _sources()
+        ex = obs.LiveExporter(sources={"metrics": reg}, interval_s=0.02)
+        try:
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                if ex.last_snapshot().get("metrics"):
+                    break
+                time.sleep(0.01)
+            assert ex.last_snapshot()["metrics"][
+                "runtime.lane.tasks{site=read}"
+            ] == 3.0
+        finally:
+            ex.close()
+
+    def test_collector_error_is_counted_never_fatal(self):
+        def boom():
+            raise RuntimeError("collector down")
+
+        ex = obs.LiveExporter(
+            sources={"bad": boom, "good": lambda: {"v": 1}},
+            interval_s=60.0,
+        )
+        try:
+            doc = ex.publish_now()
+            assert doc["good"]["v"] == 1
+            assert "bad" not in doc
+            assert ex.metrics.snapshot()["exporter.errors"] >= 1
+        finally:
+            ex.close()
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="interval_s"):
+            obs.LiveExporter(interval_s=0.0)
+        with pytest.raises(TypeError, match="callable"):
+            obs.LiveExporter(sources={"x": 42})
+
+    def test_render_prometheus_skips_non_numeric_and_sequences(self):
+        text = obs.render_prometheus({
+            "serving": {
+                "state": "OK",            # string: JSON-only
+                "ledger": [1, 2, 3],      # sequence: JSON-only
+                "ok": True,               # bool: skipped
+                "p99_latency_s": 0.25,
+            },
+        })
+        assert text == "keystone_serving_p99_latency_s 0.25\n"
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder: concurrent dumps must not clobber each other
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentFlightDumps:
+    def test_concurrent_dumps_get_unique_files(self, tmp_path):
+        """ISSUE 10 satellite regression: two replicas dying in the
+        same tick dump concurrently — every dump must land in its OWN
+        file (O_EXCL + per-process sequence), none clobbered."""
+        flight_mod.set_dump_dir(str(tmp_path))
+        n = 16
+        barrier = threading.Barrier(n)
+
+        def die(i):
+            barrier.wait()
+            flight_mod.dump_flight_record(f"replica {i} died")
+
+        threads = [
+            threading.Thread(target=die, args=(i,)) for i in range(n)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+        files = sorted(tmp_path.glob("flight-*.txt"))
+        assert len(files) == n
+        contexts = set()
+        for f in files:
+            body = f.read_text()
+            assert "flight record" in body
+            contexts.add(body.splitlines()[0])
+        assert contexts == {f"context: replica {i} died" for i in range(n)}
+
+    def test_unwritable_dump_dir_keeps_the_loud_log(self, caplog):
+        """Regression: the on-disk dump is an augmentation — an
+        unwritable dump dir (bad env, full disk) must neither raise
+        nor swallow the warning log the dump exists to emit."""
+        import logging
+
+        flight_mod.set_dump_dir("/proc/definitely/not/writable")
+        with caplog.at_level(
+            logging.WARNING, logger="keystone_tpu.obs.flight"
+        ):
+            block = flight_mod.dump_flight_record("replica died")
+        assert "flight record" in block
+        assert any("replica died" in r.message for r in caplog.records)
+
+    def test_env_knob_and_no_dir_writes_nothing(self, tmp_path,
+                                                monkeypatch):
+        sub = tmp_path / "envdumps"
+        monkeypatch.setenv(flight_mod.DUMP_DIR_ENV, str(sub))
+        flight_mod.dump_flight_record("env-configured death")
+        assert len(list(sub.glob("flight-*.txt"))) == 1
+        monkeypatch.delenv(flight_mod.DUMP_DIR_ENV)
+        flight_mod.set_dump_dir(None)
+        flight_mod.dump_flight_record("no dir configured")  # must not raise
+        assert len(list(sub.glob("flight-*.txt"))) == 1
+
+
+# ---------------------------------------------------------------------------
+# bin/slo: the snapshot renderer
+# ---------------------------------------------------------------------------
+
+
+class TestSLOCli:
+    def _snapshot_dir(self, tmp_path):
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([_latency_objective()], clock=clock)
+        for _ in range(20):
+            tr.observe(latency_s=0.01)
+        now[0] = 1.0
+        for _ in range(10):
+            tr.observe(latency_s=2.0)  # BREACH, on the record
+        now[0] = 6.0
+        tr.evaluate()  # recovery
+        ex = obs.LiveExporter(
+            sources={"serving": lambda: {
+                "completed": 30, "rejected": 0, "failed": 10,
+                "p99_latency_s": 0.02,
+            }},
+            slo=tr, snapshot_dir=str(tmp_path), interval_s=60.0,
+        )
+        ex.close()  # close() publishes the final snapshot
+        return tmp_path
+
+    def test_renders_verdict_transitions_and_ledger(self, tmp_path,
+                                                    capsys):
+        from keystone_tpu.tools import slo as slo_cli
+
+        assert slo_cli.main([str(self._snapshot_dir(tmp_path))]) == 0
+        out = capsys.readouterr().out
+        assert "SLO verdict: OK" in out
+        assert "latency" in out
+        assert "BREACH" in out          # the transition log
+        assert "budget ledger" in out
+        assert "completed=30" in out    # the serving summary line
+
+    def test_errors_on_missing_or_empty_snapshot(self, tmp_path, capsys):
+        from keystone_tpu.tools import slo as slo_cli
+
+        assert slo_cli.main([str(tmp_path / "nope")]) == 1
+        assert "cannot read" in capsys.readouterr().err
+        empty = tmp_path / "live_metrics.json"
+        empty.write_text("{}")
+        assert slo_cli.main([str(empty)]) == 1
+
+    def test_bin_wrapper_exists_and_is_executable(self):
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(root, "bin", "slo")
+        assert os.access(path, os.X_OK)
+        with open(path) as f:
+            assert "keystone_tpu.tools.slo" in f.read()
+
+
+# ---------------------------------------------------------------------------
+# Serving integration: the plane feeds the SLO tracker; exemplars flow
+# ---------------------------------------------------------------------------
+
+
+class TestServingIntegration:
+    def _server(self, slo=None, **kw):
+        from keystone_tpu.serving.export import export_plan
+        from keystone_tpu.serving.batcher import MicroBatchServer
+        from keystone_tpu.workflow import Transformer
+        from tests._serving_util import fitted_from_transformer
+
+        class Scale2(Transformer):
+            def apply(self, x):
+                import jax.numpy as jnp
+
+                return jnp.asarray(x) * 2.0
+
+        plan = export_plan(
+            fitted_from_transformer(Scale2()), np.zeros(4, np.float32),
+            max_batch=8,
+        )
+        kw.setdefault("max_wait_ms", 0.5)
+        return MicroBatchServer(plan, slo=slo, **kw)
+
+    @pytest.mark.chaos
+    def test_served_breach_and_recovery_sequence(self):
+        """The acceptance chaos sequence at unit scale, deterministic
+        under a fake tracker clock: a healthy served window is OK, an
+        injected execute-failure storm drives BREACH, post-storm
+        healthy traffic recovers to OK — and the error-budget ledger
+        attributes the failures to the degraded interval."""
+        from keystone_tpu.serving.batcher import ServerClosed  # noqa: F401
+        from keystone_tpu.utils.faults import FaultPlan, FaultRule
+
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([
+            obs.SLOObjective(
+                "availability", kind="availability", target=0.9,
+                fast_window_s=1.0, slow_window_s=4.0, breach_burn=4.0,
+            ),
+        ], clock=clock)
+        srv = self._server(slo=tr, breaker_threshold=0)
+        x = np.zeros(4, np.float32)
+        try:
+            for _ in range(20):
+                srv.submit(x).result(timeout=30)
+            assert tr.states() == {"availability": "OK"}
+
+            now[0] = 1.0
+            storm = FaultPlan([FaultRule(
+                "serving.execute", "error", calls=list(range(64)),
+            )])
+            with storm:
+                for _ in range(20):
+                    with pytest.raises(Exception):
+                        srv.submit(x).result(timeout=30)
+            assert tr.states() == {"availability": "BREACH"}
+
+            now[0] = 6.0
+            for _ in range(20):
+                srv.submit(x).result(timeout=30)
+            tr.evaluate()
+            assert tr.states() == {"availability": "OK"}
+        finally:
+            srv.close()
+        o = tr.verdict()["objectives"]["availability"]
+        tos = [t["to"] for t in o["transitions"]]
+        assert tos[-2:] == ["BREACH", "OK"]
+        assert tos.count("BREACH") == 1
+        assert o["good_total"] == 40 and o["bad_total"] == 20
+        breach = [e for e in o["ledger"] if e["state"] == "BREACH"]
+        # Escalation fires on the min_events-th failure (charged to the
+        # preceding interval); the rest of the storm lands on the
+        # breach entry.
+        assert len(breach) == 1 and breach[0]["bad"] == 10
+
+    def test_shed_feeds_slo_as_bad_event(self):
+        """Admission control spends error budget visibly: a shed
+        victim is a bad availability event."""
+        from keystone_tpu.serving.batcher import ServerOverloaded
+
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([
+            obs.SLOObjective("availability", kind="availability"),
+        ], clock=clock)
+        srv = self._server(
+            slo=tr, max_queue_depth=1, max_wait_ms=200.0,
+        )
+        x = np.zeros(4, np.float32)
+        futures = []
+        try:
+            # Queue depth 1 + a 200ms batching window: each new submit
+            # sheds the previously queued request (earliest deadline
+            # first — the VICTIM's future carries the overload, the
+            # incoming submit does not raise).
+            for _ in range(8):
+                futures.append(srv.submit(x, deadline_ms=1e6))
+        finally:
+            srv.close()
+        sheds = 0
+        for f in futures:
+            try:
+                f.result(timeout=30)
+            except ServerOverloaded:
+                sheds += 1
+            except Exception:  # noqa: BLE001 — the last queued request
+                pass           # resolves ServerClosed on close()
+        assert sheds >= 1
+        assert tr.verdict()["objectives"]["availability"]["bad_total"] \
+            >= sheds
+
+    def test_completed_requests_attach_trace_exemplars(self):
+        """Under tracing, a kept serving span's run_id/span_id lands as
+        an exemplar on its latency bucket — the p99-breach→trace
+        link."""
+        with obs.tracing() as t:
+            srv = self._server()
+            x = np.zeros(4, np.float32)
+            try:
+                for _ in range(5):
+                    srv.submit(x).result(timeout=30)
+            finally:
+                srv.close()
+            hist = srv.metrics.bucketed_histogram(METRIC_SERVING_LATENCY_S)
+            refs = hist.exemplars_at_or_above(0.0, limit=8)
+            assert refs
+            for ref in refs:
+                run_id, sid = ref.split("/")
+                assert run_id == t.run_id
+                assert any(
+                    r.get("span_id") == int(sid)
+                    for r in t.spans("serving.request")
+                )
+
+    def test_loadgen_report_carries_slo_verdict(self):
+        from keystone_tpu.serving.loadgen import run_open_loop
+
+        now, clock = _fake_clock()
+        tr = obs.SLOTracker([
+            obs.SLOObjective("availability", kind="availability"),
+        ], clock=clock)
+        srv = self._server(slo=tr)
+        try:
+            report = run_open_loop(
+                srv.submit, lambda i: np.zeros(4, np.float32),
+                rate_hz=200.0, duration_s=0.2, seed=0, slo=tr,
+            )
+        finally:
+            srv.close()
+        assert report.slo is not None
+        assert report.slo["state"] == "OK"
+        row = report.to_row_dict()
+        assert row["slo"]["objectives"]["availability"]["state"] == "OK"
+        assert "ledger" not in row["slo"]["objectives"]["availability"]

@@ -127,12 +127,16 @@ class TestBitIdentity:
         # The 20ms coalescing wait dominates this idle-arrival workload:
         # the split must ATTRIBUTE the latency to the queue side.
         assert stats["p50_queue_wait_s"] > stats["p50_exec_s"]
-        # Wait + exec compose to roughly the end-to-end percentile (the
-        # spans measure the same completions the latency deque does).
+        # Wait + exec compose to roughly the end-to-end percentile.
+        # Since ISSUE 10 the end-to-end number comes from the
+        # log-BUCKETED histogram (whole-run percentiles at ~8%/bucket
+        # resolution) while the split stays on the exact span window —
+        # the comparison tolerates one bucket width.
+        from keystone_tpu.obs.metrics import BucketedHistogram
+
         assert (
             stats["p99_queue_wait_s"] + stats["p99_exec_s"]
-            >= stats["p50_latency_s"]
-        )
+        ) * BucketedHistogram._GROWTH >= stats["p50_latency_s"]
 
 
 class TestOverload:
