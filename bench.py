@@ -223,6 +223,45 @@ def _latency_violations(obj, path):
     return bad
 
 
+def _autoscale_violations(obj, path):
+    """Auditability rule (ISSUE 12 satellite): any dict claiming
+    elasticity actions (a ``scale_ups`` / ``scale_downs`` key) must
+    carry the decision-event count (``num_decisions``) and the replica
+    bounds the controller ran under (``min_replicas`` + ``max_replicas``)
+    in the SAME dict — a scale count with no audit trail and no bounds
+    is not a measured control-loop claim. ``Autoscaler.stats()`` emits
+    exactly this shape, so dropping it into a row passes as-is."""
+    bad = []
+    if isinstance(obj, dict):
+        keys = list(obj)
+        claims = [k for k in keys if k in ("scale_ups", "scale_downs")]
+        if claims:
+
+            def has_numeric(name):
+                v = obj.get(name)
+                return isinstance(v, (int, float)) and not isinstance(
+                    v, bool
+                )
+
+            if not has_numeric("num_decisions"):
+                bad.append(
+                    f"{path}: {claims} without a numeric num_decisions "
+                    "(decision-event count) field"
+                )
+            if not (has_numeric("min_replicas")
+                    and has_numeric("max_replicas")):
+                bad.append(
+                    f"{path}: {claims} without numeric min_replicas + "
+                    "max_replicas bounds"
+                )
+        for k, v in obj.items():
+            bad.extend(_autoscale_violations(v, f"{path}.{k}"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            bad.extend(_autoscale_violations(v, f"{path}[{i}]"))
+    return bad
+
+
 def _roofline_violations(obj, path, row_unit, top=False):
     """Auditability rule (ISSUE 3 satellite): any dict claiming an ``mfu``
     must carry its arithmetic inputs in the SAME dict — a flop model
@@ -290,6 +329,7 @@ def make_row(metric, value, unit, vs_baseline, timing, detail):
     violations += _latency_violations(detail, "detail")
     violations += _recovery_violations(detail, timing)
     violations += _overhead_violations(detail, timing)
+    violations += _autoscale_violations(detail, "detail")
     if violations:
         raise ValueError(
             f"row {metric!r}: unauditable roofline claims: {violations}"
@@ -2794,6 +2834,16 @@ def serving_replicated_chaos_metric():
     silently measured a healthy run is the same lie as the
     kill-never-fired case below).
 
+    The autoscale leg (ISSUE 12): a FRESH one-replica plane with the
+    SLO-closed-loop :class:`Autoscaler` thread live — open-loop Poisson
+    at 1x one replica's naive rate, then a 4x spike (the first scale-up
+    spawn attempt chaos-killed through ``serving.autoscale.spawn`` and
+    absorbed by the restart budget), then quiesce. The row RAISES
+    unless: the spike drives a WARN/BREACH transition AND a scale-up,
+    the post-scale quiesce p99 recovers under the calibrated bound,
+    sustained idle drives a scale-down, and per-leg accounting shows
+    zero silent drops. The controller's decision log lands in the row.
+
     Env knobs: BENCH_REPLICAS (default 3), BENCH_REPLICA_DURATION_S
     (per-leg window, default 4), BENCH_REPLICA_RATE_X (offered rate as
     a multiple of one replica's naive single-request throughput,
@@ -2807,7 +2857,12 @@ def serving_replicated_chaos_metric():
         build_featurizer,
     )
     from keystone_tpu import obs
-    from keystone_tpu.serving import ReplicatedServer, export_plan, run_open_loop
+    from keystone_tpu.serving import (
+        Autoscaler,
+        ReplicatedServer,
+        export_plan,
+        run_open_loop,
+    )
     from keystone_tpu.utils.faults import FaultPlan, FaultRule
 
     n, d_in, num_ffts, bs = 8_192, 784, 2, 1_024
@@ -2855,11 +2910,19 @@ def serving_replicated_chaos_metric():
     try:
         calib = run_open_loop(
             calib_srv.submit, req, rate_hz=rate_hz,
-            duration_s=min(duration_s, 2.0), seed=20,
+            duration_s=duration_s, seed=20,
         )
     finally:
         calib_srv.close()
-    latency_bound_s = max(3.0 * calib.p99_latency_s, 40.0 * single_s, 0.05)
+    # The bound covers BOTH the healthy tail (3x p99) and the host's
+    # observed stall magnitude (1.25x the calibration storm's worst
+    # latency): a shared/noisy host's scheduler hiccup lands a whole
+    # fast window over any p99-derived bound and pages the STEADY
+    # control leg — the calibration storm runs the full leg duration so
+    # it samples the same noise the legs will see.
+    calib_max_s = max(calib.latencies_s) if calib.latencies_s else 0.0
+    latency_bound_s = max(3.0 * calib.p99_latency_s, 1.25 * calib_max_s,
+                          40.0 * single_s, 0.05)
 
     # The live SLO plane over the whole storm (ISSUE 10): a p99-latency
     # objective at the calibrated bound plus an availability objective,
@@ -3014,6 +3077,140 @@ def serving_replicated_chaos_metric():
     finally:
         srv.close()
 
+    # ---- autoscale leg (ISSUE 12): the SLO-closed loop end to end ----
+    # A FRESH plane starting at ONE replica with the Autoscaler thread
+    # driving elasticity from its own tracker: open-loop Poisson at 1x
+    # the naive single-request rate (healthy), then a 4x spike that must
+    # drive WARN/BREACH -> scale-up (with a chaos kill injected into the
+    # FIRST scale-up spawn, absorbed by the restart budget), then a
+    # quiesce leg whose p99 must recover under the calibrated bound
+    # while sustained idle drives scale-down. Zero silent drops on every
+    # leg; the controller block carries the decision-event count and
+    # replica bounds beside the scale counters (make_row's audit rule).
+    as_base_rate = rate_hz / 4.0  # 1x one replica's naive throughput
+    as_slo = obs.SLOTracker([
+        obs.SLOObjective(
+            "latency", kind="latency",
+            threshold_s=latency_bound_s, target=0.9,
+            fast_window_s=max(duration_s / 8.0, 0.25),
+            slow_window_s=max(duration_s / 2.0, 1.0),
+            breach_burn=4.0,
+        ),
+        obs.SLOObjective(
+            "availability", kind="availability", target=0.999,
+            fast_window_s=max(duration_s / 8.0, 0.25),
+            slow_window_s=max(duration_s / 2.0, 1.0),
+            breach_burn=4.0,
+        ),
+    ])
+    as_srv = ReplicatedServer(
+        plan, num_replicas=1,
+        max_wait_ms=min(25.0, max(2.0, 1.5e3 * single_s)),
+        max_queue_depth=512, watchdog_interval_s=0.02, slo=as_slo,
+    )
+    as_ctl = Autoscaler(
+        as_srv, as_slo, min_replicas=1, max_replicas=num_replicas,
+        tick_interval_s=0.02,
+        scale_up_sustain_s=max(duration_s / 16.0, 0.25),
+        scale_down_sustain_s=max(duration_s / 8.0, 0.5),
+        cooldown_s=max(duration_s / 8.0, 0.5),
+        idle_queue_depth=4, idle_outstanding_per_replica=1.0,
+        metrics=as_srv.metrics,
+    ).start()
+    spawn_kill = FaultPlan([FaultRule(
+        "serving.autoscale.spawn", "error", calls=[0],
+    )])
+    as_legs = {}
+
+    def as_leg(name, rate, seed):
+        report = run_open_loop(
+            as_srv.submit, req, rate_hz=rate, duration_s=duration_s,
+            seed=seed, slo=as_slo,
+        )
+        d = report.to_row_dict()
+        d["accounting_ok"] = (
+            report.completed + report.rejected + report.failed
+            == report.num_offered
+        )
+        if not d["accounting_ok"]:
+            raise RuntimeError(
+                f"serving_replicated_chaos: autoscale {name} leg has a "
+                f"SILENT drop (offered {report.num_offered} != "
+                f"{report.completed}+{report.rejected}+{report.failed})"
+            )
+        if not report.completed:
+            raise RuntimeError(
+                f"serving_replicated_chaos: autoscale {name} leg "
+                "completed zero requests — no p99 to report"
+            )
+        as_legs[name] = d
+        return report
+
+    try:
+        as_leg("base", as_base_rate, seed=24)
+        with spawn_kill:
+            spike_report = as_leg("spike", rate_hz, seed=25)
+        if as_ctl.scale_ups < 1:
+            raise RuntimeError(
+                "serving_replicated_chaos: the 4x spike never drove a "
+                f"scale-up (verdict {spike_report.slo['state']}, "
+                f"decisions {as_ctl.decision_log()})"
+            )
+        spike_transitions = [
+            t for o in spike_report.slo["objectives"].values()
+            for t in o["transitions"]
+        ]
+        if not any(
+            t["to"] in ("WARN", "BREACH") for t in spike_transitions
+        ):
+            raise RuntimeError(
+                "serving_replicated_chaos: the spike scaled up without "
+                "any WARN/BREACH transition — the control loop acted on "
+                "nothing the SLO plane saw"
+            )
+        if spawn_kill.calls_seen("serving.autoscale.spawn") < 2:
+            raise RuntimeError(
+                "serving_replicated_chaos: the injected scale-up spawn "
+                "kill was never retried — the restart budget did not "
+                "absorb it"
+            )
+        # Settle: let the spike's queued backlog drain before the
+        # quiesce leg, so its p99 measures recovered steady state, not
+        # the spike's tail working through the queue.
+        settle_deadline = time.perf_counter() + 30.0
+        while (as_srv.autoscale_signals()["queue_depth"] > 0
+               and time.perf_counter() < settle_deadline):
+            time.sleep(0.05)
+        quiesce_report = as_leg("quiesce", as_base_rate, seed=26)
+        if quiesce_report.p99_latency_s > latency_bound_s:
+            raise RuntimeError(
+                "serving_replicated_chaos: post-scale p99 "
+                f"({quiesce_report.p99_latency_s * 1e3:.1f}ms) never "
+                f"recovered under the calibrated bound "
+                f"({latency_bound_s * 1e3:.1f}ms)"
+            )
+        # Quiesce drives scale-down (the loadgen window may end inside
+        # the idle-sustain window — poll past it).
+        down_deadline = time.perf_counter() + 30.0
+        while (as_ctl.scale_downs < 1
+               and time.perf_counter() < down_deadline):
+            time.sleep(0.05)
+        if as_ctl.scale_downs < 1:
+            raise RuntimeError(
+                "serving_replicated_chaos: sustained quiesce never "
+                f"drove a scale-down (decisions {as_ctl.decision_log()})"
+            )
+        as_stats = as_ctl.stats()
+        as_verdict = as_slo.verdict()
+        if as_verdict["state"] == "BREACH":
+            raise RuntimeError(
+                "serving_replicated_chaos: the autoscale plane never "
+                "recovered out of SLO breach after the spike"
+            )
+    finally:
+        as_ctl.close()
+        as_srv.close()
+
     for leg_name, leg in legs.items():
         if not leg["num_samples"]:
             # A leg with zero completions has no p99 — publishing a
@@ -3059,6 +3256,36 @@ def serving_replicated_chaos_metric():
                 "failed_named": legs["swap"]["failed"],
             },
             "final_degraded": final_stats["degraded"],
+            # The SLO-closed loop (ISSUE 12): 1x base -> 4x spike ->
+            # quiesce on a fresh one-replica plane with the Autoscaler
+            # thread live; asserted above: spike drove WARN/BREACH ->
+            # scale-up (with the first spawn attempt CHAOS-KILLED and
+            # absorbed by the restart budget), quiesce p99 recovered
+            # under the calibrated bound, sustained idle drove
+            # scale-down, zero silent drops on every leg. The
+            # controller block carries num_decisions + min/max replica
+            # bounds beside the scale counters (make_row audit rule).
+            "autoscale_leg": {
+                "base_rate_hz": round(as_base_rate, 2),
+                "spike_rate_hz": round(rate_hz, 2),
+                "spawn_kill_absorbed": True,
+                "legs": as_legs,
+                "controller": {
+                    k: as_stats[k] for k in (
+                        "min_replicas", "max_replicas", "replicas_low",
+                        "replicas_high", "scale_ups", "scale_downs",
+                        "failed_scale_ups", "brownout_steps_entered",
+                        "brownout_steps_exited", "num_decisions",
+                        "ticks",
+                    )
+                },
+                "decisions": as_stats["decisions"],
+                "slo": {
+                    "state": as_verdict["state"],
+                    "spike_leg_state": as_legs["spike"]["slo"]["state"],
+                    "latency_bound_ms": round(latency_bound_s * 1e3, 3),
+                },
+            },
             # The SLO story (ISSUE 10): final per-objective verdict with
             # the FULL transition log and error-budget ledger — the
             # degraded window's spend is a ledger read (asserted above:

@@ -33,6 +33,11 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "METRIC_AUTOSCALE_BROWNOUT_LEVEL",
+    "METRIC_AUTOSCALE_DECISIONS",
+    "METRIC_AUTOSCALE_REPLICAS",
+    "METRIC_AUTOSCALE_SCALE_DOWNS",
+    "METRIC_AUTOSCALE_SCALE_UPS",
     "METRIC_EXPORTER_ERRORS",
     "METRIC_EXPORTER_PUBLISHES",
     "METRIC_EXPORTER_PUBLISH_S",
@@ -103,6 +108,15 @@ METRIC_SLO_TRANSITIONS = "slo.transitions"
 METRIC_EXPORTER_PUBLISHES = "exporter.publishes"
 METRIC_EXPORTER_ERRORS = "exporter.errors"
 METRIC_EXPORTER_PUBLISH_S = "exporter.publish_s"
+
+# SLO-closed-loop autoscaler (serving/autoscale.py) — the control
+# plane's own accounting, published into the serving plane's registry so
+# the live exporter renders scale state beside the SLO verdict.
+METRIC_AUTOSCALE_REPLICAS = "autoscale.replicas"
+METRIC_AUTOSCALE_SCALE_UPS = "autoscale.scale_ups"
+METRIC_AUTOSCALE_SCALE_DOWNS = "autoscale.scale_downs"
+METRIC_AUTOSCALE_BROWNOUT_LEVEL = "autoscale.brownout_level"
+METRIC_AUTOSCALE_DECISIONS = "autoscale.decisions"
 
 
 class Counter:

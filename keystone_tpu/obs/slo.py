@@ -399,6 +399,15 @@ class SLOTracker:
         with self._lock:
             return {n: st.state for n, st in self._objectives.items()}
 
+    def burn_rates(self) -> Dict[str, "tuple[float, float]"]:
+        """``{objective: (burn_fast, burn_slow)}`` on the current clock —
+        the light read the autoscaler's tick consumes (``verdict()``
+        builds the full transition/ledger copies; a control loop ticking
+        several times a second only needs the burns)."""
+        now = self._clock() - self._t0
+        with self._lock:
+            return {n: st.burns(now) for n, st in self._objectives.items()}
+
     def worst_state(self) -> str:
         states = self.states().values()
         for s in (STATE_BREACH, STATE_WARN):

@@ -31,7 +31,14 @@ latency SLO (the summary line prints the OK/WARN/BREACH verdict and
 budget spent), ``--metrics-port``/``--metrics-dir`` publish Prometheus
 text + atomic JSON snapshots while the server runs (``bin/slo`` renders
 them), and ``KEYSTONE_TRACE_SAMPLE``/``KEYSTONE_TRACE_SLOW_MS``
-tail-sample traced serving spans.
+tail-sample traced serving spans. ``--autoscale`` (with
+``--min-replicas``/``--max-replicas``/``--scale-cooldown-s``) closes
+the loop: an autoscaler thread consumes the SLO burn-rate state machine
+and drives zero-drop replica add/remove — and past the ceiling, the
+brownout admission ladder; the summary line reports
+``replicas_low/high``, ``scale_ups``, ``scale_downs``, and
+``brownout_steps_entered``, and ``bin/slo`` renders the autoscale
+decision log beside the verdict table (docs/serving.md).
 """
 
 from __future__ import annotations
@@ -145,6 +152,23 @@ def _serve(argv):
     parser.add_argument("--restart-budget", type=int, default=3,
                         help="replica respawn attempts before permanent "
                         "eviction (with --replicas > 1)")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="close the SLO loop: an Autoscaler thread "
+                        "drives replica add/remove (and the brownout "
+                        "ladder past --max-replicas) from the declared "
+                        "SLO's burn-rate state machine; requires "
+                        "--slo-p99-ms > 0 (docs/serving.md autoscaler "
+                        "section)")
+    parser.add_argument("--min-replicas", type=int, default=1,
+                        help="autoscaler floor (with --autoscale)")
+    parser.add_argument("--max-replicas", type=int, default=8,
+                        help="autoscaler ceiling; past it admission "
+                        "degrades down the brownout ladder "
+                        "(with --autoscale)")
+    parser.add_argument("--scale-cooldown-s", type=float, default=2.0,
+                        help="minimum spacing between any two autoscale "
+                        "actions — the no-flapping window "
+                        "(with --autoscale)")
     parser.add_argument("--rate", type=float, default=200.0,
                         help="offered Poisson rate (requests/s)")
     parser.add_argument("--duration-s", type=float, default=5.0)
@@ -172,11 +196,32 @@ def _serve(argv):
 
     from keystone_tpu import obs
     from keystone_tpu.serving import (
+        Autoscaler,
         MicroBatchServer,
         ReplicatedServer,
         export_plan,
         run_open_loop,
     )
+
+    if args.autoscale and args.slo_p99_ms <= 0:
+        print(
+            "serve: --autoscale needs a declared SLO objective "
+            "(--slo-p99-ms > 0) — the control loop consumes the "
+            "burn-rate state machine",
+            file=sys.stderr,
+        )
+        return 2
+    if args.autoscale and not 1 <= args.min_replicas <= args.max_replicas:
+        # Validate BEFORE any server threads start: a ValueError out of
+        # Autoscaler.__init__ after ReplicatedServer construction would
+        # leak running workers and violate the one-line-diagnostic
+        # contract above.
+        print(
+            f"serve: need 1 <= --min-replicas ({args.min_replicas}) <= "
+            f"--max-replicas ({args.max_replicas})",
+            file=sys.stderr,
+        )
+        return 2
 
     # Load/fit and export fail as a ONE-LINE diagnostic + non-zero exit,
     # not a bare traceback: serve is the operator-facing entry point, and
@@ -218,9 +263,14 @@ def _serve(argv):
                 "availability", kind="availability", target=0.999,
             ),
         ], metrics=slo_registry)
-    if args.replicas > 1:
+    if args.replicas > 1 or args.autoscale:
+        # Autoscale always rides the replicated plane (the elasticity
+        # primitives live there), starting inside the configured bounds.
+        n0 = args.replicas
+        if args.autoscale:
+            n0 = min(max(n0, args.min_replicas), args.max_replicas)
         server = ReplicatedServer(
-            plan, num_replicas=args.replicas, max_batch=args.max_batch,
+            plan, num_replicas=n0, max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms, max_queue_depth=args.queue_depth,
             restart_budget=args.restart_budget, slo=slo_tracker,
         )
@@ -229,11 +279,19 @@ def _serve(argv):
             plan, max_batch=args.max_batch, max_wait_ms=args.max_wait_ms,
             max_queue_depth=args.queue_depth, slo=slo_tracker,
         )
+    autoscaler = None
     exporter = None
     try:
-        # Inside the try: an exporter construction failure (e.g. the
-        # metrics port already bound) must still close() the server —
-        # the replicated plane's workers are already running.
+        # Inside the try: from here on, any construction failure must
+        # still close() the already-running server threads.
+        if args.autoscale:
+            autoscaler = Autoscaler(
+                server, slo_tracker,
+                min_replicas=args.min_replicas,
+                max_replicas=args.max_replicas,
+                cooldown_s=args.scale_cooldown_s,
+                metrics=server.metrics,
+            ).start()
         if args.metrics_port >= 0 or args.metrics_dir:
             from keystone_tpu.data.runtime import default_runtime
 
@@ -244,6 +302,10 @@ def _serve(argv):
             }
             if slo_registry is not None:
                 sources["slo_metrics"] = slo_registry
+            if autoscaler is not None:
+                # bin/slo renders this block (decision log + scale
+                # counters) beside the SLO verdict table.
+                sources["autoscale"] = autoscaler.stats
             exporter = obs.LiveExporter(
                 sources=sources,
                 slo=slo_tracker,
@@ -258,6 +320,8 @@ def _serve(argv):
         )
         stats = server.stats()
     finally:
+        if autoscaler is not None:
+            autoscaler.close()
         if exporter is not None:
             exporter.close()
         server.close()
@@ -281,9 +345,23 @@ def _serve(argv):
         })
     if exporter is not None and exporter.port is not None:
         summary["metrics_port"] = exporter.port
-    if args.replicas > 1:
+    if autoscaler is not None:
+        a_stats = autoscaler.stats()
         summary.update({
-            "replicas": args.replicas,
+            "replicas_low": a_stats["replicas_low"],
+            "replicas_high": a_stats["replicas_high"],
+            "scale_ups": a_stats["scale_ups"],
+            "scale_downs": a_stats["scale_downs"],
+            "brownout_steps_entered": a_stats["brownout_steps_entered"],
+            # The audit companions the bench row rule requires beside
+            # any scale_ups/scale_downs claim.
+            "num_decisions": a_stats["num_decisions"],
+            "min_replicas": a_stats["min_replicas"],
+            "max_replicas": a_stats["max_replicas"],
+        })
+    if args.replicas > 1 or args.autoscale:
+        summary.update({
+            "replicas": stats.get("num_replicas"),
             "healthy_replicas": stats.get("healthy_replicas"),
             "restarts_total": stats.get("restarts_total"),
             "evicted_replicas": stats.get("evicted_replicas"),

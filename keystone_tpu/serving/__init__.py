@@ -14,12 +14,19 @@ into something that serves streams of single-datum requests:
   - :class:`ReplicatedServer` — N replicas behind one
     admission-controlled front door: least-loaded routing with
     per-replica breakers, watchdog restarts within a bounded budget,
-    and zero-drop atomic hot-swap of the plan under live traffic
-    (``serving/replicas.py``).
+    zero-drop atomic hot-swap of the plan under live traffic, and the
+    zero-drop elasticity + brownout-ladder primitives the autoscaler
+    drives (``serving/replicas.py``).
+  - :class:`Autoscaler` — the SLO-closed-loop controller: sustained
+    WARN/BREACH with rising fast burn adds replicas, sustained OK with
+    idle budget removes them, and past ``max_replicas`` admission
+    degrades down the named brownout ladder — every decision a
+    structured ``autoscale.decision`` event (``serving/autoscale.py``).
   - :func:`run_open_loop` / :func:`closed_loop_qps` — Poisson load
     generation and the batch-size-1 baseline the bench A/Bs against.
 """
 
+from .autoscale import AutoscaleDecision, Autoscaler
 from .batcher import (
     MicroBatchServer,
     ServerClosed,
@@ -28,9 +35,12 @@ from .batcher import (
 )
 from .export import BatchInfo, ExportedPlan, export_plan, plan_fingerprint
 from .loadgen import LoadReport, closed_loop_qps, poisson_arrivals, run_open_loop
-from .replicas import ReplicatedServer
+from .replicas import BROWNOUT_STEPS, ReplicatedServer
 
 __all__ = [
+    "AutoscaleDecision",
+    "Autoscaler",
+    "BROWNOUT_STEPS",
     "BatchInfo",
     "ExportedPlan",
     "LoadReport",

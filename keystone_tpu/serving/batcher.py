@@ -379,6 +379,31 @@ class MicroBatchServer:
                 )
         return req.future
 
+    def set_admission_params(
+        self,
+        max_wait_ms: Optional[float] = None,
+        max_queue_depth: Optional[int] = None,
+    ) -> None:
+        """Adjust the admission knobs of a LIVE server — the replicated
+        plane's brownout ladder widens the coalescing deadline and
+        tightens the shed depth without a worker-generation swap. Takes
+        effect immediately: the worker re-reads ``max_wait_s`` on every
+        coalescing pass (it is woken here), and the next admission sheds
+        against the new depth. Shrinking the depth does NOT retroactively
+        shed already-queued requests — each new arrival over the bound
+        evicts one earliest-deadline victim, so the queue converges
+        without a shed burst."""
+        with self._cond:
+            if max_wait_ms is not None:
+                if max_wait_ms < 0:
+                    raise ValueError("max_wait_ms must be >= 0")
+                self.max_wait_s = float(max_wait_ms) / 1e3
+            if max_queue_depth is not None:
+                if max_queue_depth < 1:
+                    raise ValueError("max_queue_depth must be >= 1")
+                self.max_queue_depth = int(max_queue_depth)
+            self._cond.notify_all()
+
     # -- worker side -------------------------------------------------------
 
     def _worker(self) -> None:

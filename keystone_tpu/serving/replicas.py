@@ -50,12 +50,38 @@ live traffic.
     (``fut.plan_fingerprint``), and no batch ever mixes versions — the
     bit-identity contract of docs/reliability.md is stated per
     fingerprint.
+  - **Zero-drop elasticity.** :meth:`add_replica` and
+    :meth:`remove_replica` are the first-class capacity primitives the
+    SLO-closed-loop autoscaler (``serving/autoscale.py``) drives.
+    Addition warms the new worker's plan BEFORE it enters rotation
+    (spawn attempts run the ``serving.autoscale.spawn`` fault site with
+    bounded retries inside the restart budget — a chaos kill mid-spawn
+    is absorbed, never a dropped request). Removal reuses the hot-swap
+    drain protocol: the victim leaves rotation, drains its admitted
+    work to zero on the reservation counters, closes on an empty queue,
+    and rotation membership updates atomically — and removal never
+    picks the half-open-probe replica (evicting the probe would leave
+    its breaker's recovery unobservable). At every instant
+    ``offered == completed + rejected + failed``.
+  - **Brownout ladder.** The wall past ``max_replicas``: when scale-up
+    is exhausted and burn keeps rising, admission degrades in NAMED,
+    REVERSIBLE steps (:data:`BROWNOUT_STEPS`, entered/exited strictly
+    LIFO): ``widen_deadlines`` (coalescing windows stretch by
+    ``brownout_wait_factor`` — bigger batches, more throughput per
+    dispatch at a latency cost), then ``aggressive_shed`` (the EDF shed
+    depth shrinks by ``brownout_shed_factor`` — load is refused
+    earlier, explicitly), then ``reject_admissions`` (the front door
+    fast-fails every new request with :class:`ServerOverloaded`).
+    Every step keeps the zero-drop accounting: a browned-out rejection
+    is a NAMED error and a counted bad SLI event, never a silent drop.
   - **Chaos-provable.** ``serving.replica.execute`` is a loop-level
     fault site on replica workers (outside the per-batch error guard —
     an injected error there kills the whole worker, watchdog
-    territory); ``serving.replica.spawn`` fires per respawn attempt.
+    territory); ``serving.replica.spawn`` fires per respawn attempt and
+    ``serving.autoscale.spawn`` per scale-up spawn attempt.
     tests/test_chaos_replicas.py drives kill-mid-Poisson-storm and
-    swap-under-load through them.
+    swap-under-load through them; tests/test_chaos_autoscale.py drives
+    kill-mid-scale-up and the spike→recover→quiesce closed loop.
 """
 
 from __future__ import annotations
@@ -80,12 +106,17 @@ from .batcher import (
 )
 from .export import ExportedPlan
 
-__all__ = ["ReplicatedServer"]
+__all__ = ["BROWNOUT_STEPS", "ReplicatedServer"]
 
 logger = logging.getLogger("keystone_tpu.serving")
 
 # Breaker states eligible for normal least-loaded routing.
 _ROUTABLE = ("closed", "disabled")
+
+# The overload brownout ladder, in ENTRY order (exit is strictly LIFO):
+# each step is a named, reversible admission degradation the autoscaler
+# climbs when scale-up is exhausted past max_replicas (module docstring).
+BROWNOUT_STEPS = ("widen_deadlines", "aggressive_shed", "reject_admissions")
 
 
 class _ReplicaBatchServer(MicroBatchServer):
@@ -174,6 +205,8 @@ class ReplicatedServer:
         restart_budget: int = 3,
         watchdog_interval_s: float = 0.05,
         drain_timeout_s: float = 30.0,
+        brownout_wait_factor: float = 4.0,
+        brownout_shed_factor: float = 0.25,
         slo=None,
     ):
         factory, n = self._plan_factory(plans, num_replicas)
@@ -181,20 +214,30 @@ class ReplicatedServer:
             raise ValueError(f"num_replicas must be >= 1, got {n}")
         if restart_budget < 0:
             raise ValueError("restart_budget must be >= 0")
+        if brownout_wait_factor < 1.0:
+            raise ValueError("brownout_wait_factor must be >= 1 (widening)")
+        if not 0.0 < brownout_shed_factor <= 1.0:
+            raise ValueError("brownout_shed_factor must be in (0, 1]")
         self.num_replicas = n
         self.restart_budget = int(restart_budget)
         self.watchdog_interval_s = float(watchdog_interval_s)
         self.drain_timeout_s = float(drain_timeout_s)
+        self.brownout_wait_factor = float(brownout_wait_factor)
+        self.brownout_shed_factor = float(brownout_shed_factor)
         self._server_kwargs = dict(
             max_batch=max_batch, max_wait_ms=max_wait_ms,
             max_queue_depth=max_queue_depth, span_log_len=span_log_len,
             breaker_threshold=breaker_threshold,
             breaker_reset_s=breaker_reset_s,
         )
+        # Active brownout steps, in entry order (exit pops the tail —
+        # LIFO). Mutated only under _lock.
+        self._brownout: List[str] = []
 
         self._lock = threading.Lock()
         self._swap_lock = threading.Lock()  # serializes swap_plan calls
         self._closed = False
+        self._next_index = n  # elasticity: added replicas get fresh indices
         self._replicas: List[_Replica] = []
         self._item_shape: Optional[tuple] = None
         self._dtype = None
@@ -225,6 +268,9 @@ class ReplicatedServer:
         self.degraded_rejected = 0
         self.restarts_total = 0
         self.swaps_completed = 0
+        self.replicas_added = 0
+        self.replicas_removed = 0
+        self.brownout_rejected = 0
         self.metrics = obs.MetricsRegistry()
         self._latencies = self.metrics.bucketed_histogram(
             METRIC_SERVING_LATENCY_S
@@ -268,9 +314,27 @@ class ReplicatedServer:
                 "replica must serve the same request shape and dtype"
             )
 
+    def _effective_server_kwargs(self) -> Dict[str, Any]:
+        """The base server kwargs with the ACTIVE brownout overrides
+        applied — so a worker generation spawned mid-brownout (watchdog
+        restart, swap, scale-up) admits under the same degraded policy
+        as the live generations (mutating only live servers would let a
+        restart silently undo a brownout step)."""
+        kw = dict(self._server_kwargs)
+        with self._lock:
+            steps = list(self._brownout)
+        if "widen_deadlines" in steps:
+            kw["max_wait_ms"] = float(kw["max_wait_ms"]) \
+                * self.brownout_wait_factor
+        if "aggressive_shed" in steps:
+            kw["max_queue_depth"] = max(
+                1, int(kw["max_queue_depth"] * self.brownout_shed_factor)
+            )
+        return kw
+
     def _build_server(self, index: int, plan: ExportedPlan):
         return _ReplicaBatchServer(
-            plan, replica_index=index, **self._server_kwargs
+            plan, replica_index=index, **self._effective_server_kwargs()
         )
 
     # -- submit side -------------------------------------------------------
@@ -291,6 +355,24 @@ class ReplicatedServer:
         tried: set = set()
         saw_overload = False
         last_exc: Optional[BaseException] = None
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("submit() after close()")
+            # Brownout ladder top: the front door fast-fails every new
+            # admission with the NAMED overload error (counted, SLO-fed
+            # below — a browned-out reject is never a silent drop).
+            browned_out = "reject_admissions" in self._brownout
+            if browned_out:
+                self.rejected += 1
+                self.brownout_rejected += 1
+        if browned_out:
+            if self._slo is not None:
+                self._slo.observe(ok=False)
+            raise ServerOverloaded(
+                "brownout ladder at reject_admissions: scale-up is "
+                "exhausted and admission is fast-failing new requests "
+                "until load subsides (docs/serving.md brownout contract)"
+            )
         while True:
             with self._lock:
                 if self._closed:
@@ -408,8 +490,14 @@ class ReplicatedServer:
             self._sweep_dead_replicas()
 
     def _sweep_dead_replicas(self) -> None:
-        for rep in self._replicas:
+        # Snapshot: remove_replica() mutates membership concurrently,
+        # and iterating the live list could skip a neighbour mid-sweep.
+        with self._lock:
+            reps = list(self._replicas)
+        for rep in reps:
             with self._lock:
+                if rep not in self._replicas:  # removed while sweeping
+                    continue
                 if self._closed:
                     return
                 if rep.evicted or rep.out_of_rotation or rep.busy:
@@ -442,6 +530,14 @@ class ReplicatedServer:
                 "restart budget used)", rep.index, rep.restarts,
                 self.restart_budget,
             )
+
+    def _spawn_backoff_interrupted(self, attempt: int) -> bool:
+        """Paced spawn-retry backoff shared by the watchdog-restart,
+        swap, and scale-up paths: a transient blip (fd exhaustion, a
+        briefly busy device) must not burn a whole spawn budget in
+        microseconds. Bounded exponential; returns True when close()
+        cut the wait short (the caller must abandon the spawn)."""
+        return self._stop.wait(min(0.05 * (2 ** (attempt - 1)), 1.0))
 
     def _try_spawn(self, rep: _Replica, plan: ExportedPlan,
                    count_restart: bool = True) -> bool:
@@ -480,12 +576,7 @@ class ReplicatedServer:
                     "serving replica %d spawn attempt %d failed: %r",
                     rep.index, attempt, e,
                 )
-                # Pace the retry: a transient blip (fd exhaustion, a
-                # briefly busy device) must not burn the whole restart
-                # budget in microseconds and permanently evict a
-                # recoverable replica. Bounded exponential, and the
-                # close() event cuts the wait short.
-                if self._stop.wait(min(0.05 * (2 ** (attempt - 1)), 1.0)):
+                if self._spawn_backoff_interrupted(attempt):
                     return False
                 continue
             with self._lock:
@@ -558,7 +649,17 @@ class ReplicatedServer:
         with self._swap_lock:
             factory = self._resolve_swap_plans(new)
             report: List[Dict[str, Any]] = []
-            for rep in self._replicas:
+            with self._lock:
+                reps = list(self._replicas)  # membership may shrink mid-swap
+            for rep in reps:
+                with self._lock:
+                    removed = rep not in self._replicas
+                if removed:
+                    report.append({
+                        "replica": rep.index, "swapped": False,
+                        "reason": "removed",
+                    })
+                    continue
                 if rep.evicted:
                     report.append({
                         "replica": rep.index, "swapped": False,
@@ -648,12 +749,25 @@ class ReplicatedServer:
             return lambda i: new
         if isinstance(new, (list, tuple)):
             seq = list(new)
-            if len(seq) != self.num_replicas:
+            # Replica indices are not dense once elasticity has
+            # added/removed workers (fresh indices beyond the
+            # construction range), so a per-replica sequence maps by
+            # ROTATION POSITION over the live membership — a raw
+            # ``seq[index]`` would drop one device-pinned plan and
+            # double-assign another without any error. Membership
+            # cannot change under us: swap_plan holds the swap lock and
+            # add_replica serializes on it.
+            with self._lock:
+                live = sorted(
+                    (r.index for r in self._replicas if not r.evicted)
+                )
+            if len(seq) != len(live):
                 raise ValueError(
                     f"swap_plan got {len(seq)} plans for "
-                    f"{self.num_replicas} replicas"
+                    f"{len(live)} replicas (live membership)"
                 )
-            return lambda i: seq[i]
+            mapping = dict(zip(live, seq))
+            return lambda i: mapping[i]
         if callable(new):
             return new
         raise TypeError(
@@ -678,6 +792,235 @@ class ReplicatedServer:
                     "it re-enters rotation on its OLD plan"
                 )
             time.sleep(0.001)
+
+    # -- elasticity (the autoscaler's capacity primitives) -----------------
+
+    def add_replica(self) -> int:
+        """Grow rotation by one replica, ZERO-DROP: the new worker's
+        plan is warmed at the plane's padding buckets BEFORE the replica
+        enters rotation (no cold-compile request ever lands on it), and
+        membership updates atomically under the plane lock. The plan is
+        cloned from the first live replica, so a scale-up after a
+        hot-swap serves the swapped version.
+
+        Spawn attempts run the ``serving.autoscale.spawn`` fault site
+        with bounded, paced retries inside the restart budget — a chaos
+        kill mid-spawn is ABSORBED (the next attempt succeeds) rather
+        than dropped or leaked. Raises :class:`ServerDegraded` when the
+        budget is exhausted (the plane keeps serving at its current
+        size). Returns the new replica's index.
+
+        Serialized against :meth:`swap_plan` (the swap lock): a replica
+        added mid-rollout would be invisible to the swap's membership
+        snapshot and leave the plane permanently serving mixed plan
+        versions."""
+        with self._swap_lock:
+            return self._add_replica_locked_swap()
+
+    def _add_replica_locked_swap(self) -> int:
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("add_replica() after close()")
+            # Donor preference: an IN-ROTATION replica (under the swap
+            # lock the only out-of-rotation/busy members are mid-restart
+            # — their plan is current too, but rotation members are the
+            # unambiguous source of the live version).
+            live = [r for r in self._replicas if not r.evicted]
+            donor = next(
+                (r for r in live if not r.out_of_rotation and not r.busy),
+                live[0] if live else None,
+            )
+            if donor is None:
+                raise ServerDegraded(
+                    "add_replica: every replica is evicted — no live "
+                    "plan to clone"
+                )
+            plan = donor.plan
+            index = self._next_index
+            self._next_index += 1
+        plan.warm()  # warm BEFORE rotation entry (a no-op when compiled)
+        attempts = 0
+        budget = max(1, self.restart_budget)
+        while True:
+            attempts += 1
+            try:
+                faults.maybe_fail(faults.SITE_AUTOSCALE_SPAWN)
+                server = self._build_server(index, plan)
+                break
+            except BaseException as e:  # noqa: BLE001 — budget-bounded
+                logger.warning(
+                    "autoscale: replica %d spawn attempt %d failed: %r",
+                    index, attempts, e,
+                )
+                if attempts >= budget:
+                    raise ServerDegraded(
+                        f"add_replica: spawn failed {attempts} time(s) "
+                        f"(restart budget {budget}): {e!r}"
+                    ) from e
+                if self._spawn_backoff_interrupted(attempts):
+                    raise ServerClosed("add_replica() during close()")
+        rep = _Replica(index, plan, server)
+        with self._lock:
+            closed = self._closed
+            if not closed:
+                self._replicas.append(rep)
+                self.num_replicas += 1
+                self.replicas_added += 1
+        if closed:
+            server.close(timeout=1.0)
+            raise ServerClosed("add_replica() during close()")
+        return index
+
+    def remove_replica(
+        self, drain_timeout_s: Optional[float] = None
+    ) -> int:
+        """Shrink rotation by one replica, ZERO-DROP, via the hot-swap
+        drain protocol: the victim leaves rotation (no new admissions),
+        every request already admitted to it completes (reservation
+        ordering — a drain can never close over an invisible in-flight),
+        the server closes on an empty queue, and membership updates
+        atomically.
+
+        Victim selection: the least-loaded in-rotation replica, and
+        NEVER the half-open-probe replica — its breaker is mid-recovery
+        and evicting it would leave the probe outcome unobservable
+        (highest index wins ties, so elastic scale-down preferentially
+        retires the most recently added capacity). Raises
+        :class:`ValueError` at one live replica (the plane never scales
+        to zero) and :class:`TimeoutError` if the victim fails to drain
+        — in which case it re-enters rotation and nothing was dropped.
+        Returns the removed replica's index.
+
+        Serialized against :meth:`swap_plan` (the swap lock), like
+        :meth:`add_replica`: a removal mid-rollout could hand the
+        swap's ownership wait an already-retired replica — its counters
+        would fold into the plane history twice and the swap would
+        respawn a worker no membership list tracks."""
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        with self._swap_lock:
+            return self._remove_replica_locked_swap(timeout)
+
+    def _remove_replica_locked_swap(self, timeout: float) -> int:
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("remove_replica() after close()")
+            live = [r for r in self._replicas if not r.evicted]
+            if len(live) <= 1:
+                raise ValueError(
+                    "remove_replica: refusing to remove the last live "
+                    "replica"
+                )
+            candidates = []
+            for r in live:
+                if r.out_of_rotation or r.busy:
+                    continue
+                state, _ = r.server.routing_state
+                if state == "half_open":
+                    continue  # never the probe replica
+                candidates.append(r)
+            if not candidates:
+                raise ServerDegraded(
+                    "remove_replica: no removable replica (all are "
+                    "mid-restart, mid-swap, or half-open probes)"
+                )
+            victim = min(
+                candidates, key=lambda r: (r.outstanding, -r.index)
+            )
+            victim.busy = True
+            victim.out_of_rotation = True
+        try:
+            self._drain(victim, timeout)
+        except BaseException:
+            with self._lock:  # zero-drop: victim resumes serving
+                victim.out_of_rotation = False
+                victim.busy = False
+            raise
+        self._retire_server(victim.server)
+        victim.server.close()
+        with self._lock:
+            if victim in self._replicas:
+                self._replicas.remove(victim)
+                self.num_replicas -= 1
+            self.replicas_removed += 1
+            victim.busy = False
+        return victim.index
+
+    # -- brownout ladder ---------------------------------------------------
+
+    @property
+    def brownout_level(self) -> int:
+        with self._lock:
+            return len(self._brownout)
+
+    @property
+    def brownout_steps(self) -> "tuple[str, ...]":
+        """Active brownout steps in entry order (exit pops the tail)."""
+        with self._lock:
+            return tuple(self._brownout)
+
+    def enter_brownout_step(self) -> Optional[str]:
+        """Climb one rung of :data:`BROWNOUT_STEPS`; returns the step
+        entered, or None at the ladder top. Effects apply to every live
+        worker generation immediately and to every generation spawned
+        while the step is active (``_effective_server_kwargs``)."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("enter_brownout_step() after close()")
+            if len(self._brownout) >= len(BROWNOUT_STEPS):
+                return None
+            step = BROWNOUT_STEPS[len(self._brownout)]
+            self._brownout.append(step)
+        self._apply_admission_params()
+        return step
+
+    def exit_brownout_step(self) -> Optional[str]:
+        """Descend one rung — strictly LIFO: the most recently entered
+        step is reverted first (``reject_admissions`` lifts before the
+        shed depth restores, before the deadlines narrow). Returns the
+        step exited, or None when no step is active."""
+        with self._lock:
+            if not self._brownout:
+                return None
+            step = self._brownout.pop()
+        self._apply_admission_params()
+        return step
+
+    def _apply_admission_params(self) -> None:
+        """Push the current effective admission knobs onto every live
+        server generation (outside the plane lock — set_admission_params
+        takes each server's own condition lock)."""
+        kw = self._effective_server_kwargs()
+        with self._lock:
+            servers = [
+                r.server for r in self._replicas if not r.evicted
+            ]
+        for s in servers:
+            s.set_admission_params(
+                max_wait_ms=kw["max_wait_ms"],
+                max_queue_depth=kw["max_queue_depth"],
+            )
+
+    def autoscale_signals(self) -> Dict[str, Any]:
+        """The numpy-free signal block the autoscaler's tick consumes:
+        live replica count, rotation occupancy (outstanding reservations
+        — the same counters hot-swap drains on), total queued-not-
+        dispatched depth across replicas, and the brownout state."""
+        with self._lock:
+            reps = [r for r in self._replicas if not r.evicted]
+            n = len(reps)
+            in_rotation = sum(1 for r in reps if not r.out_of_rotation)
+            outstanding = sum(r.outstanding for r in reps)
+            brownout = list(self._brownout)
+        queue_depth = sum(r.server.queue_depth for r in reps)
+        return {
+            "replicas": n,
+            "in_rotation": in_rotation,
+            "outstanding": outstanding,
+            "queue_depth": queue_depth,
+            "brownout_level": len(brownout),
+            "brownout_steps": brownout,
+        }
 
     # -- observability -----------------------------------------------------
 
@@ -710,6 +1053,11 @@ class ReplicatedServer:
                 "degraded_rejected": self.degraded_rejected,
                 "restarts_total": self.restarts_total,
                 "swaps_completed": self.swaps_completed,
+                "replicas_added": self.replicas_added,
+                "replicas_removed": self.replicas_removed,
+                "brownout_level": len(self._brownout),
+                "brownout_steps": list(self._brownout),
+                "brownout_rejected": self.brownout_rejected,
                 "retired_generations": dict(self._retired),
                 "num_latency_samples": lat["count"],
             }
@@ -760,7 +1108,7 @@ class ReplicatedServer:
         self._stop.set()
         if not already:
             self._watchdog.join(timeout=timeout)
-        for rep in self._replicas:
+        for rep in list(self._replicas):
             rep.server.close(timeout=timeout)
 
     def __enter__(self) -> "ReplicatedServer":

@@ -10,6 +10,10 @@ and renders the operator view of the live plane:
     spent/remaining, good/bad totals;
   - the transition log (when a breach happened and at what burn);
   - the error-budget ledger (which state interval spent what);
+  - the autoscale decision log beside the verdict table, when the
+    snapshot carries an ``autoscale`` section (``run.py serve
+    --autoscale``): replica count/bounds, scale counters, brownout
+    state, and the audited decisions — action, reason, inputs;
   - a one-line serving summary when the snapshot carries a
     ``serving`` section (completed/rejected/failed + p99).
 
@@ -101,6 +105,37 @@ def render(doc: Dict[str, Any]) -> str:
                     )
     else:
         lines.append("(no SLO objectives in this snapshot)")
+    autoscale = doc.get("autoscale") or {}
+    if autoscale:
+        lines.append("")
+        lines.append(
+            f"autoscale: replicas={autoscale.get('replicas', '?')} "
+            f"(bounds {autoscale.get('min_replicas', '?')}.."
+            f"{autoscale.get('max_replicas', '?')}, observed "
+            f"{autoscale.get('replicas_low', '?')}.."
+            f"{autoscale.get('replicas_high', '?')}) "
+            f"scale_ups={autoscale.get('scale_ups', 0)} "
+            f"scale_downs={autoscale.get('scale_downs', 0)} "
+            f"brownout_level={autoscale.get('brownout_level', 0)}"
+            + (f" steps={autoscale['brownout_steps']}"
+               if autoscale.get("brownout_steps") else "")
+        )
+        decisions = autoscale.get("decisions") or []
+        if decisions:
+            lines.append("  decision log:")
+            for d in decisions:
+                inputs = d.get("inputs") or {}
+                step = f":{d['step']}" if d.get("step") else ""
+                ok = "" if d.get("ok", True) else " FAILED"
+                lines.append(
+                    f"    t+{d.get('t_s', 0):.3f}s "
+                    f"{d.get('action', '?')}{step}{ok} "
+                    f"(state={inputs.get('state', '?')} "
+                    f"burn_fast={_fmt_burn(inputs.get('burn_fast'))} "
+                    f"replicas={inputs.get('replicas', '?')} "
+                    f"queue={inputs.get('queue_depth', '?')}) — "
+                    f"{d.get('reason', '')}"
+                )
     serving = doc.get("serving") or {}
     if serving:
         p99 = serving.get("p99_latency_s")

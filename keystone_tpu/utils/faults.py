@@ -27,6 +27,13 @@ Instrumented sites (each site counts its own calls, 0-based):
                         replicated server's restart path; injected
                         errors burn the restart budget toward
                         permanent eviction.
+  - ``serving.autoscale.spawn`` — one scale-up spawn attempt in
+                        ``ReplicatedServer.add_replica``
+                        (``serving/replicas.py``): injected errors are
+                        absorbed by bounded retries within the restart
+                        budget, so chaos tests can kill an autoscaler's
+                        scale-up mid-flight and prove elasticity stays
+                        zero-drop.
   - ``checkpoint.write`` — one snapshot write inside
                         ``CheckpointSpec.save`` (``data/durable.py``)
                         — fires on the write-behind runtime worker
@@ -62,6 +69,7 @@ __all__ = [
     "FaultPlan",
     "FaultRule",
     "RetryPolicy",
+    "SITE_AUTOSCALE_SPAWN",
     "SITE_CHECKPOINT_WRITE",
     "SITE_PREFETCH_READ",
     "SITE_REPLICA_EXECUTE",
@@ -83,6 +91,7 @@ SITE_PREFETCH_READ = "prefetch.read"
 SITE_SERVING_EXECUTE = "serving.execute"
 SITE_REPLICA_EXECUTE = "serving.replica.execute"
 SITE_REPLICA_SPAWN = "serving.replica.spawn"
+SITE_AUTOSCALE_SPAWN = "serving.autoscale.spawn"
 SITE_CHECKPOINT_WRITE = "checkpoint.write"
 
 _KINDS = ("error", "corrupt", "latency")

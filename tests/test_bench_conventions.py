@@ -260,3 +260,81 @@ class TestRooflineAuditability:
         for field in ('"achieved_gbps"', '"peak_hbm_gbps"',
                       '"traffic_model_gb"', '"featurize_s"'):
             assert field in body, f"mnist row lost {field}"
+
+    def test_autoscale_claims_require_decisions_and_bounds(self):
+        """ISSUE 12 satellite: any dict claiming scale_ups/scale_downs
+        must carry the decision-event count and the min/max replica
+        bounds in the SAME dict — a scale count with no audit trail is
+        not a measured control-loop claim."""
+        bench = _load_bench()
+        good = {
+            "scale_ups": 2,
+            "scale_downs": 1,
+            "num_decisions": 5,
+            "min_replicas": 1,
+            "max_replicas": 3,
+        }
+        row = bench.make_row(
+            "autoscale_probe", 1.0, "s", None, "open_loop_latency",
+            {"controller": good},
+        )
+        assert row["detail"]["controller"]["num_decisions"] == 5
+        for missing, pat in (
+            ("num_decisions", "num_decisions"),
+            ("min_replicas", "min_replicas"),
+            ("max_replicas", "min_replicas"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "autoscale_probe", 1.0, "s", None,
+                    "open_loop_latency", {"controller": d},
+                )
+        # A prose decision count must not satisfy the rule.
+        d = dict(good)
+        d["num_decisions"] = "a handful"
+        with pytest.raises(ValueError, match="num_decisions"):
+            bench.make_row(
+                "autoscale_probe", 1.0, "s", None, "open_loop_latency",
+                {"controller": d},
+            )
+        # Either claim key alone triggers the rule, at any nesting.
+        with pytest.raises(ValueError, match="num_decisions"):
+            bench.make_row(
+                "autoscale_probe", 1.0, "s", None, "open_loop_latency",
+                {"legs": [{"scale_downs": 1}]},
+            )
+        # Dicts with no scale claims are not burdened.
+        bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
+
+    def test_autoscaler_stats_block_passes_the_audit_as_is(self):
+        """The contract the rule states: Autoscaler.stats() emits the
+        compliant shape, so the bench drops it into a row unmodified."""
+        bench = _load_bench()
+
+        class _Plane:
+            num_replicas = 2
+            metrics = None
+            brownout_level = 0
+            brownout_steps = ()
+
+            def autoscale_signals(self):
+                return {"replicas": 2, "in_rotation": 2,
+                        "outstanding": 0, "queue_depth": 0,
+                        "brownout_level": 0, "brownout_steps": []}
+
+        class _SLO:
+            def evaluate(self):
+                return {"o": "OK"}
+
+            def burn_rates(self):
+                return {"o": (0.0, 0.0)}
+
+        from keystone_tpu.serving import Autoscaler
+
+        stats = Autoscaler(_Plane(), _SLO()).stats()
+        row = bench.make_row(
+            "autoscale_probe", 1.0, "s", None, "open_loop_latency",
+            {"controller": stats},
+        )
+        assert row["detail"]["controller"]["scale_ups"] == 0

@@ -41,6 +41,7 @@ class TestPackageIsClean:
             "SITE_SERVING_EXECUTE": faults.SITE_SERVING_EXECUTE,
             "SITE_REPLICA_EXECUTE": faults.SITE_REPLICA_EXECUTE,
             "SITE_REPLICA_SPAWN": faults.SITE_REPLICA_SPAWN,
+            "SITE_AUTOSCALE_SPAWN": faults.SITE_AUTOSCALE_SPAWN,
             "SITE_CHECKPOINT_WRITE": faults.SITE_CHECKPOINT_WRITE,
         }
 

@@ -11,7 +11,16 @@ from dataclasses import dataclass
 
 import numpy as np
 import jax.numpy as jnp
-from hypothesis import given, settings, strategies as st
+import pytest
+
+# This container's environment may lack hypothesis entirely; a bare
+# import would be a COLLECTION ERROR for the whole tier-1 run (not a
+# skip), so guard it — the module skips cleanly where the dependency is
+# missing and runs everywhere else.
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="hypothesis not installed in this environment"
+)
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.workflow import Transformer
@@ -21,8 +30,6 @@ from keystone_tpu.workflow.rules import (
     EquivalentNodeMergeRule,
     UnusedBranchRemovalRule,
 )
-
-import pytest
 
 
 @dataclass(frozen=True)

@@ -1,0 +1,711 @@
+"""SLO-closed-loop autoscaler (ISSUE 12 tentpole): the controller state
+machine pinned DETERMINISTICALLY under a fake clock (scale-up on
+sustained WARN with rising burn, no flapping inside a cooldown window,
+scale-down only on sustained OK + idle budget, brownout entry/exit
+strictly LIFO, the ladder-top relief exit that an OK-gated design would
+deadlock), plus the real-plane elasticity primitives: zero-drop
+add/remove under live traffic, removal never picking the half-open-probe
+replica, live admission-knob updates, and brownout effects applied to
+every worker generation.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu import obs
+from keystone_tpu.serving import (
+    BROWNOUT_STEPS,
+    Autoscaler,
+    MicroBatchServer,
+    ReplicatedServer,
+    ServerOverloaded,
+    export_plan,
+)
+
+from tests._serving_util import TINY_D_IN, fit_tiny_mnist
+
+
+# ---------------------------------------------------------------------------
+# Deterministic controller harness: fake clock, stub SLO, fake plane
+# ---------------------------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+class StubSLO:
+    """The two reads the controller makes, directly settable."""
+
+    def __init__(self, state="OK", burn=0.0):
+        self.state = state
+        self.burn = burn
+
+    def evaluate(self):
+        return {"latency": self.state}
+
+    def burn_rates(self):
+        return {"latency": (self.burn, self.burn)}
+
+
+class FakePlane:
+    """The elasticity surface the controller drives, with an action log
+    so ordering assertions are exact."""
+
+    def __init__(self, replicas=2):
+        self.num_replicas = replicas
+        self.queue_depth = 0
+        self.outstanding = 0
+        self._brownout = []
+        self.log = []
+        self.metrics = obs.MetricsRegistry()
+
+    def autoscale_signals(self):
+        return {
+            "replicas": self.num_replicas,
+            "in_rotation": self.num_replicas,
+            "outstanding": self.outstanding,
+            "queue_depth": self.queue_depth,
+            "brownout_level": len(self._brownout),
+            "brownout_steps": list(self._brownout),
+        }
+
+    def add_replica(self):
+        self.num_replicas += 1
+        self.log.append(("add", self.num_replicas))
+        return self.num_replicas - 1
+
+    def remove_replica(self):
+        self.num_replicas -= 1
+        self.log.append(("remove", self.num_replicas))
+        return self.num_replicas
+
+    @property
+    def brownout_level(self):
+        return len(self._brownout)
+
+    @property
+    def brownout_steps(self):
+        return tuple(self._brownout)
+
+    def enter_brownout_step(self):
+        if len(self._brownout) >= len(BROWNOUT_STEPS):
+            return None
+        step = BROWNOUT_STEPS[len(self._brownout)]
+        self._brownout.append(step)
+        self.log.append(("enter", step))
+        return step
+
+    def exit_brownout_step(self):
+        if not self._brownout:
+            return None
+        step = self._brownout.pop()
+        self.log.append(("exit", step))
+        return step
+
+
+def make_controller(plane=None, slo=None, clock=None, **kw):
+    plane = plane if plane is not None else FakePlane()
+    slo = slo if slo is not None else StubSLO()
+    clock = clock if clock is not None else FakeClock()
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("scale_up_sustain_s", 1.0)
+    kw.setdefault("scale_down_sustain_s", 2.0)
+    kw.setdefault("cooldown_s", 5.0)
+    kw.setdefault("idle_queue_depth", 0)
+    a = Autoscaler(plane, slo, clock=clock, **kw)
+    return a, plane, slo, clock
+
+
+def drive(a, clock, dt, n):
+    """n ticks spaced dt apart on the fake clock; returns the actions."""
+    out = []
+    for _ in range(n):
+        clock.advance(dt)
+        rec = a.tick()
+        if rec is not None:
+            out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The deterministic state-machine suite
+# ---------------------------------------------------------------------------
+
+
+class TestScaleUp:
+    def test_sustained_warn_with_rising_burn_scales_up(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "WARN", 2.0
+        a.tick()  # pressure starts; no sustain yet
+        assert plane.log == []
+        actions = drive(a, clock, 0.6, 2)  # t=1.2 > sustain 1.0
+        assert [r["action"] for r in actions] == ["scale_up"]
+        assert plane.num_replicas == 3
+        assert a.scale_ups == 1
+        assert actions[0]["inputs"]["state"] == "WARN"
+        assert actions[0]["thresholds"]["max_replicas"] == 4
+
+    def test_no_action_before_sustain_window(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "WARN", 2.0
+        a.tick()
+        assert drive(a, clock, 0.2, 4) == []  # t=0.8 < 1.0
+        assert plane.num_replicas == 2
+
+    def test_falling_burn_is_recovery_not_pressure(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "WARN", 5.0
+        a.tick()
+        for burn in (4.0, 3.0, 2.0, 1.5, 1.2, 1.1):
+            slo.burn = burn
+            clock.advance(0.5)
+            assert a.tick() is None
+        assert plane.num_replicas == 2  # the plane was healing itself
+
+    def test_breach_counts_as_pressure_even_when_burn_falls(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "BREACH", 9.0
+        a.tick()
+        slo.burn = 8.0  # falling, but still a breach
+        actions = drive(a, clock, 0.6, 2)
+        assert [r["action"] for r in actions] == ["scale_up"]
+
+    def test_intermittent_warn_never_sustains(self):
+        """Alternating WARN/OK resets the sustain timer every other
+        tick — the classic flap input produces ZERO actions."""
+        a, plane, slo, clock = make_controller()
+        for i in range(20):
+            slo.state = "WARN" if i % 2 == 0 else "OK"
+            slo.burn = 2.0 if i % 2 == 0 else 0.0
+            clock.advance(0.6)
+            assert a.tick() is None
+        assert plane.log == []
+
+
+class TestCooldown:
+    def test_no_scale_action_inside_cooldown_window(self):
+        """The acceptance pin: after one action, sustained pressure
+        produces NOTHING until cooldown_s has elapsed — then exactly one
+        more action."""
+        a, plane, slo, clock = make_controller(cooldown_s=5.0)
+        slo.state, slo.burn = "WARN", 3.0
+        a.tick()
+        actions = drive(a, clock, 0.6, 2)
+        assert len(actions) == 1  # the first scale-up, at t=1.2
+        t_action = actions[0]["t_s"]
+        # Pressure stays sustained for the whole cooldown window: no
+        # second action inside it.
+        inside = drive(a, clock, 0.5, 9)  # t -> 5.7; 5.7-1.2=4.5 < 5.0
+        assert inside == []
+        # Past cooldown AND a fresh sustain window: exactly one more.
+        after = drive(a, clock, 0.5, 4)  # t -> 7.7
+        assert [r["action"] for r in after] == ["scale_up"]
+        assert after[0]["t_s"] - t_action >= 5.0
+        assert plane.num_replicas == 4
+
+    def test_action_resets_sustain_timer(self):
+        """Immediately after an action the pressure evidence is spent:
+        even with cooldown 0 the next action needs a FULL new sustain
+        window."""
+        a, plane, slo, clock = make_controller(cooldown_s=0.0)
+        slo.state, slo.burn = "WARN", 3.0
+        a.tick()
+        drive(a, clock, 1.2, 1)  # first scale-up
+        assert plane.num_replicas == 3
+        clock.advance(0.5)
+        assert a.tick() is None  # sustain timer RESTARTS at this tick
+        clock.advance(0.6)
+        assert a.tick() is None  # 0.6 since the restart < 1.0
+        clock.advance(0.5)
+        assert a.tick() is not None  # 1.1 >= 1.0
+        assert plane.num_replicas == 4
+
+
+class TestScaleDown:
+    def test_sustained_ok_idle_scales_down(self):
+        a, plane, slo, clock = make_controller()
+        plane.num_replicas = 3
+        slo.state, slo.burn = "OK", 0.1
+        a.tick()
+        actions = drive(a, clock, 0.7, 3)  # t=2.1 >= sustain 2.0
+        assert [r["action"] for r in actions] == ["scale_down"]
+        assert plane.num_replicas == 2
+
+    def test_ok_but_busy_never_scales_down(self):
+        a, plane, slo, clock = make_controller()
+        plane.num_replicas = 3
+        plane.queue_depth = 10  # idle budget not met
+        slo.state = "OK"
+        assert drive(a, clock, 0.7, 10) == []
+        assert plane.num_replicas == 3
+
+    def test_outstanding_occupancy_blocks_scale_down(self):
+        a, plane, slo, clock = make_controller(
+            idle_outstanding_per_replica=0.5
+        )
+        plane.num_replicas = 3
+        plane.outstanding = 2  # > 0.5 * 3
+        slo.state = "OK"
+        assert drive(a, clock, 0.7, 10) == []
+
+    def test_never_below_min_replicas(self):
+        a, plane, slo, clock = make_controller(min_replicas=2)
+        plane.num_replicas = 2
+        slo.state = "OK"
+        assert drive(a, clock, 0.7, 10) == []
+        assert plane.num_replicas == 2
+
+    def test_warn_blocks_scale_down_even_when_idle(self):
+        """A browned-out-free WARN plane with an empty queue must not
+        shed capacity: scale-down is OK-gated."""
+        a, plane, slo, clock = make_controller()
+        plane.num_replicas = 3
+        slo.state, slo.burn = "WARN", 5.0
+        a.tick()
+        for i in range(10):
+            slo.burn = 5.0 - 0.2 * (i + 1)  # strictly falling: recovery
+            clock.advance(0.7)
+            assert a.tick() is None
+        assert plane.num_replicas == 3
+
+
+class TestBrownoutLadder:
+    def test_ladder_climbs_past_max_replicas_and_exits_lifo(self):
+        a, plane, slo, clock = make_controller(
+            max_replicas=2, cooldown_s=1.0, scale_up_sustain_s=1.0,
+            scale_down_sustain_s=1.0,
+        )
+        plane.num_replicas = 2  # already at the wall
+        plane.queue_depth = 50  # real load pressure, not stale burn
+        slo.state, slo.burn = "BREACH", 8.0
+        a.tick()
+        actions = drive(a, clock, 0.6, 12)
+        entered = [r["step"] for r in actions
+                   if r["action"] == "brownout_enter"]
+        assert entered == list(BROWNOUT_STEPS)  # in ladder order
+        assert plane.brownout_steps == BROWNOUT_STEPS
+        # Relief: load subsides (queue drains). The stub SLO stays in
+        # BREACH — rejections keep burning — and the exit must fire
+        # anyway (the SLO-blind relief gate).
+        plane.queue_depth = 0
+        plane.outstanding = 0
+        exits = [
+            r["step"]
+            for r in drive(a, clock, 0.6, 16)
+            if r["action"] == "brownout_exit"
+        ]
+        assert exits == list(reversed(BROWNOUT_STEPS))  # strictly LIFO
+        assert plane.brownout_level == 0
+
+    def test_ladder_top_with_max_replicas_takes_no_further_action(self):
+        a, plane, slo, clock = make_controller(
+            max_replicas=2, cooldown_s=0.5,
+        )
+        plane.num_replicas = 2
+        plane._brownout = list(BROWNOUT_STEPS)
+        plane.queue_depth = 50  # no relief either
+        slo.state, slo.burn = "BREACH", 9.0
+        a.tick()
+        assert drive(a, clock, 0.6, 10) == []
+
+    def test_brownout_exit_precedes_scale_down(self):
+        """Recovery unwinds the ladder BEFORE capacity leaves: with an
+        active step and a scale-down-eligible plane, the exit fires
+        first."""
+        a, plane, slo, clock = make_controller(cooldown_s=1.0)
+        plane.num_replicas = 3
+        plane._brownout = ["widen_deadlines"]
+        slo.state = "OK"
+        a.tick()
+        actions = drive(a, clock, 0.7, 8)
+        kinds = [r["action"] for r in actions]
+        assert kinds[0] == "brownout_exit"
+        assert "scale_down" in kinds
+        assert kinds.index("brownout_exit") < kinds.index("scale_down")
+
+
+class TestDecisionAudit:
+    def test_every_action_is_a_structured_traced_decision(self):
+        with obs.tracing() as tracer:
+            a, plane, slo, clock = make_controller()
+            slo.state, slo.burn = "WARN", 2.0
+            a.tick()
+            drive(a, clock, 0.6, 2)
+        events = [e for e in tracer.events
+                  if e.get("name") == "autoscale.decision"]
+        assert len(events) == 1
+        args = events[0]["args"]
+        assert args["action"] == "scale_up"
+        assert args["ok"] is True
+        # The cost.decision mirror: inputs + thresholds + action +
+        # reason all ride the one event.
+        assert args["inputs"]["state"] == "WARN"
+        assert args["inputs"]["burn_fast"] == pytest.approx(2.0)
+        assert args["thresholds"]["cooldown_s"] == 5.0
+        assert "sustained WARN" in args["reason"]
+
+    def test_decision_log_and_stats_block(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "WARN", 2.0
+        a.tick()
+        drive(a, clock, 0.6, 2)
+        log = a.decision_log()
+        assert len(log) == 1 and log[0]["action"] == "scale_up"
+        st = a.stats()
+        # The make_row audit contract: scale claims ride with the
+        # decision count and the replica bounds in the SAME dict.
+        assert st["scale_ups"] == 1
+        assert st["num_decisions"] == 1
+        assert st["min_replicas"] == 1 and st["max_replicas"] == 4
+        assert st["replicas_high"] == 3 and st["replicas_low"] == 2
+        assert st["decisions"][-1]["action"] == "scale_up"
+
+    def test_failed_scale_up_is_an_audited_not_ok_decision(self):
+        class FailingPlane(FakePlane):
+            def add_replica(self):
+                raise RuntimeError("spawn storm")
+
+        plane = FailingPlane()
+        a, plane, slo, clock = make_controller(plane=plane)
+        slo.state, slo.burn = "WARN", 2.0
+        a.tick()
+        actions = drive(a, clock, 0.6, 2)
+        assert len(actions) == 1
+        assert actions[0]["action"] == "scale_up"
+        assert actions[0]["ok"] is False
+        assert a.failed_scale_ups == 1 and a.scale_ups == 0
+
+    def test_registry_gauges_and_counters_publish(self):
+        a, plane, slo, clock = make_controller()
+        slo.state, slo.burn = "WARN", 2.0
+        a.tick()
+        drive(a, clock, 0.6, 2)
+        snap = plane.metrics.snapshot()
+        assert snap["autoscale.scale_ups"] == 1
+        assert snap["autoscale.decisions"] == 1
+        assert snap["autoscale.replicas"] == 3
+        assert snap["autoscale.brownout_level"] == 0
+
+    def test_constructor_validation(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            Autoscaler(FakePlane(), StubSLO(), min_replicas=0)
+        with pytest.raises(ValueError, match="max_replicas"):
+            Autoscaler(FakePlane(), StubSLO(), min_replicas=3,
+                       max_replicas=2)
+        with pytest.raises(ValueError, match="SLOTracker"):
+            Autoscaler(FakePlane(), None)
+
+    def test_thread_lifecycle(self):
+        """start()/close() run the same tick on a daemon thread and
+        join it — the watchdog-style lifecycle run.py serve uses."""
+        a, plane, slo, clock = make_controller(tick_interval_s=0.005)
+        a.start()
+        deadline = time.perf_counter() + 5.0
+        while a.ticks == 0 and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        a.close()
+        assert a.ticks >= 1
+        assert not a._thread.is_alive()
+
+
+# ---------------------------------------------------------------------------
+# The real plane: zero-drop elasticity primitives
+# ---------------------------------------------------------------------------
+
+
+def _plane(num_replicas=2, **kw):
+    fitted, X = fit_tiny_mnist()
+    plan = export_plan(fitted, np.zeros(TINY_D_IN, np.float32), max_batch=8)
+    kw.setdefault("max_wait_ms", 0.5)
+    kw.setdefault("watchdog_interval_s", 0.01)
+    return plan, X, ReplicatedServer(plan, num_replicas=num_replicas, **kw)
+
+
+class TestElasticityPrimitives:
+    def test_add_replica_zero_drop_under_load(self):
+        plan, X, srv = _plane(num_replicas=2)
+        try:
+            futures = []
+            for i in range(60):
+                futures.append(srv.submit(X[i % len(X)]))
+                if i == 20:
+                    new_index = srv.add_replica()
+                    assert new_index == 2
+                time.sleep(0.001)
+            for f in futures:
+                f.result(timeout=30)  # nothing dropped, nothing failed
+            stats = srv.stats()
+            assert stats["replicas_added"] == 1
+            assert stats["num_replicas"] == 3
+            assert stats["failed"] == 0 and stats["rejected"] == 0
+            # The new replica actually serves.
+            done = [f for f in futures if f.replica_index == 2]
+            post = [srv.submit(X[i % len(X)]) for i in range(40)]
+            for f in post:
+                f.result(timeout=30)
+            done += [f for f in post if f.replica_index == 2]
+            assert done, "added replica never served a request"
+        finally:
+            srv.close()
+
+    def test_remove_replica_drains_zero_drop(self):
+        plan, X, srv = _plane(num_replicas=3)
+        try:
+            futures = [srv.submit(X[i % len(X)]) for i in range(40)]
+            removed = srv.remove_replica()
+            for f in futures:
+                f.result(timeout=30)  # drained, not dropped
+            stats = srv.stats()
+            assert stats["num_replicas"] == 2
+            assert stats["replicas_removed"] == 1
+            assert removed not in stats["per_replica"]
+            assert stats["failed"] == 0 and stats["rejected"] == 0
+            # Completions from the removed replica's generation were
+            # folded into the retired history, not lost.
+            assert (stats["completed"]
+                    == sum(1 for f in futures if f.done()))
+        finally:
+            srv.close()
+
+    def test_remove_refuses_last_replica(self):
+        plan, X, srv = _plane(num_replicas=2)
+        try:
+            srv.remove_replica()
+            with pytest.raises(ValueError, match="last live replica"):
+                srv.remove_replica()
+            srv.submit(X[0]).result(timeout=30)  # still serving
+        finally:
+            srv.close()
+
+    def test_remove_never_picks_half_open_probe_replica(self):
+        """The probe replica's breaker is mid-recovery; evicting it
+        would leave the probe outcome unobservable. Removal must pick
+        another replica even when the probe one would win least-loaded
+        selection."""
+        plan, X, srv = _plane(num_replicas=3)
+        try:
+            # Force replica 2 (the tie-break winner: equal load, highest
+            # index) into half_open: breaker open with the cooldown
+            # already elapsed.
+            probe_rep = srv._replicas[2]
+            with probe_rep.server._lock:
+                probe_rep.server._breaker_open = True
+                probe_rep.server._breaker_opened_t = (
+                    time.perf_counter() - 999.0
+                )
+            assert probe_rep.server.breaker_state == "half_open"
+            removed = srv.remove_replica()
+            assert removed != 2
+            assert srv.num_replicas == 2
+        finally:
+            srv.close()
+
+    def test_scale_up_serves_swapped_plan(self):
+        """A replica added AFTER a hot-swap clones the swapped plan —
+        elasticity tracks the live version, not the construction one."""
+        fitted2, _ = fit_tiny_mnist(seed=42)
+        plan, X, srv = _plane(num_replicas=2)
+        plan2 = export_plan(fitted2, np.zeros(TINY_D_IN, np.float32),
+                            max_batch=8)
+        try:
+            srv.swap_plan(plan2)
+            idx = srv.add_replica()
+            rep = next(r for r in srv._replicas if r.index == idx)
+            assert rep.plan.fingerprint == plan2.fingerprint
+            # Burst (no per-request wait) so least-loaded routing
+            # actually spreads onto the new replica, then confirm its
+            # responses carry the swapped fingerprint.
+            futures = [srv.submit(X[i % len(X)]) for i in range(64)]
+            for f in futures:
+                f.result(timeout=30)
+            assert all(
+                f.plan_fingerprint == plan2.fingerprint for f in futures
+            )
+        finally:
+            srv.close()
+
+
+class TestElasticitySwapInteraction:
+    def test_add_replica_serializes_against_swap_lock(self):
+        """A replica added mid-swap would be invisible to the swap's
+        membership snapshot and serve the OLD plan forever — add must
+        block until the rollout releases the swap lock."""
+        import threading
+
+        plan, X, srv = _plane(num_replicas=2)
+        added = []
+        try:
+            srv._swap_lock.acquire()
+            t = threading.Thread(
+                target=lambda: added.append(srv.add_replica())
+            )
+            t.start()
+            t.join(timeout=0.3)
+            assert t.is_alive() and not added  # blocked on the rollout
+            srv._swap_lock.release()
+            t.join(timeout=30)
+            assert added == [2]
+        finally:
+            if srv._swap_lock.locked():  # pragma: no cover - guard
+                srv._swap_lock.release()
+            srv.close()
+
+    def test_remove_replica_serializes_against_swap_lock(self):
+        """A removal mid-rollout would hand the swap's ownership wait
+        an already-retired replica (counters folded twice, a respawned
+        worker no membership list tracks) — remove must block too."""
+        import threading
+
+        plan, X, srv = _plane(num_replicas=3)
+        removed = []
+        try:
+            srv._swap_lock.acquire()
+            t = threading.Thread(
+                target=lambda: removed.append(srv.remove_replica())
+            )
+            t.start()
+            t.join(timeout=0.3)
+            assert t.is_alive() and not removed
+            srv._swap_lock.release()
+            t.join(timeout=30)
+            assert removed and srv.num_replicas == 2
+        finally:
+            if srv._swap_lock.locked():  # pragma: no cover - guard
+                srv._swap_lock.release()
+            srv.close()
+
+    def test_swap_sequence_maps_by_rotation_position(self):
+        """With non-dense indices (remove + add), a per-replica plan
+        sequence maps by position over the live membership — no plan
+        silently dropped, none double-assigned."""
+        plan, X, srv = _plane(num_replicas=3)
+        plans = [
+            export_plan(fit_tiny_mnist(seed=s)[0],
+                        np.zeros(TINY_D_IN, np.float32), max_batch=8)
+            for s in (10, 11, 12)
+        ]
+        try:
+            srv.remove_replica()      # retires index 2
+            idx = srv.add_replica()   # fresh index 3 -> members {0,1,3}
+            assert idx == 3
+            srv.swap_plan(plans)
+            by_index = {r.index: r.plan.fingerprint
+                        for r in srv._replicas}
+            assert by_index == {
+                0: plans[0].fingerprint,
+                1: plans[1].fingerprint,
+                3: plans[2].fingerprint,
+            }
+            with pytest.raises(ValueError, match="live membership"):
+                srv.swap_plan(plans[:2])
+        finally:
+            srv.close()
+
+
+class TestBrownoutMechanics:
+    def test_set_admission_params_live(self):
+        fitted, X = fit_tiny_mnist()
+        plan = export_plan(fitted, np.zeros(TINY_D_IN, np.float32),
+                           max_batch=8)
+        srv = MicroBatchServer(plan, max_wait_ms=2.0, max_queue_depth=64)
+        try:
+            srv.set_admission_params(max_wait_ms=8.0, max_queue_depth=4)
+            assert srv.max_wait_s == pytest.approx(8e-3)
+            assert srv.max_queue_depth == 4
+            with pytest.raises(ValueError):
+                srv.set_admission_params(max_queue_depth=0)
+            srv.submit(X[0]).result(timeout=30)  # still serves
+        finally:
+            srv.close()
+
+    def test_steps_apply_to_live_servers_and_revert(self):
+        plan, X, srv = _plane(num_replicas=2, max_wait_ms=2.0,
+                              max_queue_depth=64)
+        try:
+            base_wait = srv._replicas[0].server.max_wait_s
+            assert srv.enter_brownout_step() == "widen_deadlines"
+            for rep in srv._replicas:
+                assert rep.server.max_wait_s == pytest.approx(
+                    base_wait * srv.brownout_wait_factor
+                )
+            assert srv.enter_brownout_step() == "aggressive_shed"
+            for rep in srv._replicas:
+                assert rep.server.max_queue_depth == 16  # 64 * 0.25
+            # LIFO revert restores each knob.
+            assert srv.exit_brownout_step() == "aggressive_shed"
+            assert srv._replicas[0].server.max_queue_depth == 64
+            assert srv.exit_brownout_step() == "widen_deadlines"
+            assert srv._replicas[0].server.max_wait_s == pytest.approx(
+                base_wait
+            )
+            assert srv.exit_brownout_step() is None
+        finally:
+            srv.close()
+
+    def test_reject_admissions_is_named_and_counted(self):
+        plan, X, srv = _plane(num_replicas=2)
+        try:
+            for _ in range(3):
+                srv.enter_brownout_step()
+            assert srv.brownout_level == 3
+            with pytest.raises(ServerOverloaded, match="brownout"):
+                srv.submit(X[0])
+            stats = srv.stats()
+            assert stats["rejected"] == 1
+            assert stats["brownout_rejected"] == 1
+            srv.exit_brownout_step()
+            srv.submit(X[0]).result(timeout=30)  # readmitted
+        finally:
+            srv.close()
+
+    def test_brownout_rejects_feed_the_slo_as_bad_events(self):
+        slo = obs.SLOTracker([obs.SLOObjective(
+            "availability", kind="availability", target=0.99,
+            min_events=1,
+        )])
+        plan, X, srv = _plane(num_replicas=2, slo=slo)
+        try:
+            for _ in range(3):
+                srv.enter_brownout_step()
+            for _ in range(4):
+                with pytest.raises(ServerOverloaded):
+                    srv.submit(X[0])
+            verdict = slo.verdict()
+            assert verdict["objectives"]["availability"]["bad_total"] == 4
+        finally:
+            srv.close()
+
+    def test_new_generation_spawns_under_active_brownout(self):
+        """A worker generation built while a step is active inherits the
+        degraded admission knobs — a watchdog restart cannot silently
+        undo a brownout."""
+        plan, X, srv = _plane(num_replicas=2, max_wait_ms=2.0,
+                              max_queue_depth=64)
+        try:
+            srv.enter_brownout_step()  # widen_deadlines
+            srv.enter_brownout_step()  # aggressive_shed
+            kw = srv._effective_server_kwargs()
+            assert kw["max_wait_ms"] == pytest.approx(
+                2.0 * srv.brownout_wait_factor
+            )
+            assert kw["max_queue_depth"] == 16
+            idx = srv.add_replica()
+            rep = next(r for r in srv._replicas if r.index == idx)
+            assert rep.server.max_wait_s == pytest.approx(
+                2.0 * srv.brownout_wait_factor / 1e3
+            )
+            assert rep.server.max_queue_depth == 16
+        finally:
+            srv.close()
