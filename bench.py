@@ -262,6 +262,39 @@ def _autoscale_violations(obj, path):
     return bad
 
 
+def _calibration_violations(obj, path):
+    """Auditability rule (ISSUE 13 satellite): any dict claiming a
+    cost-model prediction error (a ``prediction_error*`` key) must carry
+    the decision-event count (``num_decisions``) and the weight-family
+    name (``weights_family``) in the SAME dict — an error statistic with
+    no n and no family is not a calibration claim.
+    ``obs.calibrate.calibration_report`` emits exactly this shape, so
+    dropping a report's summary into a row passes as-is."""
+    bad = []
+    if isinstance(obj, dict):
+        keys = list(obj)
+        claims = [k for k in keys if k.startswith("prediction_error")]
+        if claims:
+            nd = obj.get("num_decisions")
+            if not (isinstance(nd, (int, float))
+                    and not isinstance(nd, bool)):
+                bad.append(
+                    f"{path}: {claims} without a numeric num_decisions "
+                    "(decision-event count) field"
+                )
+            if not isinstance(obj.get("weights_family"), str):
+                bad.append(
+                    f"{path}: {claims} without a weights_family name "
+                    "field"
+                )
+        for k, v in obj.items():
+            bad.extend(_calibration_violations(v, f"{path}.{k}"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            bad.extend(_calibration_violations(v, f"{path}[{i}]"))
+    return bad
+
+
 def _roofline_violations(obj, path, row_unit, top=False):
     """Auditability rule (ISSUE 3 satellite): any dict claiming an ``mfu``
     must carry its arithmetic inputs in the SAME dict — a flop model
@@ -330,6 +363,7 @@ def make_row(metric, value, unit, vs_baseline, timing, detail):
     violations += _recovery_violations(detail, timing)
     violations += _overhead_violations(detail, timing)
     violations += _autoscale_violations(detail, "detail")
+    violations += _calibration_violations(detail, "detail")
     if violations:
         raise ValueError(
             f"row {metric!r}: unauditable roofline claims: {violations}"
@@ -2522,6 +2556,108 @@ def _observability_serving_overhead():
     }
 
 
+def _cost_calibration_block():
+    """Calibration audit of the shipped cost-model constants on THIS
+    host (ISSUE 13 satellite): a selector-driven fit runs TRACED — the
+    decision recorded, the winner's measured wall back-annotated by the
+    executor — and the trace is replayed through the calibrator
+    (``obs/calibrate.py``). The block RAISES if the median |log error|
+    under the active weights exceeds the stated bound, so constants
+    that stopped matching this host fail the bench loudly instead of
+    silently mis-routing every fit.
+
+    Measurement discipline matches ``scripts/fit_cost_weights.py``: the
+    scored leg is a WARM fit (a first traced fit eats the compile) and
+    a calibrated null-dispatch round trip is subtracted — the model
+    prices device time, and the tunnel's dispatch overhead must not
+    read as model error. On a non-TPU host the bound derates (the
+    constants are TPU-fit; a CPU run proves the machinery, not the
+    constants) and the block says so (``host_derated_bound``).
+
+    Env knobs: BENCH_CAL_N (rows, default 65536),
+    BENCH_CAL_MAX_ABS_LOG_ERR (the bound).
+    """
+    from keystone_tpu import obs
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.obs import calibrate as cal
+    from keystone_tpu.ops.learning.cost import LeastSquaresEstimator
+
+    n = int(os.environ.get("BENCH_CAL_N", str(65_536)))
+    d, k = 2048, 32
+    rng = np.random.default_rng(23)
+    Xh = rng.normal(size=(n, d)).astype(np.float32)
+    Yh = rng.normal(size=(n, k)).astype(np.float32)
+    data, labels = Dataset.of(jnp.asarray(Xh)), Dataset.of(jnp.asarray(Yh))
+    sample = Dataset.of(jnp.asarray(Xh[:24]))
+    sample.total_n = n
+    ls = Dataset.of(jnp.asarray(Yh[:24]))
+    est = LeastSquaresEstimator(lam=1e-3, num_machines=1)
+
+    @jax.jit
+    def _null(x):
+        return x + 1.0
+
+    _sync_scalar(_null(jnp.zeros(())))  # compile
+    dispatch = min(
+        min_wall(lambda: _sync_scalar(_null(jnp.zeros(()))), reps=3)[0],
+        0.5,
+    )
+    def fit_once(chosen, timing):
+        # The bench's own barrier discipline: the measured wall must
+        # cover the device work, and host transfer is the only reliable
+        # barrier on tunneled backends — apply the fitted model to one
+        # datum and transfer the result before the clock stops.
+        ref = chosen._pending_cost_outcome
+        chosen._pending_cost_outcome = None
+        t0 = time.perf_counter()
+        m = chosen.fit_datasets([data, labels])
+        float(np.abs(np.asarray(m.single_transform([Xh[0]]))).sum())
+        if ref is not None:
+            ref.stamp(time.perf_counter() - t0, timing=timing)
+
+    with obs.tracing() as t:
+        # Cold leg: compile + warm (its decision/outcome is recorded
+        # but NOT scored — compile time is not a model claim).
+        fit_once(est.optimize(sample, ls), "single_run_cold")
+        # Scored leg: a fresh decision whose stamped outcome is warm.
+        fit_once(est.optimize(sample, ls), "single_run_warm")
+    outcomes = cal.join_decisions(t.events)
+    warm = outcomes[-1]
+    warm.measured_s = max(warm.measured_s - dispatch, 1e-6)
+    active = cal.family_weights("active")
+    report = cal.calibration_report([warm], weights=active)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    bound = float(os.environ.get(
+        "BENCH_CAL_MAX_ABS_LOG_ERR", "2.5" if on_tpu else "12.0"
+    ))
+    verdict = cal.drift_gate(report, threshold=bound)
+    med = report["median_abs_log_error"]
+    if med is None or verdict["drifted"]:
+        raise AssertionError(
+            f"cost-model calibration audit failed on this host: median "
+            f"|log error| {med} vs bound {bound} under the "
+            f"{report['weights_family']!r} weights (winner {warm.winner}"
+            f", predicted {warm.predicted_s}, measured-minus-dispatch "
+            f"{warm.measured_s:.4f}s) — refit with bin/calibrate --refit"
+        )
+    return {
+        "prediction_error_median_abs_log": round(med, 4),
+        "num_decisions": report["num_decisions"],
+        "weights_family": report["weights_family"],
+        "bound_abs_log_error": bound,
+        "host_derated_bound": not on_tpu,
+        "winner": warm.winner,
+        "predicted_winner_s": (
+            round(warm.predicted_s, 6)
+            if warm.predicted_s is not None else None
+        ),
+        "measured_minus_dispatch_s": round(warm.measured_s, 4),
+        "dispatch_overhead_s": round(dispatch, 4),
+        "misroutes": len(report["misroutes"]),
+        "n": n, "d": d, "k": k,
+    }
+
+
 def observability_overhead_metric():
     """The obs plane's price (ISSUE 9 acceptance): the SAME warmed
     disk-streamed dense fit with tracing ON (obs.tracing into a temp
@@ -2539,8 +2675,14 @@ def observability_overhead_metric():
     with SLO tracking + the live exporter + tail-sampled tracing —
     the served-p99 overhead fraction, target <= 5%.
 
+    The ``cost_calibration`` sub-block (ISSUE 13) audits the shipped
+    cost-model constants against this host: a traced selector-driven
+    fit replayed through the calibrator, raising past the stated
+    median-|log error| bound (``_cost_calibration_block``).
+
     Env knobs: BENCH_OBS_N (rows, default 65536), BENCH_OBS_SERVE_S
-    (per-leg serve window, default 3).
+    (per-leg serve window, default 3), BENCH_CAL_N /
+    BENCH_CAL_MAX_ABS_LOG_ERR (the calibration audit).
     """
     import shutil
     import tempfile
@@ -2605,6 +2747,7 @@ def observability_overhead_metric():
         wall_on, loss, _ = min_wall(traced_fit, reps=3)
         span_count = len(obs.load_events(last_trace_dir[0]))
         serving_live = _observability_serving_overhead()
+        cost_calibration = _cost_calibration_block()
     finally:
         if ambient_trace is not None:
             os.environ["KEYSTONE_TRACE"] = ambient_trace
@@ -2629,6 +2772,11 @@ def observability_overhead_metric():
             # ISSUE 10: the live plane's price on SERVED p99 (SLO
             # tracker + exporter + tail-sampled tracing), target <= 5%.
             "serving_live_plane": serving_live,
+            # ISSUE 13: the calibration audit — a traced selector-driven
+            # fit replayed through obs/calibrate.py; raises past the
+            # stated |log error| bound (the shipped constants must still
+            # hold on this host).
+            "cost_calibration": cost_calibration,
             "timing_note": (
                 "each leg: warm fit (compile), then min of 3 timed "
                 "fits; identical fold programs and segment order — the "

@@ -29,6 +29,13 @@ causally-linked record:
     ring of recent events that chaos/fault paths (worker death, breaker
     opens, shard corruption, watchdog evictions) dump alongside the
     exception, so a postmortem names the spans in flight at death.
+  - :mod:`~keystone_tpu.obs.calibrate` — the cost-model calibration
+    plane (ISSUE 13): joins every ``cost.decision`` with the measured
+    seconds of the work it priced, reports prediction error per engine
+    and weight family, flags mis-routes with their regret, refits the
+    weight families from production traces
+    (``KEYSTONE_COST_WEIGHTS=calibrated:<artifact>``), and gates on
+    drift (``bin/calibrate``).
 
 Activation (docs/observability.md): ``KEYSTONE_TRACE=dir`` env knob,
 ``run.py --trace=dir``, or ``with obs.tracing(dir):`` in code. This
@@ -36,6 +43,14 @@ package imports no jax — the data-plane runtime (which must stay
 jax-free) reports into it from its IO workers.
 """
 
+from keystone_tpu.obs.calibrate import (
+    calibration_report,
+    drift_gate,
+    join_decisions,
+    load_calibration_artifact,
+    refit,
+    write_calibration_artifact,
+)
 from keystone_tpu.obs.export import (
     load_events,
     to_chrome_trace,
@@ -64,6 +79,7 @@ from keystone_tpu.obs.slo import (
 )
 from keystone_tpu.obs.tracer import (
     CostDecision,
+    CostOutcomeRef,
     Span,
     TailSampler,
     Tracer,
@@ -79,6 +95,7 @@ from keystone_tpu.obs.tracer import (
 
 __all__ = [
     "CostDecision",
+    "CostOutcomeRef",
     "FlightRecorder",
     "LiveExporter",
     "MetricsRegistry",
@@ -91,13 +108,19 @@ __all__ = [
     "TailSampler",
     "Tracer",
     "active_tracer",
+    "calibration_report",
     "counter_track",
+    "drift_gate",
     "enabled",
     "event",
     "flight_note",
     "flight_snapshot",
+    "join_decisions",
+    "load_calibration_artifact",
     "load_events",
     "record_cost_decision",
+    "refit",
+    "write_calibration_artifact",
     "render_flight_record",
     "render_prometheus",
     "span",

@@ -38,6 +38,11 @@ __all__ = [
     "METRIC_AUTOSCALE_REPLICAS",
     "METRIC_AUTOSCALE_SCALE_DOWNS",
     "METRIC_AUTOSCALE_SCALE_UPS",
+    "METRIC_CALIBRATION_DECISIONS",
+    "METRIC_CALIBRATION_DRIFT",
+    "METRIC_CALIBRATION_ERROR",
+    "METRIC_CALIBRATION_MISROUTES",
+    "METRIC_CALIBRATION_REGRET_S",
     "METRIC_EXPORTER_ERRORS",
     "METRIC_EXPORTER_PUBLISHES",
     "METRIC_EXPORTER_PUBLISH_S",
@@ -117,6 +122,17 @@ METRIC_AUTOSCALE_SCALE_UPS = "autoscale.scale_ups"
 METRIC_AUTOSCALE_SCALE_DOWNS = "autoscale.scale_downs"
 METRIC_AUTOSCALE_BROWNOUT_LEVEL = "autoscale.brownout_level"
 METRIC_AUTOSCALE_DECISIONS = "autoscale.decisions"
+
+# Cost-model calibration plane (obs/calibrate.py) — predicted-vs-measured
+# audit of the cost.decision trail. calibration.error is the |log error|
+# distribution per engine (label: engine=<candidate label>);
+# calibration.drift is the gate verdict (1 = fresh traces disagree with
+# the active weights past the stated threshold).
+METRIC_CALIBRATION_ERROR = "calibration.error"
+METRIC_CALIBRATION_DECISIONS = "calibration.decisions"
+METRIC_CALIBRATION_MISROUTES = "calibration.misroutes"
+METRIC_CALIBRATION_REGRET_S = "calibration.regret_s"
+METRIC_CALIBRATION_DRIFT = "calibration.drift"
 
 
 class Counter:

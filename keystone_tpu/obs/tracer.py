@@ -46,6 +46,7 @@ logger = logging.getLogger("keystone_tpu.obs.tracer")
 
 __all__ = [
     "CostDecision",
+    "CostOutcomeRef",
     "Span",
     "TailSampler",
     "Tracer",
@@ -387,15 +388,20 @@ class Tracer:
                 attrs["keep"] = reason
         return self.add_span(name, t0, t1, **attrs)
 
-    def event(self, name: str, **attrs) -> None:
+    def event(self, name: str, **attrs) -> Dict[str, Any]:
+        """Record an instant event; returns the record dict (the handle
+        :class:`CostOutcomeRef` mutates to back-annotate a decision with
+        its measured outcome before the trace file is written)."""
         th = threading.current_thread()
+        rec = {
+            "type": "event", "name": name,
+            "ts_us": self._us(time.perf_counter()),
+            "tid": th.ident, "thread": th.name,
+            "run_id": self.run_id, "args": dict(attrs),
+        }
         with self._lock:
-            self._append_locked({
-                "type": "event", "name": name,
-                "ts_us": self._us(time.perf_counter()),
-                "tid": th.ident, "thread": th.name,
-                "run_id": self.run_id, "args": dict(attrs),
-            })
+            self._append_locked(rec)
+        return rec
 
     def counter_track(self, name: str, value: float) -> None:
         with self._lock:
@@ -456,18 +462,61 @@ class CostDecision:
         }
 
 
-def record_cost_decision(decision: CostDecision) -> None:
+class CostOutcomeRef:
+    """Handle onto one recorded ``cost.decision`` event: whoever runs
+    the priced work back-annotates the decision record with the
+    MEASURED outcome (the executor stamps the winning fit's wall +
+    span id — ``workflow/pipeline.py``), so predicted-vs-measured is
+    one record with no join (``obs/calibrate.py``; ``bin/trace``'s
+    decision table prints it per row). The mutation happens under the
+    tracer lock, before the trace file is written at ``tracing()``
+    exit; a stamp after exit mutates a dict nothing reads — harmless."""
+
+    __slots__ = ("_tracer", "_record")
+
+    def __init__(self, tracer: "Tracer", record: Dict[str, Any]):
+        self._tracer = tracer
+        self._record = record
+
+    def stamp(self, measured_s: float, span_id: Optional[int] = None,
+              **extra) -> None:
+        if self._tracer is None or self._record is None:
+            return  # ref crossed a pickle boundary: nothing to annotate
+        outcome = {"measured_s": float(measured_s)}
+        if span_id is not None:
+            outcome["span_id"] = span_id
+        outcome.update(extra)
+        with self._tracer._lock:
+            self._record.setdefault("args", {})["outcome"] = outcome
+
+    def __getstate__(self):
+        # A pending ref rides on the selected estimator, and estimators
+        # get cloudpickled (FittedPipeline saves); the live tracer
+        # (locks) must not be dragged along — a pickled ref drops its
+        # annotation instead.
+        return {}
+
+    def __setstate__(self, state) -> None:
+        self._tracer = None
+        self._record = None
+
+
+def record_cost_decision(decision: CostDecision) -> Optional[CostOutcomeRef]:
     """Emit a ``cost.decision`` instant event (and a flight-recorder
-    note) for one selection. One branch when tracing is disabled."""
+    note) for one selection. One branch when tracing is disabled.
+    Returns a :class:`CostOutcomeRef` for the measured-outcome
+    back-annotation, or None when no tracer is active."""
     t = _ACTIVE
+    ref: Optional[CostOutcomeRef] = None
     if t is not None:
-        t.event("cost.decision", **decision.to_args())
+        ref = CostOutcomeRef(t, t.event("cost.decision", **decision.to_args()))
     from keystone_tpu.obs import flight
 
     flight.flight_note(
         "decision", decision.decision, winner=decision.winner,
         reason=decision.reason,
     )
+    return ref
 
 
 # ---------------------------------------------------------------------------

@@ -123,25 +123,100 @@ TPU_NETWORK_WEIGHT = 1.0e-11  # pinned (single-chip unobservable), not fit
 TPU_SPARSE_GATHER_OVERHEAD = 500.0
 
 
-def active_weights() -> Tuple[float, float, float]:
-    """The selector's (cpu, mem, network) weights: TPU-derived by default;
-    ``KEYSTONE_COST_WEIGHTS=ec2`` restores the reference's cluster
-    constants."""
+# Weight-family spec for trace-calibrated constants:
+# KEYSTONE_COST_WEIGHTS=calibrated:<path> points at a refit artifact
+# written by the calibration plane (obs/calibrate.py — trace-driven
+# refit with provenance: source run_ids, span counts, residuals).
+CALIBRATED_PREFIX = "calibrated:"
+
+# Loaded-artifact cache keyed by path -> (mtime, weights dict): a
+# selector consulting the env per construction must not re-read and
+# re-validate the JSON every time, but a refreshed artifact (refit in
+# place) must be picked up.
+_CALIBRATED_CACHE: dict = {}
+
+
+def _calibrated_weights(path: str) -> dict:
     import os
 
-    if os.environ.get("KEYSTONE_COST_WEIGHTS", "").lower() == "ec2":
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError as e:
+        raise ValueError(
+            f"KEYSTONE_COST_WEIGHTS={CALIBRATED_PREFIX}{path}: artifact "
+            f"is unreadable: {e}"
+        ) from e
+    cached = _CALIBRATED_CACHE.get(path)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    from keystone_tpu.obs.calibrate import load_calibration_artifact
+
+    weights = dict(load_calibration_artifact(path)["weights"])
+    _CALIBRATED_CACHE[path] = (mtime, weights)
+    return weights
+
+
+def _parse_weights_env() -> Tuple[str, Optional[str]]:
+    """Parse ``KEYSTONE_COST_WEIGHTS`` into (family, artifact_path).
+
+    Accepted (family part case-insensitive; artifact paths keep their
+    case): unset/empty or ``tpu`` -> the TPU constants, ``ec2`` -> the
+    reference cluster set, ``calibrated:<path>`` -> a refit artifact.
+    Anything else raises naming the variable — a typo'd family must not
+    silently select the default and mis-price every decision (the exact
+    failure mode the calibration plane exists to catch)."""
+    import os
+
+    raw = os.environ.get("KEYSTONE_COST_WEIGHTS", "").strip()
+    low = raw.lower()
+    if not raw or low == "tpu":
+        return "tpu", None
+    if low == "ec2":
+        return "ec2", None
+    if low.startswith(CALIBRATED_PREFIX):
+        return "calibrated", raw[len(CALIBRATED_PREFIX):]
+    raise ValueError(
+        f"KEYSTONE_COST_WEIGHTS={raw!r}: expected 'tpu', 'ec2' or "
+        f"'calibrated:<artifact.json>'"
+    )
+
+
+def weights_family_name() -> str:
+    """The active weight family's name: ``tpu`` (default), ``ec2``, or
+    ``calibrated`` — what decision audits and calibration reports record
+    as provenance."""
+    return _parse_weights_env()[0]
+
+
+def active_weights() -> Tuple[float, float, float]:
+    """The selector's (cpu, mem, network) weights: TPU-derived by
+    default; ``KEYSTONE_COST_WEIGHTS=ec2`` restores the reference's
+    cluster constants; ``KEYSTONE_COST_WEIGHTS=calibrated:<path>`` loads
+    a trace-refit artifact (obs/calibrate.py) — malformed or missing
+    artifacts, and unknown family names, raise naming the variable
+    rather than mis-pricing silently."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
         return EC2_CPU_WEIGHT, EC2_MEM_WEIGHT, EC2_NETWORK_WEIGHT
+    if family == "calibrated":
+        w = _calibrated_weights(path)
+        return float(w["cpu"]), float(w["mem"]), float(w["network"])
     return TPU_CPU_WEIGHT, TPU_MEM_WEIGHT, TPU_NETWORK_WEIGHT
 
 
 def sparse_gather_overhead() -> float:
     """Random-access multiplier for the sparse gather engine's mem term,
     matching the active weight family (the EC2 mem weight already prices
-    bytes at cluster rates, so its historical factor stays 8)."""
-    import os
-
-    if os.environ.get("KEYSTONE_COST_WEIGHTS", "").lower() == "ec2":
+    bytes at cluster rates, so its historical factor stays 8). A
+    calibrated artifact fit from traces with no gather rows records
+    null — the TPU constant stands in, since the artifact's (cpu, mem)
+    are TPU-fit refinements."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
         return EC2_SPARSE_GATHER_OVERHEAD
+    if family == "calibrated":
+        so = _calibrated_weights(path).get("sparse_gather_overhead")
+        return float(so) if so is not None else TPU_SPARSE_GATHER_OVERHEAD
     return TPU_SPARSE_GATHER_OVERHEAD
 
 
@@ -454,12 +529,23 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
              zip(self.options, costs)],
         )
 
-        def emit_decision(winner, reason: str) -> None:
+        my_weights = (self.cpu_weight, self.mem_weight, self.network_weight)
+        try:
+            family = (
+                weights_family_name()
+                if my_weights == active_weights() else "custom"
+            )
+        except ValueError:  # broken calibrated artifact mid-process
+            family = "custom"
+
+        def emit_decision(winner, reason: str):
             # The structured audit event (obs plane, ISSUE 9): candidate
             # set, predicted costs, feasibility verdicts, winner —
             # tests/test_cost_replay.py's trace-backed audit leg asserts
             # the recorded winner matches every replay assertion.
-            obs.record_cost_decision(obs.CostDecision(
+            # Returns the CostOutcomeRef the executor later stamps the
+            # winner's measured wall onto (obs/calibrate.py).
+            return obs.record_cost_decision(obs.CostDecision(
                 decision="least_squares_solver",
                 winner=candidate_label(winner),
                 candidates=[
@@ -482,6 +568,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                     "weights": {
                         "cpu": self.cpu_weight, "mem": self.mem_weight,
                         "network": self.network_weight,
+                        "family": family,
                     },
                 },
             ))
@@ -496,8 +583,14 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 "selecting least-resident %s",
                 budget / 2**30, n, d, type(best[0]).__name__,
             )
-            emit_decision(best[0], "least_resident_fallback")
+            best[1]._pending_cost_outcome = emit_decision(
+                best[0], "least_resident_fallback"
+            )
             return best[1]
         chosen = self.options[int(np.argmin(costs))]
-        emit_decision(chosen[0], "argmin")
+        # The pending back-annotation: whoever fits the winner (the
+        # executor's fit_datasets, or a fused streamed fit that inherits
+        # the ref) stamps the measured wall + span id onto the decision
+        # record, closing the predicted-vs-measured loop per decision.
+        chosen[1]._pending_cost_outcome = emit_decision(chosen[0], "argmin")
         return chosen[1]

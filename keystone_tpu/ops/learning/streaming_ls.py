@@ -717,7 +717,16 @@ class StreamingLeastSquaresChoice(LabelEstimator):
         )
 
     def fuse_with_members(self, members) -> "StreamedFitEstimator":
-        return StreamedFitEstimator(members, self)
+        fused = StreamedFitEstimator(members, self)
+        # A pending cost-decision back-annotation (cost.py optimize)
+        # follows the fit wherever it actually runs: the fused streamed
+        # estimator replaces this choice in the graph, so the executor
+        # stamps the measured wall through IT, not through the choice.
+        ref = getattr(self, "_pending_cost_outcome", None)
+        if ref is not None:
+            fused._pending_cost_outcome = ref
+            self._pending_cost_outcome = None
+        return fused
 
     def fit_source(self, data: Dataset, labels: Dataset, featurize,
                    d_feat: int):

@@ -12,8 +12,13 @@ and prints the three views a postmortem starts from:
     (``runtime.task`` spans grouped by their ``lane`` attr) over the
     trace's wall — the overlap picture at a glance.
   - **Cost-decision table**: every ``cost.decision`` event — decision
-    kind, winner, reason, and the feasible/infeasible candidate split —
-    the audit trail for "why did the optimizer run THIS engine".
+    kind, winner, reason, the feasible/infeasible candidate split, and
+    (when the executor back-annotated the decision with its measured
+    outcome) predicted vs measured seconds with the log error per row,
+    plus a drift WARNING when the median |log error| exceeds the
+    calibration threshold — the audit trail for "why did the optimizer
+    run THIS engine" and "was the model right". ``bin/calibrate``
+    renders the full per-engine/mis-route analysis and refits.
 
 ``--perfetto OUT.json`` (re-)emits the Chrome-trace projection from the
 JSONL rows (e.g. after post-processing, or when only the event log was
@@ -24,11 +29,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
+import statistics
 import sys
 from collections import defaultdict
 from typing import Any, Dict, List, Optional, Sequence
 
+from keystone_tpu.obs.calibrate import DEFAULT_DRIFT_THRESHOLD as \
+    DRIFT_THRESHOLD
 from keystone_tpu.obs.export import (
     load_events,
     to_chrome_trace,
@@ -130,15 +139,58 @@ def _render(summary: Dict[str, Any], top: int) -> str:
     decisions = summary["cost_decisions"]
     if decisions:
         lines.append("")
-        lines.append("cost decisions:")
+        lines.append("cost decisions (predicted vs measured via the "
+                     "back-annotated outcome — obs/calibrate.py):")
+        errors = []
         for d in decisions:
             cands = d.get("candidates", [])
             feas = sum(1 for c in cands if c.get("feasible"))
-            lines.append(
-                f"  {d.get('decision', '?'):<24} winner="
-                f"{d.get('winner', '?')} reason={d.get('reason', '?')} "
+            winner = d.get("winner", "?")
+            row = (
+                f"  {d.get('decision', '?'):<24} winner={winner} "
+                f"reason={d.get('reason', '?')} "
                 f"({feas}/{len(cands)} candidates feasible)"
             )
+            predicted = next(
+                (c.get("cost_s") for c in cands
+                 if c.get("label") == winner), None,
+            )
+            measured = (d.get("outcome") or {}).get("measured_s")
+            if measured is not None:
+                # Same scoreability guard as DecisionOutcome.log_error:
+                # a zero/negative wall (an external stamp) renders as
+                # measured-only, never a math domain error.
+                err = (
+                    math.log(measured / predicted)
+                    if predicted and predicted > 0 and measured > 0
+                    else None
+                )
+                if err is not None:
+                    errors.append(abs(err))
+                err_s = f" log_err={err:+.3f}" if err is not None else ""
+                pred_s = (
+                    f"{predicted:.4g}s" if predicted is not None
+                    else "inf"
+                )
+                row += (
+                    f" predicted={pred_s} measured={measured:.4g}s"
+                    f"{err_s}"
+                )
+            lines.append(row)
+        if errors:
+            # statistics.median — the same median CONVENTION as
+            # drift_gate. (bin/calibrate scores a broader row set —
+            # span-window joins, re-prediction — so its verdict is the
+            # authoritative one; this warning is the inline tripwire.)
+            med = statistics.median(errors)
+            if med > DRIFT_THRESHOLD:
+                lines.append(
+                    f"  WARNING: cost-model drift — median |log error| "
+                    f"{med:.3f} > {DRIFT_THRESHOLD} across "
+                    f"{len(errors)} measured decisions; audit with "
+                    "bin/calibrate (and --refit to re-estimate the "
+                    "weights from this trace)"
+                )
     return "\n".join(lines)
 
 

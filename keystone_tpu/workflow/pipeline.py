@@ -593,6 +593,63 @@ class Identity(Transformer[A, A]):
 # ---------------------------------------------------------------------------
 
 
+def _sync_fitted(fitted) -> None:
+    """Best-effort execution barrier for the measured-outcome stamp:
+    jax dispatch is async, so a fit-call wall can close before the
+    device work it priced has run. Host-transfer one scalar from the
+    first device array in the fitted transformer's state (the
+    tunnel-reliable barrier — ``block_until_ready`` returns early on
+    remote backends). Results whose arrays hide in closures (chained
+    transformers) are skipped: an under-stamped outcome is a smaller
+    lie than a crashed fit, and the calibrator's span-window join still
+    sees the fold spans."""
+    state = getattr(fitted, "__dict__", None) or {}
+    for v in state.values():
+        for a in (v if isinstance(v, (list, tuple)) else (v,)):
+            if isinstance(a, jnp.ndarray) and getattr(a, "size", 0):
+                try:
+                    float(jnp.asarray(a).ravel()[0])
+                except Exception:
+                    pass
+                return
+
+
+def _stamped_fit(est, thunk):
+    """Run one estimator fit, back-annotating a pending cost decision.
+
+    When the cost model selected ``est`` (``LeastSquaresEstimator.
+    optimize`` left a ``CostOutcomeRef`` on it), the executor is the one
+    place that observes the priced work actually run — so it stamps the
+    winner's measured wall + ``estimator.fit`` span id onto the decision
+    record (obs/calibrate.py joins predicted-vs-measured from that).
+    The ref is consumed BEFORE the fit so a failed fit never stamps a
+    bogus measurement and a re-fit never double-stamps. Estimators with
+    no pending decision take the bare path — no span, no timing."""
+    ref = getattr(est, "_pending_cost_outcome", None)
+    if ref is None:
+        return thunk()
+    est._pending_cost_outcome = None
+    import time as _time
+
+    from keystone_tpu import obs
+
+    t0 = _time.perf_counter()
+    with obs.span("estimator.fit", estimator=type(est).__name__) as sp:
+        fitted = thunk()
+        _sync_fitted(fitted)
+    # timing="single_run_cold": a pipeline fits each estimator once, so
+    # this wall INCLUDES XLA compile — the calibrator surfaces the mix
+    # (calibration_report "timings") and the refit discipline prefers
+    # warm rows (docs/observability.md calibration section); the sweep
+    # harness stamps min_of_N_warm on its dispatch-subtracted points.
+    ref.stamp(
+        _time.perf_counter() - t0,
+        span_id=getattr(sp, "span_id", None),
+        timing="single_run_cold",
+    )
+    return fitted
+
+
 class Estimator(EstimatorOperator, Generic[A, B]):
     """Fits a Transformer from a dataset (Estimator.scala:10-62)."""
 
@@ -600,7 +657,7 @@ class Estimator(EstimatorOperator, Generic[A, B]):
         raise NotImplementedError
 
     def fit_datasets(self, inputs: Sequence[Any]) -> TransformerOperator:
-        return self.fit(inputs[0])
+        return _stamped_fit(self, lambda: self.fit(inputs[0]))
 
     def with_data(self, data: Any) -> Pipeline[A, B]:
         """Pipeline that fits this estimator on `data`, then applies the fitted
@@ -629,7 +686,7 @@ class LabelEstimator(EstimatorOperator, Generic[A, B, L]):
         raise NotImplementedError
 
     def fit_datasets(self, inputs: Sequence[Any]) -> TransformerOperator:
-        return self.fit(inputs[0], inputs[1])
+        return _stamped_fit(self, lambda: self.fit(inputs[0], inputs[1]))
 
     def with_data(self, data: Any, labels: Any) -> Pipeline[A, B]:
         data = _as_pipeline_dataset(data)

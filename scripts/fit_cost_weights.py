@@ -1,34 +1,44 @@
 """Fit the solver cost-model weights from measured TPU DEVICE time.
 
 The reference derives its cpu/mem/network weights by regressing measured
-solver times on a 16-node cluster (scripts/constantEstimator.R, consumed by
-LeastSquaresEstimator.scala:28-31). This is the TPU edition, round-6 form:
+solver times on a 16-node cluster (scripts/constantEstimator.R, consumed
+by LeastSquaresEstimator.scala:28-31). This is the TPU edition, round-13
+form: the script is now ONLY the measurement harness — every timed
+(engine, geometry) point is recorded as a ``calibration_sweep``
+cost-decision event with its measured outcome stamped on, and the
+fitting itself is the calibration plane's trace-driven refit
+(``keystone_tpu/obs/calibrate.py`` — the SAME join → fit path
+``bin/calibrate --refit`` runs on production traces, so there is
+exactly one weight-fitting implementation).
+
+Measurement discipline (kept from round 6):
 
   - DEVICE time, not wall: every point is min-of-N warm wall minus a
     calibrated null-dispatch round trip (the tunneled dev TPU adds
-    ~0.1 s/dispatch of pure overhead — the round-5 fit regressed on it and
-    produced weights off by five orders of magnitude).
-  - bench-adjacent geometries: the grid runs up to the largest shapes the
-    attached chip fits (OOM points are skipped and reported), so the rates
-    come from the regime the selector actually discriminates in, not from
-    sub-millisecond toys.
-  - the max() form the selector evaluates: time ≈ max(cpu·flops, mem·bytes)
-    + net·network, with each solver's own cost() extractor providing the
-    features.
-  - the sparse gather engine's random-access multiplier (``sparse_overhead``
-    in SparseLBFGSwithL2.cost) is refit from the sparse rows GIVEN the dense
-    (cpu, mem) — one global mem weight cannot price sequential scans and
-    random gathers at once; the overhead factor is where that gap lives.
-  - the network weight is PINNED (cost.TPU_NETWORK_WEIGHT): a single-chip
-    fit cannot observe it. Refit on a multi-chip mesh before trusting
-    cross-mesh rankings.
+    ~0.1 s/dispatch of pure overhead — the round-5 fit regressed on it
+    and produced weights off by five orders of magnitude).
+  - bench-adjacent geometries: the grid runs up to the largest shapes
+    the attached chip fits (OOM points are skipped and reported), so
+    the rates come from the regime the selector actually discriminates
+    in, not from sub-millisecond toys.
+  - the max() form the selector evaluates: time ≈ max(cpu·flops,
+    mem·bytes) + net·network, with each solver's own cost() extractor
+    providing the features (calibrate.fit_weights).
+  - the sparse gather engine's random-access multiplier is refit from
+    the gather rows GIVEN the dense (cpu, mem).
+  - the network weight is PINNED (cost.TPU_NETWORK_WEIGHT): a
+    single-chip fit cannot observe it.
 
-Prints fitted weights, per-point relative errors, and the measured pairwise
-orderings; paste the constants into keystone_tpu/ops/learning/cost.py
-(TPU_*_WEIGHT / TPU_SPARSE_GATHER_OVERHEAD). tests/test_cost_replay.py
-replays the recorded bench geometries against whatever is active.
+Output: the refit constants (paste into cost.py's TPU_* block, or —
+the preferred round-13 route — activate the written artifact directly
+with ``KEYSTONE_COST_WEIGHTS=calibrated:<out>``), per-engine residuals,
+and the measured pairwise orderings the replay test pins. With
+``--from-trace DIR`` the sweep is skipped entirely and the refit runs
+on an existing traced run (what ``bin/calibrate --refit`` wraps).
 
-Usage: python scripts/fit_cost_weights.py [--quick]
+Usage: python scripts/fit_cost_weights.py [--quick] [--out ART.json]
+                                          [--trace-dir DIR]
+       python scripts/fit_cost_weights.py --from-trace DIR [--out ...]
 """
 
 import argparse
@@ -83,16 +93,54 @@ def time_solver(est, data, labels, overhead: float, reps: int = 2) -> float:
     return max(best - overhead, 1e-6)
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--quick", action="store_true")
-    args = parser.parse_args()
+def record_point(est, context, measured_s: float) -> None:
+    """Record one timed (engine, geometry) point as a single-candidate
+    ``calibration_sweep`` decision with its measured outcome stamped on
+    — the row shape the trace-driven refit joins, identical to a
+    production decision the executor back-annotated."""
+    from keystone_tpu import obs
+    from keystone_tpu.ops.learning import cost as cost_mod
 
+    label = cost_mod.candidate_label(est)
+    cpu, mem, net = cost_mod.active_weights()
+    try:
+        predicted = est.cost(
+            context["n"], context["d"], context["k"],
+            context["sparsity"], context["machines"], cpu, mem, net,
+        )
+    except TypeError:  # estimators without a cost extractor
+        predicted = None
+    ref = obs.record_cost_decision(obs.CostDecision(
+        decision="calibration_sweep",
+        winner=label,
+        candidates=[{
+            "label": label,
+            "cost_s": (None if predicted is None else float(predicted)),
+            "feasible": True,
+        }],
+        reason="sweep",
+        context={
+            **context,
+            "weights": {
+                "cpu": cpu, "mem": mem, "network": net,
+                "family": cost_mod.weights_family_name(),
+            },
+        },
+    ))
+    if ref is not None:
+        # min_of_N_warm: time_solver warms/compiles first and subtracts
+        # the calibrated dispatch round trip — device time, the row
+        # family the refit trusts most.
+        ref.stamp(measured_s, timing="min_of_N_warm")
+
+
+def run_sweep(quick: bool) -> None:
+    """Time the solver grid, recording every point into the active
+    tracer as a stamped ``calibration_sweep`` decision."""
     import jax
     import jax.numpy as jnp
 
     from keystone_tpu.data import Dataset
-    from keystone_tpu.ops.learning import cost as cost_mod
     from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
     from keystone_tpu.ops.learning.lbfgs import (
         DenseLBFGSwithL2,
@@ -106,7 +154,7 @@ def main():
 
     dense_shapes = (
         [(16384, 1024, 16), (65536, 2048, 32)]
-        if args.quick
+        if quick
         else [
             (16384, 1024, 16),
             (65536, 2048, 32),
@@ -116,7 +164,6 @@ def main():
         ]
     )
     rng = np.random.default_rng(0)
-    dense_rows = []  # (feats, device_s, name, shape)
     for n, d, k in dense_shapes:
         X = jnp.asarray(rng.normal(size=(n, d)).astype(np.float32))
         Y = jnp.asarray(rng.normal(size=(n, k)).astype(np.float32))
@@ -132,17 +179,15 @@ def main():
             except Exception as e:  # OOM etc: skip the point, say so
                 print(f"skip {name} n={n} d={d} k={k}: {type(e).__name__}")
                 continue
-            feats = [
-                est.cost(n, d, k, 1.0, machines, 1.0, 0.0, 0.0),
-                est.cost(n, d, k, 1.0, machines, 0.0, 1.0, 0.0),
-            ]
-            dense_rows.append((feats, secs, name, (n, d, k)))
+            record_point(est, {
+                "n": n, "d": d, "k": k, "sparsity": 1.0,
+                "machines": machines,
+            }, secs)
             print(f"{name:7s} n={n:7d} d={d:5d} k={k:3d}: {secs:7.3f}s device")
 
     # Sparse gather/gram points at the amazon-row geometry family.
-    sparse_rows = []
     for n, d, nnz, k in [(250_000, 16384, 82, 2), (500_000, 16384, 82, 2)]:
-        if args.quick and n > 250_000:
+        if quick and n > 250_000:
             continue
         idx = rng.integers(0, d, size=(n, nnz)).astype(np.int32)
         idx.sort(axis=1)
@@ -164,62 +209,89 @@ def main():
             except Exception as e:
                 print(f"skip sparse-{solver} n={n}: {type(e).__name__}")
                 continue
-            sparse_rows.append((est, secs, solver, (n, d, k, s)))
+            record_point(est, {
+                "n": n, "d": d, "k": k, "sparsity": s,
+                "machines": machines,
+            }, secs)
             print(f"sparse-{solver:6s} n={n:7d}: {secs:7.3f}s device")
 
-    # --- (cpu, mem) fit on the dense rows under the max() form ----------
-    A = np.asarray([r[0] for r in dense_rows])
-    b = np.asarray([r[1] for r in dense_rows])
 
-    def rel_err(w):
-        pred = np.maximum(w[0] * A[:, 0], w[1] * A[:, 1])
-        return np.abs(pred - b) / np.maximum(b, 1e-9)
-
-    # Log-grid around the single-row closed forms (each row pins cpu OR mem
-    # exactly when its term dominates), minimizing the median rel err.
-    cpu0 = float(np.median(b / np.maximum(A[:, 0], 1e-9)))
-    mem0 = float(np.median(b / np.maximum(A[:, 1], 1e-9)))
-    grid = [10.0 ** (e / 4.0) for e in range(-8, 9)]
-    best_w, best = (cpu0, mem0), np.inf
-    for s0 in grid:
-        for s1 in grid:
-            w = (cpu0 * s0, mem0 * s1)
-            err = float(np.median(rel_err(w)))
-            if err < best:
-                best, best_w = err, w
-    cpu_w, mem_w = best_w
-    rel = rel_err(best_w)
-    print(f"\ncpu={cpu_w:.3e} mem={mem_w:.3e} "
-          f"(dense rel err: median {np.median(rel):.2f}, max {rel.max():.2f})")
-
-    # --- sparse_overhead refit given (cpu, mem) -------------------------
-    overheads = []
-    for est, secs, solver, (n, d, k, s) in sparse_rows:
-        if solver != "gather":
-            continue
-        per_iter = max(
-            cpu_w * n * s * d * k / machines, mem_w * n * d * s / machines
-        )
-        overheads.append(secs / (est.num_iterations * max(per_iter, 1e-12)))
-    sparse_overhead = float(np.median(overheads)) if overheads else None
-
-    print("\nPaste into keystone_tpu/ops/learning/cost.py:")
-    print(f"TPU_CPU_WEIGHT = {cpu_w:.3e}")
-    print(f"TPU_MEM_WEIGHT = {mem_w:.3e}")
-    print(f"TPU_NETWORK_WEIGHT = {cost_mod.TPU_NETWORK_WEIGHT:.3e}"
+def print_refit(result) -> None:
+    w = result["weights"]
+    print("\nPaste into keystone_tpu/ops/learning/cost.py (or activate "
+          "the artifact directly):")
+    print(f"TPU_CPU_WEIGHT = {w['cpu']:.3e}")
+    print(f"TPU_MEM_WEIGHT = {w['mem']:.3e}")
+    print(f"TPU_NETWORK_WEIGHT = {w['network']:.3e}"
           "  # pinned: single-chip fit cannot observe the network term")
-    if sparse_overhead is not None:
-        print(f"TPU_SPARSE_GATHER_OVERHEAD = {sparse_overhead:.0f}.0")
+    if w["sparse_gather_overhead"] is not None:
+        print("TPU_SPARSE_GATHER_OVERHEAD = "
+              f"{w['sparse_gather_overhead']:.0f}.0")
+    after = result["after"]
+    before = result["before"]
+    fmt = lambda v: "?" if v is None else f"{v:.3f}"  # noqa: E731
+    print(f"\nresiduals (median |log error|): "
+          f"{fmt(before['median_abs_log_error'])} under the base family "
+          f"-> {fmt(after['median_abs_log_error'])} refit")
+    for label, eng in sorted(after["per_engine"].items()):
+        print(f"  {label:<40} n={eng['count']:<3} "
+              f"med|err|={fmt(eng['median_abs_log_error'])}")
 
-    # --- measured pairwise orderings the replay test pins ----------------
-    by_key = {}
-    for feats, secs, name, shape in dense_rows:
-        by_key[(name, shape)] = secs
+    # Measured pairwise orderings the replay test pins: per geometry,
+    # engines ranked by their measured seconds.
+    outcomes = [
+        o for o in result["outcomes"] if o.measured_s is not None
+    ]
+    by_geom = {}
+    for o in outcomes:
+        n, d, k = (o.context.get("n"), o.context.get("d"),
+                   o.context.get("k"))
+        by_geom.setdefault((n, d, k), []).append(o)
     print("\nmeasured orderings (feed tests/test_cost_replay.py):")
-    for shape in {s for _, s in by_key}:
-        row = {n: by_key[(n, s)] for (n, s) in by_key if s == shape}
-        order = sorted(row, key=row.get)
-        print(f"  n,d,k={shape}: " + " < ".join(order))
+    for geom, rows in sorted(by_geom.items()):
+        if len(rows) < 2:
+            continue
+        rows.sort(key=lambda o: o.measured_s)
+        print(f"  n,d,k={geom}: "
+              + " < ".join(o.winner for o in rows))
+    if result["artifact_path"]:
+        print(f"\nartifact: {result['artifact_path']}")
+        print("activate: KEYSTONE_COST_WEIGHTS=calibrated:"
+              f"{result['artifact_path']}")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    parser.add_argument(
+        "--out", default="", metavar="ART.json",
+        help="write the calibration artifact here "
+             "(KEYSTONE_COST_WEIGHTS=calibrated:ART.json)",
+    )
+    parser.add_argument(
+        "--trace-dir", default="", metavar="DIR",
+        help="also persist the sweep's trace (decisions + outcomes) "
+             "for later re-analysis with bin/calibrate",
+    )
+    parser.add_argument(
+        "--from-trace", default="", metavar="DIR",
+        help="skip the sweep: refit from an existing traced run "
+             "(the bin/calibrate --refit path)",
+    )
+    args = parser.parse_args()
+
+    from keystone_tpu import obs
+    from keystone_tpu.obs import calibrate as cal
+
+    if args.from_trace:
+        records = obs.load_events(args.from_trace)
+    else:
+        with obs.tracing(args.trace_dir or None) as t:
+            run_sweep(args.quick)
+            records = t.events
+
+    result = cal.refit(records, out_path=args.out or None)
+    print_refit(result)
 
 
 if __name__ == "__main__":

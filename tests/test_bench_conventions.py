@@ -307,6 +307,78 @@ class TestRooflineAuditability:
         # Dicts with no scale claims are not burdened.
         bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
 
+    def test_calibration_claims_require_decisions_and_family(self):
+        """ISSUE 13 satellite: any dict claiming a cost-model prediction
+        error (a ``prediction_error*`` key) must carry the
+        decision-event count and the weight-family name in the SAME
+        dict — an error statistic with no n and no family is not a
+        calibration claim."""
+        bench = _load_bench()
+        good = {
+            "prediction_error_median_abs_log": 0.31,
+            "num_decisions": 4,
+            "weights_family": "tpu",
+        }
+        row = bench.make_row(
+            "cal_probe", 1.0, "fraction", None, "overhead_fraction",
+            {"baseline_wall_s": 1.0, "cost_calibration": good},
+        )
+        assert row["detail"]["cost_calibration"]["weights_family"] == (
+            "tpu"
+        )
+        for missing, pat in (
+            ("num_decisions", "num_decisions"),
+            ("weights_family", "weights_family"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "cal_probe", 1.0, "fraction", None,
+                    "overhead_fraction",
+                    {"baseline_wall_s": 1.0, "cost_calibration": d},
+                )
+        # A prose decision count / non-string family must not satisfy.
+        d = dict(good)
+        d["num_decisions"] = "several"
+        with pytest.raises(ValueError, match="num_decisions"):
+            bench.make_row(
+                "cal_probe", 1.0, "fraction", None, "overhead_fraction",
+                {"baseline_wall_s": 1.0, "cost_calibration": d},
+            )
+        d = dict(good)
+        d["weights_family"] = 7
+        with pytest.raises(ValueError, match="weights_family"):
+            bench.make_row(
+                "cal_probe", 1.0, "fraction", None, "overhead_fraction",
+                {"baseline_wall_s": 1.0, "cost_calibration": d},
+            )
+        # The rule reaches any nesting depth.
+        with pytest.raises(ValueError, match="num_decisions"):
+            bench.make_row(
+                "cal_probe", 1.0, "s", None, "min_of_N_warm",
+                {"legs": [{"prediction_error_p90": 0.5}]},
+            )
+
+    def test_calibration_report_summary_passes_the_audit_as_is(self):
+        """The contract the rule states: a calibration_report's summary
+        fields drop into a row unmodified."""
+        bench = _load_bench()
+        from keystone_tpu.obs import calibrate as cal
+
+        report = cal.calibration_report([])
+        block = {
+            "prediction_error_median_abs_log": (
+                report["median_abs_log_error"]
+            ),
+            "num_decisions": report["num_decisions"],
+            "weights_family": report["weights_family"],
+        }
+        row = bench.make_row(
+            "cal_probe", 1.0, "fraction", None, "overhead_fraction",
+            {"baseline_wall_s": 1.0, "cost_calibration": block},
+        )
+        assert row["detail"]["cost_calibration"]["num_decisions"] == 0
+
     def test_autoscaler_stats_block_passes_the_audit_as_is(self):
         """The contract the rule states: Autoscaler.stats() emits the
         compliant shape, so the bench drops it into a row unmodified."""

@@ -318,3 +318,25 @@ class TestWeightFamilySwitch:
         monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)
         est = LeastSquaresEstimator(lam=0.1, cpu_weight=1.0, mem_weight=2.0)
         assert est.cpu_weight == 1.0 and est.mem_weight == 2.0
+
+    def test_calibrated_artifact_family(self, monkeypatch, tmp_path):
+        """The third family (ISSUE 13): a trace-refit artifact selected
+        via KEYSTONE_COST_WEIGHTS=calibrated:<path> drives the selector
+        exactly like the built-in constants. The refit round-trip
+        against the golden trace fixture — loading the artifact
+        reproduces the recorded winners at these replay geometries —
+        lives in tests/test_calibrate.py::TestRefitRoundTrip."""
+        from keystone_tpu.obs import calibrate as cal
+
+        path = str(tmp_path / "cal.json")
+        cal.write_calibration_artifact(
+            path,
+            {"cpu": 7e-15, "mem": 3e-11, "network": 2e-11,
+             "sparse_gather_overhead": 321.0},
+            {"run_ids": ["test"]},
+        )
+        monkeypatch.setenv("KEYSTONE_COST_WEIGHTS", f"calibrated:{path}")
+        assert active_weights() == (7e-15, 3e-11, 2e-11)
+        assert sparse_gather_overhead() == 321.0
+        est = LeastSquaresEstimator(lam=0.1)
+        assert est.cpu_weight == 7e-15 and est.mem_weight == 3e-11
