@@ -262,6 +262,53 @@ def _autoscale_violations(obj, path):
     return bad
 
 
+def _tenant_violations(obj, path):
+    """Auditability rule (ISSUE 14 satellite): any dict carrying a
+    ``tenants`` mapping whose per-tenant blocks claim latency
+    percentiles (``p99*``) or SLO verdicts (``slo``) must carry a
+    numeric ``num_tenants`` in the SAME dict, and EVERY per-tenant
+    block must carry a numeric ``offered*`` field — a per-tenant
+    isolation claim with no tenant count and no per-tenant offered load
+    is not a measurement. ``MultiTenantLoadReport.to_row_dict`` and
+    ``ModelZoo.stats()`` emit exactly this shape, so dropping either
+    into a row passes as-is."""
+    bad = []
+    if isinstance(obj, dict):
+        tenants = obj.get("tenants")
+        if isinstance(tenants, dict) and any(
+            isinstance(b, dict) and any(
+                k.startswith("p99") or k == "slo" for k in b
+            )
+            for b in tenants.values()
+        ):
+            nt = obj.get("num_tenants")
+            if not (isinstance(nt, (int, float))
+                    and not isinstance(nt, bool)):
+                bad.append(
+                    f"{path}: per-tenant p99/slo claims without a "
+                    "numeric num_tenants field beside the tenants block"
+                )
+            for name, b in tenants.items():
+                if not isinstance(b, dict):
+                    continue
+                if not any(
+                    k.startswith("offered")
+                    and isinstance(b[k], (int, float))
+                    and not isinstance(b[k], bool)
+                    for k in b
+                ):
+                    bad.append(
+                        f"{path}.tenants.{name}: per-tenant block "
+                        "without a numeric offered* field"
+                    )
+        for k, v in obj.items():
+            bad.extend(_tenant_violations(v, f"{path}.{k}"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            bad.extend(_tenant_violations(v, f"{path}[{i}]"))
+    return bad
+
+
 def _calibration_violations(obj, path):
     """Auditability rule (ISSUE 13 satellite): any dict claiming a
     cost-model prediction error (a ``prediction_error*`` key) must carry
@@ -364,6 +411,7 @@ def make_row(metric, value, unit, vs_baseline, timing, detail):
     violations += _overhead_violations(detail, timing)
     violations += _autoscale_violations(detail, "detail")
     violations += _calibration_violations(detail, "detail")
+    violations += _tenant_violations(detail, "detail")
     if violations:
         raise ValueError(
             f"row {metric!r}: unauditable roofline claims: {violations}"
@@ -2949,6 +2997,259 @@ def serving_mnist_metric():
     )
 
 
+def serving_model_zoo_isolation_metric():
+    """The multi-tenant model zoo's isolation contract under load
+    (ISSUE 14 tentpole): >= 8 tenants, each with its own exported plan,
+    per-tenant SLO tracker, and deficit-weighted admission share, driven
+    by aggregate open-loop Poisson through three legs:
+
+      1. ``steady``    — every tenant at the base rate: the baseline
+         per-tenant p99 and an all-OK verdict row.
+      2. ``spike``     — ONE tenant offers 8x the aggregate of the
+         others, far past its admission share. The contract: the hot
+         tenant's own sheds drive ITS verdict past WARN while every
+         other tenant's verdict stays OK — the row RAISES otherwise.
+         value = the worst NON-spiking tenant's p99 during the spike;
+         vs_baseline = steady worst-other p99 / spike worst-other p99
+         (~1.0 when isolation holds).
+      3. ``coldstart`` — the budget binds (2 of 8 tenants resident);
+         explicit page-ins exercise LRU-by-cost eviction, and a storm
+         of DEADLINED requests against cold tenants fast-fails with the
+         named TenantColdStart (counted) instead of wedging behind
+         multi-second weight rebuilds, while the resident tenants keep
+         completing.
+
+    Every leg's per-tenant accounting must balance (offered ==
+    completed + rejected + failed, loadgen-side AND zoo-side — zero
+    silent drops), and the zoo's paging decisions (page_in / page_out /
+    evict audit events) land in the row. Env knobs:
+    BENCH_ZOO_DURATION_S (per-leg window, default 3),
+    BENCH_ZOO_TENANTS (default 8).
+    """
+    from keystone_tpu import obs
+    from keystone_tpu.data import Dataset
+    from keystone_tpu.ops.learning.block import BlockLeastSquaresEstimator
+    from keystone_tpu.ops.util import ClassLabelIndicatorsFromIntLabels
+    from keystone_tpu.pipelines.mnist_random_fft import (
+        MnistRandomFFTConfig,
+        build_featurizer,
+    )
+    from keystone_tpu.serving import (
+        ModelZoo,
+        export_plan,
+        run_multi_tenant_open_loop,
+    )
+
+    num_tenants = max(int(os.environ.get("BENCH_ZOO_TENANTS", "8")), 8)
+    duration_s = float(os.environ.get("BENCH_ZOO_DURATION_S", "3"))
+    d_in, num_ffts, bs, n_fit = 64, 2, 64, 512
+
+    def fit_one(seed):
+        rng = np.random.default_rng(seed)
+        X = jnp.asarray(rng.normal(size=(n_fit, d_in)).astype(np.float32))
+        y = rng.integers(0, 10, size=n_fit)
+        labels = ClassLabelIndicatorsFromIntLabels(10)(
+            Dataset.of(jnp.asarray(y))
+        )
+        return build_featurizer(
+            MnistRandomFFTConfig(
+                num_ffts=num_ffts, block_size=bs, image_size=d_in
+            )
+        ).and_then(
+            BlockLeastSquaresEstimator(bs, 1, 1e-3), Dataset.of(X), labels
+        ).fit()
+
+    names = [f"t{i}" for i in range(num_tenants - 1)] + ["hot"]
+    plans = {
+        name: export_plan(
+            fit_one(seed), np.zeros(d_in, np.float32), max_batch=8
+        )
+        for seed, name in enumerate(names)
+    }
+    per_bytes = {n: max(p.pinned_bytes, 1) for n, p in plans.items()}
+    rng = np.random.default_rng(29)
+    pool = rng.normal(size=(256, d_in)).astype(np.float32)
+
+    def fresh_slos():
+        return {
+            name: obs.SLOTracker([
+                obs.SLOObjective(
+                    "availability", kind="availability", target=0.95,
+                ),
+            ])
+            for name in names
+        }
+
+    def run_leg(rates, slos, zoo, deadline_ms=None):
+        report = run_multi_tenant_open_loop(
+            zoo.submit, lambda tenant, i: pool[i % len(pool)],
+            rates_hz=rates, duration_s=duration_s, seed=31,
+            deadline_ms=deadline_ms, slos=slos,
+        )
+        stats = zoo.stats()
+        leg = report.to_row_dict()
+        leg["tenant_slo_states"] = report.tenant_states()
+        leg["zoo"] = {
+            k: stats[k]
+            for k in (
+                "num_tenants", "residents", "resident_bytes",
+                "budget_bytes", "page_ins", "page_outs", "quarantined",
+                "coldstart_failfast", "accounting_ok", "num_decisions",
+            )
+        }
+        if not (report.accounting_ok() and stats["accounting_ok"]):
+            raise RuntimeError(
+                f"zoo leg lost requests: loadgen "
+                f"{report.accounting_ok()}, zoo {stats['accounting_ok']}"
+            )
+        return leg, report, stats
+
+    base = 25.0
+    zoo_kwargs = dict(
+        max_batch=8, max_wait_ms=10.0,
+        tenant_queue_cap=8, max_outstanding_total=8 * num_tenants,
+    )
+
+    # Leg 1: steady — everyone at the base rate, verdicts all OK.
+    slos = fresh_slos()
+    zoo = ModelZoo(
+        budget_bytes=sum(per_bytes.values()) + num_tenants, **zoo_kwargs
+    )
+    try:
+        for name in names:
+            zoo.add_tenant(name, plans[name], slo=slos[name])
+        steady_leg, steady_report, _ = run_leg(
+            {name: base for name in names}, slos, zoo
+        )
+    finally:
+        zoo.close()
+    if any(
+        s not in (None, "OK")
+        for s in steady_leg["tenant_slo_states"].values()
+    ):
+        raise RuntimeError(
+            f"steady leg not all-OK: {steady_leg['tenant_slo_states']}"
+        )
+
+    # Leg 2: one tenant spikes to 8x the aggregate of the others.
+    slos = fresh_slos()
+    zoo = ModelZoo(
+        budget_bytes=sum(per_bytes.values()) + num_tenants, **zoo_kwargs
+    )
+    try:
+        for name in names:
+            zoo.add_tenant(name, plans[name], slo=slos[name])
+        rates = {name: base for name in names}
+        rates["hot"] = 8.0 * base * (num_tenants - 1)
+        spike_leg, spike_report, _ = run_leg(rates, slos, zoo)
+    finally:
+        zoo.close()
+    states = spike_leg["tenant_slo_states"]
+    if states["hot"] not in ("WARN", "BREACH"):
+        raise RuntimeError(
+            f"the spiking tenant never degraded: {states['hot']} "
+            "(the leg proved nothing)"
+        )
+    bad_others = {
+        n: s for n, s in states.items() if n != "hot" and s != "OK"
+    }
+    if bad_others:
+        raise RuntimeError(
+            f"isolation violated: non-spiking tenants left OK under the "
+            f"hot tenant's load: {bad_others}"
+        )
+
+    def worst_other_p99(report):
+        vals = [
+            r.p99_latency_s for n, r in report.tenants.items()
+            if n != "hot" and r.p99_latency_s is not None
+        ]
+        return max(vals) if vals else None
+
+    steady_p99 = worst_other_p99(steady_report)
+    spike_p99 = worst_other_p99(spike_report)
+    if steady_p99 is None or spike_p99 is None:
+        raise RuntimeError("a leg completed zero non-hot requests")
+
+    # Leg 3: the budget binds — 2 of 8 resident; explicit page-ins
+    # exercise priced eviction, deadlined cold submits fast-fail.
+    slos = fresh_slos()
+    two = per_bytes[names[0]] + per_bytes[names[1]] + 2
+    zoo = ModelZoo(
+        budget_bytes=two, cold_start_estimate_s=30.0, **zoo_kwargs
+    )
+    try:
+        for name in names:
+            zoo.add_tenant(
+                name, plans[name], slo=slos[name], resident=False,
+                resident_bytes=per_bytes[name],
+            )
+        for name in names[:3]:  # 3rd page-in must evict (budget = 2)
+            zoo.page_in(name)
+        cold_leg, _, cold_stats = run_leg(
+            {name: base for name in names}, slos, zoo, deadline_ms=250.0,
+        )
+        decisions = zoo.decision_log()
+    finally:
+        zoo.close()
+    if cold_stats["coldstart_failfast"] < 1:
+        raise RuntimeError(
+            "the cold-start storm never fast-failed a deadlined request"
+        )
+    actions = {d["action"] for d in decisions}
+    if not {"page_in", "page_out", "evict"} <= actions:
+        raise RuntimeError(
+            f"paging decisions missing from the audit log: {actions}"
+        )
+    if cold_leg["completed_total"] < 1:
+        raise RuntimeError(
+            "no resident tenant completed anything during the cold-start "
+            "storm"
+        )
+
+    return make_row(
+        "serving_model_zoo_isolation",
+        round(spike_p99, 5),
+        "s",
+        round(steady_p99 / spike_p99, 3),
+        "open_loop_latency",
+        {
+            "num_tenants": num_tenants,
+            "pipeline": f"mnist_random_fft x{num_tenants} "
+            f"(d_in={d_in}, independent exports)",
+            "per_tenant_weight_bytes": per_bytes,
+            "zoo_knobs": {
+                "max_batch": zoo_kwargs["max_batch"],
+                "max_wait_ms": zoo_kwargs["max_wait_ms"],
+                "tenant_queue_cap": zoo_kwargs["tenant_queue_cap"],
+                "max_outstanding_total":
+                    zoo_kwargs["max_outstanding_total"],
+            },
+            "legs": {
+                "steady": steady_leg,
+                "spike": spike_leg,
+                "coldstart": cold_leg,
+            },
+            "isolation": {
+                "hot_state": states["hot"],
+                "others_all_ok": not bad_others,
+                "steady_worst_other_p99_s": round(steady_p99, 6),
+                "spike_worst_other_p99_s": round(spike_p99, 6),
+            },
+            "paging_decisions": decisions[-32:],
+            "timing_note": (
+                "value = worst NON-spiking tenant p99 (s) during the "
+                "8x one-tenant spike leg; vs_baseline = steady worst-"
+                "other p99 / spike worst-other p99 (~1.0 = isolation "
+                f"held); each leg ran an independent {duration_s:.0f}s "
+                "open-loop window against a fresh zoo + fresh per-"
+                "tenant SLO trackers"
+            ),
+            "device": str(jax.devices()[0]),
+        },
+    )
+
+
 def serving_replicated_chaos_metric():
     """The replicated serving plane under chaos (ISSUE 7 tentpole):
     N micro-batch replicas behind one admission-controlled front door
@@ -3482,6 +3783,7 @@ def main():
             mnist_fft_metric,
             serving_mnist_metric,
             serving_replicated_chaos_metric,
+            serving_model_zoo_isolation_metric,
             autocache_metric,
             autocache_host_boundary_metric,
             stupidbackoff_metric,

@@ -69,6 +69,16 @@ __all__ = [
     "METRIC_SLO_BURN_SLOW",
     "METRIC_SLO_STATE",
     "METRIC_SLO_TRANSITIONS",
+    "METRIC_TENANT_COLDSTART_FAILFAST",
+    "METRIC_TENANT_COMPLETED",
+    "METRIC_TENANT_FAILED",
+    "METRIC_TENANT_OFFERED",
+    "METRIC_TENANT_REJECTED",
+    "METRIC_ZOO_DECISIONS",
+    "METRIC_ZOO_PAGE_INS",
+    "METRIC_ZOO_PAGE_OUTS",
+    "METRIC_ZOO_QUARANTINED",
+    "METRIC_ZOO_RESIDENTS",
 ]
 
 # ---------------------------------------------------------------------------
@@ -133,6 +143,21 @@ METRIC_CALIBRATION_DECISIONS = "calibration.decisions"
 METRIC_CALIBRATION_MISROUTES = "calibration.misroutes"
 METRIC_CALIBRATION_REGRET_S = "calibration.regret_s"
 METRIC_CALIBRATION_DRIFT = "calibration.drift"
+
+# Multi-tenant model zoo (serving/zoo.py) — residency/paging counters
+# plus the per-tenant front-door accounting (label: tenant=<id>), so the
+# live exporter renders every tenant's offered/completed/rejected/failed
+# beside the plane counters and the per-tenant SLO verdicts.
+METRIC_ZOO_RESIDENTS = "zoo.residents"
+METRIC_ZOO_PAGE_INS = "zoo.page_ins"
+METRIC_ZOO_PAGE_OUTS = "zoo.page_outs"
+METRIC_ZOO_QUARANTINED = "zoo.quarantined"
+METRIC_ZOO_DECISIONS = "zoo.decisions"
+METRIC_TENANT_OFFERED = "tenant.offered"
+METRIC_TENANT_COMPLETED = "tenant.completed"
+METRIC_TENANT_REJECTED = "tenant.rejected"
+METRIC_TENANT_FAILED = "tenant.failed"
+METRIC_TENANT_COLDSTART_FAILFAST = "tenant.coldstart_failfast"
 
 
 class Counter:

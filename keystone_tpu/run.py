@@ -169,6 +169,21 @@ def _serve(argv):
                         help="minimum spacing between any two autoscale "
                         "actions — the no-flapping window "
                         "(with --autoscale)")
+    parser.add_argument("--tenants", type=int, default=1,
+                        help="serve N tenants through the multi-tenant "
+                        "model zoo (each tenant gets its own exported "
+                        "plan, SLO tracker, and fair admission share; "
+                        "--rate is split uniformly across tenants) — "
+                        "docs/serving.md model-zoo section")
+    parser.add_argument("--tenant-spec", default="",
+                        help="JSON tenant spec file: {\"tenants\": "
+                        "[{\"id\": \"a\", \"weight\": 1.0, \"rate_hz\": "
+                        "100}, ...]} — overrides --tenants/--rate with "
+                        "a skewed per-tenant mix")
+    parser.add_argument("--zoo-budget-mb", type=float, default=0.0,
+                        help="device-memory budget for the zoo's "
+                        "resident weights (0 = size to fit every "
+                        "tenant; a binding budget exercises paging)")
     parser.add_argument("--rate", type=float, default=200.0,
                         help="offered Poisson rate (requests/s)")
     parser.add_argument("--duration-s", type=float, default=5.0)
@@ -223,10 +238,32 @@ def _serve(argv):
         )
         return 2
 
+    tenant_specs = _serve_tenant_specs(args)
+    if tenant_specs is not None and args.autoscale:
+        print(
+            "serve: --tenants/--tenant-spec and --autoscale are "
+            "mutually exclusive (the zoo's admission plane does its own "
+            "per-tenant degradation)",
+            file=sys.stderr,
+        )
+        return 2
+
     # Load/fit and export fail as a ONE-LINE diagnostic + non-zero exit,
     # not a bare traceback: serve is the operator-facing entry point, and
     # a supervisor restarting it needs the exit code, not a stack.
     phase = "load" if args.model else "quick-fit"
+    if tenant_specs is not None:
+        try:
+            fitted, d_in = _serve_build_fitted(args)
+        except SystemExit:
+            raise
+        except Exception as e:
+            print(
+                f"serve: {phase} failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 1
+        return _serve_zoo(args, fitted, d_in, tenant_specs)
     try:
         fitted, d_in = _serve_build_fitted(args)
         phase = "export"
@@ -415,6 +452,173 @@ def _serve_build_fitted(args):
         f"--serve quick-fit supports MnistRandomFFT (got "
         f"{args.pipeline!r}); pass --model for anything else"
     )
+
+
+def _serve_tenant_specs(args):
+    """``[{"id", "weight", "rate_hz"}, ...]`` from --tenant-spec (the
+    skewed-mix form) or --tenants N (uniform — --rate split evenly);
+    None when serve should run the single-tenant path."""
+    import json
+
+    if args.tenant_spec:
+        with open(args.tenant_spec) as f:
+            doc = json.load(f)
+        specs = doc.get("tenants") if isinstance(doc, dict) else doc
+        if not isinstance(specs, list) or not specs:
+            raise SystemExit(
+                f"--tenant-spec {args.tenant_spec!r}: expected "
+                '{"tenants": [{"id": ..., "weight": ..., "rate_hz": '
+                "...}, ...]}"
+            )
+        return [
+            {
+                "id": str(s["id"]),
+                "weight": float(s.get("weight", 1.0)),
+                "rate_hz": float(s.get("rate_hz", args.rate / len(specs))),
+            }
+            for s in specs
+        ]
+    if args.tenants > 1:
+        return [
+            {
+                "id": f"t{i}",
+                "weight": 1.0,
+                "rate_hz": args.rate / args.tenants,
+            }
+            for i in range(args.tenants)
+        ]
+    return None
+
+
+def _serve_zoo(args, fitted, d_in, tenant_specs):
+    """Multi-tenant serve: one zoo, one exported plan per tenant (the
+    fitted pipeline is cloned per tenant — paging mutates operator
+    state in place, so tenants must never share operator objects), a
+    per-tenant SLO tracker when an SLO is declared, skewed open-loop
+    Poisson load, and a summary line with the per-tenant verdicts plus
+    the zoo's paging/quarantine/cold-start counters."""
+    import json
+    import pickle
+
+    import numpy as np
+
+    from keystone_tpu import obs
+    from keystone_tpu.serving import (
+        ModelZoo,
+        export_plan,
+        run_multi_tenant_open_loop,
+    )
+
+    names = [s["id"] for s in tenant_specs]
+    if len(set(names)) != len(names):
+        print(f"serve: duplicate tenant ids: {names}", file=sys.stderr)
+        return 2
+
+    slos = {}
+    if args.slo_p99_ms > 0:
+        # NO shared registry across trackers: every tracker would
+        # register the SAME (slo.*, objective=) gauge keys and stomp
+        # each other last-writer-wins. The per-tenant verdicts ride the
+        # zoo's stats block (the "zoo" exporter source below), which is
+        # what bin/slo's tenant table renders.
+        for name in names:
+            slos[name] = obs.SLOTracker([
+                obs.SLOObjective(
+                    "latency", kind="latency",
+                    threshold_s=args.slo_p99_ms / 1e3,
+                    target=args.slo_target,
+                ),
+                obs.SLOObjective(
+                    "availability", kind="availability", target=0.999,
+                ),
+            ])
+
+    plans = {}
+    try:
+        for spec in tenant_specs:
+            # Clone per tenant: pickle round trip (the documented
+            # FittedPipeline copy path — compile caches rebuild lazily).
+            clone = pickle.loads(pickle.dumps(fitted))
+            plans[spec["id"]] = export_plan(
+                clone, np.zeros(d_in, np.float32), max_batch=args.max_batch
+            )
+    except Exception as e:
+        print(
+            f"serve: tenant export failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+
+    per_tenant_bytes = {
+        name: max(p.pinned_bytes, 1) for name, p in plans.items()
+    }
+    budget = (
+        int(args.zoo_budget_mb * (1 << 20)) if args.zoo_budget_mb > 0
+        else sum(per_tenant_bytes.values()) + len(plans)
+    )
+    zoo = ModelZoo(
+        budget_bytes=budget,
+        max_batch=args.max_batch,
+        max_wait_ms=args.max_wait_ms,
+        max_queue_depth=args.queue_depth,
+    )
+    exporter = None
+    try:
+        for spec in tenant_specs:
+            zoo.add_tenant(
+                spec["id"], plans[spec["id"]], weight=spec["weight"],
+                slo=slos.get(spec["id"]),
+            )
+        if args.metrics_port >= 0 or args.metrics_dir:
+            from keystone_tpu.data.runtime import default_runtime
+
+            sources = {
+                "metrics": zoo.metrics,
+                "zoo": zoo.stats,
+                "runtime": default_runtime().stats,
+            }
+            exporter = obs.LiveExporter(
+                sources=sources,
+                snapshot_dir=args.metrics_dir or None,
+                port=args.metrics_port if args.metrics_port >= 0 else None,
+                interval_s=args.metrics_interval_s,
+            )
+        rng = np.random.default_rng(args.seed + 1)
+        pool = rng.normal(size=(256, d_in)).astype(np.float32)
+        report = run_multi_tenant_open_loop(
+            zoo.submit,
+            lambda tenant, i: pool[i % len(pool)],
+            rates_hz={s["id"]: s["rate_hz"] for s in tenant_specs},
+            duration_s=args.duration_s, seed=args.seed,
+            slos=slos or None,
+        )
+        stats = zoo.stats()
+    finally:
+        if exporter is not None:
+            exporter.close()
+        zoo.close()
+    summary = report.to_row_dict()
+    # The summary line keeps the per-tenant report blocks under
+    # ``per_tenant``; ``tenants`` is the headline COUNT (the satellite
+    # counters an operator greps for).
+    summary["per_tenant"] = summary.pop("tenants")
+    summary.update({
+        "tenants": stats["num_tenants"],
+        "residents": stats["residents"],
+        "quarantined": stats["quarantined"],
+        "coldstart_failfast": stats["coldstart_failfast"],
+        "page_ins": stats["page_ins"],
+        "page_outs": stats["page_outs"],
+        "zoo_budget_bytes": stats["budget_bytes"],
+        "accounting_ok": stats["accounting_ok"]
+        and report.accounting_ok(),
+    })
+    if slos:
+        summary["tenant_slo_states"] = report.tenant_states()
+    if exporter is not None and exporter.port is not None:
+        summary["metrics_port"] = exporter.port
+    print(json.dumps(summary))
+    return 0
 
 
 PIPELINES: Dict[str, Callable] = {

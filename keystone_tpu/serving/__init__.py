@@ -22,8 +22,16 @@ into something that serves streams of single-datum requests:
     idle budget removes them, and past ``max_replicas`` admission
     degrades down the named brownout ladder — every decision a
     structured ``autoscale.decision`` event (``serving/autoscale.py``).
-  - :func:`run_open_loop` / :func:`closed_loop_qps` — Poisson load
-    generation and the batch-size-1 baseline the bench A/Bs against.
+  - :class:`ModelZoo` — the multi-tenant tier: many fingerprinted
+    plans under one hard device-memory budget, weights paged host-side
+    in the bit-exact int16+bf16 split-plane encoding with per-tensor
+    CRCs, LRU-priced-by-cost eviction, per-tenant SLOs with
+    deficit-weighted fair admission, deadline-bounded cold starts, and
+    loud quarantine on corruption (``serving/zoo.py``).
+  - :func:`run_open_loop` / :func:`run_multi_tenant_open_loop` /
+    :func:`closed_loop_qps` — Poisson load generation (single and
+    skewed multi-tenant mixes) and the batch-size-1 baseline the bench
+    A/Bs against.
 """
 
 from .autoscale import AutoscaleDecision, Autoscaler
@@ -34,8 +42,22 @@ from .batcher import (
     ServerOverloaded,
 )
 from .export import BatchInfo, ExportedPlan, export_plan, plan_fingerprint
-from .loadgen import LoadReport, closed_loop_qps, poisson_arrivals, run_open_loop
+from .loadgen import (
+    LoadReport,
+    MultiTenantLoadReport,
+    closed_loop_qps,
+    poisson_arrivals,
+    run_multi_tenant_open_loop,
+    run_open_loop,
+)
 from .replicas import BROWNOUT_STEPS, ReplicatedServer
+from .zoo import (
+    ModelZoo,
+    PagedWeights,
+    TenantColdStart,
+    TenantQuarantined,
+    ZooDecision,
+)
 
 __all__ = [
     "AutoscaleDecision",
@@ -45,13 +67,20 @@ __all__ = [
     "ExportedPlan",
     "LoadReport",
     "MicroBatchServer",
+    "ModelZoo",
+    "MultiTenantLoadReport",
+    "PagedWeights",
     "ReplicatedServer",
     "ServerClosed",
     "ServerDegraded",
     "ServerOverloaded",
+    "TenantColdStart",
+    "TenantQuarantined",
+    "ZooDecision",
     "closed_loop_qps",
     "export_plan",
     "plan_fingerprint",
     "poisson_arrivals",
+    "run_multi_tenant_open_loop",
     "run_open_loop",
 ]

@@ -25,7 +25,14 @@ from keystone_tpu.utils import profiling
 
 from .batcher import ServerClosed, ServerDegraded, ServerOverloaded
 
-__all__ = ["LoadReport", "closed_loop_qps", "poisson_arrivals", "run_open_loop"]
+__all__ = [
+    "LoadReport",
+    "MultiTenantLoadReport",
+    "closed_loop_qps",
+    "poisson_arrivals",
+    "run_multi_tenant_open_loop",
+    "run_open_loop",
+]
 
 
 def poisson_arrivals(rate_hz: float, duration_s: float, seed: int = 0):
@@ -228,6 +235,173 @@ def run_open_loop(
         per_fingerprint_completed=per_fingerprint,
         slo=verdict,
     )
+
+
+@dataclass
+class MultiTenantLoadReport:
+    """One multi-tenant open-loop run: per-tenant :class:`LoadReport`
+    blocks (each auditable on its own — offered rate, sample count, SLO
+    verdict) plus the aggregate. ``num_tenants`` and per-tenant
+    ``offered_rate_hz`` ride in :meth:`to_row_dict` so the bench's
+    tenant-audit rule (any per-tenant p99/SLO claim must carry
+    ``num_tenants`` + per-tenant ``offered*``) passes by construction."""
+
+    tenants: Dict[str, LoadReport]
+    duration_s: float
+
+    @property
+    def num_tenants(self) -> int:
+        return len(self.tenants)
+
+    def tenant_states(self) -> Dict[str, Optional[str]]:
+        """``{tenant: SLO worst-state}`` (None when no SLO declared) —
+        the isolation-contract read: a spike on one tenant must leave
+        every OTHER tenant's state OK."""
+        return {
+            name: (r.slo or {}).get("state")
+            for name, r in self.tenants.items()
+        }
+
+    def accounting_ok(self) -> bool:
+        """Per-tenant zero-silent-drop claim over the LOADGEN's own
+        books: every offered request is accounted completed, rejected,
+        or failed (the zoo's front-door counters state the same claim
+        server-side)."""
+        return all(
+            r.num_offered == r.completed + r.rejected + r.failed
+            for r in self.tenants.values()
+        )
+
+    def to_row_dict(self) -> Dict[str, Any]:
+        agg_offered = sum(r.num_offered for r in self.tenants.values())
+        return {
+            "num_tenants": self.num_tenants,
+            "duration_s": round(self.duration_s, 3),
+            "offered_total": agg_offered,
+            "offered_rate_hz_total": round(
+                sum(r.offered_rate_hz for r in self.tenants.values()), 2
+            ),
+            "completed_total": sum(
+                r.completed for r in self.tenants.values()
+            ),
+            "rejected_total": sum(
+                r.rejected for r in self.tenants.values()
+            ),
+            "failed_total": sum(r.failed for r in self.tenants.values()),
+            "accounting_ok": self.accounting_ok(),
+            "tenants": {
+                name: r.to_row_dict()
+                for name, r in sorted(self.tenants.items())
+            },
+        }
+
+
+def run_multi_tenant_open_loop(
+    submit: Callable[..., Any],
+    make_request: Callable[[str, int], Any],
+    rates_hz: Dict[str, float],
+    duration_s: float,
+    seed: int = 0,
+    deadline_ms: Optional[float] = None,
+    result_timeout_s: float = 60.0,
+    slos: Optional[Dict[str, Any]] = None,
+) -> MultiTenantLoadReport:
+    """Drive a multi-tenant front door (``submit(tenant, x,
+    deadline_ms)`` — the :class:`~keystone_tpu.serving.zoo.ModelZoo`
+    contract) with INDEPENDENT per-tenant open-loop Poisson processes,
+    merged into one arrival schedule. Each tenant keeps its own rate
+    (the skewed-traffic shape the isolation chaos tests need — e.g. one
+    tenant at 8x the others), its own seeded arrival stream
+    (deterministic per (seed, tenant)), and its own
+    :class:`LoadReport` with per-tenant SLO verdict when ``slos`` maps
+    the tenant to the tracker its serving path feeds.
+
+    Classification mirrors :func:`run_open_loop`: ``ServerOverloaded``
+    (which the zoo's cold-start fast-fail subclasses) counts
+    ``rejected``; any other named failure counts ``failed`` — the storm
+    keeps offering through degraded windows and accounts for
+    everything, so offered == completed + rejected + failed per tenant
+    by construction."""
+    if not rates_hz:
+        raise ValueError("rates_hz must name at least one tenant")
+    arrivals: List[Any] = []  # (t_offset, tenant, per-tenant index)
+    for k, tenant in enumerate(sorted(rates_hz)):
+        offsets = poisson_arrivals(
+            rates_hz[tenant], duration_s, seed=seed * 1009 + k
+        )
+        arrivals.extend(
+            (float(t), tenant, i) for i, t in enumerate(offsets)
+        )
+    arrivals.sort(key=lambda a: a[0])
+
+    records: Dict[str, List[Any]] = {t: [] for t in rates_hz}
+    rejected: Dict[str, int] = {t: 0 for t in rates_hz}
+    failed: Dict[str, int] = {t: 0 for t in rates_hz}
+    offered: Dict[str, int] = {t: 0 for t in rates_hz}
+    t_start = time.perf_counter()
+    for t_arr, tenant, i in arrivals:
+        delay = (t_start + t_arr) - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        x = make_request(tenant, i)
+        offered[tenant] += 1
+        stamp: Dict[str, float] = {}
+        t_sub = time.perf_counter()
+        try:
+            fut = submit(tenant, x, deadline_ms)
+        except ServerOverloaded:
+            rejected[tenant] += 1
+            continue
+        except (ServerDegraded, ServerClosed):
+            failed[tenant] += 1
+            continue
+        fut.add_done_callback(
+            lambda f, s=stamp: s.setdefault("t_done", time.perf_counter())
+        )
+        records[tenant].append((t_sub, fut, stamp))
+    wall = time.perf_counter() - t_start
+
+    reports: Dict[str, LoadReport] = {}
+    for tenant in sorted(rates_hz):
+        latencies: List[float] = []
+        for t_sub, fut, stamp in records[tenant]:
+            try:
+                fut.result(timeout=result_timeout_s)
+            except ServerOverloaded:
+                rejected[tenant] += 1
+                continue
+            except Exception:  # ServerClosed, plan errors, timeouts
+                failed[tenant] += 1
+                continue
+            latencies.append(
+                stamp.get("t_done", time.perf_counter()) - t_sub
+            )
+        pct = profiling.latency_percentiles(latencies)
+        completed = len(latencies)
+        verdict = None
+        tracker = (slos or {}).get(tenant)
+        if tracker is not None:
+            tracker.evaluate()
+            verdict = tracker.verdict()
+        reports[tenant] = LoadReport(
+            offered_rate_hz=rates_hz[tenant],
+            duration_s=duration_s,
+            num_offered=offered[tenant],
+            completed=completed,
+            rejected=rejected[tenant],
+            failed=failed[tenant],
+            p50_latency_s=pct["p50"] if pct else None,
+            p99_latency_s=pct["p99"] if pct else None,
+            mean_latency_s=(
+                sum(latencies) / completed if completed else None
+            ),
+            achieved_qps=(
+                completed / wall if completed and wall > 0 else None
+            ),
+            latencies_s=latencies,
+            slo=verdict,
+        )
+    return MultiTenantLoadReport(tenants=reports, duration_s=duration_s)
 
 
 def closed_loop_qps(

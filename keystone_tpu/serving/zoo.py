@@ -1,0 +1,1313 @@
+"""Multi-tenant model zoo: many fingerprinted plans on one device budget
+(ROADMAP item 3 — "a model-zoo tier that pages exported plan weights
+between host RAM and device HBM ... with per-tenant SLOs and fair
+admission so one hot tenant can't starve the rest").
+
+KeystoneML's pipeline-as-value design makes exported plans cheap to
+HOLD — a frozen graph plus weight arrays — but every serving plane so
+far serves exactly ONE of them: "production-scale serving for millions
+of users" stops at a single model. This module is the robustness layer
+that lets many tenants share one device, with ISOLATION as the headline
+contract:
+
+  - **Weight paging under a hard budget.** A RESIDENT tenant holds a
+    live, AOT-warmed :class:`~keystone_tpu.serving.export.ExportedPlan`
+    (weights device-pinned) behind its own batcher. A PAGED-OUT tenant
+    holds its weights host-side in the compressed int16+bf16 split-plane
+    encoding (:class:`PagedWeights` — the PR-8 resident tier's
+    two-16-bit-lane layout, reused bit-EXACTLY: an f32 tensor splits
+    into its bf16 high half + an int16 low-mantissa plane, and tensors
+    whose low plane is all zero — bf16-representable weights — store
+    only 2 B/elem). Every tensor carries a CRC in the
+    ``data/durable.py`` checksum discipline: a bit-flipped paged tensor
+    raises :class:`~keystone_tpu.data.durable.ShardCorrupted` at
+    page-in and QUARANTINES the plan — never a silently-wrong response.
+    Page-in/page-out run as tasks on a
+    :class:`~keystone_tpu.data.runtime.DataPlaneRuntime` lane (host-only
+    work — the jax-off-thread discipline; the JAX rebuild runs on the
+    faulting caller) through the ``serving.zoo.page_in`` /
+    ``serving.zoo.page_out`` fault sites with a bounded-backoff
+    :class:`~keystone_tpu.utils.faults.RetryPolicy`.
+  - **Bit-identity per fingerprint.** A plan's
+    :func:`~keystone_tpu.serving.export.plan_fingerprint` is recorded at
+    registration; after every page-in the rebuilt plan's fingerprint
+    must MATCH it (the fingerprint covers weight content CRCs), so a
+    paging round trip is provably bit-identical — the hot-swap contract
+    of docs/reliability.md extended to residency transitions.
+  - **LRU eviction priced by cost.** When the budget binds, the victim
+    is chosen by score = recency / (page-in cost × SLO pressure):
+    least-recently-used wins, discounted by how expensive the tenant is
+    to bring back (measured page-in seconds, seeded from the cost
+    model's byte pricing) and by its live SLO state (a WARN/BREACH
+    tenant is held resident). Every choice is a structured
+    ``zoo.decision`` audit event mirroring ``cost.decision`` /
+    ``autoscale.decision``: candidates with their scores, winner,
+    reason.
+  - **Per-tenant SLOs + deficit-weighted fair admission.** Each tenant
+    carries its own :class:`~keystone_tpu.obs.slo.SLOTracker` and the
+    front door runs weighted fair queuing over tenants: every tenant
+    has a per-tenant queue-depth cap, and once the GLOBAL outstanding
+    pool is full, only tenants still under their deficit-weighted
+    guaranteed share (``weight_i / Σweights × max_outstanding_total``)
+    admit — a hot tenant's overflow is rejected AT ITS OWN DOOR with a
+    named error that burns ITS budget, while every other tenant's
+    guaranteed share stays admittable. The isolation contract
+    (docs/reliability.md): *no tenant's admission latency or SLO state
+    may degrade past WARN because of another tenant's offered load,
+    and ``offered == completed + rejected + failed`` holds per tenant
+    at all times.*
+  - **Graceful degradation.** A page fault on a cold tenant is
+    bounded-latency: when the request carries a deadline the page-in
+    estimate (measured EMA, seeded by ``cold_start_estimate_s``) is
+    checked FIRST and an unmeetable deadline fast-fails with the named
+    :class:`TenantColdStart` instead of wedging behind a multi-second
+    rebuild. Repeated page-in failures (retry exhaustion) or any CRC
+    mismatch QUARANTINE the plan loudly — flight-record dump,
+    ``zoo.quarantined`` metric, every later submit fast-failing with
+    :class:`TenantQuarantined` — while every other tenant keeps
+    serving.
+
+Per-tenant servers are :class:`~keystone_tpu.serving.batcher
+.MicroBatchServer`\\ s by default; ``replicas_per_tenant > 1`` fronts
+each tenant with a full
+:class:`~keystone_tpu.serving.replicas.ReplicatedServer` plane (same
+submit/stats/close contract), so one replicated plane design serves
+MANY fingerprinted plans.
+
+Chaos-provable (tests/test_chaos_zoo.py): a hot-tenant spike leaves
+every other tenant's SLO verdict OK with zero silent drops; a page-in
+fault is absorbed by the retry budget; a kill mid-page-out leaves the
+previous RESIDENT copy authoritative (the encode completes or nothing
+changes — the paged copy is swapped in atomically after verification).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from keystone_tpu import obs
+from keystone_tpu.data import durable
+from keystone_tpu.obs.metrics import (
+    METRIC_TENANT_COLDSTART_FAILFAST,
+    METRIC_TENANT_COMPLETED,
+    METRIC_TENANT_FAILED,
+    METRIC_TENANT_OFFERED,
+    METRIC_TENANT_REJECTED,
+    METRIC_ZOO_DECISIONS,
+    METRIC_ZOO_PAGE_INS,
+    METRIC_ZOO_PAGE_OUTS,
+    METRIC_ZOO_QUARANTINED,
+    METRIC_ZOO_RESIDENTS,
+)
+from keystone_tpu.utils import faults
+
+from .batcher import (
+    MicroBatchServer,
+    ServerClosed,
+    ServerDegraded,
+    ServerOverloaded,
+)
+from .export import ExportedPlan
+
+__all__ = [
+    "ModelZoo",
+    "PagedWeights",
+    "TenantColdStart",
+    "TenantQuarantined",
+    "ZooDecision",
+]
+
+logger = logging.getLogger("keystone_tpu.serving")
+
+# SLO-pressure multipliers for eviction scoring: a tenant already
+# burning budget is held resident (its page-in cost is effectively
+# multiplied), so the budget squeeze lands on healthy-idle tenants.
+_SLO_PRESSURE = {"OK": 1.0, "WARN": 4.0, "BREACH": 16.0}
+
+
+class TenantColdStart(ServerOverloaded):
+    """A page fault on a cold (paged-out) tenant could not meet the
+    request's deadline: the estimated page-in time exceeds the deadline
+    budget, so the request fast-fails HERE — named, counted, SLO-fed —
+    instead of wedging the batcher behind a multi-second weight rebuild.
+    An :class:`~keystone_tpu.serving.batcher.ServerOverloaded` subclass:
+    capacity (residency) was the limiting resource."""
+
+
+class TenantQuarantined(ServerDegraded):
+    """The tenant's plan is quarantined — a paged tensor failed its CRC
+    (bit flip: serving it would be silently wrong) or page-in failed
+    past the retry budget. Every submit fast-fails with this error until
+    the operator re-registers the tenant; every OTHER tenant keeps
+    serving. A :class:`~keystone_tpu.serving.batcher.ServerDegraded`
+    subclass: the plan, not the load, is the problem."""
+
+
+# ---------------------------------------------------------------------------
+# Paged weight encoding: bit-exact int16+bf16 split planes + CRCs
+# ---------------------------------------------------------------------------
+
+
+class _PagedTensor:
+    """One weight tensor paged host-side. f32 tensors store the PR-8
+    two-16-bit-lane layout: ``hi`` is the bf16 high half (truncated f32
+    top 16 bits — exactly the bfloat16 bit pattern) and ``lo`` the int16
+    low-mantissa residue; ``f32 == (hi << 16) | lo`` bit-for-bit, so the
+    round trip is EXACT, and a tensor whose low plane is all zero (a
+    bf16-representable weight) drops it — 2 B/elem, the compressed win.
+    Non-f32 dtypes ride as raw bytes. ``crc`` digests the ORIGINAL
+    array's bytes (durable.py discipline, algorithm recorded)."""
+
+    __slots__ = ("shape", "dtype", "hi", "lo", "raw", "crc", "algo")
+
+    def __init__(self, shape, dtype, hi, lo, raw, crc, algo):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.hi = hi
+        self.lo = lo
+        self.raw = raw
+        self.crc = crc
+        self.algo = algo
+
+    @property
+    def nbytes(self) -> int:
+        total = 0
+        for plane in (self.hi, self.lo, self.raw):
+            if plane is not None:
+                total += plane.nbytes
+        return total
+
+
+def _encode_tensor(arr: np.ndarray) -> _PagedTensor:
+    arr = np.ascontiguousarray(arr)
+    crc = durable.crc_of_array(arr)
+    algo = durable.checksum_algo()
+    if arr.dtype == np.float32:
+        u = arr.view(np.uint32)
+        hi = (u >> np.uint32(16)).astype(np.uint16)
+        lo = (u & np.uint32(0xFFFF)).astype(np.uint16)
+        if not lo.any():
+            lo = None  # bf16-representable: the compressed 2 B/elem form
+        return _PagedTensor(arr.shape, arr.dtype, hi, lo, None, crc, algo)
+    return _PagedTensor(
+        arr.shape, arr.dtype, None, None,
+        arr.view(np.uint8).reshape(-1).copy(), crc, algo,
+    )
+
+
+def _decode_tensor(pt: _PagedTensor, site: str) -> np.ndarray:
+    """Decode one paged tensor, running each stored plane through the
+    fault harness's corruption hook (so chaos plans can flip a byte at
+    ``site``) and verifying the recorded CRC over the DECODED bytes —
+    a mismatch raises through :func:`durable.corrupted` (flight dump
+    beside it), which the retry layer never retries."""
+    if pt.raw is not None:
+        raw = faults.corrupt_array(site, pt.raw)
+        out = raw.view(pt.dtype).reshape(pt.shape).copy()
+    else:
+        hi = faults.corrupt_array(site, pt.hi)
+        u = hi.astype(np.uint32) << np.uint32(16)
+        if pt.lo is not None:
+            lo = faults.corrupt_array(site, pt.lo)
+            u = u | lo.astype(np.uint32)
+        out = u.view(np.float32).reshape(pt.shape)
+    got = durable.crc_of_array(out, pt.algo)
+    if got != pt.crc:
+        raise durable.corrupted(
+            f"paged weight tensor failed checksum at {site}: "
+            f"crc {got:#x} != recorded {pt.crc:#x} ({pt.algo}, shape "
+            f"{pt.shape}, dtype {pt.dtype}) — serving it would be "
+            f"silently wrong; the plan must be quarantined"
+        )
+    return out
+
+
+class PagedWeights:
+    """The host-side paged form of one plan's device weights: the
+    tensors in slot order (the deterministic jax-array-attribute walk of
+    the plan graph), each CRC-guarded. ``decoded_bytes`` is the resident
+    footprint the tensors decode back to — what the budget arithmetic
+    charges a page-in with."""
+
+    __slots__ = ("tensors", "decoded_bytes")
+
+    def __init__(self, tensors: List[_PagedTensor], decoded_bytes: int):
+        self.tensors = tensors
+        self.decoded_bytes = int(decoded_bytes)
+
+    @property
+    def nbytes(self) -> int:
+        return sum(t.nbytes for t in self.tensors)
+
+
+def _page_out_task(host_arrays: List[np.ndarray]) -> PagedWeights:
+    """The page-out lane task (host/numpy only — jax-off-thread): fire
+    the fault site, then encode every tensor. Runs to completion or
+    raises with NOTHING published — the caller swaps the result in
+    atomically, so a kill mid-encode leaves the previous resident copy
+    authoritative."""
+    faults.maybe_fail(faults.SITE_ZOO_PAGE_OUT)
+    tensors = [_encode_tensor(a) for a in host_arrays]
+    return PagedWeights(tensors, sum(a.nbytes for a in host_arrays))
+
+
+def _page_in_task(paged: PagedWeights) -> List[np.ndarray]:
+    """The page-in lane task (host/numpy only): fire the fault site,
+    decode + CRC-verify every tensor. A transient injected error is
+    retried by the caller's policy; a checksum mismatch raises
+    ShardCorrupted and is NEVER retried (persistent state)."""
+    faults.maybe_fail(faults.SITE_ZOO_PAGE_IN)
+    return [_decode_tensor(t, faults.SITE_ZOO_PAGE_IN) for t in paged.tensors]
+
+
+# ---------------------------------------------------------------------------
+# Decision audit
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ZooDecision:
+    """One paging/eviction/quarantine choice, as evidence — the zoo
+    analogue of ``cost.decision`` / ``autoscale.decision``: what the zoo
+    saw (inputs, scored candidates for evictions), what it did (action,
+    tenant), and why (reason). ``ok=False`` records an attempted action
+    that failed (e.g. a page-out killed mid-encode)."""
+
+    action: str                  # page_in | page_out | evict | quarantine
+    tenant: str
+    reason: str
+    t_s: float
+    ok: bool = True
+    inputs: Dict[str, Any] = field(default_factory=dict)
+    candidates: List[Dict[str, Any]] = field(default_factory=list)
+
+    def to_args(self) -> Dict[str, Any]:
+        out = {
+            "action": self.action,
+            "tenant": self.tenant,
+            "reason": self.reason,
+            "ok": self.ok,
+            "t_s": self.t_s,
+            "inputs": dict(self.inputs),
+        }
+        if self.candidates:
+            out["candidates"] = [dict(c) for c in self.candidates]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Tenant state
+# ---------------------------------------------------------------------------
+
+
+class _Tenant:
+    """One tenant's full state: identity (fingerprint, graph, slots),
+    residency (plan+server when resident, PagedWeights when not), the
+    front-door accounting counters (authoritative — they survive server
+    teardown across page-outs), and the per-tenant SLO tracker."""
+
+    __slots__ = (
+        "tenant_id", "weight", "graph", "source", "sink", "example",
+        "max_batch", "buckets", "fingerprint", "slots", "op_ids", "plan",
+        "server", "paged", "resident_bytes", "quarantined",
+        "quarantine_reason", "paging", "outstanding", "offered",
+        "completed", "rejected", "failed", "coldstart_failfast",
+        "page_ins", "page_outs", "page_retries", "last_used",
+        "last_page_in_s", "slo", "replicas",
+    )
+
+    def __init__(self, tenant_id: str, plan: ExportedPlan, weight: float,
+                 slo, replicas: int, resident_bytes: int):
+        self.tenant_id = tenant_id
+        self.weight = float(weight)
+        self.graph = plan.graph
+        self.source = plan.source
+        self.sink = plan.sink
+        self.example = np.zeros(plan.item_shape, np.dtype(plan.dtype))
+        self.max_batch = plan.max_batch
+        self.buckets = list(plan.buckets)
+        self.fingerprint = plan.fingerprint
+        self.slots: List[Tuple[Any, str, Optional[int]]] = []
+        self.op_ids: frozenset = frozenset()
+        self.plan: Optional[ExportedPlan] = plan
+        self.server = None
+        self.paged: Optional[PagedWeights] = None
+        self.resident_bytes = int(resident_bytes)
+        self.quarantined = False
+        self.quarantine_reason: Optional[str] = None
+        self.paging = False
+        self.outstanding = 0
+        self.offered = 0
+        self.completed = 0
+        self.rejected = 0
+        self.failed = 0
+        self.coldstart_failfast = 0
+        self.page_ins = 0
+        self.page_outs = 0
+        self.page_retries = 0
+        self.last_used = 0.0
+        self.last_page_in_s: Optional[float] = None
+        self.slo = slo
+        self.replicas = int(replicas)
+
+    @property
+    def resident(self) -> bool:
+        return self.server is not None
+
+    def slo_state(self) -> str:
+        return self.slo.worst_state() if self.slo is not None else "OK"
+
+
+def _collect_weight_slots(graph):
+    """``[(operator, attr, list_index_or_None, array)]`` — the same
+    jax-array-attribute DETECTION as ``export._pin_operator_arrays``
+    (which attrs count as pageable device weights), walked in sorted
+    attribute order. Slot order is the paging identity only between
+    page-out and page-in of the same entry (both read ``entry.slots``);
+    it deliberately does NOT promise to match the pin walk's insertion
+    order. Caller-thread only (touches jax)."""
+    import jax
+
+    from keystone_tpu.workflow.fusion import fused_members
+
+    slots = []
+    seen = set()
+    for node in graph.nodes:
+        op0 = graph.get_operator(node)
+        for op in fused_members(op0) + [op0]:
+            if id(op) in seen or not hasattr(op, "__dict__"):
+                continue
+            seen.add(id(op))
+            for k, v in sorted(op.__dict__.items()):
+                if isinstance(v, jax.Array):
+                    slots.append((op, k, None, v))
+                elif isinstance(v, list) and v and all(
+                    isinstance(a, jax.Array) for a in v
+                ):
+                    for i, a in enumerate(v):
+                        slots.append((op, k, i, a))
+    return slots
+
+
+def _restore_slot(op, attr, idx, value) -> None:
+    if idx is None:
+        object.__setattr__(op, attr, value)
+    else:
+        getattr(op, attr)[idx] = value
+
+
+# ---------------------------------------------------------------------------
+# The zoo
+# ---------------------------------------------------------------------------
+
+
+class ModelZoo:
+    """Serve MANY fingerprinted plans under one hard device-memory
+    budget with per-tenant isolation (module docstring for the design).
+
+    Knobs:
+
+      - ``budget_bytes``: the hard resident-weight budget. Page-ins
+        evict until the faulting tenant fits; a single tenant larger
+        than the budget is rejected at :meth:`add_tenant`.
+      - ``max_outstanding_total`` / ``tenant_queue_cap``: the fair
+        admission surface — the global outstanding pool WFQ shares are
+        computed over, and the per-tenant depth cap.
+      - ``cold_start_estimate_s``: the page-in time estimate before any
+        page-in has been measured (the deadline-aware fast-fail bound;
+        replaced by a measured EMA after the first page-in).
+      - ``page_retry_attempts``: transient page-task failures absorbed
+        per page operation before the tenant is quarantined (page-in)
+        or the page-out is abandoned with the resident copy intact.
+      - ``evict_drain_timeout_s``: bound on draining an eviction
+        victim's in-flight work; a victim that cannot drain re-enters
+        rotation untouched (zero-drop) and the page-in fails.
+      - ``replicas_per_tenant``: 1 = one MicroBatchServer per resident
+        tenant; >1 fronts each with a ReplicatedServer plane.
+      - ``max_batch`` / ``max_wait_ms`` / ``max_queue_depth``: the
+        per-tenant server knobs (docs/serving.md).
+    """
+
+    def __init__(
+        self,
+        budget_bytes: int,
+        max_outstanding_total: int = 256,
+        tenant_queue_cap: int = 64,
+        cold_start_estimate_s: float = 1.0,
+        page_retry_attempts: int = 3,
+        evict_drain_timeout_s: float = 5.0,
+        replicas_per_tenant: int = 1,
+        max_batch: int = 64,
+        max_wait_ms: float = 1.0,
+        max_queue_depth: int = 256,
+        runtime=None,
+        metrics=None,
+        decision_log_len: int = 256,
+    ):
+        if budget_bytes < 1:
+            raise ValueError("budget_bytes must be >= 1")
+        if max_outstanding_total < 1:
+            raise ValueError("max_outstanding_total must be >= 1")
+        if tenant_queue_cap < 1:
+            raise ValueError("tenant_queue_cap must be >= 1")
+        if replicas_per_tenant < 1:
+            raise ValueError("replicas_per_tenant must be >= 1")
+        self.budget_bytes = int(budget_bytes)
+        self.max_outstanding_total = int(max_outstanding_total)
+        self.tenant_queue_cap = int(tenant_queue_cap)
+        self.cold_start_estimate_s = float(cold_start_estimate_s)
+        self.page_retry_attempts = int(page_retry_attempts)
+        self.evict_drain_timeout_s = float(evict_drain_timeout_s)
+        self.replicas_per_tenant = int(replicas_per_tenant)
+        self._server_kwargs = dict(
+            max_batch=max_batch, max_wait_ms=max_wait_ms,
+            max_queue_depth=max_queue_depth,
+        )
+        self._runtime = runtime
+        self.metrics = metrics if metrics is not None \
+            else obs.MetricsRegistry()
+        self._g_residents = self.metrics.gauge(METRIC_ZOO_RESIDENTS)
+        self._c_page_ins = self.metrics.counter(METRIC_ZOO_PAGE_INS)
+        self._c_page_outs = self.metrics.counter(METRIC_ZOO_PAGE_OUTS)
+        self._c_quarantined = self.metrics.counter(METRIC_ZOO_QUARANTINED)
+        self._c_decisions = self.metrics.counter(METRIC_ZOO_DECISIONS)
+
+        self._lock = threading.Lock()
+        # Serializes ALL residency transitions (page-in, page-out,
+        # eviction, add/remove): budget arithmetic stays single-writer
+        # and two concurrent page faults cannot double-evict.
+        self._page_lock = threading.Lock()
+        self._closed = False
+        self._t0 = time.monotonic()
+        self._tenants: Dict[str, _Tenant] = {}
+        self._page_in_ema_s: Optional[float] = None
+        self._decisions: "deque[Dict[str, Any]]" = deque(
+            maxlen=decision_log_len
+        )
+        self.num_decisions = 0
+
+    # -- construction / membership -----------------------------------------
+
+    def _rt(self):
+        if self._runtime is not None:
+            return self._runtime
+        from keystone_tpu.data.runtime import default_runtime
+
+        return default_runtime()
+
+    def add_tenant(
+        self,
+        tenant_id: str,
+        plan_or_fitted,
+        example=None,
+        weight: float = 1.0,
+        slo=None,
+        resident: bool = True,
+        resident_bytes: Optional[int] = None,
+        max_batch: Optional[int] = None,
+    ) -> str:
+        """Register a tenant. ``plan_or_fitted`` is an
+        :class:`ExportedPlan` or a ``FittedPipeline`` (exported here at
+        ``example``'s signature). The plan's fingerprint is recorded as
+        the tenant's bit-identity anchor — every later page-in must
+        reproduce it exactly. ``resident=False`` registers the tenant
+        paged-out (the weights are encoded immediately and the compiled
+        plan dropped — the cold-start-storm shape). ``resident_bytes``
+        overrides the budget charge (default: the plan's pinned bytes,
+        falling back to the decoded paged footprint). Plans never share
+        operator objects across tenants — export per tenant (paging
+        mutates operator state in place)."""
+        if weight <= 0:
+            raise ValueError(f"tenant {tenant_id!r}: weight must be > 0")
+        if isinstance(plan_or_fitted, ExportedPlan):
+            plan = plan_or_fitted
+        else:
+            from .export import export_plan
+
+            if example is None:
+                raise ValueError(
+                    "add_tenant needs example= to export a FittedPipeline"
+                )
+            plan = export_plan(
+                plan_or_fitted, example,
+                max_batch=max_batch or self._server_kwargs["max_batch"],
+            )
+        op_ids = frozenset(
+            id(plan.graph.get_operator(n)) for n in plan.graph.nodes
+        )
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("add_tenant() after close()")
+            if tenant_id in self._tenants:
+                raise ValueError(f"tenant {tenant_id!r} already registered")
+            for other in self._tenants.values():
+                if op_ids & other.op_ids:
+                    raise ValueError(
+                        f"tenant {tenant_id!r} shares operator objects "
+                        f"with tenant {other.tenant_id!r} — paging one "
+                        "would corrupt the other; export a separate plan "
+                        "per tenant (deepcopy the fitted pipeline)"
+                    )
+        slots = _collect_weight_slots(plan.graph)
+        bytes_est = resident_bytes if resident_bytes is not None else max(
+            plan.pinned_bytes,
+            sum(int(np.asarray(a).nbytes) for _, _, _, a in slots),
+            1,
+        )
+        if bytes_est > self.budget_bytes:
+            raise ValueError(
+                f"tenant {tenant_id!r} needs {bytes_est} resident bytes "
+                f"but the zoo budget is {self.budget_bytes} — it could "
+                "never be paged in"
+            )
+        entry = _Tenant(
+            tenant_id, plan, weight, slo,
+            self.replicas_per_tenant, bytes_est,
+        )
+        entry.slots = [(op, k, i) for op, k, i, _ in slots]
+        entry.op_ids = op_ids
+        with self._page_lock:
+            self._evict_until_fits(entry)
+            server = self._build_server(entry, plan)
+            with self._lock:
+                # Re-validate ATOMICALLY with the insertion: the checks
+                # above ran before the (slow) slot walk released the
+                # lock, and a concurrent add_tenant racing through that
+                # window must not silently replace an entry (leaking its
+                # live server) or smuggle shared operator objects past
+                # the guard.
+                conflict = None
+                if self._closed:
+                    conflict = ServerClosed("add_tenant() after close()")
+                elif tenant_id in self._tenants:
+                    conflict = ValueError(
+                        f"tenant {tenant_id!r} already registered"
+                    )
+                elif any(
+                    op_ids & other.op_ids
+                    for other in self._tenants.values()
+                ):
+                    conflict = ValueError(
+                        f"tenant {tenant_id!r} shares operator objects "
+                        "with a registered tenant"
+                    )
+                if conflict is None:
+                    entry.server = server
+                    entry.last_used = self._now()
+                    self._tenants[tenant_id] = entry
+            if conflict is not None:
+                server.close(timeout=1.0)
+                raise conflict
+            self._g_residents.set(self._num_residents())
+        if not resident:
+            self.page_out(tenant_id)
+        return entry.fingerprint
+
+    def _build_server(self, entry: _Tenant, plan: ExportedPlan):
+        kw = dict(self._server_kwargs)
+        if entry.replicas > 1:
+            from .replicas import ReplicatedServer
+
+            return ReplicatedServer(
+                plan, num_replicas=entry.replicas, slo=entry.slo, **kw
+            )
+        return MicroBatchServer(plan, slo=entry.slo, **kw)
+
+    def _now(self) -> float:
+        return time.monotonic() - self._t0
+
+    def _num_residents(self) -> int:
+        with self._lock:
+            return sum(1 for t in self._tenants.values() if t.resident)
+
+    def _resident_bytes_total(self) -> int:
+        with self._lock:
+            return sum(
+                t.resident_bytes for t in self._tenants.values()
+                if t.resident
+            )
+
+    # -- fair admission + submit -------------------------------------------
+
+    def guaranteed_share(self, tenant_id: str) -> int:
+        """The tenant's deficit-weighted guaranteed slice of the global
+        outstanding pool: ``max(1, weight_i / Σweights ×
+        max_outstanding_total)``. Below it a tenant ALWAYS admits (up to
+        its queue cap) even when the pool is full of someone else's
+        load — the starvation-proof floor of the isolation contract."""
+        with self._lock:
+            entry = self._tenants[tenant_id]
+            total_w = sum(t.weight for t in self._tenants.values())
+        return max(
+            1, int(self.max_outstanding_total * entry.weight / total_w)
+        )
+
+    def submit(self, tenant: str, x, deadline_ms: Optional[float] = None):
+        """Route one request to ``tenant``'s plan; returns a Future
+        annotated with ``tenant`` and ``plan_fingerprint``. Admission is
+        decided FIRST (quarantine fast-fail, per-tenant queue cap,
+        deficit-weighted fair share), then a page fault on a cold tenant
+        either fast-fails (:class:`TenantColdStart`, deadline-aware) or
+        pages the plan in synchronously — the measured cold-start cost
+        this one caller pays, never the batcher's worker."""
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("submit() after close()")
+            entry = self._tenants.get(tenant)
+            if entry is None:
+                raise ValueError(f"unknown tenant {tenant!r}")
+            entry.offered += 1
+            self.metrics.counter(
+                METRIC_TENANT_OFFERED, tenant=tenant
+            ).add(1)
+            if entry.quarantined:
+                entry.rejected += 1
+                self.metrics.counter(
+                    METRIC_TENANT_REJECTED, tenant=tenant
+                ).add(1)
+                reason = entry.quarantine_reason
+                self._observe_slo_bad(entry)
+                raise TenantQuarantined(
+                    f"tenant {tenant!r} is quarantined: {reason}"
+                )
+            if entry.outstanding >= self.tenant_queue_cap:
+                entry.rejected += 1
+                self.metrics.counter(
+                    METRIC_TENANT_REJECTED, tenant=tenant
+                ).add(1)
+                self._observe_slo_bad(entry)
+                raise ServerOverloaded(
+                    f"tenant {tenant!r} is at its queue cap "
+                    f"({self.tenant_queue_cap}) — its own offered load "
+                    "exceeds its admission share"
+                )
+            total_out = sum(t.outstanding for t in self._tenants.values())
+            total_w = sum(t.weight for t in self._tenants.values())
+            share = max(1, int(
+                self.max_outstanding_total * entry.weight / total_w
+            ))
+            if (total_out >= self.max_outstanding_total
+                    and entry.outstanding >= share):
+                # The pool is full AND this tenant is at/over its
+                # deficit-weighted share: ITS overflow is what yields.
+                # Under-share tenants keep admitting — the WFQ floor.
+                entry.rejected += 1
+                self.metrics.counter(
+                    METRIC_TENANT_REJECTED, tenant=tenant
+                ).add(1)
+                self._observe_slo_bad(entry)
+                raise ServerOverloaded(
+                    f"tenant {tenant!r} is over its fair admission share "
+                    f"({entry.outstanding}/{share} outstanding) while the "
+                    f"global pool is full ({total_out}/"
+                    f"{self.max_outstanding_total}) — another tenant's "
+                    "guaranteed share is protected"
+                )
+            entry.last_used = self._now()
+        # Serve loop: reserve ONLY while the tenant is observably
+        # resident (a reservation held while blocked on the page lock
+        # would wedge an eviction drain forever — the drain counts
+        # outstanding reservations); a page fault runs WITHOUT a
+        # reservation, then re-checks residency. Bounded: an eviction
+        # racing this tenant back out between iterations is pathological
+        # and still terminates with a named error.
+        for _ in range(8):
+            with self._lock:
+                server = (
+                    entry.server
+                    if entry.resident and not entry.paging else None
+                )
+                if server is not None:
+                    entry.outstanding += 1  # reserve: drains count us
+            if server is None:
+                est = self.page_in_estimate_s()
+                if deadline_ms is not None and est > deadline_ms / 1e3:
+                    with self._lock:
+                        entry.rejected += 1
+                        entry.coldstart_failfast += 1
+                        self.metrics.counter(
+                            METRIC_TENANT_REJECTED, tenant=tenant
+                        ).add(1)
+                        self.metrics.counter(
+                            METRIC_TENANT_COLDSTART_FAILFAST,
+                            tenant=tenant,
+                        ).add(1)
+                    self._observe_slo_bad(entry)
+                    raise TenantColdStart(
+                        f"tenant {tenant!r} is paged out and the page-in "
+                        f"estimate ({est:.3g}s) exceeds the request "
+                        f"deadline ({deadline_ms:.3g}ms) — fast-failing "
+                        "instead of wedging the request behind a cold "
+                        "start"
+                    )
+                try:
+                    self._ensure_resident(entry)
+                except BaseException:
+                    with self._lock:
+                        entry.failed += 1
+                        self.metrics.counter(
+                            METRIC_TENANT_FAILED, tenant=tenant
+                        ).add(1)
+                    self._observe_slo_bad(entry)
+                    raise
+                continue
+            try:
+                fut = server.submit(x, deadline_ms)
+            except ServerOverloaded:
+                with self._lock:
+                    entry.outstanding -= 1
+                    entry.rejected += 1
+                    self.metrics.counter(
+                        METRIC_TENANT_REJECTED, tenant=tenant
+                    ).add(1)
+                raise  # the tenant's own server already fed its SLO
+            except BaseException:
+                with self._lock:
+                    entry.outstanding -= 1
+                    entry.failed += 1
+                    self.metrics.counter(
+                        METRIC_TENANT_FAILED, tenant=tenant
+                    ).add(1)
+                raise
+            fut.tenant = tenant
+            fut.plan_fingerprint = entry.fingerprint
+            fut.add_done_callback(self._done_callback(entry))
+            return fut
+        with self._lock:
+            entry.failed += 1
+            self.metrics.counter(METRIC_TENANT_FAILED, tenant=tenant).add(1)
+        self._observe_slo_bad(entry)
+        raise ServerDegraded(
+            f"tenant {tenant!r} was repeatedly evicted between page-in "
+            "and dispatch — the zoo budget is thrashing"
+        )
+
+    def _observe_slo_bad(self, entry: _Tenant) -> None:
+        if entry.slo is not None:
+            entry.slo.observe(ok=False)
+
+    def _done_callback(self, entry: _Tenant):
+        def _cb(fut) -> None:
+            try:
+                exc = fut.exception()
+            except BaseException:  # noqa: BLE001 — client cancelled
+                exc = None
+            with self._lock:
+                entry.outstanding -= 1
+                if exc is None:
+                    entry.completed += 1
+                    name = METRIC_TENANT_COMPLETED
+                elif isinstance(exc, ServerOverloaded):
+                    entry.rejected += 1
+                    name = METRIC_TENANT_REJECTED
+                else:
+                    entry.failed += 1
+                    name = METRIC_TENANT_FAILED
+                self.metrics.counter(name, tenant=entry.tenant_id).add(1)
+        return _cb
+
+    # -- residency transitions ---------------------------------------------
+
+    def page_in_estimate_s(self) -> float:
+        """The deadline-aware cold-start bound: the measured page-in EMA
+        once one has completed, else ``cold_start_estimate_s`` seeded
+        from the knob (conservative by design — a first-ever cold start
+        against a tight deadline should fast-fail, not gamble)."""
+        with self._lock:
+            return (self._page_in_ema_s if self._page_in_ema_s is not None
+                    else self.cold_start_estimate_s)
+
+    def _retry_policy(self) -> faults.RetryPolicy:
+        return faults.RetryPolicy(attempts=self.page_retry_attempts)
+
+    def page_in(self, tenant_id: str) -> None:
+        """Make ``tenant_id`` resident (public form of the page-fault
+        path — benches pre-warm through it). No-op when already
+        resident; raises :class:`TenantQuarantined` when the decode
+        fails its CRCs or the retry budget exhausts."""
+        with self._lock:
+            entry = self._tenants[tenant_id]
+        self._ensure_resident(entry)
+
+    def _ensure_resident(self, entry: _Tenant) -> None:
+        with self._page_lock:
+            with self._lock:
+                if self._closed:
+                    raise ServerClosed("page_in() after close()")
+                if entry.resident and not entry.paging:
+                    return  # someone paged it in while we waited
+                if entry.quarantined:
+                    raise TenantQuarantined(
+                        f"tenant {entry.tenant_id!r} is quarantined: "
+                        f"{entry.quarantine_reason}"
+                    )
+                paged = entry.paged
+            if paged is None:  # pragma: no cover — structural invariant
+                raise RuntimeError(
+                    f"tenant {entry.tenant_id!r} is neither resident nor "
+                    "paged"
+                )
+            t0 = time.perf_counter()
+            self._evict_until_fits(entry)
+            retries = [0]
+
+            def _on_retry(attempt, delay_s, exc):
+                retries[0] += 1
+                logger.warning(
+                    "zoo: page-in of tenant %r attempt %d failed "
+                    "(retrying in %.3gs): %r",
+                    entry.tenant_id, attempt, delay_s, exc,
+                )
+
+            policy = self._retry_policy()
+            try:
+                host = policy.call(
+                    lambda: self._rt().submit(
+                        "zoo.page", _page_in_task, paged
+                    ).result(),
+                    key=f"zoo.page_in:{entry.tenant_id}",
+                    on_retry=_on_retry,
+                )
+            except durable.ShardCorrupted as e:
+                self._quarantine_locked_page(
+                    entry, f"paged weights failed CRC verification: {e}"
+                )
+                raise TenantQuarantined(
+                    f"tenant {entry.tenant_id!r} quarantined: {e}"
+                ) from e
+            except OSError as e:
+                self._quarantine_locked_page(
+                    entry,
+                    f"page-in failed {self.page_retry_attempts} "
+                    f"attempt(s): {e!r}",
+                )
+                raise TenantQuarantined(
+                    f"tenant {entry.tenant_id!r} quarantined after "
+                    f"{self.page_retry_attempts} failed page-in "
+                    f"attempt(s): {e!r}"
+                ) from e
+            # Host decode verified — restore the slots (as device arrays,
+            # so export re-pins them) and rebuild the plan on THIS
+            # thread (the JAX side of the page fault; the lane stays
+            # jax-free).
+            import jax.numpy as jnp
+
+            for (op, attr, idx), arr in zip(entry.slots, host):
+                _restore_slot(op, attr, idx, jnp.asarray(arr))
+            plan = ExportedPlan(
+                entry.graph, entry.source, entry.sink, entry.example,
+                max_batch=entry.max_batch, buckets=entry.buckets,
+            )
+            if plan.fingerprint != entry.fingerprint:
+                self._quarantine_locked_page(
+                    entry,
+                    f"rebuilt plan fingerprint {plan.fingerprint} != "
+                    f"registered {entry.fingerprint} — the paging round "
+                    "trip was not bit-identical",
+                )
+                raise TenantQuarantined(
+                    f"tenant {entry.tenant_id!r} quarantined: paging "
+                    f"round trip broke bit-identity ({plan.fingerprint} "
+                    f"!= {entry.fingerprint})"
+                )
+            server = self._build_server(entry, plan)
+            wall = time.perf_counter() - t0
+            with self._lock:
+                entry.plan = plan
+                entry.server = server
+                entry.paging = False
+                # Drop the host-side copy: a resident tenant holding its
+                # PagedWeights forever would grow host RAM by a full
+                # fleet weight copy over paging cycles, and read as
+                # still-paged in stats(). Page-out re-encodes from the
+                # live slots; the quarantine paths (which keep the copy
+                # for the postmortem) never reach this commit block.
+                entry.paged = None
+                entry.page_ins += 1
+                entry.page_retries += retries[0]
+                entry.last_page_in_s = wall
+                self._page_in_ema_s = (
+                    wall if self._page_in_ema_s is None
+                    else 0.5 * self._page_in_ema_s + 0.5 * wall
+                )
+            self._c_page_ins.add(1)
+            self._g_residents.set(self._num_residents())
+            self._record_decision(
+                "page_in", entry.tenant_id,
+                reason=f"page fault; decode+rebuild took {wall:.4g}s "
+                f"({retries[0]} transient retr{'y' if retries[0] == 1 else 'ies'} absorbed)",
+                inputs={
+                    "resident_bytes": entry.resident_bytes,
+                    "budget_bytes": self.budget_bytes,
+                    "page_in_s": round(wall, 6),
+                    "retries": retries[0],
+                    "fingerprint": entry.fingerprint,
+                },
+            )
+
+    def page_out(self, tenant_id: str) -> None:
+        """Page ``tenant_id``'s weights host-side and release its device
+        residency. The encode runs on the page lane through the
+        ``serving.zoo.page_out`` fault site and is swapped in ATOMICALLY
+        after it completes — a kill mid-encode raises with the resident
+        copy untouched and still authoritative (chaos-pinned)."""
+        with self._lock:
+            entry = self._tenants[tenant_id]
+        with self._page_lock:
+            self._page_out_locked(entry, reason="explicit page_out")
+
+    def _page_out_locked(self, entry: _Tenant, reason: str) -> None:
+        """Page out one tenant (page lock held). Drains the tenant's
+        outstanding work first (no admissions race: ``paging`` flips
+        under the zoo lock, and submit routes paging tenants into the
+        page-fault path which serializes behind the page lock)."""
+        with self._lock:
+            if not entry.resident:
+                return
+            entry.paging = True
+        try:
+            deadline = time.perf_counter() + self.evict_drain_timeout_s
+            while True:
+                with self._lock:
+                    if entry.outstanding == 0:
+                        break
+                if time.perf_counter() >= deadline:
+                    raise TimeoutError(
+                        f"tenant {entry.tenant_id!r} failed to drain "
+                        f"within {self.evict_drain_timeout_s:.3g}s "
+                        f"({entry.outstanding} outstanding); it stays "
+                        "resident"
+                    )
+                time.sleep(0.001)
+            # Pull to host on THIS thread (jax), encode on the lane
+            # (numpy) — nothing is published until the encode verifies.
+            host = [
+                np.asarray(a) for a in (
+                    getattr(op, attr) if idx is None
+                    else getattr(op, attr)[idx]
+                    for op, attr, idx in entry.slots
+                )
+            ]
+            policy = self._retry_policy()
+            try:
+                paged = policy.call(
+                    lambda: self._rt().submit(
+                        "zoo.page", _page_out_task, host
+                    ).result(),
+                    key=f"zoo.page_out:{entry.tenant_id}",
+                )
+            except BaseException as e:
+                self._record_decision(
+                    "page_out", entry.tenant_id, ok=False,
+                    reason=f"page-out failed ({e!r}); the resident copy "
+                    "stays authoritative",
+                    inputs={"resident_bytes": entry.resident_bytes},
+                )
+                raise
+            # Point of no return — everything below only releases.
+            server = entry.server
+            with self._lock:
+                entry.paged = paged
+                entry.server = None
+                entry.plan = None
+                entry.page_outs += 1
+            server.close()
+            for op, attr, idx in entry.slots:
+                _restore_slot(op, attr, idx, None)
+            self._c_page_outs.add(1)
+            self._g_residents.set(self._num_residents())
+            self._record_decision(
+                "page_out", entry.tenant_id, reason=reason,
+                inputs={
+                    "resident_bytes": entry.resident_bytes,
+                    "paged_bytes": paged.nbytes,
+                    "compression": round(
+                        paged.nbytes / max(paged.decoded_bytes, 1), 4
+                    ),
+                },
+            )
+        finally:
+            with self._lock:
+                entry.paging = False
+
+    # -- eviction (LRU priced by cost) -------------------------------------
+
+    def _page_cost_estimate_s(self, entry: _Tenant) -> float:
+        """What bringing this tenant BACK would cost: its measured
+        page-in wall when one exists, else the zoo EMA, else the cost
+        model's byte pricing (active mem weight × resident bytes) with
+        the cold-start seed as the floor — so eviction scoring is priced
+        even before the first measurement."""
+        if entry.last_page_in_s is not None:
+            return entry.last_page_in_s
+        with self._lock:
+            ema = self._page_in_ema_s
+        if ema is not None:
+            return ema
+        from keystone_tpu.ops.learning.cost import active_weights
+
+        _, mem_w, _ = active_weights()
+        return max(
+            entry.resident_bytes * mem_w, self.cold_start_estimate_s
+        )
+
+    def _evict_until_fits(self, incoming: _Tenant) -> None:
+        """Evict resident tenants (page lock held) until ``incoming``
+        fits the budget. Victim score = recency / (page-in cost × SLO
+        pressure) — the LRU-priced-by-cost policy: old, cheap-to-restore,
+        healthy tenants go first; a WARN/BREACH tenant is 4–16× stickier.
+        Deterministic: ties break on tenant id. Raises
+        :class:`TenantColdStart` when nothing can be evicted (every
+        resident tenant is the faulting one, draining, or undrainable)."""
+        while (self._resident_bytes_total() + incoming.resident_bytes
+               > self.budget_bytes):
+            now = self._now()
+            with self._lock:
+                candidates = [
+                    t for t in self._tenants.values()
+                    if t.resident and not t.paging
+                    and t.tenant_id != incoming.tenant_id
+                ]
+            if not candidates:
+                raise TenantColdStart(
+                    f"tenant {incoming.tenant_id!r} needs "
+                    f"{incoming.resident_bytes} bytes but nothing can be "
+                    f"evicted (budget {self.budget_bytes}, resident "
+                    f"{self._resident_bytes_total()})"
+                )
+            scored = []
+            for t in candidates:
+                age_s = max(now - t.last_used, 1e-9)
+                cost_s = max(self._page_cost_estimate_s(t), 1e-9)
+                pressure = _SLO_PRESSURE.get(t.slo_state(), 1.0)
+                scored.append({
+                    "tenant": t.tenant_id,
+                    "age_s": round(age_s, 6),
+                    "page_in_cost_s": round(cost_s, 6),
+                    "slo_state": t.slo_state(),
+                    "slo_pressure": pressure,
+                    "resident_bytes": t.resident_bytes,
+                    "score": age_s / (cost_s * pressure),
+                })
+            # Highest score evicts first; ties by tenant id so the
+            # choice replays identically (tests pin this).
+            scored.sort(key=lambda c: (-c["score"], c["tenant"]))
+            victim_id = scored[0]["tenant"]
+            with self._lock:
+                victim = self._tenants[victim_id]
+            self._record_decision(
+                "evict", victim_id,
+                reason=(
+                    f"budget binds paging in {incoming.tenant_id!r} "
+                    f"(+{incoming.resident_bytes}B over "
+                    f"{self.budget_bytes}B); LRU-by-cost winner"
+                ),
+                inputs={
+                    "incoming": incoming.tenant_id,
+                    "incoming_bytes": incoming.resident_bytes,
+                    "budget_bytes": self.budget_bytes,
+                    "resident_bytes": self._resident_bytes_total(),
+                },
+                candidates=[
+                    {k: v for k, v in c.items() if k != "score"}
+                    | {"score": round(c["score"], 6)}
+                    for c in scored
+                ],
+            )
+            self._page_out_locked(
+                victim,
+                reason=f"evicted for {incoming.tenant_id!r} (LRU-by-cost)",
+            )
+
+    # -- quarantine ---------------------------------------------------------
+
+    def _quarantine_locked_page(self, entry: _Tenant, reason: str) -> None:
+        """Quarantine a tenant (page lock held): tear down any live
+        server, keep the paged copy for the postmortem, flip the loud
+        signals (flight dump, ``zoo.quarantined`` metric, decision
+        event). Every other tenant keeps serving."""
+        server = None
+        with self._lock:
+            entry.quarantined = True
+            entry.quarantine_reason = reason
+            server = entry.server
+            entry.server = None
+            entry.plan = None
+            entry.paging = False
+        if server is not None:
+            server.close()
+        self._c_quarantined.add(1)
+        self._g_residents.set(self._num_residents())
+        logger.warning(
+            "zoo tenant %r QUARANTINED: %s", entry.tenant_id, reason
+        )
+        obs.flight.dump_flight_record(
+            f"zoo tenant {entry.tenant_id!r} quarantined: {reason}",
+            log=logger,
+        )
+        self._record_decision(
+            "quarantine", entry.tenant_id, reason=reason,
+            inputs={"fingerprint": entry.fingerprint},
+        )
+
+    # -- decision audit ----------------------------------------------------
+
+    def _record_decision(self, action, tenant, reason, ok=True,
+                         inputs=None, candidates=None) -> Dict[str, Any]:
+        decision = ZooDecision(
+            action=action, tenant=tenant, reason=reason, ok=ok,
+            t_s=round(self._now(), 6),
+            inputs=dict(inputs or {}),
+            candidates=list(candidates or []),
+        )
+        rec = decision.to_args()
+        with self._lock:
+            self._decisions.append(rec)
+            self.num_decisions += 1
+        self._c_decisions.add(1)
+        obs.event("zoo.decision", **rec)
+        obs.flight_note(
+            "zoo", f"{action}:{tenant}", ok=ok, reason=reason,
+        )
+        return rec
+
+    def decision_log(self) -> List[Dict[str, Any]]:
+        """The bounded in-memory audit trail (newest last)."""
+        with self._lock:
+            return list(self._decisions)
+
+    # -- observability -----------------------------------------------------
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._tenants)
+
+    def stats(self) -> Dict[str, Any]:
+        """The zoo summary block: per-tenant accounting + residency +
+        compact SLO verdicts, zoo-level paging counters, the decision
+        log tail, and ``accounting_ok`` — the per-tenant zero-silent-drop
+        claim (``offered == completed + rejected + failed + outstanding``
+        at the instant of the snapshot; exactly ``== completed +
+        rejected + failed`` once the plane is drained). ``bin/slo``
+        renders the tenant table from this shape."""
+        now = self._now()
+        per_tenant: Dict[str, Dict[str, Any]] = {}
+        accounting_ok = True
+        quarantined = 0
+        coldstart_failfast = 0
+        # Counter fields are mutated in single lock acquisitions at the
+        # front door (offered+outstanding together, resolution
+        # outstanding+outcome together), so the balance check must read
+        # them under the SAME lock — a half-observed submit would read
+        # as a spurious accounting violation.
+        with self._lock:
+            tenants = list(self._tenants.values())
+            decisions = list(self._decisions)
+            num_decisions = self.num_decisions
+            total_out = sum(t.outstanding for t in tenants)
+            total_w = sum(t.weight for t in tenants) or 1.0
+            for t in tenants:
+                balanced = (
+                    t.offered
+                    == t.completed + t.rejected + t.failed + t.outstanding
+                )
+                accounting_ok = accounting_ok and balanced
+                quarantined += int(t.quarantined)
+                coldstart_failfast += t.coldstart_failfast
+                block: Dict[str, Any] = {
+                    "resident": t.resident,
+                    "quarantined": t.quarantined,
+                    "weight": t.weight,
+                    "offered": t.offered,
+                    "completed": t.completed,
+                    "rejected": t.rejected,
+                    "failed": t.failed,
+                    "outstanding": t.outstanding,
+                    "coldstart_failfast": t.coldstart_failfast,
+                    "accounting_ok": balanced,
+                    "resident_bytes": t.resident_bytes,
+                    "paged_bytes": t.paged.nbytes if t.paged else None,
+                    "page_ins": t.page_ins,
+                    "page_outs": t.page_outs,
+                    "page_retries": t.page_retries,
+                    "last_used_age_s": round(
+                        max(now - t.last_used, 0.0), 6
+                    ),
+                    "guaranteed_share": max(1, int(
+                        self.max_outstanding_total * t.weight / total_w
+                    )),
+                    "admission_share": round(
+                        t.outstanding / total_out, 4
+                    ) if total_out else 0.0,
+                    "fingerprint": t.fingerprint,
+                }
+                if t.quarantine_reason:
+                    block["quarantine_reason"] = t.quarantine_reason
+                per_tenant[t.tenant_id] = block
+        # SLO verdicts OUTSIDE the zoo lock (each tracker takes its own
+        # lock and renders ledgers).
+        for t in tenants:
+            if t.slo is not None:
+                v = t.slo.verdict()
+                per_tenant[t.tenant_id]["slo"] = {
+                    "state": v["state"],
+                    "objectives": {
+                        name: {
+                            "state": o["state"],
+                            "burn_fast": o["burn_fast"],
+                            "burn_slow": o["burn_slow"],
+                            "budget_spent_fraction":
+                                o["budget_spent_fraction"],
+                        }
+                        for name, o in v["objectives"].items()
+                    },
+                }
+        return {
+            "num_tenants": len(tenants),
+            "residents": sum(1 for t in tenants if t.resident),
+            "budget_bytes": self.budget_bytes,
+            "resident_bytes": sum(
+                t.resident_bytes for t in tenants if t.resident
+            ),
+            "page_ins": int(self._c_page_ins.value),
+            "page_outs": int(self._c_page_outs.value),
+            "quarantined": quarantined,
+            "coldstart_failfast": coldstart_failfast,
+            "accounting_ok": accounting_ok,
+            "num_decisions": num_decisions,
+            "page_in_estimate_s": round(self.page_in_estimate_s(), 6),
+            "max_outstanding_total": self.max_outstanding_total,
+            "tenant_queue_cap": self.tenant_queue_cap,
+            "tenants": per_tenant,
+            "decisions": decisions[-64:],
+        }
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the zoo: every resident tenant's server closes
+        (in-flight batches complete, queued requests fail with
+        :class:`~keystone_tpu.serving.batcher.ServerClosed`).
+        Idempotent; paged copies are left in place."""
+        with self._page_lock:
+            with self._lock:
+                self._closed = True
+                servers = [
+                    t.server for t in self._tenants.values()
+                    if t.server is not None
+                ]
+            for s in servers:
+                s.close(timeout=timeout)
+
+    def __enter__(self) -> "ModelZoo":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -14,6 +14,11 @@ and renders the operator view of the live plane:
     snapshot carries an ``autoscale`` section (``run.py serve
     --autoscale``): replica count/bounds, scale counters, brownout
     state, and the audited decisions — action, reason, inputs;
+  - the per-tenant verdict table when the snapshot carries a ``zoo``
+    section (``run.py serve --tenants N``): per tenant — SLO state,
+    burn rates, budget spent, admission shares, residency and the
+    front-door accounting — beside the zoo paging summary and its
+    decision log;
   - a one-line serving summary when the snapshot carries a
     ``serving`` section (completed/rejected/failed + p99).
 
@@ -135,6 +140,60 @@ def render(doc: Dict[str, Any]) -> str:
                     f"replicas={inputs.get('replicas', '?')} "
                     f"queue={inputs.get('queue_depth', '?')}) — "
                     f"{d.get('reason', '')}"
+                )
+    zoo = doc.get("zoo") or {}
+    if zoo.get("tenants"):
+        lines.append("")
+        lines.append(
+            f"zoo: tenants={zoo.get('num_tenants', '?')} "
+            f"residents={zoo.get('residents', '?')} "
+            f"resident_bytes={zoo.get('resident_bytes', '?')}/"
+            f"{zoo.get('budget_bytes', '?')} "
+            f"page_ins={zoo.get('page_ins', 0)} "
+            f"page_outs={zoo.get('page_outs', 0)} "
+            f"quarantined={zoo.get('quarantined', 0)} "
+            f"coldstart_failfast={zoo.get('coldstart_failfast', 0)} "
+            f"accounting_ok={zoo.get('accounting_ok', '?')}"
+        )
+        lines.append(
+            f"  {'tenant':<12} {'state':<7} {'burn_fast':>9} "
+            f"{'burn_slow':>9} {'budget_spent':>12} {'share':>6} "
+            f"{'offered':>8} {'done':>8} {'rej':>6} {'fail':>5} "
+            f"{'residency':<10}"
+        )
+        for name, t in sorted(zoo["tenants"].items()):
+            slo_t = t.get("slo") or {}
+            objectives = slo_t.get("objectives") or {}
+            burn_fast = burn_slow = spent = None
+            for o in objectives.values():
+                if burn_fast is None or (o.get("burn_fast") or 0) > burn_fast:
+                    burn_fast = o.get("burn_fast")
+                    burn_slow = o.get("burn_slow")
+                    spent = o.get("budget_spent_fraction")
+            spent_s = f"{spent:.1%}" if isinstance(spent, (int, float)) \
+                else "?"
+            residency = (
+                "QUARANTINE" if t.get("quarantined")
+                else "resident" if t.get("resident") else "paged"
+            )
+            lines.append(
+                f"  {name:<12} {slo_t.get('state', '-'):<7} "
+                f"{_fmt_burn(burn_fast):>9} {_fmt_burn(burn_slow):>9} "
+                f"{spent_s:>12} "
+                f"{t.get('admission_share', 0):>6.2f} "
+                f"{t.get('offered', 0):>8} {t.get('completed', 0):>8} "
+                f"{t.get('rejected', 0):>6} {t.get('failed', 0):>5} "
+                f"{residency:<10}"
+            )
+        decisions = zoo.get("decisions") or []
+        if decisions:
+            lines.append("  paging decision log:")
+            for d in decisions:
+                ok = "" if d.get("ok", True) else " FAILED"
+                lines.append(
+                    f"    t+{d.get('t_s', 0):.3f}s "
+                    f"{d.get('action', '?')}:{d.get('tenant', '?')}{ok} "
+                    f"— {d.get('reason', '')}"
                 )
     serving = doc.get("serving") or {}
     if serving:

@@ -40,6 +40,18 @@ Instrumented sites (each site counts its own calls, 0-based):
                         (or inline for a synchronous spec), so chaos
                         tests can kill/fail/delay a snapshot while the
                         fold keeps running.
+  - ``serving.zoo.page_in`` — one paged-weight decode task on the model
+                        zoo's page lane (``serving/zoo.py``): error
+                        rules are absorbed by the zoo's bounded
+                        RetryPolicy (exhaustion quarantines the
+                        tenant), corrupt rules flip a byte of a stored
+                        weight plane — the per-tensor CRCs must catch
+                        it and quarantine, never serve.
+  - ``serving.zoo.page_out`` — one weight encode task on the zoo's
+                        page lane: an injected kill mid-encode must
+                        leave the previous RESIDENT copy authoritative
+                        (nothing is published until the encode
+                        completes).
 
 Activation is either lexical (``with plan.active():``) or ambient via
 the ``KEYSTONE_FAULT_PLAN`` env var (a JSON plan, or ``@/path/to.json``)
@@ -76,6 +88,8 @@ __all__ = [
     "SITE_REPLICA_SPAWN",
     "SITE_SERVING_EXECUTE",
     "SITE_SHARD_LOAD",
+    "SITE_ZOO_PAGE_IN",
+    "SITE_ZOO_PAGE_OUT",
     "active_plan",
     "corrupt_array",
     "install",
@@ -93,6 +107,8 @@ SITE_REPLICA_EXECUTE = "serving.replica.execute"
 SITE_REPLICA_SPAWN = "serving.replica.spawn"
 SITE_AUTOSCALE_SPAWN = "serving.autoscale.spawn"
 SITE_CHECKPOINT_WRITE = "checkpoint.write"
+SITE_ZOO_PAGE_IN = "serving.zoo.page_in"
+SITE_ZOO_PAGE_OUT = "serving.zoo.page_out"
 
 _KINDS = ("error", "corrupt", "latency")
 _EXC_TYPES: Dict[str, type] = {

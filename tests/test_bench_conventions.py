@@ -117,6 +117,14 @@ class TestEveryMetricUsesMakeRow:
         main_body = src[src.index("def main("):]
         assert "recovery_overhead_metric," in main_body
 
+    def test_zoo_isolation_row_registered(self):
+        bench = _load_bench()
+        assert callable(bench.serving_model_zoo_isolation_metric)
+        with open(_BENCH_PATH) as f:
+            src = f.read()
+        main_body = src[src.index("def main("):]
+        assert "serving_model_zoo_isolation_metric," in main_body
+
 
 class TestRooflineAuditability:
     """ISSUE 3 satellite: every row claiming an ``mfu`` or achieved-GB/s
@@ -410,3 +418,81 @@ class TestRooflineAuditability:
             {"controller": stats},
         )
         assert row["detail"]["controller"]["scale_ups"] == 0
+
+    def test_tenant_claims_require_num_tenants_and_offered(self):
+        """ISSUE 14 satellite: any dict carrying a ``tenants`` mapping
+        whose per-tenant blocks claim p99/SLO must carry a numeric
+        ``num_tenants`` in the SAME dict, and every per-tenant block a
+        numeric ``offered*`` field — a per-tenant isolation claim with
+        no tenant count and no per-tenant offered load is not a
+        measurement."""
+        bench = _load_bench()
+        good = {
+            "num_tenants": 2,
+            "tenants": {
+                "a": {"p99_latency_ms": 3.0, "num_samples": 100,
+                      "offered_rate_hz": 50.0},
+                "b": {"slo": {"state": "OK"},
+                      "offered": 120},
+            },
+        }
+        row = bench.make_row(
+            "zoo_probe", 1.0, "s", None, "open_loop_latency",
+            {"mix": good},
+        )
+        assert row["detail"]["mix"]["num_tenants"] == 2
+        # Missing num_tenants beside the tenants block.
+        d = {"tenants": good["tenants"]}
+        with pytest.raises(ValueError, match="num_tenants"):
+            bench.make_row(
+                "zoo_probe", 1.0, "s", None, "open_loop_latency",
+                {"mix": d},
+            )
+        # A per-tenant block with no numeric offered* field.
+        d = {
+            "num_tenants": 1,
+            "tenants": {
+                "a": {"p99_latency_ms": 3.0, "num_samples": 10,
+                      "offered_note": "lots"},
+            },
+        }
+        with pytest.raises(ValueError, match="offered"):
+            bench.make_row(
+                "zoo_probe", 1.0, "s", None, "open_loop_latency",
+                {"mix": d},
+            )
+        # The rule reaches any nesting depth (a legs list).
+        with pytest.raises(ValueError, match="num_tenants"):
+            bench.make_row(
+                "zoo_probe", 1.0, "s", None, "open_loop_latency",
+                {"legs": [{"tenants": {"a": {"slo": {"state": "OK"},
+                                             "offered": 5}}}]},
+            )
+        # Tenant maps with NO p99/SLO claims are not burdened.
+        bench.make_row(
+            "zoo_probe", 1.0, "s", None, "min_of_N_warm",
+            {"tenants": {"a": {"completed": 5}}},
+        )
+
+    def test_multi_tenant_report_passes_the_audit_as_is(self):
+        """The contract the rule states: MultiTenantLoadReport's row
+        dict drops into a row unmodified — num_tenants and per-tenant
+        offered rates ride with every per-tenant percentile."""
+        bench = _load_bench()
+        from keystone_tpu.serving import LoadReport, MultiTenantLoadReport
+
+        r = LoadReport(
+            offered_rate_hz=50.0, duration_s=1.0, num_offered=48,
+            completed=40, rejected=8, failed=0,
+            p50_latency_s=0.002, p99_latency_s=0.009,
+            mean_latency_s=0.003, achieved_qps=40.0,
+        )
+        report = MultiTenantLoadReport(
+            tenants={"a": r, "b": r}, duration_s=1.0
+        )
+        row = bench.make_row(
+            "zoo_probe", 1.0, "s", None, "open_loop_latency",
+            {"mix": report.to_row_dict()},
+        )
+        assert row["detail"]["mix"]["num_tenants"] == 2
+        assert row["detail"]["mix"]["accounting_ok"]

@@ -43,6 +43,8 @@ class TestPackageIsClean:
             "SITE_REPLICA_SPAWN": faults.SITE_REPLICA_SPAWN,
             "SITE_AUTOSCALE_SPAWN": faults.SITE_AUTOSCALE_SPAWN,
             "SITE_CHECKPOINT_WRITE": faults.SITE_CHECKPOINT_WRITE,
+            "SITE_ZOO_PAGE_IN": faults.SITE_ZOO_PAGE_IN,
+            "SITE_ZOO_PAGE_OUT": faults.SITE_ZOO_PAGE_OUT,
         }
 
     def test_every_registered_fault_site_is_exercised_by_tests(self):

@@ -1,0 +1,428 @@
+"""Multi-tenant model zoo (ISSUE 14 tentpole): paging round-trip
+bit-identity per fingerprint, LRU/cost eviction determinism, CRC
+bit-flip -> quarantine (never a wrong answer), deadline-bounded cold
+start, and deficit-weighted fair admission under skew."""
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.durable import ShardCorrupted
+from keystone_tpu.serving import (
+    ModelZoo,
+    ServerClosed,
+    ServerOverloaded,
+    TenantColdStart,
+    TenantQuarantined,
+    export_plan,
+)
+from keystone_tpu.serving.zoo import (
+    PagedWeights,
+    _decode_tensor,
+    _encode_tensor,
+)
+from keystone_tpu.utils import faults
+
+from tests._serving_util import TINY_D_IN, fit_tiny_mnist
+
+
+def _plan(seed=0, max_batch=8):
+    fitted, X = fit_tiny_mnist(seed=seed)
+    return export_plan(
+        fitted, np.zeros(TINY_D_IN, np.float32), max_batch=max_batch
+    ), X
+
+
+class TestPagedEncoding:
+    def test_f32_round_trip_is_bit_exact(self):
+        """General f32 values split into bf16-high + int16-low planes
+        and reassemble to the IDENTICAL bit pattern — paging is never
+        allowed to quantize a weight."""
+        rng = np.random.default_rng(0)
+        arr = rng.normal(size=(37, 5)).astype(np.float32)
+        pt = _encode_tensor(arr)
+        assert pt.lo is not None  # dense mantissas need both planes
+        out = _decode_tensor(pt, faults.SITE_ZOO_PAGE_IN)
+        assert out.dtype == np.float32
+        assert np.array_equal(
+            out.view(np.uint32), arr.view(np.uint32)
+        )
+
+    def test_bf16_representable_drops_low_plane(self):
+        """Weights already bf16-representable (the PR-8 drift policy's
+        exact class) store ONLY the high plane — 2 B/elem, the
+        compressed win — and still round-trip exactly."""
+        arr = np.asarray([1.0, -2.0, 0.5, 0.0, 1024.0], np.float32)
+        pt = _encode_tensor(arr)
+        assert pt.lo is None
+        assert pt.nbytes == arr.size * 2
+        assert np.array_equal(
+            _decode_tensor(pt, faults.SITE_ZOO_PAGE_IN), arr
+        )
+
+    def test_non_f32_rides_raw_bytes(self):
+        arr = np.arange(12, dtype=np.int32).reshape(3, 4)
+        pt = _encode_tensor(arr)
+        assert pt.raw is not None
+        assert np.array_equal(
+            _decode_tensor(pt, faults.SITE_ZOO_PAGE_IN), arr
+        )
+
+    def test_bit_flip_raises_shard_corrupted(self):
+        """A flipped byte in a stored plane fails the per-tensor CRC at
+        decode — the named persistent error the retry layer never
+        retries."""
+        arr = np.linspace(0.0, 1.0, 16, dtype=np.float32)
+        pt = _encode_tensor(arr)
+        pt.hi.view(np.uint8)[3] ^= 0xFF
+        with pytest.raises(ShardCorrupted, match="checksum"):
+            _decode_tensor(pt, faults.SITE_ZOO_PAGE_IN)
+
+    def test_paged_weights_nbytes(self):
+        a = np.ones(8, np.float32)           # bf16-exact: 16 B
+        b = np.full(8, 1.1, np.float32)      # dense mantissa: 32 B
+        pw = PagedWeights(
+            [_encode_tensor(a), _encode_tensor(b)],
+            decoded_bytes=a.nbytes + b.nbytes,
+        )
+        assert pw.nbytes == 16 + 32
+        assert pw.decoded_bytes == 64
+
+
+class TestPagingRoundTrip:
+    def test_round_trip_bit_identity_per_fingerprint(self):
+        """Page out, page back in: the rebuilt plan's fingerprint (which
+        covers weight content CRCs) MATCHES the registered one, and the
+        served bits match the pre-paging response exactly."""
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            fp = zoo.add_tenant("a", plan)
+            before = np.asarray(zoo.submit("a", X[0]).result(timeout=30))
+            zoo.page_out("a")
+            st = zoo.stats()["tenants"]["a"]
+            assert not st["resident"]
+            assert st["paged_bytes"] is not None and st["paged_bytes"] > 0
+            after = np.asarray(zoo.submit("a", X[0]).result(timeout=30))
+            assert np.array_equal(before, after)
+            st = zoo.stats()["tenants"]["a"]
+            assert st["resident"]
+            assert st["fingerprint"] == fp
+            assert st["page_ins"] == 1 and st["page_outs"] == 1
+        finally:
+            zoo.close()
+
+    def test_paging_decisions_are_audited(self):
+        plan, X = _plan(seed=1)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            zoo.add_tenant("a", plan)
+            zoo.page_out("a")
+            zoo.page_in("a")
+            actions = [
+                (d["action"], d["tenant"]) for d in zoo.decision_log()
+            ]
+            assert ("page_out", "a") in actions
+            assert ("page_in", "a") in actions
+            assert zoo.stats()["num_decisions"] >= 2
+            # The registry mirrors the counters the decisions claim.
+            snap = zoo.metrics.snapshot()
+            assert snap["zoo.page_ins"] == 1
+            assert snap["zoo.page_outs"] == 1
+        finally:
+            zoo.close()
+
+    def test_shared_operator_objects_rejected(self):
+        """Two tenants must never share operator objects — paging one
+        would null the other's weights mid-serve."""
+        plan, X = _plan(seed=2)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            zoo.add_tenant("a", plan)
+            with pytest.raises(ValueError, match="shares operator"):
+                zoo.add_tenant("b", plan)
+        finally:
+            zoo.close()
+
+
+class TestEviction:
+    def _zoo_of_three(self, budget_tenants=2):
+        plans = [_plan(seed=s) for s in range(3)]
+        per = max(plans[0][0].pinned_bytes, 1)
+        zoo = ModelZoo(
+            budget_bytes=budget_tenants * per + budget_tenants,
+            max_batch=8, cold_start_estimate_s=0.0,
+        )
+        for i, (p, _) in enumerate(plans):
+            zoo.add_tenant(f"t{i}", p, resident_bytes=per)
+        return zoo, plans
+
+    def test_lru_eviction_is_deterministic(self):
+        """Budget fits two of three equal-cost tenants: registration
+        order makes t0 the LRU victim when t2 arrives; touching t1 then
+        faulting t0 back in evicts t2 — recency alone decides when cost
+        and SLO pressure are equal, ties on tenant id."""
+        zoo, plans = self._zoo_of_three()
+        try:
+            st = zoo.stats()["tenants"]
+            assert not st["t0"]["resident"]  # evicted by t2's arrival
+            assert st["t1"]["resident"] and st["t2"]["resident"]
+            zoo.submit("t1", plans[1][1][0]).result(timeout=30)
+            zoo.submit("t0", plans[0][1][0]).result(timeout=30)
+            st = zoo.stats()["tenants"]
+            assert st["t0"]["resident"] and st["t1"]["resident"]
+            assert not st["t2"]["resident"]
+            evicts = [
+                d for d in zoo.decision_log() if d["action"] == "evict"
+            ]
+            assert [d["tenant"] for d in evicts] == ["t0", "t2"]
+        finally:
+            zoo.close()
+
+    def test_evict_decision_carries_scored_candidates(self):
+        zoo, plans = self._zoo_of_three()
+        try:
+            evict = next(
+                d for d in zoo.decision_log() if d["action"] == "evict"
+            )
+            assert evict["inputs"]["budget_bytes"] == zoo.budget_bytes
+            cands = evict["candidates"]
+            assert cands and all(
+                {"tenant", "age_s", "page_in_cost_s", "slo_state",
+                 "slo_pressure", "score"} <= set(c) for c in cands
+            )
+            # Winner is the top-scored candidate.
+            assert evict["tenant"] == cands[0]["tenant"]
+        finally:
+            zoo.close()
+
+    def test_single_tenant_over_budget_rejected_at_add(self):
+        plan, _ = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=64, max_batch=8)
+        try:
+            with pytest.raises(ValueError, match="never be paged in"):
+                zoo.add_tenant("huge", plan, resident_bytes=1 << 20)
+        finally:
+            zoo.close()
+
+
+class TestColdStart:
+    def test_deadline_bounded_cold_start_fast_fails(self):
+        """A paged-out tenant + a deadline the page-in estimate cannot
+        meet -> the NAMED TenantColdStart, counted as a rejection and a
+        coldstart_failfast — never a request wedged behind a rebuild."""
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(
+            budget_bytes=10 * max(plan.pinned_bytes, 1),
+            max_batch=8, cold_start_estimate_s=30.0,
+        )
+        try:
+            zoo.add_tenant("a", plan, resident=False)
+            with pytest.raises(TenantColdStart, match="deadline"):
+                zoo.submit("a", X[0], deadline_ms=1.0)
+            st = zoo.stats()
+            assert st["coldstart_failfast"] == 1
+            assert st["tenants"]["a"]["rejected"] == 1
+            assert st["accounting_ok"]
+            # TenantColdStart IS a ServerOverloaded: load tooling
+            # classifies it as a rejection with no special-casing.
+            assert issubclass(TenantColdStart, ServerOverloaded)
+        finally:
+            zoo.close()
+
+    def test_no_deadline_pays_the_cold_start(self):
+        plan, X = _plan(seed=1)
+        zoo = ModelZoo(
+            budget_bytes=10 * max(plan.pinned_bytes, 1),
+            max_batch=8, cold_start_estimate_s=30.0,
+        )
+        try:
+            zoo.add_tenant("a", plan, resident=False)
+            out = np.asarray(zoo.submit("a", X[0]).result(timeout=60))
+            assert out.shape[-1] == 10
+            st = zoo.stats()["tenants"]["a"]
+            assert st["resident"] and st["page_ins"] == 1
+        finally:
+            zoo.close()
+
+    def test_estimate_becomes_measured_after_first_page_in(self):
+        plan, X = _plan(seed=2)
+        zoo = ModelZoo(
+            budget_bytes=10 * max(plan.pinned_bytes, 1),
+            max_batch=8, cold_start_estimate_s=123.0,
+        )
+        try:
+            assert zoo.page_in_estimate_s() == 123.0
+            zoo.add_tenant("a", plan, resident=False)
+            zoo.page_in("a")
+            assert zoo.page_in_estimate_s() < 60.0  # measured, not seed
+        finally:
+            zoo.close()
+
+
+class TestQuarantine:
+    def test_crc_bit_flip_quarantines_not_wrong_answer(self):
+        """Flip one byte of a paged-out weight plane: the page-in CRC
+        catches it, the tenant quarantines LOUDLY (metric + decision),
+        no response is ever served from the corrupt copy, and other
+        tenants keep serving."""
+        p0, X0 = _plan(seed=0)
+        p1, X1 = _plan(seed=1)
+        zoo = ModelZoo(budget_bytes=10 * max(p0.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            zoo.add_tenant("a", p0)
+            zoo.add_tenant("b", p1)
+            zoo.page_out("a")
+            paged = zoo._tenants["a"].paged
+            plane = next(
+                t.hi if t.hi is not None else t.raw
+                for t in paged.tensors
+            )
+            plane.view(np.uint8)[0] ^= 0xFF
+            with pytest.raises(TenantQuarantined):
+                zoo.submit("a", X0[0])
+            st = zoo.stats()
+            assert st["quarantined"] == 1
+            assert st["tenants"]["a"]["quarantined"]
+            assert "CRC" in st["tenants"]["a"]["quarantine_reason"]
+            assert zoo.metrics.snapshot()["zoo.quarantined"] == 1
+            assert any(
+                d["action"] == "quarantine" and d["tenant"] == "a"
+                for d in zoo.decision_log()
+            )
+            # Isolation: tenant b is untouched.
+            zoo.submit("b", X1[0]).result(timeout=30)
+            # And every later submit to a fast-fails, still accounted.
+            with pytest.raises(TenantQuarantined):
+                zoo.submit("a", X0[0])
+            assert zoo.stats()["accounting_ok"]
+        finally:
+            zoo.close()
+
+
+class TestFairAdmission:
+    def _two_tenant_zoo(self, **kw):
+        p0, X0 = _plan(seed=0)
+        p1, X1 = _plan(seed=1)
+        kw.setdefault("budget_bytes", 10 * max(p0.pinned_bytes, 1))
+        kw.setdefault("max_batch", 64)
+        # A wide coalescing window keeps submitted requests QUEUED so
+        # outstanding counts are deterministic while the test asserts
+        # admission outcomes.
+        kw.setdefault("max_wait_ms", 500.0)
+        zoo = ModelZoo(**kw)
+        zoo.add_tenant("cold", p0)
+        zoo.add_tenant("hot", p1)
+        return zoo, X0, X1
+
+    def test_hot_tenant_overflow_rejected_cold_tenant_admits(self):
+        """The WFQ floor: with the global pool full of the hot tenant's
+        load, the hot tenant's NEXT request is rejected at its own door
+        while the cold tenant (under its guaranteed share) still
+        admits."""
+        zoo, X0, X1 = self._two_tenant_zoo(
+            max_outstanding_total=4, tenant_queue_cap=100,
+        )
+        try:
+            assert zoo.guaranteed_share("hot") == 2
+            futs = [zoo.submit("hot", X1[0]) for _ in range(4)]
+            with pytest.raises(ServerOverloaded, match="fair admission"):
+                zoo.submit("hot", X1[0])
+            # The cold tenant's guaranteed share is untouched.
+            f_cold = zoo.submit("cold", X0[0])
+            for f in futs + [f_cold]:
+                f.result(timeout=30)
+            st = zoo.stats()
+            assert st["tenants"]["hot"]["rejected"] == 1
+            assert st["tenants"]["cold"]["rejected"] == 0
+            assert st["accounting_ok"]
+        finally:
+            zoo.close()
+
+    def test_per_tenant_queue_cap(self):
+        zoo, X0, X1 = self._two_tenant_zoo(
+            max_outstanding_total=1000, tenant_queue_cap=2,
+        )
+        try:
+            futs = [zoo.submit("hot", X1[0]) for _ in range(2)]
+            with pytest.raises(ServerOverloaded, match="queue cap"):
+                zoo.submit("hot", X1[0])
+            for f in futs:
+                f.result(timeout=30)
+        finally:
+            zoo.close()
+
+    def test_weighted_shares(self):
+        p0, X0 = _plan(seed=0)
+        p1, _ = _plan(seed=1)
+        zoo = ModelZoo(
+            budget_bytes=10 * max(p0.pinned_bytes, 1),
+            max_outstanding_total=30, max_batch=8,
+        )
+        try:
+            zoo.add_tenant("big", p0, weight=2.0)
+            zoo.add_tenant("small", p1, weight=1.0)
+            assert zoo.guaranteed_share("big") == 20
+            assert zoo.guaranteed_share("small") == 10
+        finally:
+            zoo.close()
+
+
+class TestAccountingAndLifecycle:
+    def test_offered_equals_outcomes_per_tenant(self):
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            zoo.add_tenant("a", plan)
+            futs = [zoo.submit("a", X[i % len(X)]) for i in range(20)]
+            for f in futs:
+                f.result(timeout=30)
+            st = zoo.stats()["tenants"]["a"]
+            assert st["offered"] == 20
+            assert (
+                st["completed"] + st["rejected"] + st["failed"] == 20
+            )
+            assert st["outstanding"] == 0
+            assert st["accounting_ok"]
+        finally:
+            zoo.close()
+
+    def test_futures_carry_tenant_and_fingerprint(self):
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            fp = zoo.add_tenant("a", plan)
+            fut = zoo.submit("a", X[0])
+            fut.result(timeout=30)
+            assert fut.tenant == "a"
+            assert fut.plan_fingerprint == fp
+        finally:
+            zoo.close()
+
+    def test_unknown_tenant_raises(self):
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        try:
+            zoo.add_tenant("a", plan)
+            with pytest.raises(ValueError, match="unknown tenant"):
+                zoo.submit("nope", X[0])
+        finally:
+            zoo.close()
+
+    def test_close_is_idempotent_and_poisons_submit(self):
+        plan, X = _plan(seed=0)
+        zoo = ModelZoo(budget_bytes=10 * max(plan.pinned_bytes, 1),
+                       max_batch=8)
+        zoo.add_tenant("a", plan)
+        zoo.close()
+        zoo.close()
+        with pytest.raises(ServerClosed):
+            zoo.submit("a", X[0])
+        with pytest.raises(ServerClosed):
+            zoo.add_tenant("b", plan)
