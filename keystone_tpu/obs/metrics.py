@@ -46,6 +46,11 @@ __all__ = [
     "METRIC_EXPORTER_ERRORS",
     "METRIC_EXPORTER_PUBLISHES",
     "METRIC_EXPORTER_PUBLISH_S",
+    "METRIC_LIFECYCLE_CANARY_PROMOTIONS",
+    "METRIC_LIFECYCLE_PUBLISHED",
+    "METRIC_LIFECYCLE_REJECTED",
+    "METRIC_LIFECYCLE_ROLLBACKS",
+    "METRIC_LIFECYCLE_STALENESS_S",
     "METRIC_PREFETCH_BACKOFF_S",
     "METRIC_PREFETCH_LOAD_S",
     "METRIC_PREFETCH_RETRIES",
@@ -74,6 +79,8 @@ __all__ = [
     "METRIC_TENANT_FAILED",
     "METRIC_TENANT_OFFERED",
     "METRIC_TENANT_REJECTED",
+    "METRIC_TRAINER_RESUMES",
+    "METRIC_TRAINER_SEGMENTS_FIT",
     "METRIC_ZOO_DECISIONS",
     "METRIC_ZOO_PAGE_INS",
     "METRIC_ZOO_PAGE_OUTS",
@@ -158,6 +165,21 @@ METRIC_TENANT_COMPLETED = "tenant.completed"
 METRIC_TENANT_REJECTED = "tenant.rejected"
 METRIC_TENANT_FAILED = "tenant.failed"
 METRIC_TENANT_COLDSTART_FAILFAST = "tenant.coldstart_failfast"
+
+# Continuous-learning control plane (serving/lifecycle.py +
+# learning/continuous.py) — the publication path's own accounting:
+# candidates published/rejected at the validation gate, canary
+# promotions vs rollbacks (canary OR post-promotion SLO-attributed),
+# and the model-staleness clock (newest covered shard arrival -> first
+# response served under the covering fingerprint). The trainer counters
+# ride beside them: segments folded and checkpoint resumes.
+METRIC_LIFECYCLE_PUBLISHED = "lifecycle.published"
+METRIC_LIFECYCLE_REJECTED = "lifecycle.rejected"
+METRIC_LIFECYCLE_ROLLBACKS = "lifecycle.rollbacks"
+METRIC_LIFECYCLE_CANARY_PROMOTIONS = "lifecycle.canary_promotions"
+METRIC_LIFECYCLE_STALENESS_S = "lifecycle.staleness_s"
+METRIC_TRAINER_SEGMENTS_FIT = "trainer.segments_fit"
+METRIC_TRAINER_RESUMES = "trainer.resumes"
 
 
 class Counter:

@@ -42,6 +42,7 @@ from .batcher import (
     ServerOverloaded,
 )
 from .export import BatchInfo, ExportedPlan, export_plan, plan_fingerprint
+from .lifecycle import LifecycleController, LifecycleDecision
 from .loadgen import (
     LoadReport,
     MultiTenantLoadReport,
@@ -65,6 +66,8 @@ __all__ = [
     "BROWNOUT_STEPS",
     "BatchInfo",
     "ExportedPlan",
+    "LifecycleController",
+    "LifecycleDecision",
     "LoadReport",
     "MicroBatchServer",
     "ModelZoo",

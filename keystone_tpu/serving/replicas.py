@@ -271,6 +271,13 @@ class ReplicatedServer:
         self.replicas_added = 0
         self.replicas_removed = 0
         self.brownout_rejected = 0
+        # First-completion clock per plan fingerprint (monotonic stamp
+        # of the first successfully served response under each plan
+        # version) — the serving-side half of the lifecycle plane's
+        # model-staleness measurement (shard arrival -> first response
+        # under the covering fingerprint). Stamped in the done-callback,
+        # so it is exact, not a poll-granularity estimate.
+        self._first_completed: Dict[str, float] = {}
         self.metrics = obs.MetricsRegistry()
         self._latencies = self.metrics.bucketed_histogram(
             METRIC_SERVING_LATENCY_S
@@ -464,11 +471,23 @@ class ReplicatedServer:
                     rep.outstanding -= 1
                 return
             lat = t_done - t_sub
+            fp = getattr(fut, "plan_fingerprint", None)
             with self._lock:
                 rep.outstanding -= 1
                 if exc is None:
                     self.completed += 1
                     self._latencies.observe(lat)
+                    if fp is not None and fp not in self._first_completed:
+                        self._first_completed[fp] = time.monotonic()
+                        # Bounded: one entry per plan version EVER
+                        # served would grow forever under a continuous
+                        # trainer; the staleness consumer settles each
+                        # fingerprint within one publication cycle, so
+                        # retiring the oldest entries is safe.
+                        while len(self._first_completed) > 256:
+                            self._first_completed.pop(
+                                next(iter(self._first_completed))
+                            )
                 elif isinstance(exc, ServerOverloaded):
                     self.rejected += 1
                 else:
@@ -666,68 +685,114 @@ class ReplicatedServer:
                         "reason": "evicted",
                     })
                     continue
-                new_plan = factory(rep.index)
-                self._check_signature(new_plan)
-                new_plan.warm()  # warm BEFORE taking capacity out
-                # Take lifecycle ownership: wait out a watchdog restart
-                # already replacing this replica's server generation.
-                own_deadline = time.perf_counter() + timeout
-                while True:
-                    with self._lock:
-                        if self._closed:
-                            raise ServerClosed("swap_plan() after close()")
-                        if rep.evicted:
-                            break
-                        if not rep.busy:
-                            rep.busy = True
-                            rep.out_of_rotation = True
-                            break
-                    if time.perf_counter() >= own_deadline:
-                        raise TimeoutError(
-                            f"replica {rep.index} is mid-restart and did "
-                            f"not settle within {timeout:.3g}s"
-                        )
-                    time.sleep(0.005)
-                if rep.evicted:  # evicted while we waited
-                    report.append({
-                        "replica": rep.index, "swapped": False,
-                        "reason": "evicted",
-                    })
-                    continue
-                try:
-                    try:
-                        t0 = time.perf_counter()
-                        self._drain(rep, timeout)
-                        drain_s = time.perf_counter() - t0
-                    except BaseException:
-                        with self._lock:  # zero-drop: old plan keeps serving
-                            rep.out_of_rotation = False
-                        raise
-                    old_fp = rep.server.plan.fingerprint
-                    self._retire_server(rep.server)
-                    rep.server.close()
-                    if not self._try_spawn(rep, new_plan,
-                                           count_restart=False):
-                        report.append({
-                            "replica": rep.index, "swapped": False,
-                            "reason": "spawn failed; replica evicted",
-                            "old_fingerprint": old_fp,
-                        })
-                        continue
-                    with self._lock:
-                        rep.out_of_rotation = False
-                    report.append({
-                        "replica": rep.index, "swapped": True,
-                        "old_fingerprint": old_fp,
-                        "new_fingerprint": new_plan.fingerprint,
-                        "drain_s": round(drain_s, 6),
-                    })
-                finally:
-                    with self._lock:
-                        rep.busy = False
+                report.append(self._swap_one(rep, factory(rep.index),
+                                             timeout))
             with self._lock:
                 self.swaps_completed += 1
             return {"replicas": report}
+
+    def _swap_one(self, rep: _Replica, new_plan: ExportedPlan,
+                  timeout: float) -> Dict[str, Any]:
+        """The per-replica swap protocol (swap_plan docstring steps 1-4):
+        warm, take lifecycle ownership, drain to zero, close the old
+        generation, spawn the new one. Caller holds the SWAP lock.
+        Returns the replica's swap-report dict."""
+        self._check_signature(new_plan)
+        new_plan.warm()  # warm BEFORE taking capacity out
+        # Take lifecycle ownership: wait out a watchdog restart
+        # already replacing this replica's server generation.
+        own_deadline = time.perf_counter() + timeout
+        while True:
+            with self._lock:
+                if self._closed:
+                    raise ServerClosed("swap_plan() after close()")
+                if rep.evicted:
+                    break
+                if not rep.busy:
+                    rep.busy = True
+                    rep.out_of_rotation = True
+                    break
+            if time.perf_counter() >= own_deadline:
+                raise TimeoutError(
+                    f"replica {rep.index} is mid-restart and did "
+                    f"not settle within {timeout:.3g}s"
+                )
+            time.sleep(0.005)
+        if rep.evicted:  # evicted while we waited
+            return {
+                "replica": rep.index, "swapped": False,
+                "reason": "evicted",
+            }
+        try:
+            try:
+                t0 = time.perf_counter()
+                self._drain(rep, timeout)
+                drain_s = time.perf_counter() - t0
+            except BaseException:
+                with self._lock:  # zero-drop: old plan keeps serving
+                    rep.out_of_rotation = False
+                raise
+            old_fp = rep.server.plan.fingerprint
+            self._retire_server(rep.server)
+            rep.server.close()
+            if not self._try_spawn(rep, new_plan, count_restart=False):
+                return {
+                    "replica": rep.index, "swapped": False,
+                    "reason": "spawn failed; replica evicted",
+                    "old_fingerprint": old_fp,
+                }
+            with self._lock:
+                rep.out_of_rotation = False
+            return {
+                "replica": rep.index, "swapped": True,
+                "old_fingerprint": old_fp,
+                "new_fingerprint": new_plan.fingerprint,
+                "drain_s": round(drain_s, 6),
+            }
+        finally:
+            with self._lock:
+                rep.busy = False
+
+    def swap_replica_plan(
+        self,
+        index: int,
+        new: Union[ExportedPlan, Any],
+        drain_timeout_s: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Hot-swap ONE replica onto a new plan version — the canary
+        primitive the lifecycle controller drives: a passing candidate
+        is swapped into a single replica first, compared against the
+        incumbent replicas over a sustain window, then promoted
+        (:meth:`swap_plan`) or swapped back. Same zero-drop drain
+        protocol as the full rollout, per replica; the plane serves
+        MIXED fingerprints while a canary is live (each worker
+        generation still serves exactly one version — no mixed batch
+        ever exists, and every response still names its version).
+
+        ``new`` is an :class:`ExportedPlan` or a ``FittedPipeline``
+        (exported at the plane's signature/buckets). Raises
+        :class:`ValueError` for an unknown/evicted index; serialized
+        against :meth:`swap_plan` and elasticity on the swap lock."""
+        timeout = (self.drain_timeout_s if drain_timeout_s is None
+                   else float(drain_timeout_s))
+        if isinstance(new, (list, tuple)):
+            raise TypeError(
+                "swap_replica_plan swaps ONE replica — pass a single "
+                "ExportedPlan or FittedPipeline, not a sequence"
+            )
+        with self._swap_lock:
+            plan = self._resolve_swap_plans(new)(index)
+            with self._lock:
+                rep = next(
+                    (r for r in self._replicas
+                     if r.index == index and not r.evicted), None,
+                )
+            if rep is None:
+                raise ValueError(
+                    f"swap_replica_plan: no live replica with index "
+                    f"{index}"
+                )
+            return self._swap_one(rep, plan, timeout)
 
     def _resolve_swap_plans(self, new) -> Callable[[int], ExportedPlan]:
         # A freshly fitted pipeline: export with the current signature so
@@ -1023,6 +1088,25 @@ class ReplicatedServer:
         }
 
     # -- observability -----------------------------------------------------
+
+    def live_replica_indices(self) -> List[int]:
+        """Sorted indices of live, in-rotation replicas — the canary
+        picker's view (the lifecycle controller swaps the lowest live
+        index first so canary attribution is deterministic)."""
+        with self._lock:
+            return sorted(
+                r.index for r in self._replicas
+                if not r.evicted and not r.out_of_rotation
+            )
+
+    def first_completion_times(self) -> Dict[str, float]:
+        """``{plan_fingerprint: monotonic stamp}`` of the FIRST response
+        successfully served under each plan version this plane has ever
+        run — the serving half of the lifecycle plane's model-staleness
+        clock. Survives restarts and swaps (stamped at the front-door
+        future, like the plane counters)."""
+        with self._lock:
+            return dict(self._first_completed)
 
     def _retire_server(self, server: MicroBatchServer) -> None:
         """Fold a closing server generation's counters into the plane's

@@ -14,6 +14,12 @@ and renders the operator view of the live plane:
     snapshot carries an ``autoscale`` section (``run.py serve
     --autoscale``): replica count/bounds, scale counters, brownout
     state, and the audited decisions — action, reason, inputs;
+  - the lifecycle publication summary + decision log when the snapshot
+    carries a ``lifecycle`` section (``run.py learn``): candidates
+    published/rejected/rolled back, canary promotions, the current
+    model staleness beside the incumbent fingerprint, and the audited
+    publication decisions — plus the trainer's fold/resume counters
+    from the ``trainer`` section;
   - the per-tenant verdict table when the snapshot carries a ``zoo``
     section (``run.py serve --tenants N``): per tenant — SLO state,
     burn rates, budget spent, admission shares, residency and the
@@ -141,6 +147,46 @@ def render(doc: Dict[str, Any]) -> str:
                     f"queue={inputs.get('queue_depth', '?')}) — "
                     f"{d.get('reason', '')}"
                 )
+    lifecycle = doc.get("lifecycle") or {}
+    if lifecycle:
+        stale = lifecycle.get("staleness_s")
+        stale_s = f"{stale:.3f}s" if isinstance(stale, (int, float)) \
+            else "-"
+        med = lifecycle.get("staleness_median_s")
+        med_s = f"{med:.3f}s" if isinstance(med, (int, float)) else "-"
+        lines.append("")
+        lines.append(
+            f"lifecycle: published={lifecycle.get('published', 0)} "
+            f"rejected={lifecycle.get('rejected', 0)} "
+            f"rollbacks={lifecycle.get('rollbacks', 0)} "
+            f"canary_promotions={lifecycle.get('canary_promotions', 0)} "
+            f"staleness={stale_s} (median {med_s}, "
+            f"n={lifecycle.get('staleness_num_samples', 0)}) "
+            f"incumbent={lifecycle.get('incumbent_fingerprint', '?')}"
+            + (" [attribution window OPEN]"
+               if lifecycle.get("attribution_open") else "")
+        )
+        decisions = lifecycle.get("decisions") or []
+        if decisions:
+            lines.append("  publication decision log:")
+            for d in decisions:
+                ok = "" if d.get("ok", True) else " FAILED"
+                lines.append(
+                    f"    t+{d.get('t_s', 0):.3f}s "
+                    f"{d.get('action', '?')}:"
+                    f"{d.get('fingerprint') or '<unexported>'}{ok} "
+                    f"— {d.get('reason', '')}"
+                )
+    trainer = doc.get("trainer") or {}
+    if trainer:
+        lines.append(
+            f"trainer: segments_fit={trainer.get('segments_fit', 0)}/"
+            f"{trainer.get('num_segments', '?')} "
+            f"resumes={trainer.get('resumes', 0)} "
+            f"publishes={trainer.get('publishes', 0)}"
+            + (f" ERROR={trainer['error']}"
+               if trainer.get("error") else "")
+        )
     zoo = doc.get("zoo") or {}
     if zoo.get("tenants"):
         lines.append("")

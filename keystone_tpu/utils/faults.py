@@ -52,6 +52,23 @@ Instrumented sites (each site counts its own calls, 0-based):
                         leave the previous RESIDENT copy authoritative
                         (nothing is published until the encode
                         completes).
+  - ``trainer.fit``    — one segment fold inside the continuous
+                        trainer's incremental re-fit loop
+                        (``learning/continuous.py``): an injected error
+                        kills the trainer mid-fit — the chaos suite
+                        proves a restarted trainer resumes from its
+                        checkpoint BIT-IDENTICALLY and still publishes.
+  - ``lifecycle.validate`` — one candidate validation pass in the
+                        publication gate (``serving/lifecycle.py``): an
+                        injected error is a gate-infrastructure failure
+                        — the candidate is rejected loudly (audited,
+                        ``ok=False``) and the serving plane is never
+                        touched.
+  - ``lifecycle.publish`` — one canary/promotion swap attempt in the
+                        lifecycle controller: an injected error fails
+                        the publication loudly while the incumbent plan
+                        keeps serving (zero-drop — the swap machinery
+                        re-enters the old plan on failure).
 
 Activation is either lexical (``with plan.active():``) or ambient via
 the ``KEYSTONE_FAULT_PLAN`` env var (a JSON plan, or ``@/path/to.json``)
@@ -83,11 +100,14 @@ __all__ = [
     "RetryPolicy",
     "SITE_AUTOSCALE_SPAWN",
     "SITE_CHECKPOINT_WRITE",
+    "SITE_LIFECYCLE_PUBLISH",
+    "SITE_LIFECYCLE_VALIDATE",
     "SITE_PREFETCH_READ",
     "SITE_REPLICA_EXECUTE",
     "SITE_REPLICA_SPAWN",
     "SITE_SERVING_EXECUTE",
     "SITE_SHARD_LOAD",
+    "SITE_TRAINER_FIT",
     "SITE_ZOO_PAGE_IN",
     "SITE_ZOO_PAGE_OUT",
     "active_plan",
@@ -109,6 +129,9 @@ SITE_AUTOSCALE_SPAWN = "serving.autoscale.spawn"
 SITE_CHECKPOINT_WRITE = "checkpoint.write"
 SITE_ZOO_PAGE_IN = "serving.zoo.page_in"
 SITE_ZOO_PAGE_OUT = "serving.zoo.page_out"
+SITE_TRAINER_FIT = "trainer.fit"
+SITE_LIFECYCLE_VALIDATE = "lifecycle.validate"
+SITE_LIFECYCLE_PUBLISH = "lifecycle.publish"
 
 _KINDS = ("error", "corrupt", "latency")
 _EXC_TYPES: Dict[str, type] = {

@@ -125,6 +125,14 @@ class TestEveryMetricUsesMakeRow:
         main_body = src[src.index("def main("):]
         assert "serving_model_zoo_isolation_metric," in main_body
 
+    def test_continuous_learning_row_registered(self):
+        bench = _load_bench()
+        assert callable(bench.continuous_learning_staleness_metric)
+        with open(_BENCH_PATH) as f:
+            src = f.read()
+        main_body = src[src.index("def main("):]
+        assert "continuous_learning_staleness_metric," in main_body
+
 
 class TestRooflineAuditability:
     """ISSUE 3 satellite: every row claiming an ``mfu`` or achieved-GB/s
@@ -496,3 +504,74 @@ class TestRooflineAuditability:
         )
         assert row["detail"]["mix"]["num_tenants"] == 2
         assert row["detail"]["mix"]["accounting_ok"]
+
+    # -- the continuous-learning rule (ISSUE 15 satellite) -----------------
+
+    def test_staleness_claims_require_num_published_and_offered(self):
+        """Any dict claiming ``staleness*`` must carry a numeric
+        ``num_published`` AND a numeric ``offered*`` rate in the SAME
+        dict — a staleness claim with no publication count and no
+        offered load is not a continuous-learning measurement."""
+        bench = _load_bench()
+        bare = {"staleness_median_s": 0.2}
+        with pytest.raises(ValueError, match="num_published"):
+            bench.make_row("cl_probe", 0.2, "s", None,
+                           "open_loop_latency", dict(bare))
+        with_pub = {**bare, "num_published": 4}
+        with pytest.raises(ValueError, match="offered"):
+            bench.make_row("cl_probe", 0.2, "s", None,
+                           "open_loop_latency", dict(with_pub))
+        ok = {**with_pub, "offered_rate_hz": 250.0}
+        row = bench.make_row("cl_probe", 0.2, "s", None,
+                             "open_loop_latency", dict(ok))
+        assert row["detail"]["num_published"] == 4
+
+    def test_rollbacks_claim_requires_num_published_and_offered(self):
+        bench = _load_bench()
+        with pytest.raises(ValueError, match="rollbacks"):
+            bench.make_row(
+                "cl_probe", 0.2, "s", None, "open_loop_latency",
+                {"rollbacks": 1, "num_published": 3},
+            )
+        row = bench.make_row(
+            "cl_probe", 0.2, "s", None, "open_loop_latency",
+            {"rollbacks": 1, "num_published": 3,
+             "offered_rate_hz": 100.0},
+        )
+        assert row["detail"]["rollbacks"] == 1
+
+    def test_nested_lifecycle_claims_validated_too(self):
+        bench = _load_bench()
+        with pytest.raises(ValueError, match="detail.lifecycle"):
+            bench.make_row(
+                "cl_probe", 0.2, "s", None, "open_loop_latency",
+                {"lifecycle": {"rollbacks": 0,
+                               "staleness_s": 0.1}},
+            )
+
+    def test_num_published_must_be_numeric(self):
+        bench = _load_bench()
+        with pytest.raises(ValueError, match="num_published"):
+            bench.make_row(
+                "cl_probe", 0.2, "s", None, "open_loop_latency",
+                {"staleness_s": 0.1, "num_published": "four",
+                 "offered_rate_hz": 100.0},
+            )
+
+    def test_controller_stats_plus_offered_passes_as_is(self):
+        """The embedding contract the rule's docstring states: the
+        LifecycleController stats block carries num_published itself;
+        merged with the offered rate it drops into a row unmodified."""
+        bench = _load_bench()
+        block = {
+            "published": 3, "num_published": 3, "rejected": 1,
+            "rollbacks": 1, "canary_promotions": 2,
+            "staleness_s": 0.21, "staleness_median_s": 0.19,
+            "staleness_num_samples": 3,
+            "offered_rate_hz": 250.0,
+        }
+        row = bench.make_row(
+            "cl_probe", 0.19, "s", None, "open_loop_latency",
+            {"lifecycle": block},
+        )
+        assert row["detail"]["lifecycle"]["rollbacks"] == 1

@@ -1,0 +1,272 @@
+"""Continuous trainer unit suite (ISSUE 15 tentpole): deterministic
+arriving-segment feed, the incremental normal-equations fold, the
+publish-every-K cadence, and checkpoint/resume bit-identity (the chaos
+suite drives the full plane; these pin the trainer in isolation)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from keystone_tpu.data.durable import CheckpointSpec
+from keystone_tpu.learning import ContinuousTrainer, TimedSegmentFeed
+from keystone_tpu.obs.metrics import MetricsRegistry
+from keystone_tpu.utils.faults import FaultPlan, FaultRule
+
+from tests._lifecycle_util import (
+    D,
+    K,
+    make_segments,
+    make_w_true,
+)
+
+
+def _final_W(trainer):
+    cand = trainer.candidates[-1]
+    graph = cand.transformer_graph
+    node = sorted(graph.nodes, key=repr)[0]
+    return np.asarray(graph.get_operator(node).x)
+
+
+class TestTimedSegmentFeed:
+    def test_empty_feed_rejected(self):
+        with pytest.raises(ValueError, match=">= 1 segment"):
+            TimedSegmentFeed([])
+
+    def test_offset_count_mismatch_rejected(self):
+        segs = make_segments(3, make_w_true())
+        with pytest.raises(ValueError, match="arrival offsets"):
+            TimedSegmentFeed(segs, arrival_offsets=[0.0])
+
+    def test_decreasing_offsets_rejected(self):
+        segs = make_segments(3, make_w_true())
+        with pytest.raises(ValueError, match="non-decreasing"):
+            TimedSegmentFeed(segs, arrival_offsets=[0.0, 2.0, 1.0])
+
+    def test_availability_follows_the_clock(self):
+        segs = make_segments(3, make_w_true())
+        t = {"now": 0.0}
+        feed = TimedSegmentFeed(
+            segs, arrival_offsets=[0.0, 1.0, 2.0],
+            clock=lambda: t["now"],
+        )
+        assert feed.available() == 0  # not started
+        feed.start()
+        assert feed.available() == 1
+        t["now"] = 1.5
+        assert feed.available() == 2
+        t["now"] = 5.0
+        assert feed.available() == 3
+
+    def test_start_is_idempotent_epoch(self):
+        """Offsets are relative to the FIRST start — a resumed trainer
+        sees the original arrival stamps."""
+        segs = make_segments(2, make_w_true())
+        t = {"now": 10.0}
+        feed = TimedSegmentFeed(
+            segs, arrival_offsets=[0.0, 1.0], clock=lambda: t["now"]
+        )
+        feed.start()
+        t0 = feed.arrival_time(1)
+        t["now"] = 50.0
+        feed.start()
+        assert feed.arrival_time(1) == t0 == 11.0
+
+    def test_arrival_time_before_start_raises(self):
+        feed = TimedSegmentFeed(make_segments(1, make_w_true()))
+        with pytest.raises(RuntimeError, match="not started"):
+            feed.arrival_time(0)
+
+    def test_wait_for_respects_stop(self):
+        segs = make_segments(2, make_w_true())
+        feed = TimedSegmentFeed(segs, arrival_offsets=[0.0, 60.0])
+        stop = threading.Event()
+        stop.set()
+        assert feed.wait_for(1, stop) is False
+
+
+class TestTrainerFold:
+    def test_final_candidate_matches_direct_ridge_solve(self):
+        """The incremental fold over all segments equals the one-shot
+        normal-equations solve over the concatenated data — exactly
+        (the fold IS that solve, accumulated per segment)."""
+        w_true = make_w_true()
+        segs = make_segments(6, w_true)
+        trainer = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=3, lam=1e-3
+        )
+        trainer.run()
+        # Per-segment accumulation in the same order the trainer folds.
+        G = np.zeros((D, D), np.float64)
+        C = np.zeros((D, K), np.float64)
+        for X, y in segs:
+            X64 = X.astype(np.float64)
+            G += X64.T @ X64
+            C += X64.T @ y.astype(np.float64)
+        W_direct = np.linalg.solve(
+            G + 1e-3 * np.eye(D), C
+        ).astype(np.float32)
+        assert np.array_equal(_final_W(trainer), W_direct)
+
+    def test_publish_cadence_includes_final_segment(self):
+        """K=4 over 6 segments -> boundaries at segment 4 and at the
+        final segment (a tail shorter than K is never unfitted)."""
+        segs = make_segments(6, make_w_true())
+        trainer = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=4
+        )
+        trainer.run()
+        assert trainer.publishes == 2
+        assert len(trainer.candidates) == 2
+        assert trainer.segments_fit == 6
+
+    def test_publish_every_segment(self):
+        segs = make_segments(3, make_w_true())
+        trainer = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=1
+        )
+        trainer.run()
+        assert trainer.publishes == 3
+
+    def test_invalid_publish_cadence_rejected(self):
+        with pytest.raises(ValueError, match="publish_every_k"):
+            ContinuousTrainer(
+                TimedSegmentFeed(make_segments(1, make_w_true())),
+                None, publish_every_k=0,
+            )
+
+    def test_metrics_counters(self):
+        reg = MetricsRegistry()
+        segs = make_segments(4, make_w_true())
+        ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=2,
+            metrics=reg,
+        ).run()
+        snap = reg.snapshot()
+        assert snap["trainer.segments_fit"] == 4
+        assert snap["trainer.resumes"] == 0
+
+
+class TestCheckpointResume:
+    def test_kill_mid_fit_resumes_bit_identically(self, tmp_path):
+        """The headline contract: a trainer killed mid-fit (the
+        ``trainer.fit`` fault site) restores the carry + cursor from
+        its snapshot and the candidate it finally publishes is
+        BIT-IDENTICAL to the uninterrupted run's."""
+        w_true = make_w_true()
+        segs = make_segments(9, w_true)
+        ref = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=4
+        )
+        ref.run()
+        W_ref = _final_W(ref)
+
+        spec = CheckpointSpec(str(tmp_path), every_segments=2)
+        plan = FaultPlan([
+            FaultRule("trainer.fit", calls=[6], exc="RuntimeError")
+        ])
+        killed = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=4,
+            checkpoint=spec,
+        )
+        with plan.active():
+            with pytest.raises(RuntimeError, match="injected fault"):
+                killed.run()
+        assert killed.segments_fit == 6
+        assert spec.has_snapshot()
+
+        resumed = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=4,
+            checkpoint=spec,
+        )
+        resumed.run()
+        assert resumed.resumes == 1
+        assert resumed.segments_fit == 3  # only the unfolded tail
+        assert np.array_equal(_final_W(resumed), W_ref)
+        # Completion spends the snapshot — a fresh identical fit starts
+        # clean (the streamed-solver contract).
+        assert not spec.has_snapshot()
+
+    def test_thread_crash_is_recorded_loudly(self, tmp_path):
+        segs = make_segments(4, make_w_true())
+        plan = FaultPlan([
+            FaultRule("trainer.fit", calls=[1], exc="RuntimeError")
+        ])
+        trainer = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=2,
+            checkpoint=str(tmp_path),
+        )
+        with plan.active():
+            trainer.start()
+            trainer.join(timeout=30.0)
+        assert isinstance(trainer.error, RuntimeError)
+        assert trainer.stats()["error"] is not None
+
+    def test_resume_metric_counter(self, tmp_path):
+        reg = MetricsRegistry()
+        segs = make_segments(5, make_w_true())
+        spec = CheckpointSpec(str(tmp_path), every_segments=2)
+        plan = FaultPlan([
+            FaultRule("trainer.fit", calls=[3], exc="RuntimeError")
+        ])
+        with plan.active():
+            with pytest.raises(RuntimeError):
+                ContinuousTrainer(
+                    TimedSegmentFeed(segs), None, publish_every_k=2,
+                    checkpoint=spec, metrics=reg,
+                ).run()
+        ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=2,
+            checkpoint=spec, metrics=reg,
+        ).run()
+        assert reg.snapshot()["trainer.resumes"] == 1
+
+    def test_stale_fingerprint_does_not_seed(self, tmp_path):
+        """A snapshot from a different λ must not seed this fit — the
+        CheckpointSpec fingerprint guard, exercised through the
+        trainer's fingerprint."""
+        segs = make_segments(5, make_w_true())
+        spec = CheckpointSpec(str(tmp_path), every_segments=2)
+        plan = FaultPlan([
+            FaultRule("trainer.fit", calls=[3], exc="RuntimeError")
+        ])
+        with plan.active():
+            with pytest.raises(RuntimeError):
+                ContinuousTrainer(
+                    TimedSegmentFeed(segs), None, publish_every_k=2,
+                    checkpoint=spec, lam=1e-3,
+                ).run()
+        other = ContinuousTrainer(
+            TimedSegmentFeed(segs), None, publish_every_k=2,
+            checkpoint=spec, lam=1e-2,  # different fit identity
+        )
+        other.run()
+        assert other.resumes == 0
+        assert other.segments_fit == 5  # folded everything itself
+
+
+class TestArrivingSegments:
+    def test_trainer_blocks_for_arrivals(self):
+        """Segments arriving over real time: the trainer folds them as
+        they land, and the run wall covers the arrival spread."""
+        segs = make_segments(4, make_w_true(), n=32)
+        feed = TimedSegmentFeed(
+            segs, arrival_offsets=[0.0, 0.05, 0.1, 0.15]
+        )
+        trainer = ContinuousTrainer(feed, None, publish_every_k=2)
+        t0 = time.perf_counter()
+        trainer.run()
+        assert time.perf_counter() - t0 >= 0.15
+        assert trainer.segments_fit == 4
+
+    def test_stop_interrupts_a_waiting_trainer(self):
+        segs = make_segments(2, make_w_true(), n=32)
+        feed = TimedSegmentFeed(segs, arrival_offsets=[0.0, 60.0])
+        trainer = ContinuousTrainer(feed, None, publish_every_k=1)
+        trainer.start()
+        time.sleep(0.2)
+        trainer.stop()
+        trainer.join(timeout=10.0)
+        assert trainer.error is None
+        assert trainer.segments_fit == 1  # folded what had arrived

@@ -45,6 +45,9 @@ class TestPackageIsClean:
             "SITE_CHECKPOINT_WRITE": faults.SITE_CHECKPOINT_WRITE,
             "SITE_ZOO_PAGE_IN": faults.SITE_ZOO_PAGE_IN,
             "SITE_ZOO_PAGE_OUT": faults.SITE_ZOO_PAGE_OUT,
+            "SITE_TRAINER_FIT": faults.SITE_TRAINER_FIT,
+            "SITE_LIFECYCLE_VALIDATE": faults.SITE_LIFECYCLE_VALIDATE,
+            "SITE_LIFECYCLE_PUBLISH": faults.SITE_LIFECYCLE_PUBLISH,
         }
 
     def test_every_registered_fault_site_is_exercised_by_tests(self):
