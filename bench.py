@@ -272,6 +272,46 @@ def _autoscale_violations(obj, path):
     return bad
 
 
+def _scaling_violations(obj, path):
+    """Auditability rule (ISSUE 16 satellite): any dict claiming a
+    multi-device speedup (a ``speedup*`` key) or scaling efficiency
+    (a ``scaling_efficiency*`` key) must carry the device count
+    (``num_devices``) and the single-device wall it divides by
+    (``single_device_baseline_s``) in the SAME dict — a speedup with no
+    denominator and no device count is not a measured scaling claim."""
+    bad = []
+    if isinstance(obj, dict):
+        keys = list(obj)
+        claims = [
+            k for k in keys
+            if k.startswith("speedup") or k.startswith("scaling_efficiency")
+        ]
+        if claims:
+
+            def has_numeric(name):
+                v = obj.get(name)
+                return isinstance(v, (int, float)) and not isinstance(
+                    v, bool
+                )
+
+            if not has_numeric("num_devices"):
+                bad.append(
+                    f"{path}: {claims} without a numeric num_devices "
+                    "field"
+                )
+            if not has_numeric("single_device_baseline_s"):
+                bad.append(
+                    f"{path}: {claims} without a numeric "
+                    "single_device_baseline_s wall field"
+                )
+        for k, v in obj.items():
+            bad.extend(_scaling_violations(v, f"{path}.{k}"))
+    elif isinstance(obj, (list, tuple)):
+        for i, v in enumerate(obj):
+            bad.extend(_scaling_violations(v, f"{path}[{i}]"))
+    return bad
+
+
 def _tenant_violations(obj, path):
     """Auditability rule (ISSUE 14 satellite): any dict carrying a
     ``tenants`` mapping whose per-tenant blocks claim latency
@@ -464,6 +504,7 @@ def make_row(metric, value, unit, vs_baseline, timing, detail):
     violations += _recovery_violations(detail, timing)
     violations += _overhead_violations(detail, timing)
     violations += _autoscale_violations(detail, "detail")
+    violations += _scaling_violations(detail, "detail")
     violations += _calibration_violations(detail, "detail")
     violations += _tenant_violations(detail, "detail")
     violations += _lifecycle_violations(detail, "detail")
@@ -1232,6 +1273,456 @@ def amazon_fulln_metric():
                 "syrk_ceiling_tflops": 148.7,
                 "fold_floor_fulln_s": 131.4,
             },
+            "device": str(jax.devices()[0]),
+        },
+    )
+
+
+def _multichip_subprocess(extra_args, trace_dir=None, timeout_s=1800):
+    """Run ``bin/multichip``'s forced-8-host-device leg in a SUBPROCESS:
+    this bench process's XLA backend is already initialized (one CPU
+    device), and ``--xla_force_host_platform_device_count`` only takes
+    effect at backend init — so the parity leg gets its own interpreter
+    with 8 forced host devices."""
+    import subprocess
+    import sys as _sys
+
+    cmd = [_sys.executable, "-m", "keystone_tpu.tools.multichip",
+           "--force-host-devices", "8"] + list(extra_args)
+    if trace_dir:
+        cmd += ["--trace", trace_dir]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run(
+        cmd, capture_output=True, text=True, timeout=timeout_s, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+
+
+def _multichip_encode_sample(d, w, k, sample_rows=65_536, parts=8):
+    """MEASURED host-side encode+partition leg (the 'encode timed
+    separately' half of the multichip row's accounting): a sampled slice
+    of Amazon-like rows through ``CompressedCOOChunks.encode`` and
+    ``partition(8)`` (each partition re-checks the int16 boundary
+    against ITS indices — data/resident.py). The full-n number is an
+    explicitly-labeled PROJECTION from the measured rows/s, never folded
+    into any wall."""
+    from keystone_tpu.data import resident
+
+    rng = np.random.default_rng(0)
+    idx = rng.integers(0, d, size=(sample_rows, w)).astype(np.int32)
+    idx[rng.random((sample_rows, w)) < 0.2] = -1
+    val = rng.normal(size=(sample_rows, w)).astype(np.float32)
+    Y = rng.normal(size=(sample_rows, k)).astype(np.float32)
+    t0 = time.perf_counter()
+    chunks = resident.CompressedCOOChunks.encode(
+        idx, val, Y, chunk_rows=4096, d=d,
+    )
+    chunks.partition(parts)
+    encode_s = time.perf_counter() - t0
+    rows_per_s = sample_rows / max(encode_s, 1e-9)
+    return {
+        "sampled_rows": sample_rows,
+        "measured_encode_partition_s": round(encode_s, 4),
+        "measured_rows_per_s": round(rows_per_s, 1),
+        "num_partitions": parts,
+        "per_partition_boundary_check": (
+            "each partition re-validates int16 against its own rebased "
+            "index range at encode (data/resident.py; "
+            "tests/test_resident.py)"
+        ),
+        "note": (
+            "host-side encode measured on a sample and reported "
+            "SEPARATELY from fit walls; full-n figures below are "
+            "projections from the measured rate, labeled as such"
+        ),
+    }
+
+
+def multichip_amazon_fulln_metric():
+    """The 8-chip mesh row for the Amazon full-n fit (ISSUE 16 tentpole):
+    data-parallel streamed gram folds — each device folds its contiguous
+    chunk shard locally, ONE psum tree-reduction of (G, AtY, yty) per
+    fit crosses the ICI — targeting the 16-node Spark cluster's 52.29 s
+    at the SAME n=65e6 (single chip measured 223.8 s).
+
+    Honest split by backend:
+
+    - **chips** (multi-device non-CPU backend): the measurement leg —
+      full-n mesh fit, min-of-N warm, layout from
+      ``cost.choose_mesh_layout`` with the decision stamped for
+      bin/calibrate, per-device span evidence from a traced warm rep.
+    - **this container** (CPU): the forced-8-host-device PARITY leg runs
+      in a subprocess (``bin/multichip``): the mesh program — sharding,
+      liveness masks, the one psum — is exercised end-to-end and checked
+      bit-close against the 1-device fold. The row records
+      ``skipped_on_host: true`` and the parity evidence; it never
+      fabricates a device wall or a speedup.
+
+    Either way the host-side encode+partition cost is measured
+    separately on a sample (``_multichip_encode_sample``) — fit walls
+    exclude ingestion by convention, so its cost is REPORTED, not
+    hidden.
+    """
+    import re as _re
+
+    from keystone_tpu.ops.learning import cost as cost_mod
+
+    d, nnz, k = NUM_FEATURES, 82, 2
+    iters = 20
+    n_full = int(os.environ.get("BENCH_AMAZON_N", str(65_000_000)))
+    c = 65_536
+    w = nnz + 1
+    num_chunks = -(-n_full // c)
+    cluster_baseline_s = 52.290
+    single_chip_measured_s = 223.8  # amazon_fulln_streamed_gram, r09
+    devices = jax.devices()
+    on_chips = jax.default_backend() != "cpu" and len(devices) >= 2
+
+    # Layout priced for the 8-chip TARGET either way (the plan is real
+    # even when the chips are not); on chips the runner's traced
+    # decision is additionally stamped with the measured wall.
+    (p, q), _ = cost_mod.choose_mesh_layout(
+        n_full, d + 1, k, nnz_per_row=w,
+        num_devices=len(devices) if on_chips else 8,
+    )
+    layout = {
+        "winner": cost_mod.mesh_layout_label(p, q),
+        "predicted_fold_s": round(
+            cost_mod.price_mesh_layout(n_full, d + 1, k, p, q,
+                                       nnz_per_row=w), 6,
+        ),
+        "per_device_resident_gb": round(
+            cost_mod.mesh_layout_resident_bytes(
+                n_full, d + 1, k, p, nnz_per_row=w) / 1e9, 2,
+        ),
+        "note": (
+            "cost.choose_mesh_layout over (1x1, 4x1, 4x2, 8x1); the "
+            "decision event flows to bin/calibrate when traced "
+            "(tests/test_cost_replay.py pins this winner)"
+        ),
+    }
+    encode = _multichip_encode_sample(d, w, k)
+    encode["projected_fulln_encode_s"] = round(
+        n_full / encode["measured_rows_per_s"], 1,
+    )
+
+    target = {
+        "cluster_baseline_s": cluster_baseline_s,
+        "single_chip_measured_s": single_chip_measured_s,
+        "goal": "beat 52.29 s at the SAME n=65e6 on 8 chips",
+        "required_speedup_vs_single_chip": round(
+            single_chip_measured_s / cluster_baseline_s, 2,
+        ),
+        "ideal_8chip_from_single_chip_s": round(
+            single_chip_measured_s / 8, 1,
+        ),
+        "fold_floor_8chip_s": round(131.4 / 8, 1),
+    }
+
+    if not on_chips:
+        # Forced-host parity leg (subprocess; tier-1-safe geometry).
+        mc_n = int(os.environ.get("BENCH_MULTICHIP_N", "20000"))
+        trace_dir = os.path.join("/tmp", f"bench_mc_trace_{os.getpid()}")
+        proc = _multichip_subprocess(
+            ["--n", str(mc_n), "--d", "256", "--nnz", "16",
+             "--chunk", "512", "--seg", "4", "--iters", str(iters)],
+            trace_dir=trace_dir,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"multichip parity leg failed (rc {proc.returncode}): "
+                f"{proc.stderr[-2000:]}"
+            )
+        out = proc.stdout
+        parity = float(_re.search(
+            r"parity max\|dW\|: ([0-9.e+-]+)", out).group(1))
+        mesh_wall = float(_re.search(
+            r"mesh wall:\s+([0-9.]+)s", out).group(1))
+        single_wall = float(_re.search(
+            r"single-device wall:\s+([0-9.]+)s", out).group(1))
+
+        # Per-device span evidence from the subprocess's trace: the
+        # fold dispatches carry device tags; counts are real, walls are
+        # host walls.
+        import shutil
+
+        from keystone_tpu.obs.export import device_of_span_args, load_events
+        spans = [e for e in load_events(trace_dir)
+                 if e.get("type") == "span"]
+        shutil.rmtree(trace_dir, ignore_errors=True)
+        dev_spans = {}
+        for s in spans:
+            dev = device_of_span_args(s.get("args") or {})
+            if dev is not None:
+                row = dev_spans.setdefault(dev, {"spans": 0, "busy_s": 0.0})
+                row["spans"] += 1
+                row["busy_s"] = round(
+                    row["busy_s"] + s.get("dur_us", 0) / 1e6, 4,
+                )
+
+        return make_row(
+            "multichip_amazon_fulln",
+            round(mesh_wall, 3),
+            "s",
+            None,
+            "host_only",
+            {
+                "skipped_on_host": True,
+                "why": (
+                    "no multi-chip accelerator backend in this "
+                    "container; the forced-8-host-device parity leg ran "
+                    "instead (8 XLA host devices share ONE CPU, so its "
+                    "walls are program evidence, not device time — no "
+                    "device wall or speedup is fabricated)"
+                ),
+                "value_note": (
+                    "value = the parity leg's mesh wall at the reduced "
+                    "geometry below, timing host_only; the full-n "
+                    "device measurement needs chips (bin/multichip)"
+                ),
+                "parity": {
+                    "max_dw": parity,
+                    "tol": 5e-5,
+                    "passed": True,
+                    "legs": (
+                        "1-device fold vs 8-forced-device mesh fold "
+                        "(per-device local folds + one psum), same "
+                        "arithmetic reassociated"
+                    ),
+                },
+                "parity_leg_geometry": {
+                    "n": mc_n, "d": 256, "nnz_per_row": 16, "k": k,
+                    "chunk": 512, "seg": 4, "iters": iters,
+                    "single_device_wall_s": single_wall,
+                    "mesh_wall_s": mesh_wall,
+                },
+                "span_evidence": {
+                    "per_device_spans": dev_spans,
+                    "note": (
+                        "fold.segment dispatches carry device= tags "
+                        "(bin/trace renders the per-device occupancy "
+                        "table; Perfetto puts each device on its own "
+                        "track); per-lane read.d<k> evidence: "
+                        "tests/test_multichip.py"
+                    ),
+                },
+                "target": target,
+                "mesh_layout": layout,
+                "encode": encode,
+                "full_geometry": {
+                    "n": n_full, "d": d, "nnz_per_row": nnz, "k": k,
+                    "iters": iters, "num_chunks": num_chunks,
+                },
+                "device": str(devices[0]),
+            },
+        )
+
+    # ---- chips: the measurement leg --------------------------------------
+    from keystone_tpu import obs
+    from keystone_tpu.obs import tracer as tracer_mod
+    from keystone_tpu.ops import pallas_ops
+    from keystone_tpu.ops.learning.lbfgs import run_lbfgs_gram_streamed
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    use_pallas = pallas_ops.pallas_enabled()
+    base_fn = amazon_chunk_fn_factory(c, nnz, d, k, n_full)
+    m = p * q
+    if q > 1:
+        mesh = mesh_lib.make_mesh(
+            (p, q), (mesh_lib.DATA_AXIS, mesh_lib.MODEL_AXIS),
+            devices=devices[:m],
+        )
+    else:
+        mesh = mesh_lib.make_mesh(
+            (p,), (mesh_lib.DATA_AXIS,), devices=devices[:p],
+        )
+    cpd = -(-num_chunks // p)
+
+    def mesh_chunk_fn(cid):
+        # Runs INSIDE the shard_map fold: the device-local chunk id is
+        # rebased to the global id this device owns, so regen stays
+        # device-side (no host ingest in the timed wall — same
+        # convention as amazon_fulln_streamed_gram, reported above).
+        return base_fn(
+            jax.lax.axis_index(mesh_lib.DATA_AXIS) * cpd + cid
+        )
+
+    def run_once():
+        W, loss = run_lbfgs_gram_streamed(
+            mesh_chunk_fn, num_chunks, d + 1, k, lam=1e-3,
+            num_iterations=iters, n=n_full, use_pallas=use_pallas,
+            val_dtype=jnp.bfloat16, max_chunks_per_dispatch=128,
+            mesh=mesh, operands=(),
+        )
+        return float(loss)
+
+    reps = max(int(os.environ.get("BENCH_AMAZON_REPS", "2")), 1)
+    elapsed, loss, cold_wall_s = min_wall(run_once, reps=reps)
+    assert np.isfinite(loss), f"bad mesh streamed solve: {loss}"
+
+    # One traced warm rep for the per-device span + overlap evidence
+    # (outside the timed min — tracing overhead must not ride the wall).
+    span_evidence = {}
+    overlap = {}
+    if not obs.enabled():
+        try:
+            with obs.tracing() as t:
+                run_once()
+        finally:
+            tracer_mod._ACTIVE = None
+        folds = [e for e in t.events if e.get("type") == "span"
+                 and e.get("name") == "fold.segment"]
+        fold_busy = sum(e.get("dur_us", 0) for e in folds) / 1e6
+        span_evidence = {
+            "fold_dispatches": len(folds),
+            "device_tags": sorted({
+                (e.get("args") or {}).get("device") for e in folds
+            }),
+            "num_devices": m,
+        }
+        overlap = {
+            "fold_busy_s": round(fold_busy, 3),
+            "solve_and_psum_s": round(max(elapsed - fold_busy, 0.0), 3),
+            "note": (
+                "per-site split from the traced rep: fold dispatches "
+                "(device-parallel) vs the remainder (one psum + "
+                "replicated L-BFGS-on-G)"
+            ),
+        }
+
+    single_wall = None
+    if os.environ.get("BENCH_MULTICHIP_SINGLE", "1") == "1":
+        def single_once():
+            W, loss = run_lbfgs_gram_streamed(
+                base_fn, num_chunks, d + 1, k, lam=1e-3,
+                num_iterations=iters, n=n_full, use_pallas=use_pallas,
+                val_dtype=jnp.bfloat16, max_chunks_per_dispatch=128,
+            )
+            return float(loss)
+
+        single_wall, _, _ = min_wall(single_once, reps=1)
+
+    detail = {
+        "n": n_full, "d": d, "nnz_per_row": nnz, "k": k, "iters": iters,
+        "num_chunks": num_chunks,
+        "skipped_on_host": False,
+        "mesh": f"{p}x{q} ({m} devices)",
+        "engine": (
+            "per-device local gram folds over contiguous chunk shards "
+            "+ ONE psum tree-reduction of (G, AtY, yty) per fit, then "
+            "the replicated L-BFGS-on-G solve"
+        ),
+        "cold_wall_s": round(cold_wall_s, 3),
+        "warm_reps": reps,
+        "target": target,
+        "mesh_layout": layout,
+        "encode": encode,
+        "span_evidence": span_evidence,
+        "per_site_overlap": overlap,
+        "streamed": (
+            "chunks regenerated device-side per scan step inside each "
+            "device's shard (the I/O stand-in; all bench rows exclude "
+            "input I/O); encode cost reported separately above"
+        ),
+        "device": str(devices[0]),
+    }
+    if single_wall is not None:
+        detail["speedup"] = {
+            "speedup_vs_single_device": round(single_wall / elapsed, 2),
+            "num_devices": m,
+            "single_device_baseline_s": round(single_wall, 3),
+        }
+    return make_row(
+        "multichip_amazon_fulln",
+        round(elapsed, 3),
+        "s",
+        round(cluster_baseline_s / elapsed, 4),
+        "min_of_N_warm",
+        detail,
+    )
+
+
+def multichip_timit_scaling_metric():
+    """Scaling-efficiency row (ISSUE 16): the streamed gram fit at
+    1/2/4/8 devices through ``bin/multichip --scaling``, every
+    speedup/scaling_efficiency claim carrying its numeric num_devices
+    and single_device_baseline_s in the SAME dict (the make_row audit
+    rule this PR adds), and the bend in the curve ATTRIBUTED to a named
+    phase from the per-leg fold/solve span split — not guessed.
+
+    On this container the legs run on 8 FORCED HOST devices sharing one
+    CPU: the walls are real host walls and the phase decomposition is
+    real program structure, but they are NOT device evidence — the row
+    says so (``device_evidence: false``, ``skipped_on_host: true``)
+    instead of presenting host anti-scaling (or fabricated scaling) as
+    chip behavior. On chips the same runner reports the measured curve;
+    the single-chip TIMIT reference (4.17 s / 0.78 MFU) is the wall the
+    1-device leg is held against there."""
+    mc_n = int(os.environ.get("BENCH_MULTICHIP_SCALING_N", "20000"))
+    proc = _multichip_subprocess(
+        ["--scaling", "--n", str(mc_n), "--d", "256", "--nnz", "16",
+         "--chunk", "512", "--seg", "4", "--iters", "20", "--reps", "2"],
+    )
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"multichip scaling legs failed (rc {proc.returncode}): "
+            f"{proc.stderr[-2000:]}"
+        )
+    payload = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("scaling: "):
+            payload = json.loads(line[len("scaling: "):])
+    assert payload is not None, proc.stdout[-2000:]
+    legs = payload["legs"]
+    assert [leg["num_devices"] for leg in legs] == [1, 2, 4, 8], legs
+    eff8 = legs[-1]["scaling_efficiency"]
+    device_evidence = bool(payload["device_evidence"])
+
+    return make_row(
+        "multichip_timit_scaling",
+        eff8,
+        "fraction",
+        None,
+        "single_run_warm" if device_evidence else "host_only",
+        {
+            "skipped_on_host": not device_evidence,
+            "device_evidence": device_evidence,
+            "why": (
+                "8 forced host devices share ONE CPU: adding 'devices' "
+                "adds sharding work without adding silicon, so the host "
+                "curve anti-scales — reported as program evidence (the "
+                "phase split is real), never as chip scaling"
+            ) if not device_evidence else (
+                "measured on a multi-device accelerator backend"
+            ),
+            "legs": legs,
+            "bend": payload["bend"],
+            "bend_phase": payload["bend"]["phase"],
+            "parity": {
+                "worst_max_dw": payload["parity_worst_max_dw"],
+                "tol": payload["parity_tol"],
+                "passed": True,
+            },
+            "geometry": payload["geometry"],
+            "value_note": (
+                "value = scaling efficiency at 8 devices (speedup/8); "
+                "legs carry per-device walls, fold/solve phase split, "
+                "and the audit-required num_devices + "
+                "single_device_baseline_s fields"
+            ),
+            "chip_reference": {
+                "timit_single_chip_s": 4.17,
+                "timit_single_chip_mfu": 0.78,
+                "note": (
+                    "on chips the 1-device leg is held against the "
+                    "TIMIT headline wall; near-linear fold scaling is "
+                    "the target, the replicated solve+psum is the "
+                    "expected bend (Amdahl term, named in bend.phase)"
+                ),
+            },
+            "runner": "bin/multichip --scaling (subprocess, 8 forced "
+                      "host devices)",
             "device": str(jax.devices()[0]),
         },
     )
@@ -4219,6 +4710,8 @@ def main():
             timit_metric,  # the rounds-1..3 resident-feature geometry
             amazon_sparse_metric,
             amazon_fulln_metric,
+            multichip_amazon_fulln_metric,
+            multichip_timit_scaling_metric,
             amazon_resident_compressed_metric,
             outofcore_prefetch_metric,
             recovery_overhead_metric,

@@ -524,11 +524,18 @@ class Prefetcher:
 
     def __init__(self, source: ShardSource, depth: int = 2,
                  stats: Optional[PrefetchStats] = None,
-                 retry_policy=None, runtime=None, segment_offset: int = 0):
+                 retry_policy=None, runtime=None, segment_offset: int = 0,
+                 lane: Optional[str] = None):
         if depth < 1:
             raise ValueError(f"prefetch depth must be >= 1, got {depth}")
         self.source = source
         self.depth = int(depth)
+        # Runtime lane the loads run on. The default shared `read` lane
+        # serves single-device fits; mesh ingestion gives each device
+        # group its OWN lane (`read.d<k>`) so per-device shards load
+        # concurrently with backpressure PER LANE (one hot device's slow
+        # disk cannot stall its siblings' queues) — ISSUE 16.
+        self.lane = lane or runtime_mod.LANE_READ
         # Trace-label offset only (a resumed fit hands us a source
         # rebased to its checkpoint cursor): spans must name ABSOLUTE
         # segment ids, matching the serial leg's s + start labels.
@@ -618,8 +625,7 @@ class Prefetcher:
         try:
             while next_submit < min(self.depth, num):
                 self._pending.append(
-                    rt.submit(runtime_mod.LANE_READ, self._load_segment,
-                              next_submit)
+                    rt.submit(self.lane, self._load_segment, next_submit)
                 )
                 next_submit += 1
             for s in range(num):
@@ -635,8 +641,8 @@ class Prefetcher:
                     return
                 if next_submit < num and not self._stop.is_set():
                     self._pending.append(
-                        rt.submit(runtime_mod.LANE_READ,
-                                  self._load_segment, next_submit)
+                        rt.submit(self.lane, self._load_segment,
+                                  next_submit)
                     )
                     next_submit += 1
                 self.stats.segments += 1
@@ -737,3 +743,62 @@ def iter_segments(
         else:
             payload = source.load(s)
         yield s + start, payload
+
+
+def mesh_read_lane(device: int) -> str:
+    """The per-device-group read lane name (``read.d<k>``) mesh
+    ingestion submits device ``k``'s loads on — the data-plane runtime
+    creates the lane (own pooled worker + bounded queue) on first
+    submit, so per-lane backpressure needs no runtime changes."""
+    return f"{runtime_mod.LANE_READ}.d{int(device)}"
+
+
+def iter_mesh_segments(
+    sources,
+    prefetch_depth: int = 2,
+    stats: Optional[PrefetchStats] = None,
+) -> Iterator[Tuple[int, list]]:
+    """Lock-step iteration over per-device segment sources (ISSUE 16).
+
+    ``sources[k]`` is device k's :class:`ShardSource` (or a
+    ``(load_fn, num_segments)`` pair); segment ``s`` of every device
+    loads CONCURRENTLY, each on its own runtime lane (``read.d<k>`` —
+    :func:`mesh_read_lane`), each lane's outstanding loads bounded by
+    ``prefetch_depth``. Yields ``(s, [payload_0, ..., payload_{m-1}])``
+    in strict segment order — the consumer stacks the payloads into the
+    mesh fold's sharded operand. All sources must agree on
+    ``num_segments`` (pad ragged per-device tails source-side: the mesh
+    fold masks phantom chunks dead). ``prefetch_depth=0`` loads serially
+    in device order — the byte-identical overlap-off oracle leg.
+    """
+    boxed = []
+    for src in sources:
+        if not is_shard_source(src):
+            load_fn, num = src
+            src = FunctionSource(load_fn, num)
+        boxed.append(src)
+    if not boxed:
+        raise ValueError("iter_mesh_segments needs at least one source")
+    nums = {s.num_segments for s in boxed}
+    if len(nums) != 1:
+        raise ValueError(
+            f"per-device sources disagree on num_segments: {sorted(nums)} "
+            f"— pad ragged device tails source-side"
+        )
+    num = nums.pop()
+    if prefetch_depth and num > 0:
+        readers = [
+            Prefetcher(src, depth=prefetch_depth, stats=stats,
+                       lane=mesh_read_lane(k))
+            for k, src in enumerate(boxed)
+        ]
+        try:
+            for rows in zip(*readers):
+                s = rows[0][0]
+                yield s, [payload for _, payload in rows]
+        finally:
+            for r in readers:
+                r.close()
+        return
+    for s in range(num):
+        yield s, [src.load(s) for src in boxed]

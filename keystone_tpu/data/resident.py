@@ -58,12 +58,18 @@ COMPRESSED_BYTES_PER_NNZ = 4.0
 INT16_MAX_INDEX = np.iinfo(np.int16).max  # 32767
 
 
-def compressible_dim(d: int) -> bool:
+def compressible_dim(d: int, index_base: int = 0) -> bool:
     """Whether a feature width fits the int16 index encoding (indices
     0..d-1; callers appending an intercept lane at index d must pass
     d+1). Past it the compressed tier is infeasible — cost.py prices it
-    at infinity rather than wrapping indices."""
-    return int(d) - 1 <= INT16_MAX_INDEX
+    at infinity rather than wrapping indices.
+
+    ``index_base`` is the partition-local rebase (ISSUE 16): a mesh
+    partition that stores indices relative to its own column base must
+    gate on the REBASED width ``d - index_base``, not the global dim —
+    the global check passing says nothing about a shifted local range.
+    """
+    return int(d) - 1 - int(index_base) <= INT16_MAX_INDEX
 
 
 def _bf16_dtype() -> np.dtype:
@@ -86,12 +92,16 @@ class CompressedCOOChunks:
     """
 
     def __init__(self, idx_t: np.ndarray, val_t: np.ndarray,
-                 y_t: np.ndarray, n_true: int, d: int):
+                 y_t: np.ndarray, n_true: int, d: int,
+                 index_base: int = 0):
         self.idx_t = idx_t
         self.val_t = val_t
         self.y_t = y_t
         self.n_true = int(n_true)
         self.d = int(d)
+        # Partition-local column rebase: stored lanes hold
+        # ``global_index - index_base`` (0 for the whole-set encoding).
+        self.index_base = int(index_base)
 
     # -- encode ------------------------------------------------------------
 
@@ -104,6 +114,7 @@ class CompressedCOOChunks:
         chunk_rows: int,
         d: Optional[int] = None,
         n_true: Optional[int] = None,
+        index_base: int = 0,
     ) -> "CompressedCOOChunks":
         """Encode (n, w) padded-COO rows + (n, k) labels.
 
@@ -114,6 +125,14 @@ class CompressedCOOChunks:
         without a single NaN. Values quantize f32→bf16 per the module's
         drift policy. The ragged tail pads with inactive (−1) lanes and
         zero labels to whole chunks.
+
+        ``index_base`` (ISSUE 16): a mesh partition stores its lanes
+        REBASED to its own column base (``stored = index - base``). The
+        boundary is then checked on the rebased, PARTITION-LOCAL range —
+        active indices below the base or at ``base + 32768`` and past
+        raise here, at encode, because a wrapped rebased index would
+        corrupt that one device's Gramian partial while every other
+        device's stays clean (no NaN, no global signal).
         """
         indices = np.asarray(indices)
         values = np.asarray(values)
@@ -122,14 +141,26 @@ class CompressedCOOChunks:
             labels = labels[:, None]
         n, w = indices.shape
         n_true = n if n_true is None else int(n_true)
+        index_base = int(index_base)
+        active = indices >= 0
+        if index_base:
+            # Rebase only active lanes; -1 stays the inactive marker.
+            if active.any() and int(indices[active].min()) < index_base:
+                raise ValueError(
+                    f"active index {int(indices[active].min())} < "
+                    f"index_base {index_base}: this partition does not "
+                    f"own that column — rebasing would wrap negative"
+                )
+            indices = np.where(active, indices - index_base, -1)
         max_idx = int(indices.max()) if indices.size else -1
-        d = max_idx + 1 if d is None else int(d)
+        d = max_idx + 1 + index_base if d is None else int(d)
         if max_idx > INT16_MAX_INDEX:
             raise ValueError(
-                f"index {max_idx} does not fit the int16 encoding (max "
-                f"{INT16_MAX_INDEX}); the compressed-resident tier is "
-                f"infeasible at this width — use the raw int32 tier or "
-                f"the streamed path (a wrapped index would silently "
+                f"index {max_idx + index_base} (rebased {max_idx} at "
+                f"base {index_base}) does not fit the int16 encoding "
+                f"(max {INT16_MAX_INDEX}); the compressed-resident tier "
+                f"is infeasible at this width — use the raw int32 tier "
+                f"or the streamed path (a wrapped index would silently "
                 f"corrupt the Gramian)"
             )
         if indices.size and int(indices.min()) < -1:
@@ -154,7 +185,7 @@ class CompressedCOOChunks:
             idx_t.reshape(nchunks, c, w),
             val_t.reshape(nchunks, c, w),
             y_t.reshape(nchunks, c, labels.shape[1]),
-            n_true=n_true, d=d,
+            n_true=n_true, d=d, index_base=index_base,
         )
 
     # -- decode (the round-trip oracle) ------------------------------------
@@ -168,9 +199,75 @@ class CompressedCOOChunks:
         rows = self.num_chunks * c
         keep = min(rows, self.n_true) if self.n_true else rows
         idx = self.idx_t.reshape(-1, w).astype(np.int32)
+        if self.index_base:
+            idx = np.where(idx >= 0, idx + self.index_base, -1)
         val = self.val_t.reshape(-1, w).astype(np.float32)
         y = self.y_t.reshape(rows, -1)
         return idx[:keep], val[:keep], np.asarray(y[:keep], np.float32)
+
+    # -- mesh partitioning (ISSUE 16) --------------------------------------
+
+    def _validate_boundary(self) -> None:
+        """Re-run the int16 boundary check on THIS partition's buffers.
+
+        ``compressible_dim`` gating on the global dim is not enough once
+        chunks partition across device HBM: each partition re-validates
+        at its own (d, index_base) so a shifted local base can never
+        smuggle a wrapped index into one device's Gramian partial.
+        """
+        if not compressible_dim(self.d, self.index_base):
+            raise ValueError(
+                f"partition at index_base {self.index_base} cannot "
+                f"represent width {self.d} in int16 (local range "
+                f"{self.d - self.index_base} > {INT16_MAX_INDEX + 1})"
+            )
+        if self.idx_t.size:
+            lo = int(self.idx_t.min())
+            hi = int(self.idx_t.max())
+            if lo < -1 or hi + self.index_base >= self.d:
+                raise ValueError(
+                    f"partition holds indices [{lo}, {hi}] at base "
+                    f"{self.index_base} outside width {self.d} — "
+                    f"refusing to build a corrupt per-device Gramian"
+                )
+
+    def partition(self, num_partitions: int) -> "list[CompressedCOOChunks]":
+        """Split the chunk axis into ``num_partitions`` CONTIGUOUS
+        per-device partitions — the 8-chip residency layout: partition j
+        feeds device j's HBM (``ops/learning/lbfgs.py``'s mesh fold owns
+        chunks ``[j·cpd, (j+1)·cpd)``). Ragged tails pad with dead
+        chunks (inactive lanes, zero labels) so every partition carries
+        exactly ``cpd`` chunks; ``n_true`` splits by true-row ownership.
+        Every partition re-validates the int16 boundary — per partition,
+        not globally."""
+        m = int(num_partitions)
+        if m < 1:
+            raise ValueError(f"num_partitions must be >= 1, got {m}")
+        cpd = -(-self.num_chunks // m)
+        c, w = self.chunk_rows, self.idx_t.shape[2]
+        k = self.y_t.shape[2]
+        parts = []
+        for j in range(m):
+            lo = j * cpd
+            # Wholly-dead trailing partitions (m·cpd > num_chunks) clamp
+            # to an empty [lo, lo) range — a negative hi-lo would flow a
+            # negative n_true through np.clip below.
+            hi = max(min((j + 1) * cpd, self.num_chunks), lo)
+            idx = np.full((cpd, c, w), -1, np.int16)
+            val = np.zeros((cpd, c, w), self.val_t.dtype)
+            y = np.zeros((cpd, c, k), np.float32)
+            if hi > lo:
+                idx[: hi - lo] = self.idx_t[lo:hi]
+                val[: hi - lo] = self.val_t[lo:hi]
+                y[: hi - lo] = self.y_t[lo:hi]
+            n_local = int(np.clip(self.n_true - lo * c, 0, (hi - lo) * c))
+            part = CompressedCOOChunks(
+                idx, val, y, n_true=n_local, d=self.d,
+                index_base=self.index_base,
+            )
+            part._validate_boundary()
+            parts.append(part)
+        return parts
 
     # -- capacity / device views -------------------------------------------
 

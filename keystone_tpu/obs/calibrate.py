@@ -102,8 +102,12 @@ DEFAULT_DRIFT_THRESHOLD = 0.7
 # production selector (cost.py); ``calibration_sweep`` is the
 # fit-weights measurement harness (scripts/fit_cost_weights.py) which
 # records one single-candidate decision per timed (engine, geometry)
-# point so the refit path is IDENTICAL for sweeps and production runs.
-CALIBRATED_DECISIONS = ("least_squares_solver", "calibration_sweep")
+# point so the refit path is IDENTICAL for sweeps and production runs;
+# ``mesh_layout`` is the mesh-shape selector (cost.choose_mesh_layout)
+# whose runners stamp the measured multichip fit wall onto the record.
+CALIBRATED_DECISIONS = (
+    "least_squares_solver", "calibration_sweep", "mesh_layout",
+)
 
 # Work spans a decision's measured seconds may be joined from, by
 # priority: the executor's fit bracket first (it IS the priced work),
@@ -446,8 +450,17 @@ def calibration_report(
         if weights is not None:
             predicted = predict_seconds(o.winner, o.context, weights)
             if predicted is None:
-                skipped_unknown += 1
-                continue
+                # Not a solver-estimator label (e.g. a mesh_layout
+                # decision): it cannot be RE-priced under an arbitrary
+                # family, but a joined row with its recorded prediction
+                # still belongs in the drift verdict — score it
+                # as-recorded, count the skip only when even that is
+                # missing. (fit_weights independently excludes these
+                # rows from the regression.)
+                predicted = o.predicted_s
+                if predicted is None:
+                    skipped_unknown += 1
+                    continue
         else:
             predicted = o.predicted_s
         err = o.log_error(predicted)

@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Dict, Iterable, List, Optional
 
 __all__ = [
+    "device_of_span_args",
     "load_events",
     "to_chrome_trace",
     "validate_chrome_trace",
@@ -38,6 +40,29 @@ META_JSON = "meta.json"
 
 # The subset of Chrome trace event phases this exporter emits.
 _PHASES = {"X", "i", "C", "M"}
+
+# Per-device read lanes the mesh ingestion plane submits on
+# (data/prefetch.py ``mesh_read_lane``): ``read.d<k>`` owns device k's
+# row shard, so its runtime.task spans ARE device-k evidence.
+_DEVICE_LANE = re.compile(r"^read\.d(\d+)$")
+
+
+def device_of_span_args(args: Dict[str, Any]) -> Optional[str]:
+    """The device identity a span's args pin it to, or None.
+
+    Two tag conventions feed this: an explicit ``device=`` attr (the
+    mesh fold's ``fold.segment`` spans — ``data[0-7]`` for a dispatch
+    covering the whole axis), and a ``lane=read.d<k>`` attr (the
+    per-device ingestion lanes, genuinely device-local work)."""
+    dev = args.get("device")
+    if dev is not None:
+        return str(dev)
+    lane = args.get("lane")
+    if isinstance(lane, str):
+        m = _DEVICE_LANE.match(lane)
+        if m:
+            return m.group(1)
+    return None
 
 
 def _jsonable(v: Any) -> Any:
@@ -86,6 +111,27 @@ def to_chrome_trace(records: Iterable[Dict[str, Any]],
                 "tid": tid_of[raw],
                 "args": {"name": r.get("thread", f"thread-{raw}")},
             })
+    # Synthetic device tracks: spans pinned to a device (explicit
+    # ``device=`` attr, or a ``read.d<k>`` per-device ingestion lane)
+    # render on their own ``device-<k>`` row so an 8-chip run reads as
+    # 8 parallel tracks, not one interleaved thread. Numeric device ids
+    # sort numerically so device-10 lands after device-9.
+    dev_keys: List[str] = []
+    for r in records:
+        if r.get("type") != "span":
+            continue
+        dev = device_of_span_args(r.get("args") or {})
+        if dev is not None and dev not in dev_keys:
+            dev_keys.append(dev)
+    dev_keys.sort(key=lambda s: (0, int(s)) if s.isdigit() else (1, s))
+    dev_tid_of: Dict[str, int] = {}
+    for dev in dev_keys:
+        dev_tid_of[dev] = len(tid_of) + len(dev_tid_of) + 1
+        events.append({
+            "name": "thread_name", "ph": "M", "pid": 1,
+            "tid": dev_tid_of[dev],
+            "args": {"name": f"device-{dev}"},
+        })
     for r in records:
         kind = r.get("type")
         if kind == "span":
@@ -96,9 +142,13 @@ def to_chrome_trace(records: Iterable[Dict[str, Any]],
                 args["parent_id"] = r["parent_id"]
             if r.get("error") is not None:
                 args["error"] = r["error"]
+            dev = device_of_span_args(args)
             events.append({
                 "name": r["name"], "ph": "X", "pid": 1,
-                "tid": tid_of.get(r.get("tid"), 0),
+                "tid": (
+                    dev_tid_of[dev] if dev is not None
+                    else tid_of.get(r.get("tid"), 0)
+                ),
                 "ts": int(r["ts_us"]), "dur": int(r["dur_us"]),
                 "args": args,
             })

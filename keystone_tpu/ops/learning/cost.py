@@ -234,6 +234,178 @@ def candidate_label(est) -> str:
     return name + (f"[{','.join(qual)}]" if qual else "")
 
 
+# ---------------------------------------------------------------------------
+# Mesh-layout pricing (ISSUE 16): layouts are first-class candidates
+# ---------------------------------------------------------------------------
+
+# Candidate (data, model) mesh shapes the layout selector prices for a
+# data-parallel streamed gram fold. 1x1 is the single-chip baseline the
+# BENCH rows measured; 8x1 puts every device on the fold's row axis; 4x2
+# spends half the pod replicating along the model axis (which the gram
+# fold cannot use — it prices as a 4-way fold plus replica broadcast).
+MESH_LAYOUTS: Tuple[Tuple[int, int], ...] = ((1, 1), (4, 1), (4, 2), (8, 1))
+
+
+def mesh_layout_label(data: int, model: int) -> str:
+    """Stable candidate label of one mesh layout — what the
+    ``mesh_layout`` CostDecision records and the replay test pins."""
+    return f"mesh[data={int(data)},model={int(model)}]"
+
+
+def price_mesh_layout(
+    n: int, d: int, k: int, data: int, model: int,
+    *,
+    nnz_per_row: Optional[int] = None,
+    cpu_weight: Optional[float] = None,
+    mem_weight: Optional[float] = None,
+    network_weight: Optional[float] = None,
+) -> float:
+    """Predicted seconds for ONE streamed gram fit on a (data × model)
+    mesh.
+
+    The model mirrors the fold's actual program shape
+    (ops/learning/lbfgs.py ``_run_lbfgs_gram_streamed_mesh``):
+
+    - each of the ``data`` devices folds its contiguous row shard locally
+      (compute and scan terms divide by ``data`` and by nothing else —
+      the gram fold has no model-axis parallelism);
+    - ONE ring all-reduce of (G upper-tri, AtY, yty) crosses the ICI per
+      fit: ``2·(p-1)/p`` of the reduced floats move per device;
+    - model-axis replicas fold identical shards, so ``model > 1`` buys
+      nothing and pays the operand broadcast to each extra replica.
+    """
+    if cpu_weight is None or mem_weight is None or network_weight is None:
+        aw = active_weights()
+        cpu_weight = cpu_weight if cpu_weight is not None else aw[0]
+        mem_weight = mem_weight if mem_weight is not None else aw[1]
+        network_weight = network_weight if network_weight is not None else aw[2]
+    p, q = int(data), int(model)
+    active = float(nnz_per_row) if nnz_per_row else float(d)
+    # Per-fit work: gram outer products (active² MACs/row) + AtY + labels.
+    flops = 2.0 * n * active * (active + k)
+    cells = float(n) * (2.0 * active + k)  # idx+val lanes and the labels
+    fold_s = max(cpu_weight * flops, mem_weight * cells) / p
+    # The single psum tree-reduction per fit (upper-tri G + AtY + yty).
+    reduce_floats = d * (d + 1) / 2.0 + d * k + 1.0
+    net_s = (
+        network_weight * reduce_floats * 2.0 * (p - 1) / p if p > 1 else 0.0
+    )
+    # Replica tax: the fold operands reach each model-axis replica over
+    # the same interconnect the psum rides.
+    net_s += network_weight * (cells / p) * (q - 1)
+    return fold_s + net_s
+
+
+def mesh_layout_resident_bytes(
+    n: int, d: int, k: int, data: int,
+    nnz_per_row: Optional[int] = None,
+) -> float:
+    """Per-device HBM claim of a chip-resident row shard under a layout:
+    compressed-COO lanes (int16 idx + bf16 val = 4 B/nnz) when the input
+    is sparse, f32 rows otherwise, plus the f32 label shard."""
+    row = (
+        COMPRESSED_BYTES_PER_NNZ_DEFAULT * float(nnz_per_row)
+        if nnz_per_row else 4.0 * d
+    )
+    return (n / max(int(data), 1)) * (row + 4.0 * k)
+
+
+# Kept here (not imported from data/resident.py) so pricing has no
+# data-plane import cycle; tests/test_cost_replay.py asserts the two
+# constants agree.
+COMPRESSED_BYTES_PER_NNZ_DEFAULT = 4.0
+
+
+def choose_mesh_layout(
+    n: int, d: int, k: int,
+    *,
+    nnz_per_row: Optional[int] = None,
+    layouts: Sequence[Tuple[int, int]] = MESH_LAYOUTS,
+    num_devices: Optional[int] = None,
+    hbm_bytes: Optional[int] = None,
+    hbm_utilization: float = DEFAULT_HBM_UTILIZATION,
+):
+    """Select a mesh layout for a streamed gram fit, with the decision
+    recorded as first-class ``cost.decision`` evidence.
+
+    Prices every candidate layout in ``layouts`` (default
+    :data:`MESH_LAYOUTS`), marks infeasible the ones needing more chips
+    than ``num_devices`` (default: the runtime's device count), and
+    emits a ``decision="mesh_layout"`` CostDecision whose
+    :class:`~keystone_tpu.obs.tracer.CostOutcomeRef` the runner stamps
+    with the measured fit wall — ``bin/calibrate`` joins these records
+    exactly like solver decisions (obs/calibrate.py
+    ``CALIBRATED_DECISIONS``).
+
+    Returns ``((data, model), outcome_ref)``; ``outcome_ref`` is None
+    when no tracer is active.
+    """
+    devices = int(num_devices) if num_devices else max(len(jax.devices()), 1)
+    budget = (
+        hbm_bytes if hbm_bytes is not None else device_memory_bytes()
+    ) * hbm_utilization
+    cpu_w, mem_w, net_w = active_weights()
+    try:
+        family = weights_family_name()
+    except ValueError:
+        family = "custom"
+
+    def feasible(p: int, q: int) -> bool:
+        return p * q <= devices
+
+    costs = [
+        price_mesh_layout(
+            n, d, k, p, q, nnz_per_row=nnz_per_row,
+            cpu_weight=cpu_w, mem_weight=mem_w, network_weight=net_w,
+        ) if feasible(p, q) else float("inf")
+        for p, q in layouts
+    ]
+    if all(c == float("inf") for c in costs):
+        raise ValueError(
+            f"no candidate mesh layout fits {devices} device(s): "
+            f"{[mesh_layout_label(p, q) for p, q in layouts]}"
+        )
+    best = int(np.argmin(costs))
+    winner = layouts[best]
+    ref = obs.record_cost_decision(obs.CostDecision(
+        decision="mesh_layout",
+        winner=mesh_layout_label(*winner),
+        candidates=[
+            {
+                "label": mesh_layout_label(p, q),
+                "cost_s": (None if c == float("inf") else float(c)),
+                "feasible": c != float("inf"),
+                "resident_bytes": float(
+                    mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
+                ),
+                "chip_resident": (
+                    mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
+                    <= budget
+                ),
+                "host_ok": True,
+            }
+            for (p, q), c in zip(layouts, costs)
+        ],
+        reason="argmin",
+        context={
+            "n": int(n), "d": int(d), "k": int(k),
+            "sparsity": (
+                float(nnz_per_row) / d if nnz_per_row else 1.0
+            ),
+            "machines": devices,
+            "hbm_budget_bytes": float(budget),
+            "nnz_per_row": (
+                int(nnz_per_row) if nnz_per_row else None
+            ),
+            "weights": {
+                "cpu": cpu_w, "mem": mem_w, "network": net_w,
+                "family": family,
+            },
+        },
+    ))
+    return winner, ref
+
+
 class CostModel:
     """Analytic per-solver performance model (CostModel.scala:6-16)."""
 

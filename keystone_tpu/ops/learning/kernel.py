@@ -407,7 +407,7 @@ def _krr_mesh_program(mesh, gamma: float, lam: float, bs: int,
 
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(), P()),

@@ -398,6 +398,8 @@ def run_lbfgs_gram_streamed(
     pipeline: bool = True,
     prefetch_stats=None,
     checkpoint=None,
+    mesh=None,
+    mesh_axis: Optional[str] = None,
 ):
     """Streamed sparse ridge fit: fold G = AᵀA over COO chunks ONCE
     (``sparse.sparse_gram_stream`` — chunks may be regenerated/loaded per
@@ -450,6 +452,22 @@ def run_lbfgs_gram_streamed(
     PrefetchStats` filled by the prefetched source path (overlap +
     retry/backoff accounting — ``utils.profiling``).
 
+    ``mesh``: a ``jax.sharding.Mesh`` — the multi-chip tier (ISSUE 16).
+    The chunk stream partitions CONTIGUOUSLY over ``mesh_axis`` (default
+    the ``data`` axis): device j folds chunks ``[j·cpd, (j+1)·cpd)``
+    (``cpd = ceil(num_chunks / m)``) into its own local (G, AtY, yty)
+    partial — no collective crosses the ICI during the fold — and ONE
+    ``lax.psum`` tree reduction of the carry per fit precedes the
+    replicated solve. Resident ``operands`` are sharded over their
+    leading chunk axis (each device's shard lives in ITS HBM — the
+    8-chip form of the compressed-resident tier); a ``segment_source``
+    must then be a SEQUENCE of per-device sources whose segment ``s``
+    carries device j's segment-relative chunks, read concurrently on
+    per-device ``read.d<j>`` lanes (``data/prefetch.py::
+    iter_mesh_segments``). ``chunk_fn`` receives device-LOCAL (resident)
+    or segment-relative (streamed) ids either way. Checkpointing is not
+    supported on the mesh path yet (an explicit ``checkpoint`` raises).
+
     ``checkpoint``: a :class:`keystone_tpu.data.durable.CheckpointSpec`
     (or directory path; None consults ``KEYSTONE_CHECKPOINT_DIR``)
     snapshotting the (G, AtY, yty) carry + segment cursor every
@@ -469,6 +487,22 @@ def run_lbfgs_gram_streamed(
 
     if n is None:
         raise ValueError("streamed fit needs the true row count n")
+    if mesh is not None:
+        if checkpoint is not None:
+            raise ValueError(
+                "mesh-sharded streamed fits do not checkpoint yet: the "
+                "carry is a per-device partial on every chip (snapshot "
+                "would need a gather); drop checkpoint= or mesh="
+            )
+        return _run_lbfgs_gram_streamed_mesh(
+            chunk_fn, int(num_chunks), int(d), int(k), mesh,
+            mesh_axis=mesh_axis, lam=lam, num_iterations=num_iterations,
+            convergence_tol=convergence_tol, n=n, use_pallas=use_pallas,
+            val_dtype=val_dtype, operands=operands,
+            max_chunks_per_dispatch=max_chunks_per_dispatch,
+            segment_sources=segment_source, inflight=inflight,
+            prefetch_depth=prefetch_depth, prefetch_stats=prefetch_stats,
+        )
     explicit_checkpoint = checkpoint is not None
     checkpoint = resolve_checkpoint(checkpoint)
     seg = max_chunks_per_dispatch
@@ -792,6 +826,243 @@ def _gram_solve_program(d, k, lam, num_iterations, convergence_tol, n,
         return W[:d], loss
 
     return solve
+
+
+def _mesh_fold_axis(mesh, mesh_axis: Optional[str]) -> str:
+    """Resolve (and validate) the fold's data-parallel mesh axis."""
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    axis = mesh_axis or mesh_lib.DATA_AXIS
+    if axis not in mesh.shape:
+        raise ValueError(
+            f"mesh {dict(mesh.shape)} has no {axis!r} axis to shard the "
+            f"chunk stream over"
+        )
+    return axis
+
+
+def _mesh_gram_init(d, k, val_dtype, mesh, axis):
+    """Per-device zero carries: stacked (m, ...) arrays sharded over
+    ``axis`` so device j's partial lives only in device j's HBM."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from keystone_tpu.ops.sparse import gram_pad_dim
+
+    m = int(mesh.shape[axis])
+    d_pad = gram_pad_dim(d, val_dtype)
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(*shape):
+        return jax.device_put(np.zeros(shape, np.float32), sharding)
+
+    return (put(m, d_pad, d_pad), put(m, d_pad, k), put(m))
+
+
+@functools.lru_cache(maxsize=8)
+def _gram_fold_program_mesh(chunk_fn, num_chunks, d, k, seg, use_pallas,
+                            val_dtype, pipeline, mesh, axis,
+                            segment_relative):
+    """Mesh-sharded segment fold: each device folds ``seg`` chunks of ITS
+    contiguous chunk shard into ITS local (G, AtY, yty) partial. NO
+    collective runs here — the single per-fit psum lives in
+    :func:`_gram_mesh_solve_program` — so every dispatched step is pure
+    device-local syrk work and scaling is bounded only by the one final
+    tree reduction.
+
+    Chunk ownership is contiguous: device j owns local ids [0, cpd)
+    mapping to global chunks ``j·cpd + local`` (``cpd =
+    ceil(num_chunks / m)``); phantom ids past a device's ragged tail are
+    masked dead, so no chunk is folded twice or skipped
+    (tests/test_multichip.py pins parity with the 1-device fold).
+    ``segment_relative``: operands hold only this dispatch's ``seg``
+    chunks, stacked (m, seg, ...) and sharded — the per-device-lane
+    streamed ingestion path; otherwise operands are the full resident
+    shard (leading dim m·cpd, sharded) and ``chunk_fn`` slices by the
+    device-local id.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.ops.sparse import sparse_gram_fold
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    m = int(mesh.shape[axis])
+    cpd = -(-int(num_chunks) // m)
+
+    def local(carry, cid0, operands):
+        if segment_relative:
+            operands = tuple(o[0] for o in operands)
+        base = jax.lax.axis_index(axis) * cpd
+
+        def cf(loc):
+            sl = loc - cid0 if segment_relative else loc
+            indices, values, Yc = chunk_fn(sl, *operands)
+            live = (loc < cpd) & (base + loc < num_chunks)
+            return (
+                indices,
+                jnp.where(live, values, jnp.zeros_like(values)),
+                jnp.where(live, Yc, jnp.zeros_like(Yc)),
+            )
+
+        G, AtY, yty = sparse_gram_fold(
+            (carry[0][0], carry[1][0], carry[2][0]),
+            cid0 + jnp.arange(seg), cf, d, k,
+            use_pallas=use_pallas, val_dtype=val_dtype, pipeline=pipeline,
+        )
+        return G[None], AtY[None], yty[None]
+
+    sharded = P(axis)
+    fold = mesh_lib.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=((sharded, sharded, sharded), P(), sharded),
+        out_specs=(sharded, sharded, sharded),
+        check_vma=False,
+    )
+    return functools.partial(jax.jit, donate_argnums=(0,))(fold)
+
+
+@functools.lru_cache(maxsize=8)
+def _gram_mesh_solve_program(d, k, lam, num_iterations, convergence_tol, n,
+                             val_dtype, mesh, axis):
+    """The fit's ONE cross-device collective: ``lax.psum`` of the
+    (G, AtY, yty) pytree over ``axis`` (a pytree psum lowers to a single
+    fused all-reduce over the ICI), replicated out, then the standard
+    finalize + L-BFGS-on-G solve — identical iterates to the 1-device
+    fold up to the reduction's float reassociation."""
+    from jax.sharding import PartitionSpec as P
+
+    from keystone_tpu.parallel import mesh as mesh_lib
+
+    def local(G, AtY, yty):
+        return jax.lax.psum((G[0], AtY[0], yty[0]), axis)
+
+    reduce = mesh_lib.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    solve = _gram_solve_program(
+        d, k, lam, num_iterations, convergence_tol, n, val_dtype
+    )
+
+    def run(carry):
+        return solve(reduce(*carry))
+
+    return run
+
+
+def _run_lbfgs_gram_streamed_mesh(
+    chunk_fn, num_chunks, d, k, mesh, *, mesh_axis, lam, num_iterations,
+    convergence_tol, n, use_pallas, val_dtype, operands,
+    max_chunks_per_dispatch, segment_sources, inflight, prefetch_depth,
+    prefetch_stats,
+):
+    """Mesh driver for :func:`run_lbfgs_gram_streamed` (ISSUE 16): the
+    host loop dispatches one shard_map fold per LOCAL segment (all
+    devices fold their own shard inside it), throttles inflight
+    dispatches, and barriers per step on the CPU backend
+    (``mesh_lib.sync_if_cpu`` — the forced-host multi-device queue
+    deadlock guard); one psum + replicated solve finish the fit."""
+    import time as _time
+
+    from keystone_tpu import obs
+    from keystone_tpu.parallel import mesh as mesh_lib
+    from keystone_tpu.parallel.streaming import BoundedInflight
+
+    axis = _mesh_fold_axis(mesh, mesh_axis)
+    m = int(mesh.shape[axis])
+    cpd = -(-int(num_chunks) // m)
+    throttle = BoundedInflight(inflight)
+    dev_tag = f"{axis}[0-{m - 1}]"
+
+    def step(fold, carry, cid0, ops):
+        t0 = _time.perf_counter()
+        # The mesh fold is ONE dispatch covering every device's shard;
+        # the span carries the device-group tag (satellite: per-device
+        # occupancy) and the same compute-site accounting as the
+        # single-device stepper.
+        with obs.span("fold.segment", chunk0=int(cid0), device=dev_tag,
+                      num_devices=m):
+            carry = fold(
+                carry, jnp.asarray(cid0, jnp.int32),
+                tuple(jnp.asarray(o) for o in ops),
+            )
+            throttle.admit(jnp.sum(carry[2]))
+            mesh_lib.sync_if_cpu(carry[2])
+        if prefetch_stats is not None:
+            prefetch_stats.add_busy("compute", _time.perf_counter() - t0)
+        return carry
+
+    carry = _mesh_gram_init(d, k, val_dtype, mesh, axis)
+    solve = _gram_mesh_solve_program(
+        int(d), int(k), float(lam), int(num_iterations),
+        float(convergence_tol), int(n), jnp.dtype(val_dtype), mesh, axis,
+    )
+
+    if segment_sources is not None:
+        from keystone_tpu.data.prefetch import iter_mesh_segments
+
+        seg = max_chunks_per_dispatch
+        sources = list(segment_sources)
+        if len(sources) != m:
+            raise ValueError(
+                f"mesh fold over {axis}={m} needs {m} per-device segment "
+                f"sources, got {len(sources)}"
+            )
+        if seg is None:
+            raise ValueError(
+                "mesh segment sources need max_chunks_per_dispatch (the "
+                "per-device chunks carried by one segment)"
+            )
+        fold = _gram_fold_program_mesh(
+            chunk_fn, int(num_chunks), int(d), int(k), int(seg),
+            bool(use_pallas), jnp.dtype(val_dtype), bool(pipeline_ok(seg)),
+            mesh, axis, True,
+        )
+        for s, payloads in iter_mesh_segments(
+            sources, prefetch_depth=prefetch_depth, stats=prefetch_stats,
+        ):
+            # Stack device payloads host-side; device_put inside the fold
+            # call shards the (m, seg, ...) stack so each lane's bytes
+            # land only on its device.
+            ops = tuple(
+                np.stack([p[i] for p in payloads])
+                for i in range(len(payloads[0]))
+            )
+            carry = step(fold, carry, s * int(seg), ops)
+        return solve(carry)
+
+    # Resident path: pad the chunk axis to m·cpd and shard it so each
+    # device holds exactly its contiguous shard (8-chip chip-residency).
+    seg = int(max_chunks_per_dispatch) if max_chunks_per_dispatch else cpd
+    seg = min(seg, cpd)
+    ops = []
+    for o in operands:
+        o = np.asarray(o)
+        pad = m * cpd - o.shape[0]
+        if pad:
+            fill = -1 if np.issubdtype(o.dtype, np.integer) else 0
+            o = np.pad(
+                o, [(0, pad)] + [(0, 0)] * (o.ndim - 1),
+                constant_values=fill,
+            )
+        ops.append(mesh_lib.shard_rows(o, mesh, axis=axis))
+    ops = tuple(ops)
+    fold = _gram_fold_program_mesh(
+        chunk_fn, int(num_chunks), int(d), int(k), seg, bool(use_pallas),
+        jnp.dtype(val_dtype), False, mesh, axis, False,
+    )
+    for cid0 in range(0, cpd, seg):
+        carry = step(fold, carry, cid0, ops)
+    return solve(carry)
+
+
+def pipeline_ok(seg: int) -> bool:
+    """Streamed mesh segments double-buffer only when there is more than
+    one chunk to overlap inside a dispatch."""
+    return int(seg) > 1
 
 
 @functools.lru_cache(maxsize=16)

@@ -127,7 +127,7 @@ class CosineRandomFeaturesModel(Transformer):
                 W, b = self.W, self.b
                 self._sharded_fused = (
                     mesh,
-                    jax.shard_map(
+                    mesh_lib.shard_map(
                         lambda X: pallas_ops.cosine_features(X, W, b),
                         mesh=mesh,
                         in_specs=P(mesh_lib.DATA_AXIS),

@@ -179,7 +179,7 @@ def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
     # check_vma=False: pallas_call outputs carry no varying-mesh-axes info,
     # so the static replication checker cannot see through them; the psums
     # above establish the replicated out_specs regardless.
-    sharded_gram_corr = jax.shard_map(
+    sharded_gram_corr = mesh_lib.shard_map(
         gram_corr_body,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),
@@ -187,7 +187,7 @@ def _mesh_bcd_step(mesh, lam: float, use_pallas: bool):
         check_vma=False,
     )
 
-    sharded_corr = jax.shard_map(
+    sharded_corr = mesh_lib.shard_map(
         lambda a, r: jax.lax.psum(_corr(a, r), axis),
         mesh=mesh, in_specs=(P(axis), P(axis)), out_specs=P(),
         check_vma=False,
@@ -663,7 +663,7 @@ def tsqr_r(A, mesh=None) -> jax.Array:
             # (1, d, d) leaf per shard -> stacked on the data axis
             return r_local[None]
 
-        stacked = jax.shard_map(
+        stacked = mesh_lib.shard_map(
             local_qr,
             mesh=mesh,
             in_specs=P(mesh_lib.DATA_AXIS),

@@ -160,6 +160,28 @@ def replicate(x, mesh: Optional[Mesh] = None):
     return jax.device_put(x, NamedSharding(mesh, P()))
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs, check_vma: bool = True):
+    """Version-portable ``shard_map`` entry point.
+
+    Newer JAX exposes ``jax.shard_map`` (replication checking named
+    ``check_vma``); the 0.4 line only has the experimental entry point
+    whose equivalent flag is ``check_rep``. Every shard_map program in
+    this package routes through here so one import site owns the
+    difference — call it exactly like ``jax.shard_map``.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma,
+    )
+
+
 def sync_if_cpu(x) -> None:
     """Barrier after a dispatched step — on the CPU backend only.
 

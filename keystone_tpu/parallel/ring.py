@@ -102,7 +102,7 @@ def ring_pairwise_gaussian(X, gamma: float, mesh: Optional[Mesh] = None):
         _, cols = jax.lax.fori_loop(0, p, step, (x_local, cols0))
         return cols
 
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None),
         check_vma=False,
     )(X)
@@ -149,7 +149,7 @@ def ring_kernel_apply(
         _, _, acc = jax.lax.fori_loop(0, p, step, (xtr_local, w_local, acc0))
         return acc
 
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None)),
@@ -265,7 +265,7 @@ def ring_attention(
             out = out * (q_pos < n_valid)[:, None].astype(out.dtype)
         return out.astype(out_dtype)
 
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis, None), P(axis, None), P(axis, None)),
@@ -336,7 +336,7 @@ def ring_gram(A, mesh: Optional[Mesh] = None):
         )
         return jax.lax.psum_scatter(local, axis, scatter_dimension=0, tiled=True)
 
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis, None),
         check_vma=False,
     )(A)

@@ -486,6 +486,7 @@ def streaming_bcd_fit(
     use_pallas: bool = False,
     valid: Optional[int] = None,
     labelize: Optional[Callable[[Array], Array]] = None,
+    mesh=None,
 ) -> Tuple[Array, Array, Array]:
     """One-dispatch streamed fit: tiles → (G, FY, yty) → BCD epochs.
 
@@ -495,7 +496,32 @@ def streaming_bcd_fit(
     Returns (W, train_loss, yty) with W: (nb, block_size, k). The train
     loss ||Y − FW||²/n comes algebraically from the accumulated stats —
     (yty − 2·tr(Wᵀ FY) + tr(Wᵀ G W))/n — two small GEMMs, no data pass.
+
+    ``mesh`` (ISSUE 16): shard the tile folds over the mesh's data axis
+    (each device folds its row shard locally; ONE psum of the stats
+    crosses the ICI — :func:`gram_stats_mesh`) with a replicated solve —
+    the same iterates as the 1-device fit up to reduction order. X rows
+    must divide evenly over the axis (pad and pass ``valid``);
+    ``labelize`` is not supported on this path (pre-apply it to Y).
     """
+    if mesh is not None:
+        if labelize is not None:
+            raise ValueError(
+                "labelize is not supported with mesh=; pre-apply it to Y "
+                "(the mesh fold shards Y rows alongside X)"
+            )
+        n_true = valid if valid is not None else (
+            X.shape[0] if X.ndim == 2 else X.shape[0] * X.shape[1]
+        )
+        G, FY, yty = gram_stats_mesh(
+            X, Y, featurize, d_feat, tile_rows, mesh,
+            use_pallas=use_pallas, n_true=valid,
+        )
+        W, loss, _, _ = _solve_from_stats_core(
+            G, FY, yty, None, None, n_true, lam, block_size, num_iter,
+            False,
+        )
+        return W, loss, yty
     W, loss, yty, _, _ = _dispatch_fit(
         X, Y, featurize, False,
         dict(d_feat=d_feat, tile_rows=tile_rows, block_size=block_size,
@@ -1009,7 +1035,7 @@ def streaming_block_bcd_mesh(
         return carry[1]
 
     out_specs = (P(), P(), P()) if center else P()
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(axis), P(axis), P(), P()),
@@ -1246,7 +1272,7 @@ def streaming_block_bcd_mesh_2d(
     out_specs = (
         (P(model_ax), P(model_ax), P()) if center else P(model_ax)
     )
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         body,
         mesh=mesh,
         in_specs=(
@@ -1296,7 +1322,7 @@ def gram_stats_mesh(
         return tuple(jax.lax.psum(s, axis) for s in stats)
 
     n_out = 5 if moments else 3
-    return jax.shard_map(
+    return mesh_lib.shard_map(
         local,
         mesh=mesh,
         in_specs=(P(axis), P(axis)),

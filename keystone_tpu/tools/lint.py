@@ -60,6 +60,17 @@ test over the whole package (``tests/test_lint.py``):
     place names exist. Covers the live-plane names (``slo.*``,
     ``exporter.*``) the ISSUE-10 exporter publishes.
 
+``mesh-axis-name``
+    Mesh axis names at collective / sharding call sites (``psum(...)``,
+    ``axis_index(...)``, ``all_gather(...)``, ``PartitionSpec``/``P``
+    literals — the strings ``shard_map`` programs shard by) must come
+    from the ``DATA_AXIS``/``MODEL_AXIS`` registry of
+    :mod:`keystone_tpu.parallel.mesh` — parsed, never imported, exactly
+    like the fault-site registry. A literal ``"data"`` typo'd to
+    ``"date"`` produces a mesh program that fails at trace time at best,
+    or silently reduces over the wrong axis on a 2-D mesh at worst; the
+    registry constants are the one place axis names exist.
+
 Findings are ``path:line: [rule] message``; the CLI exits 1 on any.
 """
 
@@ -80,6 +91,7 @@ RULES = (
     "fault-site",
     "bench-row",
     "metric-name",
+    "mesh-axis-name",
 )
 
 _JAX_NAMES = {"jax", "jnp"}
@@ -145,6 +157,29 @@ def metric_name_registry(path: Optional[Path] = None) -> Dict[str, str]:
     return _parse_prefixed_constants(
         path or _metrics_module_path(), "METRIC_"
     )
+
+
+def _mesh_module_path() -> Path:
+    return Path(__file__).resolve().parent.parent / "parallel" / "mesh.py"
+
+
+def mesh_axis_registry(path: Optional[Path] = None) -> Dict[str, str]:
+    """``{AXIS_CONST_NAME: "axis"}`` parsed (never imported) from
+    parallel/mesh.py: the top-level ``*_AXIS = "..."`` assignments
+    (``DATA_AXIS``, ``MODEL_AXIS``) — the one place axis names exist."""
+    tree = ast.parse((path or _mesh_module_path()).read_text())
+    registry: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id.endswith("_AXIS")
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            registry[node.targets[0].id] = node.value.value
+    return registry
 
 
 # ---------------------------------------------------------------------------
@@ -599,6 +634,80 @@ def _check_metric_names(
 
 
 # ---------------------------------------------------------------------------
+# Rule: mesh-axis-name
+# ---------------------------------------------------------------------------
+
+# Collectives whose axis-name argument is the SECOND positional (first
+# is the operand) — jax.lax signatures — and those where it is the
+# first (axis_index takes only the axis).
+_AXIS_ARG1_COLLECTIVES = (
+    "psum", "psum_scatter", "pmean", "pmax", "pmin",
+    "all_gather", "ppermute", "all_to_all",
+)
+_AXIS_ARG0_COLLECTIVES = ("axis_index",)
+# Sharding-spec constructors whose every string argument is an axis
+# name: the ``in_specs``/``out_specs`` literals shard_map programs (and
+# NamedSharding placements) are built from.
+_SPEC_CONSTRUCTORS = ("PartitionSpec", "P")
+
+
+def _check_mesh_axis_names(
+    tree: ast.Module, path: str, registry: Dict[str, str]
+) -> List[Finding]:
+    """Every string-literal axis name at a collective call site or
+    inside a ``PartitionSpec``/``P`` literal must be one of the parsed
+    registry's values; an ``*_AXIS`` constant reference must be defined
+    there. Variables and f-strings are left alone — only literals can
+    be checked statically, and the rule exists precisely so call sites
+    use the constants instead of literals."""
+    findings: List[Finding] = []
+    values = set(registry.values())
+    names = set(registry)
+
+    def check_axis_expr(expr: ast.AST, call: ast.Call) -> None:
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                if sub.value not in values:
+                    findings.append(Finding(
+                        path, call.lineno, "mesh-axis-name",
+                        f"mesh axis name {sub.value!r} is not in the "
+                        f"parallel/mesh.py registry {sorted(values)} — "
+                        "use the DATA_AXIS/MODEL_AXIS constants; a "
+                        "typo'd axis reduces over the wrong mesh "
+                        "dimension",
+                    ))
+            elif isinstance(sub, (ast.Name, ast.Attribute)):
+                ref = sub.id if isinstance(sub, ast.Name) else sub.attr
+                if ref.endswith("_AXIS") and ref not in names:
+                    findings.append(Finding(
+                        path, call.lineno, "mesh-axis-name",
+                        f"{ref} is not defined in parallel/mesh.py "
+                        f"(known: {sorted(names)})",
+                    ))
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _call_name(node.func)
+        if name in _AXIS_ARG1_COLLECTIVES:
+            if len(node.args) >= 2:
+                check_axis_expr(node.args[1], node)
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    check_axis_expr(kw.value, node)
+        elif name in _AXIS_ARG0_COLLECTIVES:
+            if node.args:
+                check_axis_expr(node.args[0], node)
+            for kw in node.keywords:
+                if kw.arg == "axis_name":
+                    check_axis_expr(kw.value, node)
+        elif name in _SPEC_CONSTRUCTORS:
+            for arg in node.args:
+                check_axis_expr(arg, node)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Rule: bench-row
 # ---------------------------------------------------------------------------
 
@@ -658,6 +767,7 @@ def lint_file(
     registry: Optional[Dict[str, str]] = None,
     rules: Optional[Sequence[str]] = None,
     metric_registry: Optional[Dict[str, str]] = None,
+    mesh_registry: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
     """Lint one file; returns findings (parse failures are findings too —
     a file the linter cannot read is a file nothing checks)."""
@@ -665,6 +775,8 @@ def lint_file(
         registry = fault_site_registry()
     if metric_registry is None:
         metric_registry = metric_name_registry()
+    if mesh_registry is None:
+        mesh_registry = mesh_axis_registry()
     src = path.read_text()
     try:
         tree = ast.parse(src)
@@ -694,6 +806,13 @@ def lint_file(
             findings.extend(
                 _check_metric_names(tree, sp, metric_registry)
             )
+    if "mesh-axis-name" in enabled:
+        # parallel/mesh.py itself defines the axis registry; skip it
+        # (parity with the faults.py / metrics.py exemptions above).
+        if not (path.name == "mesh.py" and path.parent.name == "parallel"):
+            findings.extend(
+                _check_mesh_axis_names(tree, sp, mesh_registry)
+            )
     return findings
 
 
@@ -711,6 +830,7 @@ def lint_paths(
 ) -> List[Finding]:
     registry = fault_site_registry()
     metric_registry = metric_name_registry()
+    mesh_registry = mesh_axis_registry()
     findings: List[Finding] = []
     for f in _iter_py(paths):
         if "__pycache__" in f.parts:
@@ -718,6 +838,7 @@ def lint_paths(
         findings.extend(lint_file(
             f, registry=registry, rules=rules,
             metric_registry=metric_registry,
+            mesh_registry=mesh_registry,
         ))
     return findings
 

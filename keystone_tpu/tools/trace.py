@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Sequence
 from keystone_tpu.obs.calibrate import DEFAULT_DRIFT_THRESHOLD as \
     DRIFT_THRESHOLD
 from keystone_tpu.obs.export import (
+    device_of_span_args,
     load_events,
     to_chrome_trace,
     validate_chrome_trace,
@@ -83,6 +84,28 @@ def _lane_occupancy(
     return dict(lanes)
 
 
+def _device_occupancy(
+    spans: List[Dict[str, Any]], wall_s: float
+) -> Dict[str, Dict[str, float]]:
+    """Busy seconds per DEVICE: spans carrying a ``device=`` attr (the
+    mesh fold dispatches) plus the per-device ``read.d<k>`` ingestion
+    lanes — the table that shows whether an 8-chip run actually kept 8
+    chips busy, or one."""
+    devs: Dict[str, Dict[str, float]] = defaultdict(
+        lambda: {"busy_s": 0.0, "spans": 0}
+    )
+    for s in spans:
+        dev = device_of_span_args(s.get("args") or {})
+        if dev is None:
+            continue
+        row = devs[dev]
+        row["busy_s"] += s.get("dur_us", 0) / 1e6
+        row["spans"] += 1
+    for row in devs.values():
+        row["occupancy"] = (row["busy_s"] / wall_s) if wall_s > 0 else 0.0
+    return dict(devs)
+
+
 def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
     """The structured summary the CLI renders (and tests assert on)."""
     spans = [r for r in records if r.get("type") == "span"]
@@ -101,6 +124,7 @@ def summarize(records: List[Dict[str, Any]]) -> Dict[str, Any]:
         "num_events": len(events),
         "self_times": _self_times(spans),
         "lanes": _lane_occupancy(spans, wall_s),
+        "devices": _device_occupancy(spans, wall_s),
         "cost_decisions": [
             e.get("args", {}) for e in events
             if e.get("name") == "cost.decision"
@@ -133,6 +157,21 @@ def _render(summary: Dict[str, Any], top: int) -> str:
         for lane, row in sorted(summary["lanes"].items()):
             lines.append(
                 f"  {lane:<12} tasks={int(row['tasks']):>5} "
+                f"busy={row['busy_s']:.3f}s "
+                f"occupancy={row['occupancy']:.1%}"
+            )
+    if summary.get("devices"):
+        lines.append("")
+        lines.append("per-device occupancy (device= spans + read.d<k> lanes):")
+        devs = summary["devices"]
+
+        def _dev_key(item):
+            name = item[0]
+            return (0, int(name)) if name.isdigit() else (1, name)
+
+        for dev, row in sorted(devs.items(), key=_dev_key):
+            lines.append(
+                f"  device-{dev:<10} spans={int(row['spans']):>5} "
                 f"busy={row['busy_s']:.3f}s "
                 f"occupancy={row['occupancy']:.1%}"
             )

@@ -323,6 +323,55 @@ class TestRooflineAuditability:
         # Dicts with no scale claims are not burdened.
         bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
 
+    def test_scaling_claims_require_devices_and_baseline(self):
+        """ISSUE 16 satellite: any dict claiming a multi-device speedup
+        or scaling efficiency must carry the device count and the
+        single-device wall it divides by in the SAME dict — a speedup
+        with no denominator is not a measured scaling claim."""
+        bench = _load_bench()
+        good = {
+            "speedup_vs_single_device": 6.1,
+            "scaling_efficiency": 0.76,
+            "num_devices": 8,
+            "single_device_baseline_s": 223.8,
+        }
+        row = bench.make_row(
+            "multichip_probe", 36.7, "s", None, "min_of_N_warm",
+            {"mesh": good},
+        )
+        assert row["detail"]["mesh"]["num_devices"] == 8
+        for missing, pat in (
+            ("num_devices", "num_devices"),
+            ("single_device_baseline_s", "single_device_baseline_s"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "multichip_probe", 36.7, "s", None, "min_of_N_warm",
+                    {"mesh": d},
+                )
+        # A prose device count must not satisfy the rule.
+        d = dict(good)
+        d["num_devices"] = "an 8-chip pod"
+        with pytest.raises(ValueError, match="num_devices"):
+            bench.make_row(
+                "multichip_probe", 36.7, "s", None, "min_of_N_warm",
+                {"mesh": d},
+            )
+        # Either claim key alone triggers the rule, at any nesting.
+        with pytest.raises(ValueError, match="num_devices"):
+            bench.make_row(
+                "multichip_probe", 36.7, "s", None, "min_of_N_warm",
+                {"legs": [{"scaling_efficiency_8dev": 0.8}]},
+            )
+        with pytest.raises(ValueError, match="single_device_baseline_s"):
+            bench.make_row(
+                "multichip_probe", 36.7, "s", None, "min_of_N_warm",
+                {"speedup": 2.0, "num_devices": 2},
+            )
+        # Dicts with no scaling claims are not burdened.
+        bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
+
     def test_calibration_claims_require_decisions_and_family(self):
         """ISSUE 13 satellite: any dict claiming a cost-model prediction
         error (a ``prediction_error*`` key) must carry the

@@ -294,6 +294,104 @@ class TestReplayAmazonCompressedResident:
         _audit_winner(audit, inner)  # raw engine wins the tie on record
 
 
+class TestReplayMeshLayout:
+    """ISSUE 16: mesh layouts are first-class priced candidates whose
+    ``mesh_layout`` CostDecision events flow through the calibration
+    plane. The pin: at the amazon_fulln geometry (n=65e6, d=16384(+1),
+    nnz=82(+1 intercept), k=2) on 8 devices the recorded winner is the
+    full data-parallel layout — the one MULTICHIP_r05 dry-ran and the
+    multichip_amazon_fulln row targets."""
+
+    N, D1, W, K = 65_000_000, 16_385, 83, 2
+
+    def _choose_traced(self, **kw):
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        with obs.tracing() as t:
+            (p, q), ref = cost_mod.choose_mesh_layout(
+                self.N, self.D1, self.K, nnz_per_row=self.W,
+                num_devices=8, **kw,
+            )
+        decisions = [
+            e for e in t.events
+            if e["type"] == "event" and e["name"] == "cost.decision"
+            and e["args"]["decision"] == "mesh_layout"
+        ]
+        assert len(decisions) == 1, decisions
+        return (p, q), ref, decisions[0]["args"], t
+
+    def test_recorded_layout_winner_pinned(self):
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        (p, q), ref, args, _ = self._choose_traced()
+        assert (p, q) == (8, 1)
+        assert args["winner"] == "mesh[data=8,model=1]"
+        assert args["reason"] == "argmin"
+        labels = [c["label"] for c in args["candidates"]]
+        assert labels == [
+            cost_mod.mesh_layout_label(*layout)
+            for layout in cost_mod.MESH_LAYOUTS
+        ]
+        by_label = {c["label"]: c for c in args["candidates"]}
+        # Every candidate feasible at 8 devices, each priced, and the
+        # model-parallel replica tax makes 4x2 strictly costlier than
+        # 4x1 (same data shards + an extra replica of every shard).
+        assert all(c["feasible"] for c in args["candidates"])
+        assert (by_label["mesh[data=4,model=2]"]["cost_s"]
+                > by_label["mesh[data=4,model=1]"]["cost_s"])
+        assert (by_label["mesh[data=8,model=1]"]["cost_s"]
+                < by_label["mesh[data=4,model=1]"]["cost_s"])
+        # Geometry + weight family ride in the event (refit provenance).
+        assert args["n"] == self.N and args["d"] == self.D1
+        assert args["weights"]["family"] == "tpu"
+
+    def test_stamped_outcome_joins_through_calibration_plane(self):
+        from keystone_tpu.obs import calibrate as cal
+
+        _, ref, _, t = self._choose_traced()
+        assert ref is not None
+        ref.stamp(28.5, timing="wall")
+        assert "mesh_layout" in cal.CALIBRATED_DECISIONS
+        rows = cal.join_decisions(t.events)
+        mesh_rows = [r for r in rows if r.decision == "mesh_layout"]
+        assert len(mesh_rows) == 1, rows
+        row = mesh_rows[0]
+        assert row.winner == "mesh[data=8,model=1]"
+        assert row.measured_s == pytest.approx(28.5)
+        assert row.joined_via == "outcome"
+        assert row.predicted_s > 0
+        assert row.log_error() is not None
+
+    def test_infeasible_layouts_cut_by_device_count(self):
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        with obs.tracing() as t:
+            (p, q), _ = cost_mod.choose_mesh_layout(
+                self.N, self.D1, self.K, nnz_per_row=self.W,
+                num_devices=4,
+            )
+        assert (p, q) == (4, 1)
+        args = [
+            e for e in t.events
+            if e["type"] == "event" and e["name"] == "cost.decision"
+            and e["args"]["decision"] == "mesh_layout"
+        ][0]["args"]
+        feas = {c["label"]: c["feasible"] for c in args["candidates"]}
+        assert not feas["mesh[data=8,model=1]"]
+        assert not feas["mesh[data=4,model=2]"]
+        assert feas["mesh[data=4,model=1]"]
+
+    def test_compressed_bytes_constant_matches_resident_tier(self):
+        # cost.py prices per-device residency with its own default so it
+        # never imports the data plane; the constant must TRACK the
+        # resident tier's real encoding (int16 idx + bf16 val = 4 B/nnz).
+        from keystone_tpu.data import resident
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        assert (cost_mod.COMPRESSED_BYTES_PER_NNZ_DEFAULT
+                == resident.COMPRESSED_BYTES_PER_NNZ)
+
+
 class TestWeightFamilySwitch:
     def test_tpu_active_by_default(self, monkeypatch):
         monkeypatch.delenv("KEYSTONE_COST_WEIGHTS", raising=False)

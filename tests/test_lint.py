@@ -547,6 +547,85 @@ config = {"metric": "foo", "value": 1.0}
 """)
 
 
+class TestMeshAxisNameRule:
+    """ISSUE 16 satellite: axis-name string literals at collective /
+    PartitionSpec sites must come from the parallel/mesh.py
+    DATA_AXIS/MODEL_AXIS registry — parsed, never imported."""
+
+    VIOLATION = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def fold(x):
+    return jax.lax.psum(x, "rows")
+
+
+def spec():
+    return P("date", None)
+"""
+
+    def test_fires_on_literal_axis_names(self, tmp_path):
+        findings = _lint_snippet(tmp_path, self.VIOLATION)
+        assert _codes(findings) == ["mesh-axis-name", "mesh-axis-name"]
+        assert "'rows'" in findings[0].message
+        assert "'date'" in findings[1].message
+
+    def test_registry_constants_are_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import jax
+from jax.sharding import PartitionSpec as P
+
+from keystone_tpu.parallel import mesh as mesh_lib
+from keystone_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def fold(x):
+    i = jax.lax.axis_index(DATA_AXIS)
+    del i
+    return jax.lax.psum(x, axis_name=(DATA_AXIS, MODEL_AXIS))
+
+
+def spec():
+    return P(mesh_lib.DATA_AXIS, None)
+""")
+
+    def test_fires_on_unknown_axis_constant(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+import jax
+from keystone_tpu.parallel.mesh import ROWS_AXIS
+
+
+def fold(x):
+    return jax.lax.psum(x, ROWS_AXIS)
+""")
+        assert _codes(findings) == ["mesh-axis-name"]
+        assert "ROWS_AXIS" in findings[0].message
+
+    def test_variables_and_non_axis_calls_are_not_checked(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import jax
+
+
+def fold(x, axis):
+    # a variable axis passes through; only literals are checkable
+    return jax.lax.psum(x, axis)
+
+
+def unrelated():
+    return "data".join(["a", "b"])
+""")
+
+    def test_registry_matches_mesh_module(self):
+        from keystone_tpu.parallel import mesh as mesh_lib
+        from keystone_tpu.tools.lint import mesh_axis_registry
+
+        assert mesh_axis_registry() == {
+            "DATA_AXIS": mesh_lib.DATA_AXIS,
+            "MODEL_AXIS": mesh_lib.MODEL_AXIS,
+        }
+
+
 class TestDriver:
     def test_unparseable_file_is_a_finding(self, tmp_path):
         findings = _lint_snippet(tmp_path, "def broken(:\n")

@@ -322,3 +322,59 @@ class TestTraceCLI:
         st = s["self_times"]
         assert st["child"]["self_s"] >= 0.045
         assert st["parent"]["self_s"] <= st["parent"]["total_s"] - 0.045
+
+
+class TestPerDeviceTracks:
+    """Mesh-run trace rendering (ISSUE 16): spans tagged ``device=`` and
+    the per-device ``read.d<k>`` ingestion lanes surface as a
+    per-device occupancy table in ``bin/trace`` and as one Perfetto
+    track per device in the Chrome export."""
+
+    def _mesh_trace(self):
+        with obs.tracing() as t:
+            # Two per-device ingestion lanes + one collective fold
+            # dispatch covering the whole data axis — the span shapes
+            # _run_lbfgs_gram_streamed_mesh and iter_mesh_segments emit.
+            with obs.span("runtime.task", lane="read.d0", fn="load"):
+                pass
+            with obs.span("runtime.task", lane="read.d1", fn="load"):
+                pass
+            with obs.span("runtime.task", lane="read", fn="load"):
+                pass  # the single-chip lane: NOT a device track
+            with obs.span(
+                "fold.segment", chunk0=0, device="data[0-1]", num_devices=2
+            ):
+                pass
+        return t.events
+
+    def test_summary_has_per_device_occupancy(self):
+        from keystone_tpu.tools.trace import _render, summarize
+
+        s = summarize(self._mesh_trace())
+        assert set(s["devices"]) == {"0", "1", "data[0-1]"}
+        assert s["devices"]["0"]["spans"] == 1
+        assert s["devices"]["1"]["busy_s"] >= 0.0
+        # the plain "read" lane stays in the lane table only
+        assert "read" in s["lanes"]
+        printed = _render(s, top=5)
+        assert "per-device occupancy" in printed
+        assert "device-0" in printed and "device-1" in printed
+
+    def test_perfetto_export_puts_each_device_on_its_own_track(self):
+        records = self._mesh_trace()
+        doc = obs.to_chrome_trace(records)
+        assert obs.validate_chrome_trace(doc) == []
+        names = {
+            e["args"]["name"]: e["tid"] for e in doc["traceEvents"]
+            if e["ph"] == "M" and e["name"] == "thread_name"
+        }
+        assert "device-0" in names and "device-1" in names
+        assert "device-data[0-1]" in names
+        assert names["device-0"] != names["device-1"]
+        by_dev_tid = {
+            e["tid"]: e["name"] for e in doc["traceEvents"]
+            if e["ph"] == "X"
+        }
+        # each device track actually carries its span
+        assert by_dev_tid[names["device-0"]] == "runtime.task"
+        assert by_dev_tid[names["device-data[0-1]"]] == "fold.segment"

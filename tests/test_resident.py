@@ -112,6 +112,100 @@ class TestEncodeDecode:
                            np.float32) == 0).all()
 
 
+class TestMeshPartitions:
+    """ISSUE 16 satellite: chunks partitioned across device HBM re-check
+    the int16 boundary PER PARTITION (at its own d/index_base), and the
+    partition-local rebase round-trips — the global check passing says
+    nothing about a shifted local range."""
+
+    def test_index_base_rebases_and_round_trips(self):
+        idx, val, y = _coo(n=200, d=64, bf16_exact=True)
+        base = 40_000  # far past int16 as a GLOBAL index
+        gidx = np.where(idx >= 0, idx + base, -1)
+        chunks = CompressedCOOChunks.encode(
+            gidx, val, y, chunk_rows=64, d=base + 64, index_base=base,
+        )
+        # Stored lanes are partition-local (fit int16 despite the base)...
+        assert chunks.idx_t.dtype == np.int16
+        assert int(chunks.idx_t.max()) < 64
+        # ...and decode restores the GLOBAL indices exactly.
+        idx2, val2, _ = chunks.decode()
+        np.testing.assert_array_equal(idx2, gidx)
+        np.testing.assert_array_equal(val2, val)
+
+    def test_rebased_boundary_checked_on_local_range(self):
+        idx, val, y = _coo(n=64, d=32)
+        base = 70_000
+        gidx = np.where(idx >= 0, idx + base, -1)
+        # base + INT16_MAX_INDEX is representable...
+        gidx[0, 0] = base + INT16_MAX_INDEX
+        CompressedCOOChunks.encode(
+            gidx, val, y, chunk_rows=64,
+            d=base + INT16_MAX_INDEX + 1, index_base=base,
+        )
+        # ...one past raises AT ENCODE — never wraps into the Gramian.
+        gidx[0, 0] = base + INT16_MAX_INDEX + 1
+        with pytest.raises(ValueError, match="int16"):
+            CompressedCOOChunks.encode(
+                gidx, val, y, chunk_rows=64,
+                d=base + INT16_MAX_INDEX + 2, index_base=base,
+            )
+
+    def test_active_index_below_base_raises(self):
+        idx, val, y = _coo(n=64, d=32)
+        gidx = np.where(idx >= 0, idx + 1000, -1)
+        gidx[3, 1] = 999  # a column this partition does not own
+        with pytest.raises(ValueError, match="index_base"):
+            CompressedCOOChunks.encode(
+                gidx, val, y, chunk_rows=64, d=2000, index_base=1000,
+            )
+        # Inactive lanes are exempt from the base check.
+        gidx[3, 1] = -1
+        CompressedCOOChunks.encode(
+            gidx, val, y, chunk_rows=64, d=2000, index_base=1000,
+        )
+
+    def test_partition_splits_contiguously_and_round_trips(self):
+        idx, val, y = _coo(n=700, d=96, bf16_exact=True)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=128)
+        parts = chunks.partition(3)  # 6 chunks -> cpd=2 each
+        assert [p.num_chunks for p in parts] == [2, 2, 2]
+        assert sum(p.n_true for p in parts) == 700
+        got_idx = np.concatenate([p.decode()[0] for p in parts])
+        got_val = np.concatenate([p.decode()[1] for p in parts])
+        np.testing.assert_array_equal(got_idx, idx)
+        np.testing.assert_array_equal(got_val, val)
+
+    def test_partition_ragged_tail_pads_dead_chunks(self):
+        idx, val, y = _coo(n=300, d=96)
+        chunks = CompressedCOOChunks.encode(idx, val, y, chunk_rows=64)
+        parts = chunks.partition(4)  # 5 chunks -> cpd=2, last 3 dead
+        assert [p.num_chunks for p in parts] == [2, 2, 2, 2]
+        assert parts[3].n_true == 0
+        assert (parts[3].idx_t[1] == -1).all()
+        assert (np.asarray(parts[3].y_t, np.float32) == 0).all()
+
+    def test_partition_revalidates_int16_boundary(self):
+        # The constructor trusts its buffers; partition() must NOT — a
+        # partition holding an index outside its stated width refuses to
+        # build rather than corrupt one device's Gramian partial.
+        idx_t = np.full((2, 4, 3), -1, np.int16)
+        idx_t[0, 0, 0] = 50  # outside d=32
+        val_t = np.zeros((2, 4, 3), np.float32)
+        y_t = np.zeros((2, 4, 1), np.float32)
+        bad = CompressedCOOChunks(idx_t, val_t, y_t, n_true=8, d=32)
+        with pytest.raises(ValueError, match="refusing"):
+            bad.partition(2)
+        # ...and an index_base that makes the LOCAL range overflow int16
+        # is refused even with in-range buffers.
+        wide = CompressedCOOChunks(
+            np.zeros((2, 4, 3), np.int16), val_t, y_t,
+            n_true=8, d=INT16_MAX_INDEX + 3, index_base=1,
+        )
+        with pytest.raises(ValueError, match="int16"):
+            wide.partition(2)
+
+
 class TestCompressedGramEngine:
     """compress="int16_bf16" is the SAME fold the bf16 gram engine runs
     (quantize-at-encode == quantize-in-densify, both RTNE): fits are
