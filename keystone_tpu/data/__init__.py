@@ -4,7 +4,7 @@ checksummed, atomically written, and retry-wrapped (docs/reliability.md)."""
 
 from .dataset import Dataset, LabeledData, one_hot_pm1
 from .durable import CheckpointSpec, ShardCorrupted
-from .resident import CompressedCOOChunks
+from .resident import CompressedCOOChunks, raw_chunk_tiles
 from .runtime import DataPlaneRuntime, default_runtime
 from .prefetch import (
     COOShardSource,

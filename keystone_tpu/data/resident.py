@@ -78,6 +78,38 @@ def _bf16_dtype() -> np.dtype:
     return np.dtype(ml_dtypes.bfloat16)
 
 
+def raw_chunk_tiles(indices, values, labels, chunk_rows: int):
+    """Tile uncompressed padded-COO rows (plus labels) into the
+    ``(nchunks, chunk_rows, ·)`` operand triple every streamed fold
+    consumes (``run_lbfgs_gram_streamed``, the sketch engines' scans).
+
+    Ragged-tail rows are padded with index −1 / value 0 — the same
+    out-of-range convention the fold's densify masks — so the pad rows
+    contribute nothing to any accumulated product. Dtypes pass through
+    untouched; this is the raw (non-:class:`CompressedCOOChunks`)
+    sibling of ``.operands()``.
+    """
+    import jax.numpy as jnp
+
+    indices = jnp.asarray(indices)
+    values = jnp.asarray(values)
+    labels = jnp.asarray(labels)
+    npad = int(indices.shape[0])
+    c = int(chunk_rows)
+    nchunks = -(-npad // c)
+    pad = nchunks * c - npad
+    idx_t = jnp.pad(indices, ((0, pad), (0, 0)), constant_values=-1).reshape(
+        nchunks, c, indices.shape[1]
+    )
+    val_t = jnp.pad(values, ((0, pad), (0, 0))).reshape(
+        nchunks, c, values.shape[1]
+    )
+    y_t = jnp.pad(labels, ((0, pad), (0, 0))).reshape(
+        nchunks, c, labels.shape[1]
+    )
+    return idx_t, val_t, y_t
+
+
 class CompressedCOOChunks:
     """Padded-COO rows encoded int16+bf16 and tiled into fold chunks.
 

@@ -279,7 +279,8 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
     ``spec``: None / ``"active"`` (whatever ``KEYSTONE_COST_WEIGHTS``
     selects right now), ``"tpu"``, ``"ec2"``, or
     ``"calibrated:<path>"`` (a refit artifact). Returns
-    ``{"name", "cpu", "mem", "network", "sparse_gather_overhead"}``.
+    ``{"name", "cpu", "mem", "network", "sparse_gather_overhead",
+    "srht_sketch_overhead", "countsketch_overhead"}``.
     """
     from keystone_tpu.ops.learning import cost as cost_mod
 
@@ -291,6 +292,8 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "name": cost_mod.weights_family_name(),
             "cpu": cpu, "mem": mem, "network": net,
             "sparse_gather_overhead": cost_mod.sparse_gather_overhead(),
+            "srht_sketch_overhead": cost_mod.srht_sketch_overhead(),
+            "countsketch_overhead": cost_mod.countsketch_overhead(),
         }
     if low == "tpu":
         return {
@@ -299,6 +302,8 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "mem": cost_mod.TPU_MEM_WEIGHT,
             "network": cost_mod.TPU_NETWORK_WEIGHT,
             "sparse_gather_overhead": cost_mod.TPU_SPARSE_GATHER_OVERHEAD,
+            "srht_sketch_overhead": cost_mod.TPU_SRHT_SKETCH_OVERHEAD,
+            "countsketch_overhead": cost_mod.TPU_COUNTSKETCH_OVERHEAD,
         }
     if low == "ec2":
         return {
@@ -307,6 +312,8 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "mem": cost_mod.EC2_MEM_WEIGHT,
             "network": cost_mod.EC2_NETWORK_WEIGHT,
             "sparse_gather_overhead": cost_mod.EC2_SPARSE_GATHER_OVERHEAD,
+            "srht_sketch_overhead": cost_mod.EC2_SRHT_SKETCH_OVERHEAD,
+            "countsketch_overhead": cost_mod.EC2_COUNTSKETCH_OVERHEAD,
         }
     if low.startswith(cost_mod.CALIBRATED_PREFIX):
         art = load_calibration_artifact(
@@ -366,18 +373,42 @@ def estimator_for_label(label: str):
         return StreamingLeastSquaresChoice(
             num_iter=3, lam=1e-4, block_size_hint=1024
         )
+    if name == "SketchedLeastSquares":
+        from keystone_tpu.ops.learning.sketch import SketchedLeastSquares
+
+        return SketchedLeastSquares(lam=1e-4)
+    if name == "IterativeHessianSketch":
+        from keystone_tpu.ops.learning.sketch import IterativeHessianSketch
+
+        compress = "int16_bf16" if "int16_bf16" in quals else None
+        return IterativeHessianSketch(lam=1e-4, compress=compress)
     return None
 
 
 def _cost_under(est, ctx: Dict[str, Any], cpu: float, mem: float,
-                net: float, sparse_overhead: Optional[float]) -> float:
+                net: float, sparse_overhead: Optional[float],
+                srht_overhead: Optional[float] = None,
+                cs_overhead: Optional[float] = None) -> float:
     n, d, k, s, m = _geometry(ctx)
     from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+    from keystone_tpu.ops.learning.sketch import (
+        IterativeHessianSketch, SketchedLeastSquares,
+    )
 
     if isinstance(est, SparseLBFGSwithL2):
         return est.cost(
             n, d, k, s, m, cpu, mem, net,
             sparse_overhead=sparse_overhead,
+        )
+    if isinstance(est, SketchedLeastSquares):
+        return est.cost(
+            n, d, k, s, m, cpu, mem, net,
+            sketch_overhead=srht_overhead, gather_overhead=sparse_overhead,
+        )
+    if isinstance(est, IterativeHessianSketch):
+        return est.cost(
+            n, d, k, s, m, cpu, mem, net,
+            sketch_overhead=cs_overhead, gather_overhead=sparse_overhead,
         )
     return est.cost(n, d, k, s, m, cpu, mem, net)
 
@@ -394,6 +425,8 @@ def predict_seconds(label: str, ctx: Dict[str, Any],
     return _cost_under(
         est, ctx, float(weights["cpu"]), float(weights["mem"]),
         float(weights["network"]), weights.get("sparse_gather_overhead"),
+        srht_overhead=weights.get("srht_sketch_overhead"),
+        cs_overhead=weights.get("countsketch_overhead"),
     )
 
 
@@ -634,14 +667,24 @@ def fit_weights(
     from ``base`` — single-chip traces cannot observe it. Gram-engine
     rows are evaluation-only (their model mixes the overhead factor
     with a capacity term; the report scores them, the fit does not
-    regress on them). Row families without measurements keep ``base``'s
-    constants, and the result says so (``fitted`` lists what was
-    actually re-estimated — no silent caps)."""
+    regress on them). The sketched-engine overheads
+    (``srht_sketch_overhead`` / ``countsketch_overhead``) refit from
+    their engines' rows GIVEN the fitted (cpu, mem, gather overhead):
+    each engine's model is AFFINE in its own overhead, so the per-row
+    estimate is ``(measured − cost@0) / (cost@1 − cost@0)`` and the
+    family takes the median. Row families without measurements keep
+    ``base``'s constants, and the result says so (``fitted`` lists what
+    was actually re-estimated — no silent caps)."""
     from keystone_tpu.ops.learning.lbfgs import SparseLBFGSwithL2
+    from keystone_tpu.ops.learning.sketch import (
+        IterativeHessianSketch, SketchedLeastSquares,
+    )
 
     base = dict(base or family_weights("active"))
     dense_rows: List[Tuple[float, float, float]] = []  # f_cpu, f_mem, s
     gather_rows: List[Tuple[Any, DecisionOutcome]] = []
+    srht_rows: List[Tuple[Any, DecisionOutcome]] = []
+    cs_rows: List[Tuple[Any, DecisionOutcome]] = []
     for o in outcomes:
         if o.measured_s is None or o.measured_s <= 0:
             continue
@@ -651,6 +694,12 @@ def fit_weights(
         if isinstance(est, SparseLBFGSwithL2):
             if est.solver == "gather":
                 gather_rows.append((est, o))
+            continue
+        if isinstance(est, SketchedLeastSquares):
+            srht_rows.append((est, o))
+            continue
+        if isinstance(est, IterativeHessianSketch):
+            cs_rows.append((est, o))
             continue
         f_cpu = _cost_under(est, o.context, 1.0, 0.0, 0.0, None)
         f_mem = _cost_under(est, o.context, 0.0, 1.0, 0.0, None)
@@ -673,6 +722,41 @@ def fit_weights(
             overhead = _median(samples)
             fitted.append("sparse_gather_overhead")
 
+    def _affine_overhead(rows, kwarg):
+        # cost(ov) = c0 + ov·(c1 − c0) given (cpu, mem, gather), so each
+        # measured row pins one overhead sample; non-positive samples
+        # (the measured wall under the overhead-free floor — a
+        # mis-joined or noise row) are dropped, not clamped into the
+        # median.
+        samples = []
+        for est, o in rows:
+            c0 = _cost_under(
+                est, o.context, cpu_w, mem_w, 0.0, overhead,
+                **{kwarg: 0.0},
+            )
+            c1 = _cost_under(
+                est, o.context, cpu_w, mem_w, 0.0, overhead,
+                **{kwarg: 1.0},
+            )
+            if c1 - c0 > 0:
+                sample = (o.measured_s - c0) / (c1 - c0)
+                if sample > 0:
+                    samples.append(sample)
+        return _median(samples)
+
+    srht_ov = base.get("srht_sketch_overhead")
+    if srht_rows:
+        fit = _affine_overhead(srht_rows, "srht_overhead")
+        if fit is not None:
+            srht_ov = fit
+            fitted.append("srht_sketch_overhead")
+    cs_ov = base.get("countsketch_overhead")
+    if cs_rows:
+        fit = _affine_overhead(cs_rows, "cs_overhead")
+        if fit is not None:
+            cs_ov = fit
+            fitted.append("countsketch_overhead")
+
     return {
         "cpu": cpu_w,
         "mem": mem_w,
@@ -680,9 +764,16 @@ def fit_weights(
         "sparse_gather_overhead": (
             float(overhead) if overhead is not None else None
         ),
+        "srht_sketch_overhead": (
+            float(srht_ov) if srht_ov is not None else None
+        ),
+        "countsketch_overhead": (
+            float(cs_ov) if cs_ov is not None else None
+        ),
         "fitted": fitted,
         "num_rows": {
             "sequential": len(dense_rows), "gather": len(gather_rows),
+            "srht": len(srht_rows), "countsketch": len(cs_rows),
         },
     }
 
@@ -762,6 +853,8 @@ def refit(
         "cpu": weights["cpu"], "mem": weights["mem"],
         "network": weights["network"],
         "sparse_gather_overhead": weights["sparse_gather_overhead"],
+        "srht_sketch_overhead": weights["srht_sketch_overhead"],
+        "countsketch_overhead": weights["countsketch_overhead"],
     }
     before = calibration_report(outcomes, weights=base, kinds=kinds)
     after = calibration_report(outcomes, weights=eval_weights, kinds=kinds)
@@ -823,6 +916,16 @@ def write_calibration_artifact(
                 if weights.get("sparse_gather_overhead") is not None
                 else None
             ),
+            "srht_sketch_overhead": (
+                float(weights["srht_sketch_overhead"])
+                if weights.get("srht_sketch_overhead") is not None
+                else None
+            ),
+            "countsketch_overhead": (
+                float(weights["countsketch_overhead"])
+                if weights.get("countsketch_overhead") is not None
+                else None
+            ),
         },
         "provenance": {
             **provenance,
@@ -875,16 +978,20 @@ def load_calibration_artifact(path: str) -> Dict[str, Any]:
                 f"calibration artifact {path!r}: weights.{key} must be "
                 f"a positive number, got {v!r}"
             )
-    so = weights.get("sparse_gather_overhead")
-    if so is not None and (
-        not isinstance(so, (int, float)) or isinstance(so, bool)
-        or not so > 0
+    for opt_key in (
+        "sparse_gather_overhead", "srht_sketch_overhead",
+        "countsketch_overhead",
     ):
-        raise ValueError(
-            f"calibration artifact {path!r}: "
-            f"weights.sparse_gather_overhead must be a positive number "
-            f"or null, got {so!r}"
-        )
+        so = weights.get(opt_key)
+        if so is not None and (
+            not isinstance(so, (int, float)) or isinstance(so, bool)
+            or not so > 0
+        ):
+            raise ValueError(
+                f"calibration artifact {path!r}: "
+                f"weights.{opt_key} must be a positive number "
+                f"or null, got {so!r}"
+            )
     return doc
 
 

@@ -33,6 +33,7 @@ from .kernel import (
     NystromKernelRidge,
 )
 from .lbfgs import DenseLBFGSwithL2, SparseLBFGSwithL2, run_lbfgs
+from .sketch import IterativeHessianSketch, SketchedLeastSquares
 from .streaming_ls import (
     BlockStreamedLeastSquares,
     CosineBankFeaturize,

@@ -122,6 +122,26 @@ TPU_MEM_WEIGHT = 1.9e-11
 TPU_NETWORK_WEIGHT = 1.0e-11  # pinned (single-chip unobservable), not fit
 TPU_SPARSE_GATHER_OVERHEAD = 500.0
 
+# Sketched-engine weight families (ISSUE 17). Like the gather overhead,
+# each is a random-access multiplier on the sequential mem rate for the
+# engine's signature pass, refit from traces by ``bin/calibrate --refit``:
+#
+#   srht_sketch_overhead — the SRHT engine's densify scatter (writing
+#     n·d·s active cells into chunk slabs before the FFT mixing). Seeded
+#     slightly above the gather overhead: a scatter WRITE pays
+#     read-modify-write per cell where the gather pass's read does not.
+#   countsketch_overhead — the IHS engine's O(nnz) CountSketch
+#     scatter-add into the flattened (m·d) accumulator. Cheaper than the
+#     densify: one add per stored cell, no slab zero-fill, bucket
+#     locality within a chunk.
+#
+# The EC2 values keep the reference-cluster convention (mem already at
+# cluster rates, so the factors stay single-digit).
+TPU_SRHT_SKETCH_OVERHEAD = 650.0
+TPU_COUNTSKETCH_OVERHEAD = 250.0
+EC2_SRHT_SKETCH_OVERHEAD = 10.0
+EC2_COUNTSKETCH_OVERHEAD = 6.0
+
 
 # Weight-family spec for trace-calibrated constants:
 # KEYSTONE_COST_WEIGHTS=calibrated:<path> points at a refit artifact
@@ -218,6 +238,34 @@ def sparse_gather_overhead() -> float:
         so = _calibrated_weights(path).get("sparse_gather_overhead")
         return float(so) if so is not None else TPU_SPARSE_GATHER_OVERHEAD
     return TPU_SPARSE_GATHER_OVERHEAD
+
+
+def srht_sketch_overhead() -> float:
+    """Random-access multiplier for the SRHT engine's densify-scatter
+    sketch pass, per the active weight family. Same null convention as
+    :func:`sparse_gather_overhead`: a calibrated artifact fit from
+    traces with no SRHT rows records null and the TPU constant stands
+    in."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
+        return EC2_SRHT_SKETCH_OVERHEAD
+    if family == "calibrated":
+        so = _calibrated_weights(path).get("srht_sketch_overhead")
+        return float(so) if so is not None else TPU_SRHT_SKETCH_OVERHEAD
+    return TPU_SRHT_SKETCH_OVERHEAD
+
+
+def countsketch_overhead() -> float:
+    """Random-access multiplier for the IHS engine's CountSketch
+    scatter-add pass, per the active weight family (null-in-artifact
+    falls back to the TPU constant, as above)."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
+        return EC2_COUNTSKETCH_OVERHEAD
+    if family == "calibrated":
+        so = _calibrated_weights(path).get("countsketch_overhead")
+        return float(so) if so is not None else TPU_COUNTSKETCH_OVERHEAD
+    return TPU_COUNTSKETCH_OVERHEAD
 
 
 def candidate_label(est) -> str:
@@ -460,9 +508,13 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
     Densify->Exact normal equations, the STREAMING tier
     (StreamingLeastSquaresChoice — featurize-inside-the-fit, bound to the
     upstream featurizer by the optimizer's StreamedFitFusionRule), and
-    (only when ``allow_approximate``) Densify->SketchedLeastSquares — a
-    randomized solver whose answer is an approximation of the exact ridge
-    solution. ``optimize`` measures (n, d, k, sparsity, num devices) from
+    (only when ``allow_approximate``) the randomized tier:
+    Densify->SketchedLeastSquaresEstimator (dense CountSketch +
+    Hessian-sketch refinement), Sparsify->SketchedLeastSquares (SRHT
+    sketch-and-precondition — exact up to CG tolerance) and
+    Sparsify->IterativeHessianSketch (input-sparsity-time CountSketch
+    folds, ``ops/learning/sketch.py``). ``optimize`` measures
+    (n, d, k, sparsity, num devices) from
     the sample and picks the cost-model argmin among candidates whose
     RESIDENT operands fit the device-memory budget — a capacity term the
     reference's cluster cost model (CostModel.scala:6-16) folds into its
@@ -572,8 +624,22 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             # and-wide dense regime, but its answer is approximate, so users
             # must opt in.
             sketched = SketchedLeastSquaresEstimator(lam=lam)
+            # The streamed sketched tier (ISSUE 17): SRHT sketch-and-
+            # precondition and input-sparsity-time IHS over the SAME
+            # padded-COO chunk stream the gram fold consumes. Each has its
+            # own calibrated weight family (srht_sketch_overhead /
+            # countsketch_overhead), so a refit can re-rank them without
+            # touching the exact engines' weights.
+            from keystone_tpu.ops.learning.sketch import (
+                IterativeHessianSketch, SketchedLeastSquares,
+            )
+
+            srht = SketchedLeastSquares(lam=lam)
+            ihs = IterativeHessianSketch(lam=lam)
             self.options = list(self.options) + [
                 (sketched, TransformerLabelEstimatorChain(Densify(), sketched)),
+                (srht, TransformerLabelEstimatorChain(Sparsify(), srht)),
+                (ihs, TransformerLabelEstimatorChain(Sparsify(), ihs)),
             ]
         self._default = dense_lbfgs
 

@@ -1249,7 +1249,6 @@ class SparseLBFGSwithL2(LabelEstimator):
         (``data/resident.py``) first — 4 bytes/nnz resident, decode
         fused into the fold's densify casts."""
         c = min(self.gram_chunk_rows, idx1.shape[0])
-        npad = idx1.shape[0]
         if self.compress == "int16_bf16":
             from keystone_tpu.data.resident import CompressedCOOChunks
 
@@ -1260,17 +1259,10 @@ class SparseLBFGSwithL2(LabelEstimator):
             idx_t, val_t, Y_t = chunks.operands()
             nchunks = chunks.num_chunks
         else:
-            nchunks = -(-npad // c)
-            pad = nchunks * c - npad
-            idx_t = jnp.pad(
-                idx1, ((0, pad), (0, 0)), constant_values=-1
-            ).reshape(nchunks, c, idx1.shape[1])
-            val_t = jnp.pad(val1, ((0, pad), (0, 0))).reshape(
-                nchunks, c, val1.shape[1]
-            )
-            Y_t = jnp.pad(B, ((0, pad), (0, 0))).reshape(
-                nchunks, c, B.shape[1]
-            )
+            from keystone_tpu.data.resident import raw_chunk_tiles
+
+            idx_t, val_t, Y_t = raw_chunk_tiles(idx1, val1, B, c)
+            nchunks = int(idx_t.shape[0])
 
         from keystone_tpu.ops import pallas_ops
 

@@ -1,0 +1,576 @@
+"""Randomized sketched least-squares engines (PAPERS.md: "Faster Least
+Squares Approximation", "Iterative Hessian Sketch in Input Sparsity
+Time").
+
+Two engines beyond the exact gram/gather tiers, both streamed
+chunk-by-chunk over the same padded-COO operand tiles the gram fold
+consumes (``data.resident.raw_chunk_tiles`` /
+:class:`~keystone_tpu.data.resident.CompressedCOOChunks`), so they
+compose with the prefetch/resident storage tiers:
+
+- :class:`SketchedLeastSquares` — SRHT sketch-and-precondition. Each
+  chunk is sign-flipped, mixed with a padded real FFT
+  (``stats.srht_chunk_sketch`` — the fourth caller of the shared
+  ``rfft_real_half`` epilogue) and row-sampled; stacking the per-chunk
+  samples gives a block-diagonal SRHT of the whole row stream. One QR
+  of the sketched matrix yields a preconditioner, then preconditioned
+  CG iterates on the ORIGINAL operator (gather/segment-sum passes) to
+  full accuracy: the sketch buys conditioning, not the answer, so the
+  solution is exact up to CG tolerance.
+
+- :class:`IterativeHessianSketch` — CountSketch folds in
+  input-sparsity time: O(nnz) scatter-adds per pass, no densified
+  slab ever exists. Each outer iteration draws a FRESH sketch, folds
+  the sketched Hessian and the exact gradient in ONE pass over the
+  chunk tiles, and takes the guarded Newton-sketch step
+  ``X -= (SAᵀSA/n + λI)⁻¹ g`` (Pilanci & Wainwright). The exact
+  gradient keeps every accepted step a true descent direction even
+  when ``m ~ 4d`` is far below the oblivious-embedding bound.
+
+Both are :class:`~keystone_tpu.workflow.LabelEstimator` candidates
+priced by ``cost.py`` under ``allow_approximate=True``, each with its
+own calibrated weight family (``srht_sketch_overhead`` /
+``countsketch_overhead`` — obs/calibrate.py refits them from traces
+like the gather overhead). Randomized draws all derive from the
+explicit integer ``seed`` (the explicit-seed lint rule,
+tools/lint.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.ops.stats import padded_pow2, srht_chunk_sketch
+from keystone_tpu.workflow import LabelEstimator
+
+logger = logging.getLogger("keystone_tpu.sketch")
+
+# Ridge floor added to sketched Gramians / preconditioners so lam=0
+# problems still factor (matches linear.SketchedLeastSquaresEstimator).
+_EPS = 1e-8
+
+
+def _densify(idx, val, d: int):
+    """(c, w) padded-COO lanes -> (c, d) f32 slab; −1 / out-of-range
+    lanes masked (the sparse_gram_fold densify convention)."""
+    mask = (idx >= 0) & (idx < d)
+    safe = jnp.where(mask, idx, 0).astype(jnp.int32)
+    vals = jnp.where(mask, val, 0).astype(jnp.float32)
+    rows = jnp.broadcast_to(jnp.arange(idx.shape[0])[:, None], idx.shape)
+    return jnp.zeros((idx.shape[0], d), jnp.float32).at[rows, safe].add(vals)
+
+
+def _append_intercept(indices, values, n: int, d: int):
+    """Append-ones intercept lane at column d (LBFGS.scala:208-281);
+    padding rows get an inactive (−1) lane."""
+    npad = indices.shape[0]
+    valid = jnp.arange(npad) < n
+    idx1 = jnp.concatenate(
+        [indices, jnp.where(valid, d, -1)[:, None].astype(indices.dtype)],
+        axis=1,
+    )
+    val1 = jnp.concatenate(
+        [values, valid.astype(values.dtype)[:, None]], axis=1
+    )
+    return idx1, val1
+
+
+def _pcg(matvec, precond, b, iters: int, tol: float):
+    """Preconditioned CG on ``matvec(x) = b``, all k right-hand sides
+    vectorized (per-column alpha/beta). Columns freeze once their
+    residual drops below ``tol * ||b||`` — the remaining iterations
+    are no-ops for them, so a converged column cannot divide by a
+    vanishing curvature."""
+    x = jnp.zeros_like(b)
+    r = b
+    z = precond(r)
+    p = z
+    rz = jnp.sum(r * z, axis=0)
+    bnorm = jnp.sqrt(jnp.sum(b * b, axis=0))
+    floor = tol * jnp.maximum(bnorm, 1e-30)
+
+    def body(_, state):
+        x, r, p, rz = state
+        active = jnp.sqrt(jnp.sum(r * r, axis=0)) > floor
+        Hp = matvec(p)
+        pHp = jnp.sum(p * Hp, axis=0)
+        alpha = jnp.where(active, rz / jnp.where(pHp == 0, 1.0, pHp), 0.0)
+        x = x + alpha * p
+        r = r - alpha * Hp
+        z = precond(r)
+        rz_new = jnp.sum(r * z, axis=0)
+        beta = jnp.where(active, rz_new / jnp.where(rz == 0, 1.0, rz), 0.0)
+        p = jnp.where(active, z + beta * p, p)
+        return x, r, p, rz_new
+
+    x, _, _, _ = jax.lax.fori_loop(0, iters, body, (x, r, p, rz))
+    return x
+
+
+def _chol_precond(R):
+    """x -> R⁻¹ R⁻ᵀ x for upper-triangular R (two triangular solves) —
+    the SRHT preconditioner apply."""
+    from jax.scipy.linalg import solve_triangular
+
+    def apply(v):
+        y = solve_triangular(R, v, trans="T", lower=False)
+        return solve_triangular(R, y, lower=False)
+
+    return apply
+
+
+class SketchedLeastSquares(LabelEstimator):
+    """SRHT sketch-and-precondition ridge solver (Drineas et al.).
+
+    Streams the row chunks once to build the block-SRHT sketch ``S A``
+    (sign-flip -> padded rfft along the row axis -> sample ``m/nchunks``
+    frequency bins per chunk) plus ``AᵀB`` in the same scan, takes
+    ``R = qr([SA/√n; √λ I])`` as a preconditioner for the ridge Hessian
+    ``AᵀA/n + λI``, then runs preconditioned CG with one gather +
+    segment-sum data pass per iteration. A well-sized sketch
+    (``sketch_size ≈ 2d``) clusters the preconditioned spectrum near 1,
+    so ~10 CG passes replace the 20+ an unpreconditioned iterative
+    solver needs — the data passes, not the sketch, dominate the wall.
+
+    ``sketch_size`` is the total sketched row count ``m`` (default
+    ``sketch_factor * (d+1)``), the knob trading preconditioner quality
+    against sketch wall; the bench frontier row sweeps it.
+    """
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        sketch_size: Optional[int] = None,
+        sketch_factor: int = 2,
+        pcg_iters: int = 12,
+        convergence_tol: float = 1e-6,
+        seed: int = 0,
+        chunk_rows: int = 8192,
+        num_features: Optional[int] = None,
+    ):
+        self.lam = lam
+        self.sketch_size = sketch_size
+        self.sketch_factor = sketch_factor
+        self.pcg_iters = pcg_iters
+        self.convergence_tol = convergence_tol
+        self.seed = seed
+        self.chunk_rows = chunk_rows
+        self.num_features = num_features
+        # Overheads resolved at CONSTRUCTION like every engine's weights
+        # (a mid-process KEYSTONE_COST_WEIGHTS flip must not mix weight
+        # families within one selector's ranking).
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        self._sketch_overhead = cost_mod.srht_sketch_overhead()
+        self._gather_overhead = cost_mod.sparse_gather_overhead()
+
+    @property
+    def weight(self) -> int:
+        return self.pcg_iters + 1
+
+    def _resolve_m(self, d1: int) -> int:
+        return int(self.sketch_size or self.sketch_factor * d1)
+
+    def fit(self, data: Dataset, labels: Dataset):
+        from keystone_tpu.ops.sparse import is_sparse_dataset
+        from keystone_tpu.ops.learning.linear import (
+            LinearMapper, SparseLinearMapper,
+        )
+
+        B = jnp.asarray(labels.array).astype(jnp.float32)
+        if is_sparse_dataset(data):
+            indices = jnp.asarray(data.data["indices"])
+            values = jnp.asarray(data.data["values"])
+            d = self.num_features or int(jnp.max(indices)) + 1
+            idx1, val1 = _append_intercept(indices, values, data.n, d)
+            W1 = self._fit_sparse(idx1, val1, B, d + 1, data.n)
+            return SparseLinearMapper(W1[:-1], b_opt=W1[-1])
+        A = jnp.asarray(data.array).astype(jnp.float32)
+        npad = A.shape[0]
+        ones = (jnp.arange(npad) < data.n).astype(A.dtype)[:, None]
+        A1 = jnp.concatenate([A, ones], axis=1)
+        W1 = self._fit_dense(A1, B, data.n)
+        return LinearMapper(W1[:-1], b_opt=W1[-1])
+
+    def _sketch_stream(self, chunk_fn, nchunks: int, c: int, d1: int, Y_t):
+        """One scan over the row chunks producing the stacked block-SRHT
+        sketch (nchunks*m_pc, d1) and AᵀB — the only pass that ever
+        densifies, and only one chunk-slab at a time."""
+        p = padded_pow2(c)
+        m_pc = max(1, min(-(-self._resolve_m(d1) // nchunks), p // 2))
+        # E[(Re F z)_k²] ≈ ‖z‖²/2 under random signs, so √(2/m_pc) makes
+        # each chunk's sampled block an isometry in expectation.
+        scale = math.sqrt(2.0 / m_pc)
+        key = jax.random.key(self.seed)
+        k = Y_t.shape[-1]
+
+        def step(AtB, cid):
+            idx, val, y = chunk_fn(cid)
+            dense = _densify(idx, val, d1)
+            kc = jax.random.fold_in(key, cid)
+            ks, kb = jax.random.split(kc)
+            signs = jax.random.rademacher(ks, (c,), dtype=jnp.float32)
+            bins = jax.random.randint(kb, (m_pc,), 0, p // 2)
+            SA_c = srht_chunk_sketch(dense, signs, bins, scale)
+            return AtB + dense.T @ y.astype(jnp.float32), SA_c
+
+        AtB, SA_chunks = jax.lax.scan(
+            step, jnp.zeros((d1, k), jnp.float32), jnp.arange(nchunks)
+        )
+        return SA_chunks.reshape(nchunks * m_pc, d1), AtB
+
+    def _solve(self, SA, AtB, matvec, n: int, d1: int):
+        """QR the (scaled, ridge-augmented) sketch, PCG on the original
+        operator."""
+        ridge = math.sqrt(self.lam + _EPS)
+        M = jnp.concatenate(
+            [SA / math.sqrt(n), ridge * jnp.eye(d1, dtype=SA.dtype)], axis=0
+        )
+        R = jnp.linalg.qr(M, mode="r")
+        X = _pcg(
+            matvec, _chol_precond(R), AtB / n,
+            iters=self.pcg_iters, tol=self.convergence_tol,
+        )
+        return X
+
+    def _fit_sparse(self, idx1, val1, B, d1: int, n: int):
+        from keystone_tpu.data.resident import raw_chunk_tiles
+        from keystone_tpu.ops.sparse import sparse_matmul, sparse_matmul_t
+
+        c = min(self.chunk_rows, idx1.shape[0])
+        idx_t, val_t, Y_t = raw_chunk_tiles(idx1, val1, B, c)
+        nchunks = int(idx_t.shape[0])
+        SA, AtB = self._sketch_stream(
+            lambda cid: (idx_t[cid], val_t[cid], Y_t[cid]),
+            nchunks, c, d1, Y_t,
+        )
+
+        def matvec(V):
+            rows = sparse_matmul(idx1, val1, V)
+            return sparse_matmul_t(idx1, val1, rows, d1) / n + self.lam * V
+
+        return self._solve(SA, AtB, matvec, n, d1)
+
+    def _fit_dense(self, A1, B, n: int):
+        d1 = A1.shape[1]
+        c = min(self.chunk_rows, A1.shape[0])
+        nchunks = -(-A1.shape[0] // c)
+        pad = nchunks * c - A1.shape[0]
+        A_t = jnp.pad(A1, ((0, pad), (0, 0))).reshape(nchunks, c, d1)
+        Y_t = jnp.pad(B, ((0, pad), (0, 0))).reshape(nchunks, c, B.shape[1])
+        p = padded_pow2(c)
+        m_pc = max(1, min(-(-self._resolve_m(d1) // nchunks), p // 2))
+        scale = math.sqrt(2.0 / m_pc)
+        key = jax.random.key(self.seed)
+
+        def step(AtB, cid):
+            dense = A_t[cid]
+            kc = jax.random.fold_in(key, cid)
+            ks, kb = jax.random.split(kc)
+            signs = jax.random.rademacher(ks, (c,), dtype=jnp.float32)
+            bins = jax.random.randint(kb, (m_pc,), 0, p // 2)
+            SA_c = srht_chunk_sketch(dense, signs, bins, scale)
+            return AtB + dense.T @ Y_t[cid], SA_c
+
+        AtB, SA_chunks = jax.lax.scan(
+            step, jnp.zeros((d1, B.shape[1]), jnp.float32),
+            jnp.arange(nchunks),
+        )
+        SA = SA_chunks.reshape(nchunks * m_pc, d1)
+
+        def matvec(V):
+            return A1.T @ (A1 @ V) / n + self.lam * V
+
+        return self._solve(SA, AtB, matvec, n, d1)
+
+    def cost(
+        self, n, d, k, sparsity, num_machines,
+        cpu_weight, mem_weight, network_weight,
+        sketch_overhead: Optional[float] = None,
+        gather_overhead: Optional[float] = None,
+    ) -> float:
+        """One sketch pass (densify scatter at the SRHT random-write rate
+        plus the bandwidth-bound FFT mixing passes), one QR of the
+        (m, d) sketch, then ``pcg_iters`` gather-engine data passes."""
+        if sketch_overhead is None:
+            sketch_overhead = self._sketch_overhead
+        if gather_overhead is None:
+            gather_overhead = self._gather_overhead
+        m = self._resolve_m(int(d) + 1)
+        nnz = n * sparsity * d
+        sketch = (
+            sketch_overhead * mem_weight * nnz
+            + mem_weight * 3.0 * n * d
+        ) / num_machines
+        qr = cpu_weight * 2.0 * m * d * d / num_machines
+        per_pass = (
+            gather_overhead
+            * max(cpu_weight * nnz * k, mem_weight * nnz) / num_machines
+        )
+        network = (
+            network_weight * 2.0 * d * k
+            * math.log2(max(num_machines, 2)) * self.pcg_iters
+        )
+        return sketch + qr + self.pcg_iters * per_pass + network
+
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """Padded-COO operands, the stacked sketch + its QR workspace,
+        one densified chunk slab (transient but live at peak), labels."""
+        m = self._resolve_m(int(d) + 1)
+        slab = 4.0 * min(self.chunk_rows, n) * d
+        return (
+            8.0 * n * d * sparsity / num_machines
+            + 4.0 * n * k / num_machines
+            + 8.0 * m * d
+            + slab
+        )
+
+
+class IterativeHessianSketch(LabelEstimator):
+    """Iterative Hessian Sketch in input-sparsity time (Pilanci &
+    Wainwright; CountSketch per Clarkson & Woodruff).
+
+    Each outer iteration draws a fresh CountSketch (one bucket + one
+    sign per row) and makes ONE O(nnz) scatter pass over the COO chunk
+    tiles that folds BOTH the sketched rows ``S A`` (flattened 2-D
+    scatter-add: segment ``bucket[row]·d + col``, ghost segment for
+    inactive lanes) and the exact-gradient operand ``AᵀA X`` — no
+    densified slab ever exists, so the pass is priced at scatter rate,
+    not slab rate. The step solves the sketched normal equations
+    ``(SAᵀSA/n + λI) Δ = −g`` by Cholesky and is GUARDED: a step is
+    taken only while the exact gradient norm still shrinks, so a too-
+    small sketch degrades to fewer accepted steps, never divergence.
+
+    ``compress="int16_bf16"`` folds over the compressed-resident tier
+    (``data/resident.py`` — 4 B/nnz, decode fused into the fold's
+    casts), the same storage class the gram engine offers.
+    """
+
+    def __init__(
+        self,
+        lam: float = 0.0,
+        sketch_size: Optional[int] = None,
+        sketch_factor: int = 4,
+        outer_iters: int = 3,
+        seed: int = 0,
+        chunk_rows: int = 65536,
+        num_features: Optional[int] = None,
+        compress: Optional[str] = None,
+    ):
+        if compress not in (None, "int16_bf16"):
+            raise ValueError(
+                f'compress must be None or "int16_bf16", got {compress!r}'
+            )
+        self.lam = lam
+        self.sketch_size = sketch_size
+        self.sketch_factor = sketch_factor
+        self.outer_iters = outer_iters
+        self.seed = seed
+        self.chunk_rows = chunk_rows
+        self.num_features = num_features
+        self.compress = compress
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        self._cs_overhead = cost_mod.countsketch_overhead()
+        self._gather_overhead = cost_mod.sparse_gather_overhead()
+
+    @property
+    def weight(self) -> int:
+        return self.outer_iters + 1
+
+    def _resolve_m(self, d1: int) -> int:
+        return int(self.sketch_size or self.sketch_factor * d1)
+
+    def fit(self, data: Dataset, labels: Dataset):
+        from keystone_tpu.ops.sparse import is_sparse_dataset
+        from keystone_tpu.ops.learning.linear import (
+            LinearMapper, SparseLinearMapper,
+        )
+
+        B = jnp.asarray(labels.array).astype(jnp.float32)
+        if is_sparse_dataset(data):
+            indices = jnp.asarray(data.data["indices"])
+            values = jnp.asarray(data.data["values"])
+            d = self.num_features or int(jnp.max(indices)) + 1
+            idx1, val1 = _append_intercept(indices, values, data.n, d)
+            W1 = self._fit_sparse(idx1, val1, B, d + 1, data.n)
+            return SparseLinearMapper(W1[:-1], b_opt=W1[-1])
+        A = jnp.asarray(data.array).astype(jnp.float32)
+        npad = A.shape[0]
+        ones = (jnp.arange(npad) < data.n).astype(A.dtype)[:, None]
+        A1 = jnp.concatenate([A, ones], axis=1)
+        W1 = self._fit_dense(A1, B, data.n)
+        return LinearMapper(W1[:-1], b_opt=W1[-1])
+
+    def _fit_sparse(self, idx1, val1, B, d1: int, n: int):
+        from keystone_tpu.data.resident import (
+            CompressedCOOChunks, raw_chunk_tiles,
+        )
+        from keystone_tpu.ops.sparse import sparse_matmul_t
+
+        c = min(self.chunk_rows, idx1.shape[0])
+        if self.compress == "int16_bf16":
+            chunks = CompressedCOOChunks.encode(
+                np.asarray(idx1), np.asarray(val1), np.asarray(B),
+                chunk_rows=c, d=d1, n_true=n,
+            )
+            idx_t, val_t, _ = chunks.operands()
+        else:
+            idx_t, val_t, _ = raw_chunk_tiles(idx1, val1, B, c)
+        nchunks = int(idx_t.shape[0])
+        m = self._resolve_m(d1)
+        k = B.shape[1]
+        AtB = sparse_matmul_t(idx1, val1, B, d1)
+        key = jax.random.key(self.seed)
+
+        def fold_pass(X, key_t):
+            """One streamed pass: CountSketch fold + AᵀA X, together."""
+
+            def step(carry, cid):
+                SA_flat, AtAX = carry
+                idxi = idx_t[cid].astype(jnp.int32)
+                valf = val_t[cid].astype(jnp.float32)
+                mask = (idxi >= 0) & (idxi < d1)
+                safe = jnp.where(mask, idxi, 0)
+                vals = jnp.where(mask, valf, 0.0)
+                kc = jax.random.fold_in(key_t, cid)
+                ks, kb = jax.random.split(kc)
+                bucket = jax.random.randint(kb, (c,), 0, m)
+                sign = jax.random.rademacher(ks, (c,), dtype=jnp.float32)
+                seg = jnp.where(mask, bucket[:, None] * d1 + safe, m * d1)
+                SA_flat = SA_flat.at[seg.reshape(-1)].add(
+                    (sign[:, None] * vals).reshape(-1)
+                )
+                # Exact-gradient operand on the same chunk: gather rows
+                # of X, then scatter back (ghost row d1 for pad lanes).
+                rows = jnp.sum(
+                    vals[:, :, None] * jnp.take(X, safe, axis=0), axis=1
+                )
+                back = jnp.where(mask, safe, d1)
+                AtAX = AtAX.at[back.reshape(-1)].add(
+                    (vals[:, :, None] * rows[:, None, :]).reshape(-1, X.shape[1])
+                )
+                return (SA_flat, AtAX), None
+
+            init = (
+                jnp.zeros((m * d1 + 1,), jnp.float32),
+                jnp.zeros((d1 + 1, X.shape[1]), jnp.float32),
+            )
+            (SA_flat, AtAX), _ = jax.lax.scan(
+                step, init, jnp.arange(nchunks)
+            )
+            return SA_flat[: m * d1].reshape(m, d1), AtAX[:d1]
+
+        X = jnp.zeros((d1, k), jnp.float32)
+        X_prev, prev_gnorm = X, None
+        for t in range(self.outer_iters):
+            SA, AtAX = fold_pass(X, jax.random.fold_in(key, t))
+            g = AtAX / n - AtB / n + self.lam * X
+            gnorm = float(jnp.linalg.norm(g))
+            if prev_gnorm is not None and gnorm >= prev_gnorm:
+                # Roll back the step that RAISED the exact gradient
+                # norm — a rank-deficient sketch (m << d) can overshoot
+                # through the sketched Hessian's null space, and the
+                # returned model must never be worse than an iterate we
+                # already held.
+                logger.info(
+                    "IHS guard: gradient norm %.3g >= %.3g at outer %d; "
+                    "rolling back and stopping", gnorm, prev_gnorm, t,
+                )
+                X = X_prev
+                break
+            prev_gnorm = gnorm
+            X_prev = X
+            X = X - self._sketched_newton_step(SA, g, n, d1)
+        return X
+
+    def _fit_dense(self, A1, B, n: int):
+        d1 = A1.shape[1]
+        m = self._resolve_m(d1)
+        AtB = A1.T @ B
+        key = jax.random.key(self.seed)
+        X = jnp.zeros((d1, B.shape[1]), jnp.float32)
+        X_prev, prev_gnorm = X, None
+        for t in range(self.outer_iters):
+            kt = jax.random.fold_in(key, t)
+            ks, kb = jax.random.split(kt)
+            bucket = jax.random.randint(kb, (A1.shape[0],), 0, m)
+            sign = jax.random.rademacher(ks, (A1.shape[0],), dtype=jnp.float32)
+            SA = jax.ops.segment_sum(
+                A1 * sign[:, None], bucket, num_segments=m
+            )
+            g = A1.T @ (A1 @ X) / n - AtB / n + self.lam * X
+            gnorm = float(jnp.linalg.norm(g))
+            if prev_gnorm is not None and gnorm >= prev_gnorm:
+                X = X_prev  # same rollback as the sparse path
+                break
+            prev_gnorm = gnorm
+            X_prev = X
+            X = X - self._sketched_newton_step(SA, g, n, d1)
+        return X
+
+    def _sketched_newton_step(self, SA, g, n: int, d1: int):
+        from jax.scipy.linalg import cho_factor, cho_solve
+
+        H = SA.T @ SA / n + (self.lam + _EPS) * jnp.eye(d1, dtype=SA.dtype)
+        return cho_solve(cho_factor(H), g)
+
+    def cost(
+        self, n, d, k, sparsity, num_machines,
+        cpu_weight, mem_weight, network_weight,
+        sketch_overhead: Optional[float] = None,
+        gather_overhead: Optional[float] = None,
+    ) -> float:
+        """Per outer: one fused O(nnz) scatter pass (CountSketch fold at
+        the scatter rate + the gradient's gather/scatter priced like a
+        gather-engine iteration), the sketched gram ``2 m d²`` and its
+        ``d³/3`` Cholesky; plus the one-time AᵀB pass."""
+        if sketch_overhead is None:
+            sketch_overhead = self._cs_overhead
+        if gather_overhead is None:
+            gather_overhead = self._gather_overhead
+        m = self._resolve_m(int(d) + 1)
+        nnz = n * sparsity * d
+        gather_pass = (
+            gather_overhead
+            * max(cpu_weight * nnz * k, mem_weight * nnz) / num_machines
+        )
+        per_outer = (
+            sketch_overhead * mem_weight * nnz / num_machines
+            + cpu_weight * (2.0 * m * d * d + 2.0 * d ** 3 / 3.0)
+            / num_machines
+            + gather_pass
+        )
+        network = (
+            network_weight * d * k * self.outer_iters
+            * math.log2(max(num_machines, 2))
+        )
+        return self.outer_iters * per_outer + gather_pass + network
+
+    def resident_bytes(self, n, d, k, sparsity, num_machines) -> float:
+        """COO operands (compressed tier: 4 B/nnz, infeasible past the
+        int16 index boundary), the flattened CountSketch accumulator
+        (m·d f32 — the dominant term), sketched Gramian + its Cholesky
+        copy, labels."""
+        if self.compress is not None:
+            from keystone_tpu.data import resident as resident_mod
+
+            if not resident_mod.compressible_dim(d + 1):
+                return float("inf")
+            bytes_per_nnz = resident_mod.COMPRESSED_BYTES_PER_NNZ
+        else:
+            bytes_per_nnz = 8.0
+        m = self._resolve_m(int(d) + 1)
+        return (
+            bytes_per_nnz * n * d * sparsity / num_machines
+            + 4.0 * n * k / num_machines
+            + 4.0 * m * d
+            + 8.0 * d * d
+        )

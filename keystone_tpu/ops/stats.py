@@ -162,31 +162,72 @@ def CosineRandomFeatures(
     return CosineRandomFeaturesModel(W, b)
 
 
+def padded_pow2(n: int) -> int:
+    """The FFT padding width every padded-FFT path shares: the next power
+    of two ≥ n (minimum 2, so a width-1 input still has a non-trivial
+    transform)."""
+    return 1 << max(int(n - 1).bit_length(), 1)
+
+
+def rfft_real_half(x, p: int, axis: int = -1):
+    """Re(rfft(x))[bins 0..p/2) along ``axis`` — the shared epilogue of
+    every padded-FFT path (``PaddedFFT`` single/batch, the packed
+    gather's odd branch, and the SRHT sketch fold): the input is real
+    and already padded to ``p``, and only the real parts of the first
+    ``p // 2`` bins survive, so ``rfft`` computes the same DFT bins with
+    half the butterfly work and a (p/2+1)-wide complex intermediate
+    instead of p-wide. One implementation, so the bin convention (DC
+    included, Nyquist dropped) cannot drift between callers — the
+    batched-vs-single parity test in tests/test_learning_nodes.py pins
+    it."""
+    out = jnp.real(jnp.fft.rfft(x, axis=axis))
+    return jax.lax.slice_in_dim(out, 0, p // 2, axis=axis)
+
+
+def srht_chunk_sketch(dense_rows, signs, sample_bins, scale):
+    """One block-SRHT fold step (Drineas et al., "Faster Least Squares
+    Approximation"): sign-flip the chunk's rows, zero-pad the ROW axis to
+    a power of two, mix with the real-FFT butterfly, keep Re of the
+    first p/2 bins (:func:`rfft_real_half` — its fourth caller), and
+    gather the chunk's sampled bins.
+
+    ``dense_rows (c, d)``, ``signs (c,)`` ±1, ``sample_bins (m_c,)`` in
+    ``[0, p//2)``; returns ``scale · (m_c, d)``. Stacking every chunk's
+    sampled bins gives the block-diagonal SRHT ``S A`` of the whole row
+    stream — each chunk is sketched independently, so the transform
+    streams chunk-by-chunk and composes with the prefetch/resident
+    tiers (``ops/learning/sketch.py``)."""
+    c = dense_rows.shape[0]
+    p = padded_pow2(c)
+    Z = dense_rows * signs[:, None]
+    Zp = jnp.pad(Z, ((0, p - c), (0, 0)))
+    H = rfft_real_half(Zp, p, axis=0)  # (p//2, d)
+    return scale * jnp.take(H, sample_bins, axis=0)
+
+
 @dataclass(frozen=True)
 class PaddedFFT(Transformer):
     """Zero-pad to the next power of two, FFT, keep the real parts of the first
     half (reference: nodes/stats/PaddedFFT.scala:13-21).
 
-    The input is real, and only Re(bins 0..p/2) survive — so the batch path
-    runs ``rfft``, which computes the same DFT bins with half the butterfly
-    work and a (p/2+1)-wide complex intermediate instead of p-wide: at the
-    MNIST bench geometry that halves both the FFT flops and the c64
-    round-trip bytes of the featurize phase (the HBM-bound piece of the
-    row's roofline)."""
+    The input is real, and only Re(bins 0..p/2) survive — the shared
+    :func:`rfft_real_half` epilogue: at the MNIST bench geometry that
+    halves both the FFT flops and the c64 round-trip bytes of the
+    featurize phase (the HBM-bound piece of the row's roofline)."""
 
     def _padded_size(self, n: int) -> int:
-        return 1 << max(int(n - 1).bit_length(), 1)
+        return padded_pow2(n)
 
     def apply(self, x):
         x = jnp.asarray(x)
         p = self._padded_size(x.shape[-1])
         padded = jnp.pad(x, [(0, p - x.shape[-1])])
-        return jnp.real(jnp.fft.rfft(padded))[: p // 2]
+        return rfft_real_half(padded, p)
 
     def _batch_fn(self, X):
         p = self._padded_size(X.shape[-1])
         padded = jnp.pad(X, [(0, 0), (0, p - X.shape[-1])])
-        return jnp.real(jnp.fft.rfft(padded, axis=-1))[:, : p // 2]
+        return rfft_real_half(padded, p)
 
     def device_fn(self):
         return self._batch_fn
@@ -273,7 +314,7 @@ def packed_fft_gather_fn(branches, combiner):
                 jnp.stack([reA, reB], axis=2).reshape(n, 2 * npairs, p // 2)
             )
         if nb % 2:
-            tail = jnp.real(jnp.fft.rfft(Zp[:, -1], axis=-1))[:, : p // 2]
+            tail = rfft_real_half(Zp[:, -1], p)
             outs.append(tail[:, None, :])
         halves = outs[0] if len(outs) == 1 else jnp.concatenate(outs, axis=1)
         out = jnp.maximum(halves - alphas[None, :, None], maxvals[None, :, None])

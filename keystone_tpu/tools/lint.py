@@ -71,6 +71,19 @@ test over the whole package (``tests/test_lint.py``):
     or silently reduces over the wrong axis on a 2-D mesh at worst; the
     registry constants are the one place axis names exist.
 
+``explicit-seed``
+    Randomized LIBRARY code must take an explicit integer seed: inside
+    ``keystone_tpu/``, an argless ``jax.random.key()`` /
+    ``jax.random.PRNGKey()``, a hardcoded integer-literal seed at those
+    call sites, or a ``seed`` parameter whose default is anything but
+    an int literal (``seed=None`` pushes the draw to an implicit
+    source) is flagged. The package convention (module docstring of
+    ``ops/stats.py``): every random draw derives from a caller-visible
+    integer, so fitted models are reproducible and the sketched solver
+    tier's per-chunk ``fold_in`` streams are replayable. Benches,
+    ``scripts/`` and the test suite legitimately pin literal demo
+    seeds and are exempt.
+
 Findings are ``path:line: [rule] message``; the CLI exits 1 on any.
 """
 
@@ -92,6 +105,7 @@ RULES = (
     "bench-row",
     "metric-name",
     "mesh-axis-name",
+    "explicit-seed",
 )
 
 _JAX_NAMES = {"jax", "jnp"}
@@ -708,6 +722,85 @@ def _check_mesh_axis_names(
 
 
 # ---------------------------------------------------------------------------
+# Rule: explicit-seed
+# ---------------------------------------------------------------------------
+
+_PRNG_CONSTRUCTORS = ("key", "PRNGKey")
+
+
+def _is_prng_constructor(func: ast.AST) -> bool:
+    """``jax.random.key`` / ``random.key`` / ``jax.random.PRNGKey`` as an
+    attribute of a ``random`` module, or a bare ``PRNGKey`` name (the
+    ``from jax.random import PRNGKey`` form). A bare ``key(...)`` name is
+    NOT matched — too generic to attribute to the PRNG."""
+    if isinstance(func, ast.Attribute) and func.attr in _PRNG_CONSTRUCTORS:
+        base = func.value
+        if isinstance(base, ast.Name):
+            return base.id == "random"
+        if isinstance(base, ast.Attribute):
+            return base.attr == "random"
+        return False
+    return isinstance(func, ast.Name) and func.id == "PRNGKey"
+
+
+def _is_int_literal(node: Optional[ast.AST]) -> bool:
+    # bool is an int subclass; ``seed=True`` is not an explicit seed.
+    return (
+        isinstance(node, ast.Constant)
+        and type(node.value) is int
+    )
+
+
+def _check_explicit_seed(tree: ast.Module, path: str) -> List[Finding]:
+    """Randomized library code must take an explicit integer seed: no
+    argless PRNG-key constructors, no hardcoded integer-literal seeds at
+    those call sites, and every ``seed`` parameter's default (if any)
+    must be an int literal — ``seed=None`` defers the draw to an
+    implicit source the caller cannot replay."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_prng_constructor(node.func):
+            if not node.args and not node.keywords:
+                findings.append(Finding(
+                    path, node.lineno, "explicit-seed",
+                    "argless PRNG key constructor — library code must "
+                    "derive every key from an explicit integer seed "
+                    "parameter",
+                ))
+            elif node.args and _is_int_literal(node.args[0]):
+                findings.append(Finding(
+                    path, node.lineno, "explicit-seed",
+                    f"hardcoded seed literal "
+                    f"{ast.literal_eval(node.args[0])!r} at a PRNG key "
+                    "constructor — thread a caller-visible seed "
+                    "parameter instead",
+                ))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            pos = list(node.args.posonlyargs) + list(node.args.args)
+            defaults = list(node.args.defaults)
+            for arg, default in zip(pos[len(pos) - len(defaults):], defaults):
+                if arg.arg == "seed" and not _is_int_literal(default):
+                    findings.append(Finding(
+                        path, node.lineno, "explicit-seed",
+                        f"parameter 'seed' of {node.name}() defaults to "
+                        "a non-integer — default it to an int literal "
+                        "so the draw is replayable",
+                    ))
+            for arg, default in zip(node.args.kwonlyargs,
+                                    node.args.kw_defaults):
+                if arg.arg == "seed" and default is not None \
+                        and not _is_int_literal(default):
+                    findings.append(Finding(
+                        path, node.lineno, "explicit-seed",
+                        f"parameter 'seed' of {node.name}() defaults to "
+                        "a non-integer — default it to an int literal "
+                        "so the draw is replayable",
+                    ))
+    # ast.walk is breadth-first; report in source order.
+    return sorted(findings, key=lambda f: f.line)
+
+
+# ---------------------------------------------------------------------------
 # Rule: bench-row
 # ---------------------------------------------------------------------------
 
@@ -813,6 +906,18 @@ def lint_file(
             findings.extend(
                 _check_mesh_axis_names(tree, sp, mesh_registry)
             )
+    if "explicit-seed" in enabled:
+        # Library scope only: benches, measurement scripts and the test
+        # suite legitimately pin literal demo seeds.
+        parts = set(path.parts)
+        exempt = (
+            "tests" in parts or "scripts" in parts
+            or path.name == "bench.py"
+            or path.name.startswith("test_")
+            or path.name == "conftest.py"
+        )
+        if not exempt:
+            findings.extend(_check_explicit_seed(tree, sp))
     return findings
 
 

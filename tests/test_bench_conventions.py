@@ -372,6 +372,58 @@ class TestRooflineAuditability:
         # Dicts with no scaling claims are not burdened.
         bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
 
+    def test_sketch_claims_require_size_baseline_and_heldout(self):
+        """ISSUE 17 satellite: any dict claiming a sketched-solver
+        result (``accuracy_frontier*`` or a ``sketch_*`` key beyond the
+        ``sketch_size`` input itself) must carry a numeric
+        ``sketch_size``, the exact-solver wall (``exact_baseline_s``)
+        and a numeric ``heldout_*`` quality metric in the SAME dict —
+        a sketch wall with no exact denominator and no matched
+        held-out quality is not a measured approximation claim."""
+        bench = _load_bench()
+        good = {
+            "accuracy_frontier": [
+                {"engine": "IterativeHessianSketch", "sketch_size": 32770,
+                 "wall_s": 1.9, "heldout_accuracy": 0.52},
+            ],
+            "sketch_engine_best": "IterativeHessianSketch",
+            "sketch_size": 32770,
+            "exact_baseline_s": 7.9,
+            "heldout_accuracy": 0.52,
+        }
+        row = bench.make_row(
+            "sketch_probe", 1.9, "s", None, "min_of_N_warm", dict(good))
+        assert row["detail"]["sketch_size"] == 32770
+        for missing, pat in (
+            ("sketch_size", "sketch_size"),
+            ("exact_baseline_s", "exact_baseline_s"),
+            ("heldout_accuracy", "heldout_"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "sketch_probe", 1.9, "s", None, "min_of_N_warm", d)
+        # A prose sketch size must not satisfy the rule.
+        d = dict(good)
+        d["sketch_size"] = "2(d+1) bins"
+        with pytest.raises(ValueError, match="sketch_size"):
+            bench.make_row(
+                "sketch_probe", 1.9, "s", None, "min_of_N_warm", d)
+        # Claims trigger at any nesting depth.
+        with pytest.raises(ValueError, match="exact_baseline_s"):
+            bench.make_row(
+                "sketch_probe", 1.9, "s", None, "min_of_N_warm",
+                {"legs": [{"sketch_wall_s": 1.9}]},
+            )
+        # ``sketch_size`` ALONE is the engine input, not a result
+        # claim — frontier points carrying just the size and plainly
+        # named walls are not burdened, nor are claim-free dicts.
+        bench.make_row(
+            "sketch_probe", 1.9, "s", None, "min_of_N_warm",
+            {"points": [{"sketch_size": 1026, "wall_s": 1.0}]},
+        )
+        bench.make_row("m", 1.0, "s", None, "min_of_N_warm", {"x": 1})
+
     def test_calibration_claims_require_decisions_and_family(self):
         """ISSUE 13 satellite: any dict claiming a cost-model prediction
         error (a ``prediction_error*`` key) must carry the

@@ -835,3 +835,98 @@ class TestCalibrateCLI:
         assert rc == 0
         assert "predicted=" in out and "measured=" in out
         assert "log_err=" in out
+
+
+class TestSketchedFamilyRefit:
+    """ISSUE 17 satellite: ``bin/calibrate --refit`` re-estimates the
+    two sketched-engine overhead families from a trace of
+    ``calibration_sweep`` rows won by the sketched engines, and the
+    artifact provenance names exactly them (the exact-engine constants
+    pass through unfitted — no gather or sequential rows here)."""
+
+    GEOMETRIES = (
+        {"n": 500_000, "d": 16_384, "k": 2, "sparsity": 82 / 16_384,
+         "machines": 1},
+        {"n": 250_000, "d": 16_384, "k": 2, "sparsity": 82 / 16_384,
+         "machines": 1},
+    )
+    # The "true" overheads of the machine the synthetic trace pretends
+    # to be: 1.5x the shipped constants — inside the drift-gate bound
+    # (ln 1.5 < 0.7) yet clearly distinguishable from the base family.
+    SRHT_TRUE = cost_mod.TPU_SRHT_SKETCH_OVERHEAD * 1.5
+    CS_TRUE = cost_mod.TPU_COUNTSKETCH_OVERHEAD * 1.5
+
+    @pytest.fixture(scope="class")
+    def trace_dir(self, tmp_path_factory):
+        work = str(tmp_path_factory.mktemp("sketch_sweep"))
+        base = {
+            "cpu": cost_mod.TPU_CPU_WEIGHT,
+            "mem": cost_mod.TPU_MEM_WEIGHT,
+            "network": 0.0,  # single-chip sweep: no network term
+            "sparse_gather_overhead": cost_mod.TPU_SPARSE_GATHER_OVERHEAD,
+        }
+        with obs.tracing(work, run_id="sketchsweep01"):
+            for label, family, true_ov in (
+                ("SketchedLeastSquares", "srht_sketch_overhead",
+                 self.SRHT_TRUE),
+                ("IterativeHessianSketch", "countsketch_overhead",
+                 self.CS_TRUE),
+            ):
+                for ctx in self.GEOMETRIES:
+                    predicted = cal.predict_seconds(label, ctx, base)
+                    measured = cal.predict_seconds(
+                        label, ctx, {**base, family: true_ov}
+                    )
+                    ref = obs.record_cost_decision(obs.CostDecision(
+                        decision="calibration_sweep",
+                        winner=label,
+                        candidates=[{"label": label, "cost_s": predicted,
+                                     "feasible": True}],
+                        reason="sweep",
+                        context=dict(ctx),
+                    ))
+                    ref.stamp(measured, timing="min_of_N_warm")
+        return work
+
+    def test_cli_refit_names_sketched_families(self, trace_dir,
+                                               tmp_path, capsys):
+        from keystone_tpu.tools.calibrate import main
+
+        out_path = str(tmp_path / "cal.json")
+        rc = main([trace_dir, "--refit", out_path])
+        capsys.readouterr()
+        assert rc == 0
+        doc = cal.load_calibration_artifact(out_path)
+        prov = doc["provenance"]
+        assert set(prov["fitted"]) == {
+            "srht_sketch_overhead", "countsketch_overhead"
+        }
+        w = doc["weights"]
+        assert w["srht_sketch_overhead"] == pytest.approx(
+            self.SRHT_TRUE, rel=1e-3)
+        assert w["countsketch_overhead"] == pytest.approx(
+            self.CS_TRUE, rel=1e-3)
+        # Families with no rows in this trace keep the base constants.
+        assert w["cpu"] == pytest.approx(cost_mod.TPU_CPU_WEIGHT)
+        assert w["mem"] == pytest.approx(cost_mod.TPU_MEM_WEIGHT)
+        assert w["sparse_gather_overhead"] == pytest.approx(
+            cost_mod.TPU_SPARSE_GATHER_OVERHEAD)
+
+    def test_refit_reduces_error_on_its_own_rows(self, trace_dir):
+        events = obs.load_events(trace_dir)
+        result = cal.refit(events, kinds=("calibration_sweep",))
+        assert result["after"]["median_abs_log_error"] <= (
+            result["before"]["median_abs_log_error"])
+        assert result["after"]["median_abs_log_error"] < 1e-6
+
+    def test_sweep_trace_passes_drift_gate_as_recorded(self, trace_dir,
+                                                       capsys):
+        """1.5x overhead drift is within the gate's bound — the CLI
+        audits clean (exit 0), and the refit is the precision upgrade,
+        not a fire drill."""
+        from keystone_tpu.tools.calibrate import main
+
+        rc = main([trace_dir])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "drift verdict" in out

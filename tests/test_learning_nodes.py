@@ -274,3 +274,58 @@ class TestSampler:
 
         X = np.ones((5, 3), dtype=np.float32)
         assert Sampler(100)(Dataset.of(X)).to_numpy().shape == (5, 3)
+
+
+class TestSharedRfftEpilogue:
+    """ISSUE 17 satellite: the pad→rfft→real-half epilogue lived as
+    three inline copies in ops/stats.py (PaddedFFT.apply, its batch fn,
+    the packed odd-branch tail) before ``rfft_real_half`` factored it;
+    the SRHT engine is the fourth caller. Pin the shared helper against
+    the naive construction and the batched path against the
+    one-row-at-a-time path."""
+
+    def test_rfft_real_half_matches_naive(self):
+        import jax.numpy as jnp
+        from keystone_tpu.ops.stats import padded_pow2, rfft_real_half
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=24).astype(np.float32))
+        p = padded_pow2(24)
+        assert p == 32
+        padded = jnp.pad(x, [(0, p - 24)])
+        out = rfft_real_half(padded, p)
+        naive = np.real(np.fft.fft(np.asarray(padded)))[: p // 2]
+        np.testing.assert_allclose(np.asarray(out), naive, atol=1e-4)
+
+    def test_padded_fft_batched_matches_single(self):
+        from keystone_tpu.ops.stats import PaddedFFT
+
+        rng = np.random.default_rng(1)
+        X = rng.normal(size=(7, 45)).astype(np.float32)
+        node = PaddedFFT()
+        batched = np.asarray(node._batch_fn(X))
+        singles = np.stack([np.asarray(node.apply(row)) for row in X])
+        assert batched.shape == singles.shape == (7, 32)
+        np.testing.assert_allclose(batched, singles, atol=1e-5)
+
+    def test_srht_chunk_sketch_matches_dense_reference(self):
+        import jax.numpy as jnp
+        from keystone_tpu.ops.stats import (
+            padded_pow2, rfft_real_half, srht_chunk_sketch,
+        )
+
+        rng = np.random.default_rng(2)
+        c, d, m = 12, 5, 4
+        rows = rng.normal(size=(c, d)).astype(np.float32)
+        signs = rng.choice([-1.0, 1.0], size=c).astype(np.float32)
+        p = padded_pow2(c)
+        bins = rng.integers(0, p // 2, size=m)
+        scale = float(np.sqrt(2.0 / m))
+        out = srht_chunk_sketch(
+            jnp.asarray(rows), jnp.asarray(signs), jnp.asarray(bins), scale
+        )
+        Z = np.zeros((p, d), np.float32)
+        Z[:c] = rows * signs[:, None]
+        F = np.real(np.fft.fft(Z, axis=0))[: p // 2]
+        np.testing.assert_allclose(
+            np.asarray(out), scale * F[bins], atol=1e-4)

@@ -626,6 +626,84 @@ def unrelated():
         }
 
 
+class TestExplicitSeedRule:
+    """ISSUE 17 satellite: randomized library code must take an explicit
+    integer seed — argless PRNG constructors, hardcoded seed literals
+    and non-integer ``seed`` defaults are flagged; benches, scripts and
+    tests are exempt."""
+
+    VIOLATION = """
+import jax
+
+
+def draw():
+    return jax.random.key()
+
+
+def pinned():
+    return jax.random.PRNGKey(42)
+
+
+def defaulted(seed=None):
+    return jax.random.key(seed or 0)
+"""
+
+    def test_fires_on_each_violation_form(self, tmp_path):
+        findings = _lint_snippet(tmp_path, self.VIOLATION)
+        assert _codes(findings) == ["explicit-seed"] * 3
+        assert "argless" in findings[0].message
+        assert "42" in findings[1].message
+        assert "seed" in findings[2].message
+
+    def test_kwonly_none_default_fires(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+def make(*, seed=None):
+    return seed
+""")
+        assert _codes(findings) == ["explicit-seed"]
+
+    def test_explicit_integer_seeds_are_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+import jax
+import numpy as np
+
+
+def create(num_features, seed: int = 0):
+    return jax.random.rademacher(jax.random.key(seed), (num_features,))
+
+
+def reseeded(rng):
+    # a computed seed is a call argument, not a literal — fine
+    return jax.random.key(int(rng.integers(0, 2**31 - 1)))
+
+
+def split(*, seed: int = 12334):
+    return jax.random.split(jax.random.key(seed))
+""")
+
+    def test_bare_key_name_is_not_the_prng(self, tmp_path):
+        # dict.key()-style helpers named "key" must not trip the rule.
+        assert not _lint_snippet(tmp_path, """
+def key():
+    return "cache-key"
+
+
+def use():
+    return key()
+""")
+
+    def test_benches_scripts_and_tests_are_exempt(self, tmp_path):
+        for rel in ("scripts/sweep.py", "tests/helper.py",
+                    "test_demo.py", "bench.py", "conftest.py"):
+            f = tmp_path / rel
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_text(self.VIOLATION)
+            assert not lint_file(f), rel
+
+    def test_rule_is_registered(self):
+        assert "explicit-seed" in RULES
+
+
 class TestDriver:
     def test_unparseable_file_is_a_finding(self, tmp_path):
         findings = _lint_snippet(tmp_path, "def broken(:\n")
