@@ -18,6 +18,12 @@ from .prefetch import (
     iter_segments,
 )
 from .shards import DiskCOOShards, DiskDenseShards, DiskDenseShardWriter
+from .images import (
+    EncodedImageSource,
+    SyntheticEncodedImages,
+    images_to_disk_shards,
+    load_images,
+)
 
 __all__ = [
     "CheckpointSpec",
@@ -40,4 +46,8 @@ __all__ = [
     "DiskCOOShards",
     "DiskDenseShards",
     "DiskDenseShardWriter",
+    "EncodedImageSource",
+    "SyntheticEncodedImages",
+    "images_to_disk_shards",
+    "load_images",
 ]

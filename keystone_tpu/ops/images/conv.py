@@ -136,6 +136,23 @@ class Convolver(Transformer):
         return conv
 
     def _convolve(self, images):
+        # The declared compute dtype is float32 (declares_dtype_change):
+        # narrow float64 loader output HERE, before any arithmetic, so the
+        # eager apply() path and the compiled _batch_fn path agree — the
+        # einsum's preferred_element_type alone would otherwise leave the
+        # patch normalization running in f64 on the eager path.
+        images = jnp.asarray(images, jnp.float32)
+        from keystone_tpu.ops import pallas_images
+
+        if pallas_images.conv_featurize_ok(images, self.filters):
+            return pallas_images.conv_featurize(
+                images,
+                self.filters,
+                self.whitener.means if self.whitener is not None else None,
+                patch_size=self.patch_size,
+                normalize_patches=self.normalize_patches,
+                var_constant=self.var_constant,
+            )
         patches = im2col(images, self.patch_size)
         if self.normalize_patches:
             patches = normalize_patch_rows(patches, self.var_constant)

@@ -142,6 +142,16 @@ TPU_COUNTSKETCH_OVERHEAD = 250.0
 EC2_SRHT_SKETCH_OVERHEAD = 10.0
 EC2_COUNTSKETCH_OVERHEAD = 6.0
 
+# Image-tier decode multiplier (ISSUE 18): host-side decompression of
+# one encoded image into f32 cells, as a multiplier on the sequential
+# mem rate per DECODED cell. Seeded from the native PNM decoder's
+# ~1 GB/s single-thread throughput (≈ 4e-9 s per f32 cell against the
+# 1.9e-11 s sequential rate); the EC2 value keeps the reference
+# cluster's convention of single-digit factors. Refit from traces like
+# the other per-engine overheads.
+TPU_IMAGE_DECODE_OVERHEAD = 200.0
+EC2_IMAGE_DECODE_OVERHEAD = 4.0
+
 
 # Weight-family spec for trace-calibrated constants:
 # KEYSTONE_COST_WEIGHTS=calibrated:<path> points at a refit artifact
@@ -266,6 +276,19 @@ def countsketch_overhead() -> float:
         so = _calibrated_weights(path).get("countsketch_overhead")
         return float(so) if so is not None else TPU_COUNTSKETCH_OVERHEAD
     return TPU_COUNTSKETCH_OVERHEAD
+
+
+def image_decode_overhead() -> float:
+    """Random-access multiplier for the image tier's host decode pass,
+    per the active weight family (null-in-artifact falls back to the TPU
+    constant, as above)."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
+        return EC2_IMAGE_DECODE_OVERHEAD
+    if family == "calibrated":
+        so = _calibrated_weights(path).get("image_decode_overhead")
+        return float(so) if so is not None else TPU_IMAGE_DECODE_OVERHEAD
+    return TPU_IMAGE_DECODE_OVERHEAD
 
 
 def candidate_label(est) -> str:
@@ -445,6 +468,105 @@ def choose_mesh_layout(
             "nnz_per_row": (
                 int(nnz_per_row) if nnz_per_row else None
             ),
+            "weights": {
+                "cpu": cpu_w, "mem": mem_w, "network": net_w,
+                "family": family,
+            },
+        },
+    ))
+    return winner, ref
+
+
+IMAGE_TIERS = ("resident", "resident_u8", "disk_shards")
+
+
+def choose_image_tier(
+    n_images: int, d: int, k: int,
+    *,
+    images_per_segment: int = 256,
+    prefetch_depth: int = 2,
+    host_budget_bytes: Optional[float] = None,
+    host_utilization: float = 0.8,
+):
+    """Select the storage tier for a decoded image set, recorded as
+    first-class ``cost.decision`` evidence — this is what lets
+    ``Pipeline.fit`` route a past-host-RAM image set through disk shards
+    with NO flag: the loader prices the tiers and the infeasible ones
+    price to inf.
+
+    ``d`` is decoded floats per image (x·y·c after augmentation), ``k``
+    the label width. Candidates:
+
+      - ``resident``: decoded f32 rows held in host RAM — one decode
+        pass, cheapest reads, infeasible past the host budget.
+      - ``resident_u8``: the compressed-resident tier — uint8 pixel rows
+        (exact for 8-bit sources), 4× smaller residency, a cast per
+        epoch on the way to the device.
+      - ``disk_shards``: spill through ``DiskDenseShardWriter`` — host
+        residency is ``(prefetch_depth + 1)`` staged segments only,
+        always feasible; pays the spill write + re-read traffic.
+
+    Returns ``(tier_name, outcome_ref)``; ``outcome_ref`` is None when
+    no tracer is active.
+    """
+    cpu_w, mem_w, net_w = active_weights()
+    try:
+        family = weights_family_name()
+    except ValueError:
+        family = "custom"
+    if host_budget_bytes is not None:
+        budget = float(host_budget_bytes)
+    else:
+        budget = host_memory_bytes() * host_utilization
+
+    n = int(n_images)
+    cells = float(n) * (d + k)
+    decode_s = mem_w * image_decode_overhead() * float(n) * d
+    seg_bytes = float(images_per_segment) * (4.0 * d + 4.0 * k)
+    resident_bytes = {
+        "resident": cells * 4.0,
+        "resident_u8": float(n) * (d + 4.0 * k),
+        "disk_shards": (prefetch_depth + 1) * seg_bytes,
+    }
+    tier_cost = {
+        # One decode pass each; reads price the per-epoch traffic.
+        "resident": decode_s + mem_w * cells,
+        # u8 rows read 1/4 the bytes but pay a widening cast per epoch.
+        "resident_u8": decode_s + mem_w * cells * 1.25,
+        # Spill write + shard re-read (checksummed), both full passes.
+        "disk_shards": decode_s + mem_w * cells * 3.0,
+    }
+    costs = {
+        t: (tier_cost[t] if resident_bytes[t] <= budget else float("inf"))
+        for t in IMAGE_TIERS
+    }
+    if all(c == float("inf") for c in costs.values()):
+        raise ValueError(
+            f"no image tier fits the host budget {budget:.3g} B "
+            f"(even {prefetch_depth + 1} staged segments of "
+            f"{seg_bytes:.3g} B); shrink images_per_segment"
+        )
+    winner = min(IMAGE_TIERS, key=lambda t: costs[t])
+    ref = obs.record_cost_decision(obs.CostDecision(
+        decision="image_tier",
+        winner=winner,
+        candidates=[
+            {
+                "label": t,
+                "cost_s": (None if costs[t] == float("inf") else float(costs[t])),
+                "feasible": costs[t] != float("inf"),
+                "resident_bytes": float(resident_bytes[t]),
+                "chip_resident": False,  # the image tier is host-side
+                "host_ok": resident_bytes[t] <= budget,
+            }
+            for t in IMAGE_TIERS
+        ],
+        reason="argmin",
+        context={
+            "n": n, "d": int(d), "k": int(k),
+            "images_per_segment": int(images_per_segment),
+            "prefetch_depth": int(prefetch_depth),
+            "host_budget_bytes": float(budget),
             "weights": {
                 "cpu": cpu_w, "mem": mem_w, "network": net_w,
                 "family": family,

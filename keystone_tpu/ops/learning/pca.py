@@ -246,3 +246,141 @@ class ZCAWhitenerEstimator(Estimator):
         scaled = jnp.diag((s2 + self.eps) ** -0.5)
         whitener = vt.T @ scaled @ vt
         return ZCAWhitener(whitener, means)
+
+
+def _zca_cov_fold(sums, gram, X):
+    """One segment's contribution to (Σx, XᵀX). Exact-f32 gram (HIGHEST:
+    the eigendecomposition downstream amplifies covariance error by
+    (λ+ε)^−3/2); zero-padded tail rows contribute zero to both terms, so
+    no masking is needed — only the true-row count matters."""
+    sums = sums + jnp.sum(X, axis=0)
+    gram = gram + jax.lax.dot_general(
+        X, X,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    )
+    return sums, gram
+
+
+class StreamedZCAWhitenerEstimator(Estimator):
+    """ZCA whitening as a streamed covariance fold over a
+    :class:`~keystone_tpu.data.prefetch.ShardSource` — the out-of-core
+    form of :class:`ZCAWhitenerEstimator` for patch sets that never fit
+    in host RAM.
+
+    Algebra: the batch estimator's SVD singular values satisfy
+    s²/(n−1) = eigvals of the centered covariance, so folding
+    (Σx, XᵀX, n) and finalizing with
+
+        μ = Σx/n,  C = (XᵀX − n·μμᵀ)/(n−1),  C = V·Λ·Vᵀ,
+        whitener = V·diag((Λ+ε)^−½)·Vᵀ
+
+    reproduces ``fit_single`` up to eigenbasis roundoff (pinned in
+    tests/test_zca_stream.py). The fold rides the standard streaming
+    stack: segments arrive through ``iter_segments`` (prefetched on the
+    read lane), and the (Σx, XᵀX, n) carry snapshots through
+    :class:`~keystone_tpu.data.durable.CheckpointSpec` — a fit killed
+    mid-stream and re-run with the same spec resumes BIT-IDENTICALLY
+    (chaos-marked test, same discipline as the streamed gram solvers).
+    """
+
+    def __init__(
+        self,
+        eps: float = 0.1,
+        checkpoint=None,
+        prefetch_depth: int = 2,
+    ):
+        self.eps = eps
+        self.checkpoint = checkpoint
+        self.prefetch_depth = prefetch_depth
+
+    def fit(self, data: Dataset) -> ZCAWhitener:
+        if getattr(data, "is_shard_backed", False):
+            return self.fit_source(data.shard_source)
+        X = jnp.asarray(data.to_numpy() if data.is_host else data.array[: data.n])
+        return ZCAWhitenerEstimator(self.eps).fit_single(X)
+
+    def fit_source(self, source, stats=None) -> ZCAWhitener:
+        """Fold (Σx, XᵀX, n) over the source's segments and finalize.
+
+        Segment payloads may be ``(X, Y, valid_rows)`` triples (the
+        DenseShardSource / image-tier contract; X is flattened to rows)
+        or bare row blocks (all rows counted as true)."""
+        from keystone_tpu.data.durable import (
+            resolve_checkpoint,
+            source_fingerprint,
+        )
+        from keystone_tpu.data.prefetch import iter_segments
+
+        checkpoint = resolve_checkpoint(self.checkpoint)
+        num_segments = int(source.num_segments)
+
+        first = source.load(0)
+        d = int(self._rows(first)[0].shape[-1])
+
+        sums = jnp.zeros((d,), jnp.float32)
+        gram = jnp.zeros((d, d), jnp.float32)
+        count = 0
+        start_seg = 0
+        fingerprint = None
+        if checkpoint is not None:
+            fingerprint = {
+                "kind": "zca_stream",
+                "eps": float(self.eps),
+                "d": d,
+                "num_segments": num_segments,
+                "source": source_fingerprint(source),
+            }
+            arrays, start_seg = checkpoint.restore(fingerprint)
+            if arrays is not None:
+                sums = jnp.asarray(arrays[0])
+                gram = jnp.asarray(arrays[1])
+                count = int(np.asarray(arrays[2])[0])
+
+        fold = jax.jit(_zca_cov_fold)
+        for s, payload in iter_segments(
+            source,
+            prefetch_depth=self.prefetch_depth,
+            stats=stats,
+            start=start_seg,
+        ):
+            X, valid = self._rows(payload)
+            sums, gram = fold(sums, gram, jnp.asarray(X, jnp.float32))
+            count += valid
+            if checkpoint is not None:
+                checkpoint.maybe_save(
+                    [sums, gram, np.asarray([count], np.int64)],
+                    s, num_segments, fingerprint, stats=stats,
+                )
+        if checkpoint is not None:
+            checkpoint.clear(fingerprint)
+        return self._finalize(sums, gram, count)
+
+    @staticmethod
+    def _rows(payload):
+        """Normalize a segment payload to (rows (r, d), valid_count)."""
+        if isinstance(payload, tuple):
+            X = np.asarray(payload[0])
+            valid = int(payload[2]) if len(payload) > 2 else X.shape[0]
+        else:
+            X = np.asarray(payload)
+            valid = X.shape[0]
+        return X.reshape(-1, X.shape[-1]), valid
+
+    def _finalize(self, sums, gram, n: int) -> ZCAWhitener:
+        if n < 2:
+            raise ValueError(f"streamed ZCA needs n >= 2 rows, saw {n}")
+        means = sums / n
+        cov = (gram - n * jnp.outer(means, means)) / (n - 1.0)
+        lam, V = jnp.linalg.eigh(cov)
+        # eigh of a PSD-up-to-roundoff covariance can return tiny
+        # negative eigenvalues; clamp before the inverse square root.
+        scaled = (jnp.maximum(lam, 0.0) + self.eps) ** -0.5
+        whitener = (V * scaled[None, :]) @ V.T
+        return ZCAWhitener(whitener, means)
+
+    def cost(self, n, d, k, sparsity, num_machines, cpu_w, mem_w, net_w) -> float:
+        flops = n * d * d + d ** 3
+        # Streaming holds one (d, d) gram + a segment, not the n×d matrix.
+        return max(cpu_w * flops, mem_w * d * d) + net_w * d * d

@@ -430,11 +430,21 @@ class IterativeHessianSketch(LabelEstimator):
         AtB = sparse_matmul_t(idx1, val1, B, d1)
         key = jax.random.key(self.seed)
 
+        from keystone_tpu.ops import pallas_ops
+
+        # The sketch accumulation has two shapes: a fused Pallas kernel
+        # (countsketch_scatter: one-hot sketch tile × densified chunk
+        # tile on the MXU, no HBM scatter) when direct dispatch is safe,
+        # else the flattened-segment scatter-add. Same algebra; the
+        # kernel sums in tiled MXU order so the paths agree to float
+        # associativity (pinned in tests/test_pallas_ops.py).
+        use_kernel = pallas_ops.pallas_direct_ok(idx_t, val_t)
+
         def fold_pass(X, key_t):
             """One streamed pass: CountSketch fold + AᵀA X, together."""
 
             def step(carry, cid):
-                SA_flat, AtAX = carry
+                SA_acc, AtAX = carry
                 idxi = idx_t[cid].astype(jnp.int32)
                 valf = val_t[cid].astype(jnp.float32)
                 mask = (idxi >= 0) & (idxi < d1)
@@ -444,10 +454,15 @@ class IterativeHessianSketch(LabelEstimator):
                 ks, kb = jax.random.split(kc)
                 bucket = jax.random.randint(kb, (c,), 0, m)
                 sign = jax.random.rademacher(ks, (c,), dtype=jnp.float32)
-                seg = jnp.where(mask, bucket[:, None] * d1 + safe, m * d1)
-                SA_flat = SA_flat.at[seg.reshape(-1)].add(
-                    (sign[:, None] * vals).reshape(-1)
-                )
+                if use_kernel:
+                    SA_acc = SA_acc + pallas_ops.countsketch_scatter(
+                        jnp.where(mask, idxi, -1), vals, bucket, sign, m, d1
+                    )
+                else:
+                    seg = jnp.where(mask, bucket[:, None] * d1 + safe, m * d1)
+                    SA_acc = SA_acc.at[seg.reshape(-1)].add(
+                        (sign[:, None] * vals).reshape(-1)
+                    )
                 # Exact-gradient operand on the same chunk: gather rows
                 # of X, then scatter back (ghost row d1 for pad lanes).
                 rows = jnp.sum(
@@ -457,16 +472,19 @@ class IterativeHessianSketch(LabelEstimator):
                 AtAX = AtAX.at[back.reshape(-1)].add(
                     (vals[:, :, None] * rows[:, None, :]).reshape(-1, X.shape[1])
                 )
-                return (SA_flat, AtAX), None
+                return (SA_acc, AtAX), None
 
             init = (
-                jnp.zeros((m * d1 + 1,), jnp.float32),
+                jnp.zeros((m, d1), jnp.float32)
+                if use_kernel
+                else jnp.zeros((m * d1 + 1,), jnp.float32),
                 jnp.zeros((d1 + 1, X.shape[1]), jnp.float32),
             )
-            (SA_flat, AtAX), _ = jax.lax.scan(
+            (SA_acc, AtAX), _ = jax.lax.scan(
                 step, init, jnp.arange(nchunks)
             )
-            return SA_flat[: m * d1].reshape(m, d1), AtAX[:d1]
+            SA = SA_acc if use_kernel else SA_acc[: m * d1].reshape(m, d1)
+            return SA, AtAX[:d1]
 
         X = jnp.zeros((d1, k), jnp.float32)
         X_prev, prev_gnorm = X, None

@@ -1,0 +1,166 @@
+"""Pallas TPU kernels for the image-geometry pipeline's hot path.
+
+The reference's image featurizer is im2col into a reused patch-matrix
+buffer followed by one BLAS-3 GEMM per image (nodes/images/
+Convolver.scala:128-220). The XLA path here (`ops/images/conv.py`)
+already fuses the batch into one program, but it still materializes the
+full patch tensor ``(n, x', y', p²·c)`` in HBM between the patch
+extraction and the filter GEMM — for CIFAR geometry (32×32×3, 6×6
+patches) that intermediate is 12× the size of the images themselves, so
+the node is HBM-traffic-bound long before the MXU saturates.
+
+The kernel below processes ONE IMAGE PER GRID STEP with the whole
+featurization fused in VMEM:
+
+    grid = (n,)
+    img (1, X, Y, C) block  ->  in-kernel im2col (static (dx, dy) slices)
+                            ->  per-patch mean/variance normalization
+                            ->  whitening-mean subtraction
+                            ->  (P − μ) @ Fᵀ on the MXU
+    out (1, x', y', K) block
+
+so the patch matrix lives only as a (x'·y', p²·c) VMEM tile and the HBM
+traffic drops to images-in + features-out. Column order inside a patch
+row is row-major over ``(px, py, c)`` — the same contract as
+``conv.im2col`` / ``Convolver.pack_filters``, pinned by the
+interpreter-equality test against the XLA path.
+
+Numerics: everything is float32 with ``preferred_element_type=float32``
+and ``precision=HIGHEST`` on the dot (the same recipe as `pallas_ops`);
+the normalization uses the reference's (d−1) variance denominator. The
+fused path matches the XLA path to float-associativity tolerance (the
+mean/variance reductions associate differently), pinned at 1e-5 relative
+in tests/test_pallas_images.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from keystone_tpu.ops.pallas_ops import (
+    _COMPILER_PARAMS,  # noqa: F401  (re-exported for symmetry with pallas_ops)
+    _dot_kwargs,
+    _interpret,
+    pallas_direct_ok,
+)
+
+__all__ = [
+    "conv_featurize",
+    "conv_featurize_flops",
+    "conv_featurize_ok",
+]
+
+# One image block + its patch matrix + the output tile must fit VMEM
+# (~16 MB/core) alongside the filter matrix. Past this budget the caller
+# should stay on the XLA path (which tiles freely through HBM).
+_VMEM_BUDGET_BYTES = 8 * 1024 * 1024
+
+
+def _conv_featurize_kernel(
+    img_ref, ft_ref, mn_ref, out_ref, *,
+    patch_size, xo, yo, channels, normalize, var_constant,
+):
+    img = img_ref[0]  # (X, Y, C)
+    cols = []
+    # Static-slice im2col: dx-outer / dy-inner with the channel axis kept
+    # intact reproduces row-major (px, py, c) patch columns exactly.
+    for dx in range(patch_size):
+        for dy in range(patch_size):
+            window = img[dx:dx + xo, dy:dy + yo, :]
+            cols.append(window.reshape(xo * yo, channels))
+    patches = jnp.concatenate(cols, axis=1)  # (xo·yo, p²·c)
+    d = patch_size * patch_size * channels
+    if normalize:
+        mean = jnp.mean(patches, axis=-1, keepdims=True)
+        centered = patches - mean
+        var = jnp.sum(centered * centered, axis=-1, keepdims=True) / (d - 1.0)
+        patches = centered / jnp.sqrt(var + var_constant)
+    patches = patches - mn_ref[0]
+    feats = jax.lax.dot_general(
+        patches, ft_ref[:],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        **_dot_kwargs(jnp.float32),
+    )
+    out_ref[0] = feats.reshape(xo, yo, ft_ref.shape[1])
+
+
+def conv_featurize_flops(n: int, xo: int, yo: int, d: int, k: int) -> float:
+    """Executed-FLOP model for the fused featurizer: the filter GEMM's
+    2·n·x'·y'·d·k dominates (normalization is O(n·x'·y'·d) — <1% beside a
+    k≥128 filter bank and excluded, the same convention as the roofline
+    rows in bench.py)."""
+    return 2.0 * n * xo * yo * d * k
+
+
+def conv_featurize_ok(images, filters) -> bool:
+    """True when the fused kernel may be dispatched directly on these
+    eager operands: Pallas on, operands unsharded, batch-of-images rank,
+    and the per-image working set within the VMEM budget."""
+    if not pallas_direct_ok(images, filters):
+        return False
+    if getattr(images, "ndim", 0) != 4:
+        return False
+    n, X, Y, C = images.shape
+    k, d = filters.shape
+    p = int(round((d / C) ** 0.5))
+    xo, yo = X - p + 1, Y - p + 1
+    if xo <= 0 or yo <= 0:
+        return False
+    working_set = 4 * (X * Y * C + xo * yo * d + xo * yo * k + d * k)
+    return working_set <= _VMEM_BUDGET_BYTES
+
+
+def conv_featurize(
+    images,
+    filters,
+    means=None,
+    *,
+    patch_size: int,
+    normalize_patches: bool = True,
+    var_constant: float = 10.0,
+    interpret: Optional[bool] = None,
+):
+    """Fused im2col + normalize + whiten-center + filter GEMM.
+
+    images: (n, X, Y, C) float32, filters: (k, p²·c) packed rows (the
+    `Convolver.pack_filters` layout), means: optional (p²·c,) whitening
+    means. Returns (n, X−p+1, Y−p+1, k) float32 — bit-for-bit the same
+    contract as ``Convolver._convolve``'s XLA path, to the stated
+    associativity tolerance.
+    """
+    images = jnp.asarray(images, dtype=jnp.float32)
+    filters = jnp.asarray(filters, dtype=jnp.float32)
+    n, X, Y, C = images.shape
+    k, d = filters.shape
+    xo, yo = X - patch_size + 1, Y - patch_size + 1
+    ft = filters.T  # (d, k): contraction layout for the in-kernel dot
+    if means is None:
+        mn = jnp.zeros((1, d), dtype=jnp.float32)
+    else:
+        mn = jnp.asarray(means, dtype=jnp.float32).reshape(1, d)
+
+    return pl.pallas_call(
+        functools.partial(
+            _conv_featurize_kernel,
+            patch_size=patch_size,
+            xo=xo,
+            yo=yo,
+            channels=C,
+            normalize=bool(normalize_patches),
+            var_constant=float(var_constant),
+        ),
+        grid=(n,),
+        in_specs=[
+            pl.BlockSpec((1, X, Y, C), lambda i: (i, 0, 0, 0)),
+            pl.BlockSpec((d, k), lambda i: (0, 0)),
+            pl.BlockSpec((1, d), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, xo, yo, k), lambda i: (i, 0, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, xo, yo, k), jnp.float32),
+        interpret=_interpret() if interpret is None else interpret,
+    )(images, ft, mn)

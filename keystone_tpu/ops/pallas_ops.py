@@ -37,6 +37,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = [
+    "countsketch_scatter",
     "gaussian_kernel_block",
     "gaussian_resid_block",
     "cosine_features",
@@ -1066,3 +1067,104 @@ def block_residual_update(
         interpret=_interpret() if interpret is None else interpret,
     )(base, F, Wp, Rp)
     return out[:, :kdim]
+
+
+# ---------------------------------------------------------------------------
+# Fused CountSketch sparse×dense-random product: S·A without the HBM scatter
+# ---------------------------------------------------------------------------
+
+
+def _countsketch_kernel(
+    bucket_ref, sign_ref, idx_ref, val_ref, out_ref, acc_ref, *, s, nc
+):
+    """Grid (m_tiles, n_tiles, c_tiles), c fastest. Each step forms two
+    VMEM tiles and contracts them on the MXU:
+
+      B (tm, tc): the one-hot sketch tile, B[b, i] = sign_i·[bucket_i = b]
+                  via a broadcasted-iota comparison against the global
+                  bucket row.
+      D (tc, tn): the densified chunk-row tile, accumulated over the s
+                  nnz slots by one-hot column comparison (a masked slot
+                  carries idx = −1 and never matches).
+
+    The densify loop re-runs for every m tile, amortized over the tm
+    output rows of the MXU contraction it feeds: its VPU cost is s/tm of
+    the MXU MAC count, which is why tm is the largest tile."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    tm, tn = acc_ref.shape
+    tc = bucket_ref.shape[1]
+    b_iota = jax.lax.broadcasted_iota(jnp.int32, (tm, tc), 0) + i * tm
+    B = jnp.where(bucket_ref[:] == b_iota, sign_ref[:], 0.0)
+    col_iota = jax.lax.broadcasted_iota(jnp.int32, (tc, tn), 1) + j * tn
+    D = jnp.zeros((tc, tn), jnp.float32)
+    for t in range(s):
+        D = D + jnp.where(idx_ref[:, t:t + 1] == col_iota, val_ref[:, t:t + 1], 0.0)
+    acc_ref[:] += jax.lax.dot_general(
+        B, D,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        **_dot_kwargs(jnp.float32),
+    )
+
+    @pl.when(k == nc - 1)
+    def _():
+        out_ref[:] = acc_ref[:]
+
+
+def countsketch_scatter(
+    idx, val, bucket, sign, m: int, d1: int,
+    interpret: Optional[bool] = None,
+):
+    """SA[b, j] = Σ_{i: bucket_i = b} sign_i · Σ_{t: idx[i,t] = j} val[i,t]
+    — one chunk's CountSketch contribution S·A as a fused kernel (the
+    remaining PAPERS.md item: fast sparse × dense-random products).
+
+    idx: (c, s) int32 global column ids with −1 marking masked/pad slots;
+    val: (c, s) float32 with 0 on masked slots; bucket: (c,) int32 in
+    [0, m); sign: (c,) float32 ±1 (0 on pad rows). Returns (m, d1) f32.
+
+    The XLA path this replaces flattens (bucket, column) to a scatter-add
+    into an (m·d1,) HBM buffer — random single-element updates that
+    serialize on TPU. Here the sketch matrix is never materialized in HBM
+    at all: both operand tiles are built in VMEM from the (c, s) operands
+    and contracted immediately. Accumulation order differs from the
+    scatter (tiled f32 MXU sums), so equality against the numpy reference
+    is pinned at 1e-5 relative in tests/test_pallas_ops.py, including
+    chunk-fold composition.
+    """
+    idx = jnp.asarray(idx, jnp.int32)
+    val = jnp.asarray(val, jnp.float32)
+    c, s = idx.shape
+    tm = min(512, max(8, ((m + 7) // 8) * 8))
+    tn = min(_TILE_N, max(128, ((d1 + 127) // 128) * 128))
+    tc = min(_TILE_N, max(128, ((c + 127) // 128) * 128))
+    idx_p = jnp.pad(idx, ((0, (-c) % tc), (0, 0)), constant_values=-1)
+    val_p = _pad_to(val, tc, 0)
+    bkt = _pad_to(jnp.asarray(bucket, jnp.int32).reshape(1, c), tc, 1)
+    sgn = _pad_to(jnp.asarray(sign, jnp.float32).reshape(1, c), tc, 1)
+    mp = m + ((-m) % tm)
+    np_ = d1 + ((-d1) % tn)
+    cp = idx_p.shape[0]
+    nc = cp // tc
+
+    out = pl.pallas_call(
+        functools.partial(_countsketch_kernel, s=s, nc=nc),
+        grid=(mp // tm, np_ // tn, nc),
+        in_specs=[
+            pl.BlockSpec((1, tc), lambda i, j, k: (0, k)),
+            pl.BlockSpec((1, tc), lambda i, j, k: (0, k)),
+            pl.BlockSpec((tc, s), lambda i, j, k: (k, 0)),
+            pl.BlockSpec((tc, s), lambda i, j, k: (k, 0)),
+        ],
+        out_specs=pl.BlockSpec((tm, tn), lambda i, j, k: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((mp, np_), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        interpret=_interpret() if interpret is None else interpret,
+    )(bkt, sgn, idx_p, val_p)
+    return out[:m, :d1]

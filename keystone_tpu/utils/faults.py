@@ -52,6 +52,18 @@ Instrumented sites (each site counts its own calls, 0-based):
                         leave the previous RESIDENT copy authoritative
                         (nothing is published until the encode
                         completes).
+  - ``image.decode``  — one segment decode inside the image-tier shard
+                        source (``data/images.py``): decompressing the
+                        encoded bytes for every image of one segment on
+                        the prefetcher's read lane. Injected errors
+                        exercise the same bounded-retry path as
+                        ``prefetch.read``; decode wall time is reported
+                        to the active :func:`observing_retries` stats as
+                        per-site busy time under ``"decode"``.
+  - ``image.augment`` — one segment augmentation pass (deterministic
+                        seeded crop/flip) in the image-tier shard
+                        source, also on the read lane and also reported
+                        as per-site busy time (``"augment"``).
   - ``trainer.fit``    — one segment fold inside the continuous
                         trainer's incremental re-fit loop
                         (``learning/continuous.py``): an injected error
@@ -100,6 +112,8 @@ __all__ = [
     "RetryPolicy",
     "SITE_AUTOSCALE_SPAWN",
     "SITE_CHECKPOINT_WRITE",
+    "SITE_IMAGE_AUGMENT",
+    "SITE_IMAGE_DECODE",
     "SITE_LIFECYCLE_PUBLISH",
     "SITE_LIFECYCLE_VALIDATE",
     "SITE_PREFETCH_READ",
@@ -127,6 +141,8 @@ SITE_REPLICA_EXECUTE = "serving.replica.execute"
 SITE_REPLICA_SPAWN = "serving.replica.spawn"
 SITE_AUTOSCALE_SPAWN = "serving.autoscale.spawn"
 SITE_CHECKPOINT_WRITE = "checkpoint.write"
+SITE_IMAGE_DECODE = "image.decode"
+SITE_IMAGE_AUGMENT = "image.augment"
 SITE_ZOO_PAGE_IN = "serving.zoo.page_in"
 SITE_ZOO_PAGE_OUT = "serving.zoo.page_out"
 SITE_TRAINER_FIT = "trainer.fit"

@@ -117,6 +117,10 @@ def overlap_report(stats) -> Dict[str, Dict[str, Optional[float]]]:
         wall, attributed via ``faults.observe_busy``);
       - ``checkpoint`` — write-behind snapshot writes (busy, worker
         side) vs the fold-blocking sync+submit share (wait);
+      - ``decode`` / ``augment`` — the image tier's per-segment decode
+        and seeded augmentation (ride inside the read lane's wall,
+        attributed via ``faults.observe_busy`` from
+        ``EncodedImageSource.load`` — ISSUE 18);
       - ``compute`` — the consumer's transfer + fold dispatch + device
         throttle, the denominator phase everything else hides behind.
 

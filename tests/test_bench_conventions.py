@@ -676,3 +676,64 @@ class TestRooflineAuditability:
             {"lifecycle": block},
         )
         assert row["detail"]["lifecycle"]["rollbacks"] == 1
+
+    def test_ingest_claims_require_bytes_seconds_and_peak(self):
+        """ISSUE 18 satellite: any dict claiming ingest bandwidth
+        (``*ingest_gbps*``) or decode throughput (a rate-shaped
+        ``decode_*`` key) must carry a numeric ``bytes_read``, a
+        seconds field, and a numeric ``peak_*`` reference in the SAME
+        dict — an ingest number with no byte count, no wall, and no
+        peak to compare against is not a data-plane-bound claim."""
+        bench = _load_bench()
+        good = {
+            "ingest_gbps": 1.8,
+            "bytes_read": 3_145_728,
+            "seconds": 0.0017,
+            "peak_host_memcpy_gbps": 12.4,
+        }
+        row = bench.make_row(
+            "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+            dict(good))
+        assert row["detail"]["ingest_gbps"] == 1.8
+        for missing, pat in (
+            ("bytes_read", "bytes_read"),
+            ("seconds", "seconds"),
+            ("peak_host_memcpy_gbps", "peak_"),
+        ):
+            d = {k: v for k, v in good.items() if k != missing}
+            with pytest.raises(ValueError, match=pat):
+                bench.make_row(
+                    "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+                    d)
+        # A prose byte count must not satisfy the rule.
+        d = dict(good)
+        d["bytes_read"] = "about 3 MB"
+        with pytest.raises(ValueError, match="bytes_read"):
+            bench.make_row(
+                "ingest_probe", 0.0017, "s", None, "min_of_N_warm", d)
+        # Decode throughput claims carry the same burden (no gbps key,
+        # so this is the ingest rule alone, not the roofline rule).
+        with pytest.raises(ValueError, match="bytes_read"):
+            bench.make_row(
+                "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+                {"decode_images_per_s": 150_000.0},
+            )
+        bench.make_row(
+            "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+            {"decode_images_per_s": 150_000.0,
+             "bytes_read": 3_145_728, "seconds": 0.0017,
+             "peak_decode_images_per_s": 400_000.0},
+        )
+        # Claims trigger at any nesting depth.
+        with pytest.raises(ValueError, match="bytes_read"):
+            bench.make_row(
+                "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+                {"legs": [{"streamed_ingest_gbps": 1.8}]},
+            )
+        # Evidence fields are not claims: per-site busy seconds and
+        # plain byte counts ride free.
+        bench.make_row(
+            "ingest_probe", 0.0017, "s", None, "min_of_N_warm",
+            {"decode_busy_s": 0.5, "augment_busy_s": 0.1,
+             "bytes_read": 3_145_728},
+        )

@@ -43,6 +43,8 @@ class TestPackageIsClean:
             "SITE_REPLICA_SPAWN": faults.SITE_REPLICA_SPAWN,
             "SITE_AUTOSCALE_SPAWN": faults.SITE_AUTOSCALE_SPAWN,
             "SITE_CHECKPOINT_WRITE": faults.SITE_CHECKPOINT_WRITE,
+            "SITE_IMAGE_DECODE": faults.SITE_IMAGE_DECODE,
+            "SITE_IMAGE_AUGMENT": faults.SITE_IMAGE_AUGMENT,
             "SITE_ZOO_PAGE_IN": faults.SITE_ZOO_PAGE_IN,
             "SITE_ZOO_PAGE_OUT": faults.SITE_ZOO_PAGE_OUT,
             "SITE_TRAINER_FIT": faults.SITE_TRAINER_FIT,

@@ -1,0 +1,194 @@
+"""Fused Pallas patch/conv featurizer parity tests (interpret mode on CPU).
+
+Pins the in-kernel im2col column order, the (d−1)-denominator patch
+normalization, whitening-mean subtraction and the filter GEMM against the
+XLA path in ops/images/conv.py — the same kernel code that runs on TPU,
+validated through the Pallas interpreter (tolerance 1e-5: the fused and
+XLA paths associate the mean/variance reductions differently).
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.ops import pallas_images as pi
+from keystone_tpu.ops.images.conv import (
+    Convolver,
+    im2col,
+    normalize_patch_rows,
+)
+
+rng = np.random.default_rng(7)
+
+
+def _xla_reference(images, filters, means=None, *, patch_size,
+                   normalize_patches=True, var_constant=10.0):
+    patches = im2col(jnp.asarray(images, jnp.float32), patch_size)
+    if normalize_patches:
+        patches = normalize_patch_rows(patches, var_constant)
+    if means is not None:
+        patches = patches - jnp.asarray(means, jnp.float32)
+    return np.asarray(
+        jnp.einsum(
+            "nxyd,kd->nxyk", patches, jnp.asarray(filters, jnp.float32),
+            preferred_element_type=jnp.float32,
+        )
+    )
+
+
+class TestConvFeaturizeKernel:
+    @pytest.mark.parametrize("normalize", [True, False])
+    def test_matches_xla_path(self, normalize):
+        images = rng.normal(size=(3, 12, 10, 3)).astype(np.float32)
+        filters = rng.normal(size=(5, 5 * 5 * 3)).astype(np.float32)
+        got = pi.conv_featurize(
+            images, filters, patch_size=5,
+            normalize_patches=normalize, interpret=True,
+        )
+        want = _xla_reference(
+            images, filters, patch_size=5, normalize_patches=normalize,
+        )
+        assert got.shape == (3, 8, 6, 5)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_whitening_means_subtracted(self):
+        images = rng.normal(size=(2, 9, 9, 2)).astype(np.float32)
+        filters = rng.normal(size=(4, 3 * 3 * 2)).astype(np.float32)
+        means = rng.normal(size=(3 * 3 * 2,)).astype(np.float32)
+        got = pi.conv_featurize(
+            images, filters, means, patch_size=3, interpret=True,
+        )
+        want = _xla_reference(images, filters, means, patch_size=3)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_column_order_is_px_py_c_row_major(self):
+        # One-hot filters read individual patch columns back out: filter j
+        # must select patch coordinate (px, py, c) with index
+        # (px·p + py)·C + c — the pack_filters contract.
+        p, C = 2, 3
+        d = p * p * C
+        images = rng.normal(size=(1, 4, 4, C)).astype(np.float32)
+        filters = np.eye(d, dtype=np.float32)  # (d, d) one-hot bank
+        got = np.asarray(
+            pi.conv_featurize(
+                images, filters, patch_size=p,
+                normalize_patches=False, interpret=True,
+            )
+        )
+        for px in range(p):
+            for py in range(p):
+                for c in range(C):
+                    j = (px * p + py) * C + c
+                    np.testing.assert_allclose(
+                        got[0, :, :, j],
+                        images[0, px:px + 3, py:py + 3, c],
+                        rtol=1e-6,
+                    )
+
+    def test_fold_composition_gram_accumulates(self):
+        # Fold-level composition: featurizing the stream chunk-by-chunk and
+        # accumulating Fᵀ F must equal the whole-batch gram — the exact
+        # shape of the bench row's featurize-then-solve fold.
+        images = rng.normal(size=(8, 8, 8, 3)).astype(np.float32)
+        filters = rng.normal(size=(6, 3 * 3 * 3)).astype(np.float32)
+
+        def feats(batch):
+            f = pi.conv_featurize(
+                batch, filters, patch_size=3, interpret=True,
+            )
+            return np.asarray(f).reshape(batch.shape[0], -1)
+
+        whole = feats(images)
+        gram_whole = whole.T @ whole
+        gram_folded = np.zeros_like(gram_whole)
+        for lo in range(0, 8, 3):  # ragged final chunk on purpose
+            gram_folded += (lambda f: f.T @ f)(feats(images[lo:lo + 3]))
+        np.testing.assert_allclose(gram_folded, gram_whole, rtol=1e-4, atol=1e-4)
+
+    def test_flop_model(self):
+        assert pi.conv_featurize_flops(2, 3, 4, 5, 6) == 2.0 * 2 * 3 * 4 * 5 * 6
+
+
+class TestConvolverRouting:
+    def _conv(self):
+        filters = rng.normal(size=(4, 3 * 3 * 3)).astype(np.float32)
+        return Convolver(filters, img_x=8, img_y=8, img_channels=3)
+
+    def test_pallas_path_matches_xla_path(self, monkeypatch):
+        images = rng.normal(size=(4, 8, 8, 3)).astype(np.float64)
+        conv = self._conv()
+        monkeypatch.setenv("KEYSTONE_NO_PALLAS", "1")
+        want = np.asarray(conv.apply(images))
+        monkeypatch.delenv("KEYSTONE_NO_PALLAS")
+        monkeypatch.setenv("KEYSTONE_PALLAS", "1")  # interpret-mode dispatch
+        got = np.asarray(conv.apply(images))
+        assert got.dtype == np.float32  # declared compute dtype, f64 input
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_direct_dispatch_guards(self, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+        filters = jnp.asarray(rng.normal(size=(4, 27)), jnp.float32)
+        ok = jnp.asarray(rng.normal(size=(2, 8, 8, 3)), jnp.float32)
+        assert pi.conv_featurize_ok(ok, filters)
+        single = ok[0]  # rank-3: no batch axis
+        assert not pi.conv_featurize_ok(single, filters)
+        monkeypatch.setenv("KEYSTONE_NO_PALLAS", "1")
+        assert not pi.conv_featurize_ok(ok, filters)
+
+    def test_vmem_budget_falls_back(self, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+        # 1024² RGB image with 6×6 patches: the patch matrix alone is
+        # ~450 MB — far past the VMEM budget, must route to XLA.
+        big = jnp.zeros((1, 1024, 1024, 3), jnp.float32)
+        filters = jnp.zeros((8, 6 * 6 * 3), jnp.float32)
+        assert not pi.conv_featurize_ok(big, filters)
+
+
+class TestConvolverDtypeContract:
+    """ISSUE 18 satellite 2: the f64→f32 narrowing in Convolver is a
+    DECLARED compute-dtype contract, not silent drift — the class
+    carries ``declares_dtype_change`` and a strict verifier dry-run of
+    the image featurizer pipeline over float64 loader output is clean."""
+
+    def test_convolver_declares_dtype_change(self):
+        assert Convolver.declares_dtype_change is True
+
+    def test_eager_apply_narrows_to_f32(self):
+        conv = Convolver(
+            rng.normal(size=(4, 2 * 2 * 3)).astype(np.float32),
+            img_x=8, img_y=8, img_channels=3,
+        )
+        out = conv.apply(jnp.asarray(
+            rng.uniform(0, 255, size=(2, 8, 8, 3)), jnp.float64))
+        assert out.dtype == jnp.float32
+
+    def test_image_pipeline_strict_verify_clean_on_f64_source(self):
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.images.conv import Pooler, SymmetricRectifier
+        from keystone_tpu.ops.images.core import ImageVectorizer
+        from keystone_tpu.workflow import PipelineDataset, verify_graph
+        from keystone_tpu.workflow.verify import DTYPE_DRIFT
+
+        conv = Convolver(
+            rng.normal(size=(8, 5 * 5 * 3)).astype(np.float32),
+            img_x=32, img_y=32, img_channels=3,
+        )
+        pipe = (
+            conv.to_pipeline()
+            .and_then(SymmetricRectifier(alpha=0.25))
+            .and_then(Pooler(14, 14, pool_function="sum"))
+            .and_then(ImageVectorizer())
+        )
+        # synthetic_cifar-shaped loader output: float64 in [0, 255].
+        images = Dataset(np.asarray(
+            rng.uniform(0, 255, size=(6, 32, 32, 3)), np.float64))
+        applied = pipe.apply(PipelineDataset.of(images))
+        report = verify_graph(applied.executor.graph, strict=True)
+        assert not report.by_code(DTYPE_DRIFT), (
+            "declared f64→f32 narrowing reported as drift: "
+            + "; ".join(str(f) for f in report.by_code(DTYPE_DRIFT))
+        )
+        assert not report.findings, (
+            "image pipeline not strict-clean: "
+            + "; ".join(str(f) for f in report.findings)
+        )

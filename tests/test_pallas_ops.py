@@ -486,3 +486,108 @@ class TestGaussianResidBlock:
         np.testing.assert_allclose(
             np.asarray(ws_pl), np.asarray(ws_xla), atol=2e-4
         )
+
+
+class TestCountSketchScatter:
+    """Fused sparse×dense-random product (the remaining PAPERS.md item):
+    interpreter equality against the numpy scatter reference, pinned at
+    1e-5 relative (the kernel accumulates in tiled MXU order, the
+    reference in scatter order), including chunk-fold composition."""
+
+    @staticmethod
+    def _reference(idx, val, bucket, sign, m, d1):
+        SA = np.zeros((m, d1), dtype=np.float32)
+        c, s = idx.shape
+        for i in range(c):
+            for t in range(s):
+                j = idx[i, t]
+                if 0 <= j < d1:
+                    SA[bucket[i], j] += sign[i] * val[i, t]
+        return SA
+
+    @staticmethod
+    def _chunk(c, s, m, d1, seed, duplicate_cols=False):
+        r = np.random.default_rng(seed)
+        idx = r.integers(0, d1, size=(c, s)).astype(np.int32)
+        if duplicate_cols:
+            idx[:, 1::2] = idx[:, ::2][:, : idx[:, 1::2].shape[1]]
+        val = r.normal(size=(c, s)).astype(np.float32)
+        # mask a ragged tail of slots per row, the raw_chunk_tiles pad shape
+        drop = r.random(size=(c, s)) < 0.3
+        idx = np.where(drop, -1, idx)
+        val = np.where(drop, 0.0, val).astype(np.float32)
+        bucket = r.integers(0, m, size=(c,)).astype(np.int32)
+        sign = r.choice([-1.0, 1.0], size=(c,)).astype(np.float32)
+        return idx, val, bucket, sign
+
+    def test_matches_numpy_scatter(self):
+        m, d1 = 13, 37
+        idx, val, bucket, sign = self._chunk(50, 4, m, d1, seed=0)
+        got = po.countsketch_scatter(idx, val, bucket, sign, m, d1, interpret=True)
+        want = self._reference(idx, val, bucket, sign, m, d1)
+        assert got.shape == (m, d1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_duplicate_columns_within_a_row_accumulate(self):
+        # Two nnz slots of one row can hit the SAME column; the densify
+        # loop must sum them, not overwrite.
+        m, d1 = 7, 19
+        idx, val, bucket, sign = self._chunk(
+            24, 6, m, d1, seed=1, duplicate_cols=True
+        )
+        got = po.countsketch_scatter(idx, val, bucket, sign, m, d1, interpret=True)
+        want = self._reference(idx, val, bucket, sign, m, d1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-5)
+
+    def test_multi_tile_shapes(self):
+        # m and d1 past one tile, c past one contraction tile: exercises
+        # the grid index maps and the pad rows (sign 0 ⇒ no contribution).
+        m, d1 = 600, 300
+        idx, val, bucket, sign = self._chunk(300, 3, m, d1, seed=2)
+        got = po.countsketch_scatter(idx, val, bucket, sign, m, d1, interpret=True)
+        want = self._reference(idx, val, bucket, sign, m, d1)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5, atol=1e-4)
+
+    def test_fold_composition_across_chunks(self):
+        # Σ_chunks kernel(chunk) must equal the one-shot scatter over the
+        # concatenated stream — the shape of the IHS fold_pass carry.
+        m, d1 = 11, 23
+        chunks = [self._chunk(16, 3, m, d1, seed=10 + i) for i in range(4)]
+        acc = np.zeros((m, d1), dtype=np.float32)
+        want = np.zeros((m, d1), dtype=np.float32)
+        for idx, val, bucket, sign in chunks:
+            acc += np.asarray(
+                po.countsketch_scatter(idx, val, bucket, sign, m, d1, interpret=True)
+            )
+            want += self._reference(idx, val, bucket, sign, m, d1)
+        np.testing.assert_allclose(acc, want, rtol=1e-5, atol=1e-5)
+
+    def test_ihs_sparse_fit_matches_scatter_path(self, monkeypatch):
+        # End-to-end: the IHS sparse fold with the kernel engaged
+        # (KEYSTONE_PALLAS ⇒ interpret-mode dispatch on CPU) returns the
+        # same model as the flattened scatter-add path.
+        from keystone_tpu.data import Dataset
+        from keystone_tpu.ops.learning.sketch import IterativeHessianSketch
+
+        r = np.random.default_rng(3)
+        n, d, nnz, k = 48, 12, 4, 2
+        idx = np.sort(r.integers(0, d, size=(n, nnz)).astype(np.int32), axis=1)
+        val = r.normal(size=(n, nnz)).astype(np.float32)
+        B = r.normal(size=(n, k)).astype(np.float32)
+        data = Dataset({"indices": idx, "values": val}, n=n)
+        labels = Dataset(B)
+
+        def fit():
+            est = IterativeHessianSketch(
+                lam=1e-2, sketch_factor=4, outer_iters=2, seed=0,
+                chunk_rows=16, num_features=d,
+            )
+            return np.asarray(est.fit(data, labels).x)
+
+        with force_interpret():
+            monkeypatch.setenv("KEYSTONE_NO_PALLAS", "1")
+            w_scatter = fit()
+            monkeypatch.delenv("KEYSTONE_NO_PALLAS")
+            monkeypatch.setenv("KEYSTONE_PALLAS", "1")
+            w_kernel = fit()
+        np.testing.assert_allclose(w_kernel, w_scatter, rtol=1e-4, atol=1e-5)

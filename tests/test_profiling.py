@@ -262,3 +262,82 @@ class TestPrefetchOverlapFraction:
         assert profiling.prefetch_overlap_fraction(off) == 0.0
         frac = profiling.prefetch_overlap_fraction(on)
         assert frac is None or 0.0 <= frac <= 1.0
+
+
+class TestOverlapReportDecodeBound:
+    """ISSUE 18 satellite: overlap_report under a DECODE-bound source.
+    A slow-decode fixture rides the read lane (decode busy attributed
+    via faults.observe_busy, like EncodedImageSource.load); at
+    prefetch_depth>=1 the decode hides behind consumer compute, and the
+    serial depth-0 oracle leg reads 0 by construction."""
+
+    @staticmethod
+    def _slow_decode_source(decode_s=0.01, segments=6):
+        """ShardSource whose load() is dominated by a decode sleep."""
+        from keystone_tpu.data.prefetch import ShardSource
+
+        class _Src(ShardSource):
+            num_segments = segments
+            n_true = segments
+            load_retries_transients = False
+
+            def load(self, s):
+                from keystone_tpu.utils import faults
+
+                t0 = time.perf_counter()
+                time.sleep(decode_s)
+                faults.observe_busy("decode", time.perf_counter() - t0)
+                return np.zeros((1, 4), np.float32)
+
+        return _Src()
+
+    def _run(self, depth, decode_s=0.01, compute_s=0.025):
+        from keystone_tpu.data.prefetch import PrefetchStats, iter_segments
+
+        src = self._slow_decode_source(decode_s=decode_s)
+        stats = PrefetchStats()
+        for _s, _seg in iter_segments(src, prefetch_depth=depth,
+                                      stats=stats):
+            t0 = time.perf_counter()
+            time.sleep(compute_s)  # the fold the decode should hide behind
+            stats.add_busy("compute", time.perf_counter() - t0)
+        return stats
+
+    def test_decode_busy_rides_the_read_lane(self):
+        stats = self._run(depth=2)
+        report = profiling.overlap_report(stats)
+        assert report["decode"]["busy_s"] >= 6 * 0.01
+        # Decode wall is a subset of the read lane's wall.
+        assert report["decode"]["busy_s"] <= report["read"]["busy_s"] + 1e-6
+        assert report["compute"]["busy_s"] >= 6 * 0.025
+
+    def test_hidden_fraction_math_per_site(self):
+        stats = self._run(depth=2)
+        for site, entry in profiling.overlap_report(stats).items():
+            want_hidden = max(entry["busy_s"] - entry["wait_s"], 0.0)
+            assert entry["hidden_s"] == want_hidden
+            if entry["busy_s"] > 0.0:
+                assert entry["overlap"] == min(
+                    want_hidden / entry["busy_s"], 1.0
+                )
+            else:
+                assert entry["overlap"] is None
+
+    def test_prefetched_leg_hides_decode_behind_compute(self):
+        stats = self._run(depth=2)
+        report = profiling.overlap_report(stats)
+        # Compute outweighs decode 2.5x: past the first-segment startup
+        # wait, every load runs behind the consumer's fold.
+        assert report["read"]["overlap"] > 0.3
+        frac = profiling.prefetch_overlap_fraction(stats)
+        assert frac is not None and frac > 0.3
+
+    def test_serial_oracle_leg_reads_zero(self):
+        stats = self._run(depth=0)
+        assert stats.prefetched is False
+        # The one-run fraction: 0.0, not None — loads happened, inline.
+        assert profiling.prefetch_overlap_fraction(stats) == 0.0
+        report = profiling.overlap_report(stats)
+        # Serial read lane records busy == wait: overlap 0 by construction.
+        assert report["read"]["wait_s"] == report["read"]["busy_s"]
+        assert report["read"]["overlap"] == 0.0

@@ -1,0 +1,98 @@
+"""Streamed ZCA whitening: batch-estimator parity and the kill→resume
+bit-identity contract on the existing CheckpointSpec machinery (ISSUE 18
+tentpole). The kill/resume case is chaos-marked but fast (tiny d, six
+segments) so the contract is exercised in tier-1."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from keystone_tpu.data import Dataset
+from keystone_tpu.data.durable import CheckpointSpec
+from keystone_tpu.data.shards import DiskDenseShards
+from keystone_tpu.ops.learning.pca import (
+    StreamedZCAWhitenerEstimator,
+    ZCAWhitenerEstimator,
+)
+from keystone_tpu.utils.faults import FaultPlan, FaultRule
+
+
+def _problem(tmp_path, n=700, d=12, tile=64, tps=2, seed=7):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, d)).astype(np.float32) * 2.0 + 0.5
+    Y = np.zeros((n, 1), dtype=np.float32)
+    shards = DiskDenseShards.write(
+        str(tmp_path / "dense"), X, Y, tile_rows=tile, tiles_per_segment=tps
+    )
+    return X, shards
+
+
+class TestStreamedParity:
+    def test_matches_batch_estimator(self, tmp_path):
+        X, shards = _problem(tmp_path)
+        batch = ZCAWhitenerEstimator(eps=0.1).fit_single(X)
+        streamed = StreamedZCAWhitenerEstimator(eps=0.1).fit_source(
+            shards.as_source()
+        )
+        np.testing.assert_allclose(
+            np.asarray(streamed.means), np.asarray(batch.means),
+            rtol=1e-5, atol=1e-5,
+        )
+        # Covariance-eigh route vs centered SVD: same algebra, different
+        # factorization — whitener parity to f32 eigensolve tolerance.
+        np.testing.assert_allclose(
+            np.asarray(streamed.whitener), np.asarray(batch.whitener),
+            rtol=5e-3, atol=5e-3,
+        )
+        xw_s = np.asarray(streamed.apply(X[:50]))
+        xw_b = np.asarray(batch.apply(X[:50]))
+        np.testing.assert_allclose(xw_s, xw_b, rtol=5e-3, atol=5e-3)
+
+    def test_resident_dataset_falls_back_to_batch_path(self):
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(80, 6)).astype(np.float32)
+        got = StreamedZCAWhitenerEstimator(eps=0.2).fit(Dataset(X))
+        want = ZCAWhitenerEstimator(eps=0.2).fit_single(X)
+        np.testing.assert_array_equal(
+            np.asarray(got.whitener), np.asarray(want.whitener)
+        )
+
+    def test_too_few_rows_raises(self):
+        est = StreamedZCAWhitenerEstimator()
+        with pytest.raises(ValueError, match="n >= 2"):
+            est._finalize(jnp.zeros((3,)), jnp.zeros((3, 3)), 1)
+
+
+@pytest.mark.chaos
+class TestZCAKillResume:
+    def test_killed_and_resumed_bit_identical(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("KEYSTONE_RETRY_BASE_S", "0.001")
+        X, shards = _problem(tmp_path)
+        assert shards.num_segments >= 5
+
+        def fit(**kw):
+            est = StreamedZCAWhitenerEstimator(eps=0.1, **kw)
+            return est.fit_source(shards.as_source())
+
+        ref = fit()  # uninterrupted reference
+
+        ck = CheckpointSpec(str(tmp_path / "ck"), every_segments=2)
+        # Exhaust the 3-attempt retry budget on a mid-run segment load.
+        kill = FaultPlan([FaultRule("prefetch.read", "error",
+                                    calls=[4, 5, 6])])
+        with kill:
+            with pytest.raises(OSError):
+                fit(checkpoint=ck)
+        assert ck.has_snapshot(), (
+            "the killed ZCA fit left no snapshot to resume from"
+        )
+
+        resumed = fit(checkpoint=ck)  # resume, no faults
+        np.testing.assert_array_equal(
+            np.asarray(ref.means), np.asarray(resumed.means)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(ref.whitener), np.asarray(resumed.whitener)
+        )
+        # Completion cleared the snapshot: the next fit starts fresh.
+        assert not ck.has_snapshot()
