@@ -16,9 +16,12 @@ Storage-tier routing (`cost.choose_image_tier`, a recorded
 ``CostDecision``) is what lets ``Pipeline.fit`` take a past-host-RAM
 image set with no flag: ``load_images`` prices the tiers and either
 keeps decoded rows resident (f32, or the uint8 compressed-resident form
-— exact for 8-bit sources) or spills storage-to-storage through
-:class:`~keystone_tpu.data.shards.DiskDenseShardWriter`, host residency
-bounded by one segment.
+— exact for 8-bit sources; both fill preallocated buffers one segment
+at a time, so peak residency is the priced form, never a transient f32
+copy) or spills storage-to-storage through
+:class:`~keystone_tpu.data.shards.DiskDenseShardWriter` (uint8 rows on
+disk by default — the same compressed form), host residency bounded by
+one segment.
 
 Row layout: each decoded (and augmented) image flattens row-major over
 ``(x, y, c)`` to one f32 row — the same order ``Convolver.pack_filters``
@@ -267,6 +270,24 @@ def images_to_disk_shards(
     return writer.close().as_labeled_data()
 
 
+def _materialize_resident(source: EncodedImageSource, x_dtype):
+    """Stream-decode a source into preallocated ``(n, d)`` ``x_dtype``
+    rows and ``(n, k)`` f32 labels: one segment decodes at a time and
+    casts into place, so peak host residency is the PRICED resident form
+    plus a single staged f32 segment — never the full f32 dataset. The
+    ``resident_u8`` tier engages exactly when that f32 form busts the
+    host budget, so this path must not build it."""
+    X = np.empty((source.n_true, source.d), dtype=x_dtype)
+    Y = np.empty((source.n_true, source.k), dtype=np.float32)
+    row = 0
+    for s in range(source.num_segments):
+        X_seg, Y_seg, valid = source.load(s)
+        X[row:row + valid] = X_seg[:valid]  # exact u8 cast: 8-bit sources
+        Y[row:row + valid] = Y_seg[:valid]
+        row += valid
+    return X, Y
+
+
 def load_images(
     provider,
     *,
@@ -275,6 +296,7 @@ def load_images(
     augment_seed: int = 0,
     flip: bool = True,
     spill_dir: Optional[str] = None,
+    spill_dtype=None,
     tile_rows: int = 256,
     tiles_per_segment: int = 4,
     prefetch_depth: int = 2,
@@ -285,7 +307,12 @@ def load_images(
     model selects (a recorded ``image_tier`` CostDecision) — resident
     f32 rows, resident uint8 rows, or disk shards — with NO flag. A
     past-host-RAM corpus requires ``spill_dir`` (raises otherwise: the
-    only honest alternative would be an OOM)."""
+    only honest alternative would be an OOM). ``spill_dtype`` is the
+    on-disk row dtype for the spill tier; the ``None`` default stores
+    uint8 — the compressed-resident form, exact for 8-bit sources with
+    value-preserving augmentation, and the 4×-smaller write + per-epoch
+    re-read traffic the cost model's disk pricing assumes. Pass
+    ``np.float32`` for deeper-than-8-bit providers."""
     from keystone_tpu.data.dataset import LabeledData
     from keystone_tpu.ops.learning import cost
 
@@ -312,8 +339,9 @@ def load_images(
         return images_to_disk_shards(
             source, spill_dir,
             tile_rows=tile_rows, tiles_per_segment=tiles_per_segment,
+            x_dtype=(np.uint8 if spill_dtype is None else spill_dtype),
         ), tier, ref
-    X, Y = source.materialize()
-    if tier == "resident_u8":
-        X = X.astype(np.uint8)  # exact: 8-bit sources, value-preserving aug
+    X, Y = _materialize_resident(
+        source, np.uint8 if tier == "resident_u8" else np.float32
+    )
     return LabeledData(X, Y), tier, ref

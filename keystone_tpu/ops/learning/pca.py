@@ -306,7 +306,11 @@ class StreamedZCAWhitenerEstimator(Estimator):
 
         Segment payloads may be ``(X, Y, valid_rows)`` triples (the
         DenseShardSource / image-tier contract; X is flattened to rows)
-        or bare row blocks (all rows counted as true)."""
+        or bare row blocks — those count all rows as true, clamped
+        against the source's declared ``n_true``: fixed-shape shard
+        views (``DenseShardView``) zero-pad the tail segment, and pad
+        rows are zero in (Σx, XᵀX) but must not inflate ``n`` or the
+        mean/covariance shrink toward zero."""
         from keystone_tpu.data.durable import (
             resolve_checkpoint,
             source_fingerprint,
@@ -316,8 +320,22 @@ class StreamedZCAWhitenerEstimator(Estimator):
         checkpoint = resolve_checkpoint(self.checkpoint)
         num_segments = int(source.num_segments)
 
-        first = source.load(0)
-        d = int(self._rows(first)[0].shape[-1])
+        # Row width from the source's shape metadata when it has any
+        # (EncodedImageSource.d, DenseShardSource.d_in, DenseShardView
+        # .width). load(0) is only the fallback for bare sources: on an
+        # image source it would decode a whole extra segment — and fire
+        # the decode/augment fault sites once more — even when a
+        # checkpoint restore resumes past segment 0.
+        d = next(
+            (
+                int(v)
+                for attr in ("d", "d_in", "width")
+                if (v := getattr(source, attr, None)) is not None
+            ),
+            None,
+        )
+        if d is None:
+            d = int(self._rows(source.load(0))[0].shape[-1])
 
         sums = jnp.zeros((d,), jnp.float32)
         gram = jnp.zeros((d, d), jnp.float32)
@@ -339,6 +357,7 @@ class StreamedZCAWhitenerEstimator(Estimator):
                 count = int(np.asarray(arrays[2])[0])
 
         fold = jax.jit(_zca_cov_fold)
+        n_true = getattr(source, "n_true", None)
         for s, payload in iter_segments(
             source,
             prefetch_depth=self.prefetch_depth,
@@ -347,6 +366,8 @@ class StreamedZCAWhitenerEstimator(Estimator):
         ):
             X, valid = self._rows(payload)
             sums, gram = fold(sums, gram, jnp.asarray(X, jnp.float32))
+            if n_true is not None:
+                valid = min(valid, int(n_true) - count)
             count += valid
             if checkpoint is not None:
                 checkpoint.maybe_save(

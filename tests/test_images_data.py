@@ -198,6 +198,37 @@ class TestSpillAndRouting:
         assert labeled.data.n == 40
         assert np.asarray(labeled.data.array).dtype == np.float32
 
+    def test_load_images_resident_u8_streams_the_cast(self, monkeypatch):
+        # The compressed-resident tier engages exactly when the f32 form
+        # does NOT fit the budget — the loader must fill preallocated
+        # uint8 rows one segment at a time, never build the f32 dataset.
+        def boom(self):
+            raise AssertionError(
+                "resident_u8 must not materialize the f32 dataset"
+            )
+
+        monkeypatch.setattr(EncodedImageSource, "materialize", boom)
+        p = _provider(n=40)
+        # u8 rows (40 * 208 B) fit in 12 kB; f32 rows (4x) do not.
+        labeled, tier, _ = load_images(
+            p, images_per_segment=16, host_budget_bytes=12_000.0,
+        )
+        assert tier == "resident_u8"
+        X = np.asarray(labeled.data.array)
+        assert X.dtype == np.uint8
+        ref = EncodedImageSource(_provider(n=40), images_per_segment=16)
+        xs, ys = [], []
+        for s in range(ref.num_segments):
+            Xs, Ys, valid = ref.load(s)
+            xs.append(Xs[:valid])
+            ys.append(Ys[:valid])
+        np.testing.assert_array_equal(
+            X, np.concatenate(xs).astype(np.uint8)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(labeled.labels.array), np.concatenate(ys)
+        )
+
     def test_load_images_routes_to_disk_with_no_flag(self, tmp_path):
         # Only the budget changes — the router spills on its own.
         # 3 staged 4-image segments (~9.4 kB) fit in 10 kB; even the
@@ -209,6 +240,32 @@ class TestSpillAndRouting:
         )
         assert tier == "disk_shards"
         assert labeled.data.is_shard_backed
+
+    def test_load_images_spill_defaults_to_uint8_and_is_exact(
+        self, tmp_path
+    ):
+        # The no-flag spill stores the compressed on-disk form by
+        # default: 1/4 the write + per-epoch re-read traffic, exact for
+        # 8-bit sources with value-preserving augmentation.
+        labeled, tier, _ = load_images(
+            _provider(n=64), images_per_segment=4,
+            host_budget_bytes=10_000.0,
+            spill_dir=str(tmp_path / "spill"), tile_rows=8,
+        )
+        assert tier == "disk_shards"
+        X = np.asarray(labeled.data.array)
+        assert X.dtype == np.uint8
+        src = EncodedImageSource(_provider(n=64), images_per_segment=4)
+        X_ref, _ = src.materialize()
+        np.testing.assert_array_equal(X[:64].astype(np.float32), X_ref)
+
+    def test_load_images_spill_dtype_override(self, tmp_path):
+        labeled, _, _ = load_images(
+            _provider(n=64), images_per_segment=4,
+            host_budget_bytes=10_000.0, spill_dtype=np.float32,
+            spill_dir=str(tmp_path / "spill32"), tile_rows=8,
+        )
+        assert np.asarray(labeled.data.array).dtype == np.float32
 
     def test_load_images_disk_tier_without_spill_dir_raises(self):
         with pytest.raises(ValueError, match="spill_dir"):

@@ -9,6 +9,7 @@ import pytest
 
 from keystone_tpu.data import Dataset
 from keystone_tpu.data.durable import CheckpointSpec
+from keystone_tpu.data.prefetch import ShardSource
 from keystone_tpu.data.shards import DiskDenseShards
 from keystone_tpu.ops.learning.pca import (
     StreamedZCAWhitenerEstimator,
@@ -61,6 +62,79 @@ class TestStreamedParity:
         est = StreamedZCAWhitenerEstimator()
         with pytest.raises(ValueError, match="n >= 2"):
             est._finalize(jnp.zeros((3,)), jnp.zeros((3, 3)), 1)
+
+    def test_shard_backed_dataset_view_ignores_pad_rows(self, tmp_path):
+        # A shard-backed Dataset's row view (DenseShardView) zero-pads
+        # its tail segment to the fixed segment shape. Pad rows are zero
+        # in the (Σx, XᵀX) fold, but counting them as true rows shrinks
+        # the mean/covariance — fit() must produce the same whitener the
+        # batch estimator gets from the true rows.
+        X, shards = _problem(tmp_path, n=700, d=12, tile=64, tps=2)
+        labeled = shards.as_labeled_data()
+        view = labeled.data.shard_source
+        padded = sum(
+            view.load(s).shape[0] for s in range(view.num_segments)
+        )
+        assert padded > view.n_true  # the fixture really has pad rows
+        got = StreamedZCAWhitenerEstimator(eps=0.1).fit(labeled.data)
+        want = ZCAWhitenerEstimator(eps=0.1).fit_single(X)
+        np.testing.assert_allclose(
+            np.asarray(got.means), np.asarray(want.means),
+            rtol=1e-5, atol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got.whitener), np.asarray(want.whitener),
+            rtol=5e-3, atol=5e-3,
+        )
+
+    def test_fit_source_loads_each_segment_exactly_once(self, tmp_path):
+        # The row width comes from the source's shape metadata, not an
+        # extra load(0) — on an image source that probe would decode a
+        # whole segment (and fire its fault sites) twice.
+        X, shards = _problem(tmp_path, n=200, d=6, tile=32, tps=2)
+        inner = shards.as_source()
+        calls = []
+
+        class Counting(ShardSource):
+            num_segments = inner.num_segments
+            n_true = inner.n_true
+            d_in = inner.d_in
+
+            def load(self, s):
+                calls.append(s)
+                return inner.load(s)
+
+        got = StreamedZCAWhitenerEstimator(
+            eps=0.1, prefetch_depth=0
+        ).fit_source(Counting())
+        assert sorted(calls) == list(range(inner.num_segments))
+        want = ZCAWhitenerEstimator(eps=0.1).fit_single(X)
+        np.testing.assert_allclose(
+            np.asarray(got.whitener), np.asarray(want.whitener),
+            rtol=5e-3, atol=5e-3,
+        )
+
+    def test_fit_source_falls_back_to_load0_without_metadata(
+        self, tmp_path
+    ):
+        X, shards = _problem(tmp_path, n=150, d=5, tile=32, tps=2)
+        inner = shards.as_source()
+
+        class Bare(ShardSource):
+            num_segments = inner.num_segments
+            n_true = inner.n_true
+
+            def load(self, s):
+                return inner.load(s)
+
+        got = StreamedZCAWhitenerEstimator(
+            eps=0.1, prefetch_depth=0
+        ).fit_source(Bare())
+        want = ZCAWhitenerEstimator(eps=0.1).fit_single(X)
+        np.testing.assert_allclose(
+            np.asarray(got.whitener), np.asarray(want.whitener),
+            rtol=5e-3, atol=5e-3,
+        )
 
 
 @pytest.mark.chaos
