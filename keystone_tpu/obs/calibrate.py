@@ -105,8 +105,12 @@ DEFAULT_DRIFT_THRESHOLD = 0.7
 # point so the refit path is IDENTICAL for sweeps and production runs;
 # ``mesh_layout`` is the mesh-shape selector (cost.choose_mesh_layout)
 # whose runners stamp the measured multichip fit wall onto the record.
+# ``placement.zoo_page_in`` is the zoo's priced page fault
+# (placement/engine.py price_page_in), stamped with the measured
+# restore wall so refit can recover the paging overhead.
 CALIBRATED_DECISIONS = (
     "least_squares_solver", "calibration_sweep", "mesh_layout",
+    "placement.zoo_page_in",
 )
 
 # Work spans a decision's measured seconds may be joined from, by
@@ -189,7 +193,8 @@ def join_decisions(
     records = list(records)
     decisions = [
         r for r in records
-        if r.get("type") == "event" and r.get("name") == "cost.decision"
+        if r.get("type") == "event"
+        and r.get("name") in ("cost.decision", "placement.decision")
         and (r.get("args") or {}).get("decision") in kinds
     ]
     spans_by_run: Dict[str, List[Dict[str, Any]]] = {}
@@ -245,8 +250,11 @@ def join_decisions(
             ctx = {
                 k: v for k, v in args.items()
                 if k not in ("decision", "winner", "reason", "candidates",
-                             "outcome", "weights")
+                             "outcome", "weights", "weights_family")
             }
+            weights = dict(args.get("weights") or {})
+            if "family" not in weights and args.get("weights_family"):
+                weights["family"] = args["weights_family"]
             out.append(DecisionOutcome(
                 run_id=run_id,
                 decision=args.get("decision", "?"),
@@ -260,7 +268,7 @@ def join_decisions(
                 joined_via=via,
                 timing=(timing if measured is not None else None),
                 context=ctx,
-                weights=dict(args.get("weights") or {}),
+                weights=weights,
                 candidates=cands,
                 span_counts=counts,
             ))
@@ -280,7 +288,8 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
     selects right now), ``"tpu"``, ``"ec2"``, or
     ``"calibrated:<path>"`` (a refit artifact). Returns
     ``{"name", "cpu", "mem", "network", "sparse_gather_overhead",
-    "srht_sketch_overhead", "countsketch_overhead"}``.
+    "srht_sketch_overhead", "countsketch_overhead",
+    "zoo_page_overhead"}``.
     """
     from keystone_tpu.ops.learning import cost as cost_mod
 
@@ -294,6 +303,7 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "sparse_gather_overhead": cost_mod.sparse_gather_overhead(),
             "srht_sketch_overhead": cost_mod.srht_sketch_overhead(),
             "countsketch_overhead": cost_mod.countsketch_overhead(),
+            "zoo_page_overhead": cost_mod.zoo_page_overhead(),
         }
     if low == "tpu":
         return {
@@ -304,6 +314,7 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "sparse_gather_overhead": cost_mod.TPU_SPARSE_GATHER_OVERHEAD,
             "srht_sketch_overhead": cost_mod.TPU_SRHT_SKETCH_OVERHEAD,
             "countsketch_overhead": cost_mod.TPU_COUNTSKETCH_OVERHEAD,
+            "zoo_page_overhead": cost_mod.TPU_ZOO_PAGE_OVERHEAD,
         }
     if low == "ec2":
         return {
@@ -314,6 +325,7 @@ def family_weights(spec: Optional[str] = None) -> Dict[str, Any]:
             "sparse_gather_overhead": cost_mod.EC2_SPARSE_GATHER_OVERHEAD,
             "srht_sketch_overhead": cost_mod.EC2_SRHT_SKETCH_OVERHEAD,
             "countsketch_overhead": cost_mod.EC2_COUNTSKETCH_OVERHEAD,
+            "zoo_page_overhead": cost_mod.EC2_ZOO_PAGE_OVERHEAD,
         }
     if low.startswith(cost_mod.CALIBRATED_PREFIX):
         art = load_calibration_artifact(
@@ -685,8 +697,15 @@ def fit_weights(
     gather_rows: List[Tuple[Any, DecisionOutcome]] = []
     srht_rows: List[Tuple[Any, DecisionOutcome]] = []
     cs_rows: List[Tuple[Any, DecisionOutcome]] = []
+    zoo_rows: List[DecisionOutcome] = []
     for o in outcomes:
         if o.measured_s is None or o.measured_s <= 0:
+            continue
+        if o.decision == "placement.zoo_page_in":
+            # Zoo page faults carry a tenant id as the winner label, not
+            # an estimator name — intercepted here, BEFORE the registry
+            # lookup treats them as unknown engines.
+            zoo_rows.append(o)
             continue
         est = estimator_for_label(o.winner)
         if est is None:
@@ -757,6 +776,26 @@ def fit_weights(
             cs_ov = fit
             fitted.append("countsketch_overhead")
 
+    zoo_ov = base.get("zoo_page_overhead")
+    if zoo_rows:
+        # price_page_in is mem_w · overhead · resident_bytes, so each
+        # measured page fault pins one overhead sample GIVEN the fitted
+        # mem weight; the family takes the median.
+        samples = []
+        for o in zoo_rows:
+            rb = next(
+                (c.get("resident_bytes") for c in o.candidates
+                 if c.get("label") == o.winner), None,
+            )
+            if rb is not None and float(rb) > 0 and mem_w > 0:
+                sample = o.measured_s / (mem_w * float(rb))
+                if sample > 0:
+                    samples.append(sample)
+        fit = _median(samples)
+        if fit is not None:
+            zoo_ov = fit
+            fitted.append("zoo_page_overhead")
+
     return {
         "cpu": cpu_w,
         "mem": mem_w,
@@ -770,10 +809,14 @@ def fit_weights(
         "countsketch_overhead": (
             float(cs_ov) if cs_ov is not None else None
         ),
+        "zoo_page_overhead": (
+            float(zoo_ov) if zoo_ov is not None else None
+        ),
         "fitted": fitted,
         "num_rows": {
             "sequential": len(dense_rows), "gather": len(gather_rows),
             "srht": len(srht_rows), "countsketch": len(cs_rows),
+            "zoo_page": len(zoo_rows),
         },
     }
 
@@ -855,6 +898,7 @@ def refit(
         "sparse_gather_overhead": weights["sparse_gather_overhead"],
         "srht_sketch_overhead": weights["srht_sketch_overhead"],
         "countsketch_overhead": weights["countsketch_overhead"],
+        "zoo_page_overhead": weights["zoo_page_overhead"],
     }
     before = calibration_report(outcomes, weights=base, kinds=kinds)
     after = calibration_report(outcomes, weights=eval_weights, kinds=kinds)
@@ -926,6 +970,11 @@ def write_calibration_artifact(
                 if weights.get("countsketch_overhead") is not None
                 else None
             ),
+            "zoo_page_overhead": (
+                float(weights["zoo_page_overhead"])
+                if weights.get("zoo_page_overhead") is not None
+                else None
+            ),
         },
         "provenance": {
             **provenance,
@@ -980,7 +1029,7 @@ def load_calibration_artifact(path: str) -> Dict[str, Any]:
             )
     for opt_key in (
         "sparse_gather_overhead", "srht_sketch_overhead",
-        "countsketch_overhead",
+        "countsketch_overhead", "zoo_page_overhead",
     ):
         so = weights.get(opt_key)
         if so is not None and (

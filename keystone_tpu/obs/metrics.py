@@ -51,6 +51,8 @@ __all__ = [
     "METRIC_LIFECYCLE_REJECTED",
     "METRIC_LIFECYCLE_ROLLBACKS",
     "METRIC_LIFECYCLE_STALENESS_S",
+    "METRIC_PLACEMENT_DECISIONS",
+    "METRIC_PLACEMENT_INFEASIBLE",
     "METRIC_PREFETCH_BACKOFF_S",
     "METRIC_PREFETCH_LOAD_S",
     "METRIC_PREFETCH_RETRIES",
@@ -180,6 +182,12 @@ METRIC_LIFECYCLE_CANARY_PROMOTIONS = "lifecycle.canary_promotions"
 METRIC_LIFECYCLE_STALENESS_S = "lifecycle.staleness_s"
 METRIC_TRAINER_SEGMENTS_FIT = "trainer.segments_fit"
 METRIC_TRAINER_RESUMES = "trainer.resumes"
+
+# Global placement engine (placement/engine.py) — the unified
+# placement.decision stream's own accounting: decisions audited, and
+# candidates priced infeasible (the capacity cuts the planner replays).
+METRIC_PLACEMENT_DECISIONS = "placement.decisions"
+METRIC_PLACEMENT_INFEASIBLE = "placement.infeasible_candidates"
 
 
 class Counter:

@@ -458,6 +458,10 @@ class CostDecision:
             "winner": self.winner,
             "reason": self.reason,
             "candidates": [dict(c) for c in self.candidates],
+            # Top-level provenance shared by all six decision streams
+            # (placement/engine.py): which weight family priced this.
+            "weights_family": (self.context.get("weights") or {}).get(
+                "family"),
             **{k: v for k, v in self.context.items()},
         }
 

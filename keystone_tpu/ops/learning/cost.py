@@ -25,6 +25,12 @@ import numpy as np
 from keystone_tpu import obs
 from keystone_tpu.data import Dataset
 from keystone_tpu.ops.sparse import Densify, Sparsify, is_sparse_dataset
+from keystone_tpu.placement.engine import (
+    KIND_IMAGE_TIER,
+    KIND_MESH,
+    KIND_SOLVER,
+    PlacementEngine,
+)
 from keystone_tpu.workflow import LabelEstimator, Transformer
 from keystone_tpu.workflow.optimizable import OptimizableLabelEstimator
 
@@ -151,6 +157,18 @@ EC2_COUNTSKETCH_OVERHEAD = 6.0
 # the other per-engine overheads.
 TPU_IMAGE_DECODE_OVERHEAD = 200.0
 EC2_IMAGE_DECODE_OVERHEAD = 4.0
+
+# Zoo page-in multiplier (ISSUE 19): host-side decode + CRC + pytree
+# rebuild of one evicted tenant's spill, as a multiplier on the
+# sequential mem rate per resident BYTE. Seeded from the spill codec's
+# ~1 GB/s single-thread restore (1/(1.9e-11 x 50) ≈ 1 GB/s); the EC2
+# value keeps the cluster convention of single-digit factors. This is
+# the weight family behind ``PlacementEngine.price_page_in`` — the
+# ModelZoo seeds its page-in EMA from it instead of a hardcoded
+# constant, so ``bin/calibrate --refit`` covers zoo paging like every
+# other engine overhead.
+TPU_ZOO_PAGE_OVERHEAD = 50.0
+EC2_ZOO_PAGE_OVERHEAD = 2.0
 
 
 # Weight-family spec for trace-calibrated constants:
@@ -289,6 +307,19 @@ def image_decode_overhead() -> float:
         so = _calibrated_weights(path).get("image_decode_overhead")
         return float(so) if so is not None else TPU_IMAGE_DECODE_OVERHEAD
     return TPU_IMAGE_DECODE_OVERHEAD
+
+
+def zoo_page_overhead() -> float:
+    """Random-access multiplier for the zoo's tenant page-in pass
+    (spill decode + CRC + pytree rebuild), per the active weight family
+    (null-in-artifact falls back to the TPU constant, as above)."""
+    family, path = _parse_weights_env()
+    if family == "ec2":
+        return EC2_ZOO_PAGE_OVERHEAD
+    if family == "calibrated":
+        so = _calibrated_weights(path).get("zoo_page_overhead")
+        return float(so) if so is not None else TPU_ZOO_PAGE_OVERHEAD
+    return TPU_ZOO_PAGE_OVERHEAD
 
 
 def candidate_label(est) -> str:
@@ -436,27 +467,38 @@ def choose_mesh_layout(
             f"no candidate mesh layout fits {devices} device(s): "
             f"{[mesh_layout_label(p, q) for p, q in layouts]}"
         )
-    best = int(np.argmin(costs))
-    winner = layouts[best]
+    candidates = [
+        {
+            "label": mesh_layout_label(p, q),
+            "cost_s": (None if c == float("inf") else float(c)),
+            "feasible": c != float("inf"),
+            "resident_bytes": float(
+                mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
+            ),
+            "chip_resident": (
+                mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
+                <= budget
+            ),
+            "host_ok": True,
+        }
+        for (p, q), c in zip(layouts, costs)
+    ]
+    # The unified placement stream rides alongside the legacy
+    # cost.decision record; the engine's first-minimum argmin IS
+    # np.argmin, so the recorded winner is unchanged by construction.
+    choice = PlacementEngine(weights_family=family).decide(
+        KIND_MESH, candidates,
+        context={
+            "n": int(n), "d": int(d), "k": int(k),
+            "machines": devices,
+            "hbm_budget_bytes": float(budget),
+        },
+    )
+    winner = layouts[choice.index]
     ref = obs.record_cost_decision(obs.CostDecision(
         decision="mesh_layout",
         winner=mesh_layout_label(*winner),
-        candidates=[
-            {
-                "label": mesh_layout_label(p, q),
-                "cost_s": (None if c == float("inf") else float(c)),
-                "feasible": c != float("inf"),
-                "resident_bytes": float(
-                    mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
-                ),
-                "chip_resident": (
-                    mesh_layout_resident_bytes(n, d, k, p, nnz_per_row)
-                    <= budget
-                ),
-                "host_ok": True,
-            }
-            for (p, q), c in zip(layouts, costs)
-        ],
+        candidates=candidates,
         reason="argmin",
         context={
             "n": int(n), "d": int(d), "k": int(k),
@@ -546,21 +588,33 @@ def choose_image_tier(
             f"(even {prefetch_depth + 1} staged segments of "
             f"{seg_bytes:.3g} B); shrink images_per_segment"
         )
-    winner = min(IMAGE_TIERS, key=lambda t: costs[t])
+    candidates = [
+        {
+            "label": t,
+            "cost_s": (None if costs[t] == float("inf") else float(costs[t])),
+            "feasible": costs[t] != float("inf"),
+            "resident_bytes": float(resident_bytes[t]),
+            "chip_resident": False,  # the image tier is host-side
+            "host_ok": resident_bytes[t] <= budget,
+        }
+        for t in IMAGE_TIERS
+    ]
+    # Placement mirror: min-over-tuple-order equals the engine's
+    # first-minimum over the candidate list (both first on ties).
+    choice = PlacementEngine(weights_family=family).decide(
+        KIND_IMAGE_TIER, candidates,
+        context={
+            "n": n, "d": int(d), "k": int(k),
+            "images_per_segment": int(images_per_segment),
+            "prefetch_depth": int(prefetch_depth),
+            "host_budget_bytes": float(budget),
+        },
+    )
+    winner = IMAGE_TIERS[choice.index]
     ref = obs.record_cost_decision(obs.CostDecision(
         decision="image_tier",
         winner=winner,
-        candidates=[
-            {
-                "label": t,
-                "cost_s": (None if costs[t] == float("inf") else float(costs[t])),
-                "feasible": costs[t] != float("inf"),
-                "resident_bytes": float(resident_bytes[t]),
-                "chip_resident": False,  # the image tier is host-side
-                "host_ok": resident_bytes[t] <= budget,
-            }
-            for t in IMAGE_TIERS
-        ],
+        candidates=candidates,
         reason="argmin",
         context={
             "n": n, "d": int(d), "k": int(k),
@@ -898,6 +952,17 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
         except ValueError:  # broken calibrated artifact mid-process
             family = "custom"
 
+        candidates = [
+            {
+                "label": candidate_label(o[0]),
+                "cost_s": (None if c == float("inf") else float(c)),
+                "feasible": c != float("inf"),
+                "resident_bytes": float(resident(o)),
+                "host_ok": host_ok(o),
+            }
+            for o, c in zip(self.options, costs)
+        ]
+
         def emit_decision(winner, reason: str):
             # The structured audit event (obs plane, ISSUE 9): candidate
             # set, predicted costs, feasibility verdicts, winner —
@@ -908,16 +973,7 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
             return obs.record_cost_decision(obs.CostDecision(
                 decision="least_squares_solver",
                 winner=candidate_label(winner),
-                candidates=[
-                    {
-                        "label": candidate_label(o[0]),
-                        "cost_s": (None if c == float("inf") else float(c)),
-                        "feasible": c != float("inf"),
-                        "resident_bytes": float(resident(o)),
-                        "host_ok": host_ok(o),
-                    }
-                    for o, c in zip(self.options, costs)
-                ],
+                candidates=candidates,
                 reason=reason,
                 context={
                     "n": int(n), "d": int(d), "k": int(k),
@@ -933,24 +989,37 @@ class LeastSquaresEstimator(OptimizableLabelEstimator):
                 },
             ))
 
-        if all(c == float("inf") for c in costs):
-            # Nothing fits the budget model: take the least-resident
-            # candidate (in practice the streaming tier) rather than a
+        # The global placement engine resolves the argmin (first minimum
+        # — exactly int(np.argmin)) and, all-infeasible, the
+        # least-resident fallback (exactly min(options, key=resident)):
+        # the recorded winner is unchanged by construction, and the
+        # unified placement.decision stream gets its mirror row.
+        choice = PlacementEngine(weights_family=family).decide(
+            KIND_SOLVER, candidates,
+            context={
+                "n": int(n), "d": int(d), "k": int(k),
+                "sparsity": float(sparsity), "machines": int(machines),
+                "hbm_budget_bytes": float(budget),
+                "host_budget_bytes": float(host_budget),
+                "shard_backed": shard_backed,
+            },
+            fallback="least_resident",
+        )
+        chosen = self.options[choice.index]
+        if choice.reason == "least_resident_fallback":
+            # Nothing fits the budget model: the least-resident
+            # candidate (in practice the streaming tier) beats a
             # guaranteed OOM.
-            best = min(self.options, key=resident)
             logger.warning(
                 "no solver candidate fits the %.2f GB budget at n=%d d=%d; "
                 "selecting least-resident %s",
-                budget / 2**30, n, d, type(best[0]).__name__,
+                budget / 2**30, n, d, type(chosen[0]).__name__,
             )
-            best[1]._pending_cost_outcome = emit_decision(
-                best[0], "least_resident_fallback"
-            )
-            return best[1]
-        chosen = self.options[int(np.argmin(costs))]
         # The pending back-annotation: whoever fits the winner (the
         # executor's fit_datasets, or a fused streamed fit that inherits
         # the ref) stamps the measured wall + span id onto the decision
         # record, closing the predicted-vs-measured loop per decision.
-        chosen[1]._pending_cost_outcome = emit_decision(chosen[0], "argmin")
+        chosen[1]._pending_cost_outcome = emit_decision(
+            chosen[0], choice.reason
+        )
         return chosen[1]

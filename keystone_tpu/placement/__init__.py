@@ -1,0 +1,45 @@
+"""Global placement: one audited scheduler over the calibrated cost
+model (docs/placement.md).
+
+:mod:`keystone_tpu.placement.engine` prices every resource decision —
+solver/storage plan, mesh layout, image-ingest tier, replica count,
+brownout rung, zoo residency/eviction — from the same weight families
+and emits the unified ``placement.decision`` audit stream.
+:mod:`keystone_tpu.placement.planner` replays a recorded trace through
+that stream to answer capacity what-ifs (``bin/plan``).
+"""
+
+from keystone_tpu.placement.engine import (
+    ALL_KINDS,
+    KIND_BROWNOUT,
+    KIND_IMAGE_TIER,
+    KIND_LIFECYCLE,
+    KIND_MESH,
+    KIND_REPLICAS,
+    KIND_SOLVER,
+    KIND_ZOO_EVICT,
+    KIND_ZOO_PAGE_IN,
+    PLACEMENT_EVENT,
+    PlacementChoice,
+    PlacementEngine,
+    active_family,
+)
+from keystone_tpu.placement.planner import CapacityPlanner, decision_rows
+
+__all__ = [
+    "ALL_KINDS",
+    "KIND_BROWNOUT",
+    "KIND_IMAGE_TIER",
+    "KIND_LIFECYCLE",
+    "KIND_MESH",
+    "KIND_REPLICAS",
+    "KIND_SOLVER",
+    "KIND_ZOO_EVICT",
+    "KIND_ZOO_PAGE_IN",
+    "PLACEMENT_EVENT",
+    "PlacementChoice",
+    "PlacementEngine",
+    "active_family",
+    "CapacityPlanner",
+    "decision_rows",
+]

@@ -60,9 +60,14 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from keystone_tpu import obs
+from keystone_tpu.placement.engine import (
+    KIND_BROWNOUT,
+    KIND_REPLICAS,
+    PlacementEngine,
+)
 from keystone_tpu.obs.metrics import (
     METRIC_AUTOSCALE_BROWNOUT_LEVEL,
     METRIC_AUTOSCALE_DECISIONS,
@@ -97,6 +102,13 @@ class AutoscaleDecision:
     step: Optional[str] = None  # the brownout rung, for brownout actions
     inputs: Dict[str, Any] = field(default_factory=dict)
     thresholds: Dict[str, Any] = field(default_factory=dict)
+    # The placement-engine audit fields (ISSUE 19): the candidate
+    # replica counts / brownout rungs the controller had on the table,
+    # the one it took, and the weight family that priced them — the
+    # decision-event schema every stream shares.
+    winner: Optional[str] = None
+    candidates: Sequence[Dict[str, Any]] = field(default_factory=tuple)
+    weights_family: Optional[str] = None
 
     def to_args(self) -> Dict[str, Any]:
         out = {
@@ -106,6 +118,9 @@ class AutoscaleDecision:
             "t_s": self.t_s,
             "inputs": dict(self.inputs),
             "thresholds": dict(self.thresholds),
+            "winner": self.winner if self.winner is not None else self.action,
+            "candidates": [dict(c) for c in self.candidates],
+            "weights_family": self.weights_family,
         }
         if self.step is not None:
             out["step"] = self.step
@@ -153,6 +168,7 @@ class Autoscaler:
         clock: Callable[[], float] = time.monotonic,
         metrics=None,
         decision_log_len: int = 256,
+        service_estimate_s: float = 0.05,
     ):
         if min_replicas < 1:
             raise ValueError("min_replicas must be >= 1")
@@ -178,6 +194,10 @@ class Autoscaler:
             idle_outstanding_per_replica
         )
         self.idle_queue_depth = int(idle_queue_depth)
+        # The queueing proxy's per-request service scale — used only to
+        # PRICE replica-count candidates for the placement audit stream
+        # (the triggers stay the burn-rate state machine's).
+        self.service_estimate_s = float(service_estimate_s)
         self._clock = clock
         self._t0 = clock()
 
@@ -457,23 +477,80 @@ class Autoscaler:
             "idle_queue_depth": self.idle_queue_depth,
         }
 
+    def _placement_decision(self, action, step, inputs):
+        """The placement-engine view of one control action: the
+        neighbouring replica counts (or brownout rungs) as priced
+        candidates, and the policy's target as winner. Replica
+        candidates carry the queue-residence proxy in seconds
+        (``service_estimate_s``-scaled); feasibility is the capacity
+        bounds the controller never crosses."""
+        replicas = int(inputs.get("replicas") or 0)
+        queue = float(inputs.get("queue_depth") or 0.0)
+        outstanding = float(inputs.get("outstanding") or 0.0)
+        if action in ("scale_up", "scale_down"):
+            target = replicas + (1 if action == "scale_up" else -1)
+            candidates = [
+                {
+                    "label": f"replicas={r}",
+                    "cost_s": round(PlacementEngine.price_queue_residence(
+                        queue, outstanding, r, self.service_estimate_s), 6),
+                    "feasible": self.min_replicas <= r <= self.max_replicas,
+                    "replicas": r,
+                }
+                for r in sorted({replicas - 1, replicas, replicas + 1})
+                if r >= 1
+            ]
+            return KIND_REPLICAS, f"replicas={target}", candidates
+        level = int(inputs.get("brownout_level") or 0)
+        target = level + (1 if action == "brownout_enter" else -1)
+        candidates = [
+            {
+                "label": f"brownout={lv}",
+                "cost_s": None,
+                "feasible": 0 <= lv <= len(BROWNOUT_STEPS),
+                "brownout_level": lv,
+                "step": step if lv == target else None,
+            }
+            for lv in sorted({level, target}) if lv >= 0
+        ]
+        return KIND_BROWNOUT, f"brownout={target}", candidates
+
     def _record(self, now, action, reason, ok=True, step=None,
                 inputs=None) -> Dict[str, Any]:
         """Make the action auditable everywhere at once: the structured
         ``autoscale.decision`` trace event (the ``cost.decision``
-        mirror), a flight-recorder note, the bounded decision log, and
-        the registry counters/gauges — then start the cooldown and
-        reset the sustain timers (an action consumes its evidence)."""
+        mirror) plus its ``placement.decision`` counterpart on the
+        unified stream, a flight-recorder note, the bounded decision
+        log, and the registry counters/gauges — then start the cooldown
+        and reset the sustain timers (an action consumes its
+        evidence)."""
+        inputs = dict(inputs or {})
+        engine = PlacementEngine(metrics=self._metrics)
+        kind, winner, candidates = self._placement_decision(
+            action, step, inputs
+        )
         decision = AutoscaleDecision(
             action=action, reason=reason, ok=ok, step=step,
             t_s=round(now - self._t0, 6),
-            inputs=dict(inputs or {}), thresholds=self._thresholds(),
+            inputs=inputs, thresholds=self._thresholds(),
+            winner=winner, candidates=candidates,
+            weights_family=engine.weights_family,
         )
         rec = decision.to_args()
         with self._lock:
             self._decisions.append(rec)
             self.num_decisions += 1
         obs.event("autoscale.decision", **rec)
+        engine.audit(
+            kind, winner, candidates, reason=reason,
+            context={
+                "action": action, "ok": ok, "t_s": rec["t_s"],
+                "replicas": inputs.get("replicas"),
+                "queue_depth": inputs.get("queue_depth"),
+                "outstanding": inputs.get("outstanding"),
+                "brownout_level": inputs.get("brownout_level"),
+            },
+        )
         obs.flight_note(
             "autoscale", f"{action}{f':{step}' if step else ''}",
             ok=ok, state=rec["inputs"].get("state"),

@@ -116,6 +116,12 @@ class LifecycleDecision:
     ok: bool = True
     inputs: Dict[str, Any] = field(default_factory=dict)
     thresholds: Dict[str, Any] = field(default_factory=dict)
+    # Decision-stream schema fields (ISSUE 19): lifecycle gates are
+    # quality decisions, not resource pricing, so candidates is usually
+    # the single judged fingerprint — but the stream carries the same
+    # winner/candidates/weights_family shape as the other five, so
+    # ``bin/trace --decisions`` and the capacity planner merge it.
+    weights_family: Optional[str] = None
 
     def to_args(self) -> Dict[str, Any]:
         return {
@@ -126,6 +132,13 @@ class LifecycleDecision:
             "t_s": self.t_s,
             "inputs": dict(self.inputs),
             "thresholds": dict(self.thresholds),
+            "winner": self.fingerprint or self.action,
+            "candidates": (
+                [{"label": self.fingerprint, "cost_s": None,
+                  "feasible": self.ok}]
+                if self.fingerprint else []
+            ),
+            "weights_family": self.weights_family,
         }
 
 
@@ -728,10 +741,13 @@ class LifecycleController:
 
     def _record(self, action, reason, fingerprint, ok=True,
                 inputs=None) -> Dict[str, Any]:
+        from keystone_tpu.placement.engine import active_family
+
         decision = LifecycleDecision(
             action=action, reason=reason, fingerprint=fingerprint,
             ok=ok, t_s=round(self._clock() - self._t0, 6),
             inputs=dict(inputs or {}), thresholds=self._thresholds(),
+            weights_family=active_family(),
         )
         rec = decision.to_args()
         with self._stats_lock:

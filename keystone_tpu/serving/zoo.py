@@ -94,6 +94,12 @@ import numpy as np
 
 from keystone_tpu import obs
 from keystone_tpu.data import durable
+from keystone_tpu.placement.engine import (
+    KIND_ZOO_EVICT,
+    KIND_ZOO_PAGE_IN,
+    PlacementEngine,
+    active_family,
+)
 from keystone_tpu.obs.metrics import (
     METRIC_TENANT_COLDSTART_FAILFAST,
     METRIC_TENANT_COMPLETED,
@@ -287,19 +293,26 @@ class ZooDecision:
     ok: bool = True
     inputs: Dict[str, Any] = field(default_factory=dict)
     candidates: List[Dict[str, Any]] = field(default_factory=list)
+    # Placement-engine provenance (ISSUE 19): which weight family priced
+    # the paging/eviction candidates — the field every decision stream
+    # shares.
+    weights_family: Optional[str] = None
 
     def to_args(self) -> Dict[str, Any]:
-        out = {
+        return {
             "action": self.action,
             "tenant": self.tenant,
             "reason": self.reason,
             "ok": self.ok,
             "t_s": self.t_s,
             "inputs": dict(self.inputs),
+            # Unconditional: the decision-event schema (tools/lint.py)
+            # wants candidates/winner on every stream, [] when the
+            # action considered no alternatives.
+            "candidates": [dict(c) for c in self.candidates],
+            "winner": self.tenant,
+            "weights_family": self.weights_family,
         }
-        if self.candidates:
-            out["candidates"] = [dict(c) for c in self.candidates]
-        return out
 
 
 # ---------------------------------------------------------------------------
@@ -817,12 +830,23 @@ class ModelZoo:
 
     def page_in_estimate_s(self) -> float:
         """The deadline-aware cold-start bound: the measured page-in EMA
-        once one has completed, else ``cold_start_estimate_s`` seeded
-        from the knob (conservative by design — a first-ever cold start
-        against a tight deadline should fast-fail, not gamble)."""
+        once one has completed, else the placement engine's priced
+        worst-case tenant footprint under the active weight family
+        (``zoo_page_overhead`` — what ``bin/calibrate --refit`` refits
+        from stamped page-ins), floored at ``cold_start_estimate_s``
+        (conservative by design — a first-ever cold start against a
+        tight deadline should fast-fail, not gamble)."""
         with self._lock:
-            return (self._page_in_ema_s if self._page_in_ema_s is not None
-                    else self.cold_start_estimate_s)
+            if self._page_in_ema_s is not None:
+                return self._page_in_ema_s
+            worst_bytes = max(
+                (t.resident_bytes for t in self._tenants.values()),
+                default=0,
+            )
+        if worst_bytes:
+            priced = PlacementEngine().price_page_in(worst_bytes)
+            return max(priced, self.cold_start_estimate_s)
+        return self.cold_start_estimate_s
 
     def _retry_policy(self) -> faults.RetryPolicy:
         return faults.RetryPolicy(attempts=self.page_retry_attempts)
@@ -856,6 +880,26 @@ class ModelZoo:
                 )
             t0 = time.perf_counter()
             self._evict_until_fits(entry)
+            # Price the fault before paying it: the unified placement
+            # stream records the PREDICTED page-in (the calibrated
+            # ``zoo_page_overhead`` family) and gets the measured wall
+            # stamped onto the same record below — the rows
+            # ``bin/calibrate --refit`` refits zoo paging from.
+            engine = PlacementEngine(metrics=self.metrics)
+            placement_ref = engine.audit(
+                KIND_ZOO_PAGE_IN, entry.tenant_id,
+                [{
+                    "label": entry.tenant_id,
+                    "cost_s": engine.price_page_in(entry.resident_bytes),
+                    "feasible": True,
+                    "resident_bytes": entry.resident_bytes,
+                }],
+                reason="page_fault",
+                context={
+                    "budget_bytes": self.budget_bytes,
+                    "fingerprint": entry.fingerprint,
+                },
+            )
             retries = [0]
 
             def _on_retry(attempt, delay_s, exc):
@@ -939,6 +983,8 @@ class ModelZoo:
                 )
             self._c_page_ins.add(1)
             self._g_residents.set(self._num_residents())
+            if placement_ref is not None:
+                placement_ref.stamp(wall, timing="single_run_cold")
             self._record_decision(
                 "page_in", entry.tenant_id,
                 reason=f"page fault; decode+rebuild took {wall:.4g}s "
@@ -1051,11 +1097,9 @@ class ModelZoo:
             ema = self._page_in_ema_s
         if ema is not None:
             return ema
-        from keystone_tpu.ops.learning.cost import active_weights
-
-        _, mem_w, _ = active_weights()
         return max(
-            entry.resident_bytes * mem_w, self.cold_start_estimate_s
+            PlacementEngine().price_page_in(entry.resident_bytes),
+            self.cold_start_estimate_s,
         )
 
     def _evict_until_fits(self, incoming: _Tenant) -> None:
@@ -1102,24 +1146,43 @@ class ModelZoo:
             victim_id = scored[0]["tenant"]
             with self._lock:
                 victim = self._tenants[victim_id]
+            reason = (
+                f"budget binds paging in {incoming.tenant_id!r} "
+                f"(+{incoming.resident_bytes}B over "
+                f"{self.budget_bytes}B); LRU-by-cost winner"
+            )
+            candidates = [
+                {k: v for k, v in c.items() if k != "score"}
+                | {"score": round(c["score"], 6)}
+                for c in scored
+            ]
             self._record_decision(
                 "evict", victim_id,
-                reason=(
-                    f"budget binds paging in {incoming.tenant_id!r} "
-                    f"(+{incoming.resident_bytes}B over "
-                    f"{self.budget_bytes}B); LRU-by-cost winner"
-                ),
+                reason=reason,
                 inputs={
                     "incoming": incoming.tenant_id,
                     "incoming_bytes": incoming.resident_bytes,
                     "budget_bytes": self.budget_bytes,
                     "resident_bytes": self._resident_bytes_total(),
                 },
-                candidates=[
-                    {k: v for k, v in c.items() if k != "score"}
-                    | {"score": round(c["score"], 6)}
-                    for c in scored
+                candidates=candidates,
+            )
+            # The placement mirror: eviction scoring is policy-chosen
+            # (LRU-priced-by-cost, not a cost argmin), so the engine
+            # audits rather than decides — each candidate's restore
+            # price rides in ``page_in_cost_s``.
+            PlacementEngine(metrics=self.metrics).audit(
+                KIND_ZOO_EVICT, victim_id,
+                [
+                    {**c, "cost_s": c.get("page_in_cost_s")}
+                    for c in candidates
                 ],
+                reason="lru_by_cost",
+                context={
+                    "incoming": incoming.tenant_id,
+                    "incoming_bytes": incoming.resident_bytes,
+                    "budget_bytes": self.budget_bytes,
+                },
             )
             self._page_out_locked(
                 victim,
@@ -1166,6 +1229,7 @@ class ModelZoo:
             t_s=round(self._now(), 6),
             inputs=dict(inputs or {}),
             candidates=list(candidates or []),
+            weights_family=active_family(),
         )
         rec = decision.to_args()
         with self._lock:

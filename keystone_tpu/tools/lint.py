@@ -71,6 +71,22 @@ test over the whole package (``tests/test_lint.py``):
     or silently reduces over the wrong axis on a 2-D mesh at worst; the
     registry constants are the one place axis names exist.
 
+``decision-event``
+    Every ``*.decision`` event emitted inside ``keystone_tpu/``
+    (``tracer.event("cost.decision", ...)``, ``obs.event(
+    "zoo.decision", **rec)``, the placement engine's unified stream)
+    must carry the audit schema ``candidates`` / ``winner`` /
+    ``reason`` — the keys :mod:`keystone_tpu.obs.calibrate` joins on
+    and :mod:`keystone_tpu.placement.planner` replays. Keys may arrive
+    as literal kwargs or through a resolvable ``**spread`` (a dict
+    literal assigned in the enclosing function, or a ``*.to_args()``
+    call — resolved against the union of the module's ``to_args``
+    key sets, parsed, never imported). A spread the linter cannot
+    resolve statically makes no claim. A decision event missing its
+    candidate table is an audit stream the planner cannot replay.
+    Benches, ``scripts/`` and the test suite fabricate synthetic
+    decision payloads on purpose and are exempt.
+
 ``explicit-seed``
     Randomized LIBRARY code must take an explicit integer seed: inside
     ``keystone_tpu/``, an argless ``jax.random.key()`` /
@@ -106,6 +122,7 @@ RULES = (
     "metric-name",
     "mesh-axis-name",
     "explicit-seed",
+    "decision-event",
 )
 
 _JAX_NAMES = {"jax", "jnp"}
@@ -835,6 +852,148 @@ def _check_bench_rows(tree: ast.Module, path: str) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# decision-event: every *.decision event carries the audit schema
+# ---------------------------------------------------------------------------
+
+_DECISION_REQUIRED = ("candidates", "reason", "winner")
+
+
+def _module_string_constants(tree: ast.Module) -> Dict[str, str]:
+    """Top-level ``NAME = "string"`` assignments — how the placement
+    engine names its event (``PLACEMENT_EVENT = "placement.decision"``)
+    without the linter importing anything."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            out[node.targets[0].id] = node.value.value
+    return out
+
+
+def _to_args_key_union(tree: ast.Module) -> Set[str]:
+    """Union of the string keys any ``to_args`` method in the module
+    emits: constant keys of its dict literals plus ``out["k"] = ...``
+    subscript stores — the two forms every decision dataclass uses."""
+    keys: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.FunctionDef) and node.name == "to_args"
+        ):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Dict):
+                keys.update(
+                    k.value for k in sub.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)
+                )
+            elif (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Subscript)
+                and isinstance(sub.targets[0].slice, ast.Constant)
+                and isinstance(sub.targets[0].slice.value, str)
+            ):
+                keys.add(sub.targets[0].slice.value)
+    return keys
+
+
+def _check_decision_events(
+    tree: ast.Module, path: str
+) -> List[Finding]:
+    findings: List[Finding] = []
+    consts = _module_string_constants(tree)
+    to_args_keys = _to_args_key_union(tree)
+
+    def _event_name(call: ast.Call) -> Optional[str]:
+        if _call_name(call.func) != "event" or not call.args:
+            return None
+        arg = call.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+        elif isinstance(arg, ast.Name):
+            name = consts.get(arg.id)
+        else:
+            name = None
+        if name is None or not name.endswith(".decision"):
+            return None
+        return name
+
+    def _check_call(call: ast.Call, assigns: Dict[str, ast.AST]) -> None:
+        name = _event_name(call)
+        if name is None:
+            return
+        provided: Set[str] = set()
+        unresolvable = False
+        for kw in call.keywords:
+            if kw.arg is not None:
+                provided.add(kw.arg)
+                continue
+            v = kw.value  # a **spread
+            if isinstance(v, ast.Call) \
+                    and _call_name(v.func) == "to_args":
+                provided |= to_args_keys
+                continue
+            src = assigns.get(v.id) if isinstance(v, ast.Name) else None
+            if isinstance(src, ast.Dict) and all(
+                isinstance(k, ast.Constant) for k in src.keys
+            ):
+                provided |= {k.value for k in src.keys}
+            elif isinstance(src, ast.Call) \
+                    and _call_name(src.func) == "to_args":
+                provided |= to_args_keys
+            else:
+                # A spread the linter cannot see through (e.g. the
+                # engine's **context passthrough) could provide
+                # anything — static analysis makes no claim.
+                unresolvable = True
+        missing = [k for k in _DECISION_REQUIRED if k not in provided]
+        if missing and not unresolvable:
+            findings.append(Finding(
+                path, call.lineno, "decision-event",
+                f"decision event {name!r} is missing required schema "
+                f"key(s) {', '.join(missing)} — every *.decision event "
+                "must record its full candidate table, winner and "
+                "reason (the audit schema obs/calibrate.py joins and "
+                "placement/planner.py replays)",
+            ))
+
+    seen: Set[int] = set()
+    # Innermost scopes first (ast.walk yields outer before inner), so
+    # every emit call is checked against its tightest enclosing
+    # function's assignments; the module scope sweeps up the rest.
+    fns = [
+        node for node in ast.walk(tree)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    scopes: List[Tuple[ast.AST, Dict[str, ast.AST]]] = [
+        (fn, {}) for fn in reversed(fns)
+    ] + [(tree, {})]
+    for scope, assigns in scopes:
+        for sub in ast.walk(scope):
+            if (
+                isinstance(sub, ast.Assign)
+                and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)
+            ):
+                # Innermost-scope walk runs last and wins, matching
+                # Python's name resolution closely enough for the
+                # ``rec = decision.to_args()`` emit idiom.
+                assigns[sub.targets[0].id] = sub.value
+        for sub in ast.walk(scope):
+            if isinstance(sub, ast.Call) and id(sub) not in seen:
+                if _event_name(sub) is not None:
+                    seen.add(id(sub))
+                    _check_call(sub, assigns)
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -918,6 +1077,19 @@ def lint_file(
         )
         if not exempt:
             findings.extend(_check_explicit_seed(tree, sp))
+    if "decision-event" in enabled:
+        # Library scope only: the test suite and benches fabricate
+        # synthetic decision payloads on purpose (same exemption shape
+        # as explicit-seed).
+        parts = set(path.parts)
+        exempt = (
+            "tests" in parts or "scripts" in parts
+            or path.name == "bench.py"
+            or path.name.startswith("test_")
+            or path.name == "conftest.py"
+        )
+        if not exempt:
+            findings.extend(_check_decision_events(tree, sp))
     return findings
 
 

@@ -1,0 +1,159 @@
+"""Capacity-planner CLI: ``python -m keystone_tpu.tools.plan <dir>...``
+(wrapped by ``bin/plan``).
+
+Feeds one or more trace dirs (``KEYSTONE_TRACE=dir`` /
+``run.py --trace=dir`` / ``with obs.tracing(dir):``) to
+:class:`keystone_tpu.placement.planner.CapacityPlanner` and renders:
+
+  - **Baseline**: the measured record — decision count, the weight
+    family they were priced under, batch count, p50/p99, the peak
+    replica/queue/outstanding occupancy the autoscale stream saw.
+  - **1x fidelity**: the admission ticket — every recorded argmin
+    decision replayed over its RECORDED candidates must reproduce its
+    winner, and every stamped outcome is scored predicted-vs-measured
+    on the calibration plane's ``|ln|`` yardstick. Exit 2 when replay
+    mismatches or the worst outcome error exceeds the drift threshold:
+    a planner that cannot reproduce the past must not predict the
+    future.
+  - **What-if rows** (one per ``--whatif``): ``traffic=2x`` |
+    ``hbm=0.5x`` | ``tenants=+1`` | ``mesh=8x1``, each self-auditing
+    (prediction + measured baseline + provenance + assumptions in the
+    same dict — the shape bench.py's ``_whatif_violations`` enforces).
+
+``--json`` emits the full plan dict instead (the scriptable surface).
+See docs/placement.md (planner cookbook).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+from keystone_tpu.obs.export import load_events
+from keystone_tpu.placement.planner import (
+    CapacityPlanner,
+    DEFAULT_DRIFT_THRESHOLD,
+    parse_whatif,
+)
+
+__all__ = ["main"]
+
+
+def _fmt_s(v: Optional[float]) -> str:
+    return f"{v:.4g}s" if v is not None else "?"
+
+
+def _render(plan: Dict[str, Any], drift_threshold: float) -> List[str]:
+    lines: List[str] = []
+    base = plan["baseline"]
+    lines.append(
+        f"baseline: {base['num_decisions']} decisions "
+        f"(family={base['weights_family']}), "
+        f"{base['num_batches']} batches, "
+        f"p50={_fmt_s(base['measured_p50_s'])} "
+        f"p99={_fmt_s(base['measured_p99_s'])}, "
+        f"peaks: replicas={base['replicas_peak']} "
+        f"queue={base['queue_peak']:g} "
+        f"outstanding={base['outstanding_peak']:g}"
+    )
+    fid = plan["fidelity"]
+    ok = fid["num_reproduced"] == fid["num_replayed"]
+    worst = fid["max_abs_log_error"]
+    drifted = worst is not None and worst > drift_threshold
+    lines.append(
+        f"1x fidelity: {fid['num_reproduced']}/{fid['num_replayed']} "
+        f"argmin winners reproduced, {fid['num_outcomes']} stamped "
+        f"outcomes, worst |log error| "
+        f"{worst if worst is None else round(worst, 3)} "
+        f"(threshold {drift_threshold}) — "
+        f"{'OK' if ok and not drifted else 'FAILED'}"
+    )
+    for m in fid["mismatches"]:
+        lines.append(
+            f"  MISMATCH {m['kind']}: recorded={m['recorded']} "
+            f"replayed={m['replayed']}"
+        )
+    for row in plan["whatifs"]:
+        lines.append("")
+        lines.append(f"what-if {row['whatif']}:")
+        for key in (
+            "predicted_p99_s", "predicted_p99_1x_s", "measured_p99_s",
+            "abs_log_error_1x", "whatif_changed_winners",
+            "whatif_added_page_seconds", "predicted_page_in_s",
+            "measured_page_in_p50_s", "whatif_slowdown_x",
+            "recorded_winner", "num_mesh_decisions",
+            "measured_num_replayed", "num_page_ins", "note",
+        ):
+            if key in row and row[key] is not None:
+                v = row[key]
+                lines.append(
+                    f"  {key} = "
+                    f"{round(v, 6) if isinstance(v, float) else v}"
+                )
+        for ch in row.get("changed", []):
+            lines.append(
+                f"  FLIP {ch['kind']}: {ch['recorded']} -> "
+                f"{ch['predicted']}"
+            )
+        for a in row.get("assumptions", []):
+            lines.append(f"  (assumes: {a})")
+    return lines
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "keystone-plan", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument("trace_dirs", nargs="+",
+                        help="trace directories recorded runs wrote")
+    parser.add_argument("--whatif", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="traffic=2x | hbm=0.5x | tenants=+1 | "
+                             "mesh=8x1 (repeatable)")
+    parser.add_argument("--drift-threshold", type=float,
+                        default=DEFAULT_DRIFT_THRESHOLD,
+                        help="1x fidelity bound on |ln(pred/measured)| "
+                             "(the calibration plane's default)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the plan dict as JSON")
+    args = parser.parse_args(list(argv) if argv is not None else None)
+
+    try:
+        whatifs = [parse_whatif(s) for s in args.whatif]
+    except ValueError as e:
+        print(f"plan: {e}", file=sys.stderr)
+        return 1
+    records: List[Dict[str, Any]] = []
+    for d in args.trace_dirs:
+        try:
+            records.extend(load_events(d))
+        except OSError as e:
+            print(f"plan: cannot read {d!r}: {e}", file=sys.stderr)
+            return 1
+    if not records:
+        print("plan: no events in "
+              f"{', '.join(repr(d) for d in args.trace_dirs)}",
+              file=sys.stderr)
+        return 1
+
+    planner = CapacityPlanner(records,
+                              drift_threshold=args.drift_threshold)
+    plan = planner.plan(whatifs)
+    if args.json:
+        print(json.dumps(plan, indent=2, sort_keys=True))
+    else:
+        print("\n".join(_render(plan, args.drift_threshold)))
+    fid = plan["fidelity"]
+    worst = fid["max_abs_log_error"]
+    if fid["num_reproduced"] != fid["num_replayed"] or (
+        worst is not None and worst > args.drift_threshold
+    ):
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,13 @@ and prints the three views a postmortem starts from:
     run THIS engine" and "was the model right". ``bin/calibrate``
     renders the full per-engine/mis-route analysis and refits.
 
+``--decisions`` prints the merged chronological decision log instead:
+every ``*.decision`` event across all six streams (cost, placement,
+autoscale, zoo, lifecycle) in timestamp order with stream, kind,
+winner, reason, and the weight family it was priced under — the
+one-command answer to "what did every resource decider choose, in what
+order, under which weights" (docs/placement.md).
+
 ``--perfetto OUT.json`` (re-)emits the Chrome-trace projection from the
 JSONL rows (e.g. after post-processing, or when only the event log was
 shipped off-box). Exits non-zero on an unreadable/invalid trace dir.
@@ -233,6 +240,34 @@ def _render(summary: Dict[str, Any], top: int) -> str:
     return "\n".join(lines)
 
 
+def _render_decisions(records: List[Dict[str, Any]]) -> str:
+    """The merged chronological decision log across every stream."""
+    from keystone_tpu.placement.planner import decision_rows
+
+    rows = decision_rows(records)
+    lines: List[str] = []
+    streams = sorted({r["stream"] for r in rows})
+    lines.append(
+        f"{len(rows)} decisions across {len(streams)} streams "
+        f"({', '.join(streams) or 'none'}):"
+    )
+    if not rows:
+        return "\n".join(lines)
+    t0 = rows[0]["ts_us"]
+    lines.append(
+        f"  {'t_s':>9} {'stream':<20} {'kind':<26} {'winner':<28} "
+        f"{'reason':<24} family"
+    )
+    for r in rows:
+        lines.append(
+            f"  {(r['ts_us'] - t0) / 1e6:>9.3f} {r['stream']:<20} "
+            f"{str(r['kind']):<26} {str(r['winner']):<28} "
+            f"{str(r['reason'] or '?'):<24} "
+            f"{r['weights_family'] or '?'}"
+        )
+    return "\n".join(lines)
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         "keystone-trace", description=__doc__,
@@ -243,6 +278,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="span names in the self-time table")
     parser.add_argument("--perfetto", default="",
                         help="also (re-)emit the Chrome-trace JSON here")
+    parser.add_argument("--decisions", action="store_true",
+                        help="print the merged chronological decision "
+                             "log (all *.decision streams) instead of "
+                             "the span summary")
     args = parser.parse_args(list(argv) if argv is not None else None)
     try:
         records = load_events(args.trace_dir)
@@ -254,6 +293,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"trace: {args.trace_dir!r} holds no events",
               file=sys.stderr)
         return 1
+    if args.decisions:
+        print(_render_decisions(records))
+        return 0
     print(_render(summarize(records), args.top))
     if args.perfetto:
         doc = to_chrome_trace(records)

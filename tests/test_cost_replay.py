@@ -480,3 +480,303 @@ class TestWeightFamilySwitch:
         assert sparse_gather_overhead() == 321.0
         est = LeastSquaresEstimator(lam=0.1)
         assert est.cpu_weight == 7e-15 and est.mem_weight == 3e-11
+
+
+def _placement_events(t, kind):
+    return [
+        e["args"] for e in t.events
+        if e["type"] == "event" and e["name"] == "placement.decision"
+        and e["args"]["decision"] == kind
+    ]
+
+
+class TestReplayUnifiedPlacement:
+    """ISSUE 19 tentpole pin: every decision site routes through the ONE
+    :class:`keystone_tpu.placement.engine.PlacementEngine`, mirrored
+    into the unified ``placement.decision`` stream — and the unified
+    engine reproduces every recorded winner bit for bit (ties keep the
+    legacy first-minimum resolution)."""
+
+    def test_solver_mirror_reproduces_timit_resident_winner(self):
+        est = LeastSquaresEstimator(
+            lam=1e-4, hbm_bytes=48 << 30, num_machines=1
+        )
+        s, ls = _dense_sample(262_144, 16_384, 147)
+        with obs.tracing() as t:
+            est.optimize(s, ls)
+        legacy = [
+            e["args"] for e in t.events
+            if e["type"] == "event" and e["name"] == "cost.decision"
+            and e["args"]["decision"] == "least_squares_solver"
+        ]
+        mirrors = _placement_events(t, "placement.solver")
+        assert len(legacy) == 1 and len(mirrors) == 1
+        assert mirrors[0]["winner"] == legacy[0]["winner"] \
+            == "BlockLeastSquaresEstimator"
+        assert mirrors[0]["reason"] == "argmin"
+        assert mirrors[0]["weights_family"] == "tpu"
+        assert len(mirrors[0]["candidates"]) == len(est.options)
+
+    def test_solver_mirror_reproduces_fulln_streaming_winner(self):
+        est = LeastSquaresEstimator(
+            lam=1e-4, hbm_bytes=16 << 30, num_machines=1
+        )
+        s, ls = _dense_sample(2_200_000, 16_384, 147)
+        with obs.tracing() as t:
+            est.optimize(s, ls)
+        (mirror,) = _placement_events(t, "placement.solver")
+        assert mirror["winner"] == "StreamingLeastSquaresChoice"
+        # Infeasible residents carry cost_s=None + feasible=False in the
+        # normalized unified stream (inf never reaches JSON).
+        by_label = {c["label"]: c for c in mirror["candidates"]}
+        assert by_label["DenseLBFGSwithL2"]["feasible"] is False
+        assert by_label["DenseLBFGSwithL2"]["cost_s"] is None
+
+    def test_solver_mirror_reproduces_amazon_gram_variants(self):
+        for n, hbm, host, expect in (
+            (None, 16 << 30, None, "SparseLBFGSwithL2[gram]"),
+            (30_000_000, 16 << 30, 64 << 30,
+             "SparseLBFGSwithL2[gram,int16_bf16]"),
+        ):
+            kw = {"lam": 1e-3, "hbm_bytes": hbm, "num_machines": 1}
+            if host is not None:
+                kw["host_budget_bytes"] = host
+            est = LeastSquaresEstimator(**kw)
+            sampler = (
+                TestReplayAmazonSparse() if n is None
+                else TestReplayAmazonCompressedResident()
+            )
+            s, ls = sampler._sample()
+            with obs.tracing() as t:
+                est.optimize(s, ls)
+            (mirror,) = _placement_events(t, "placement.solver")
+            assert mirror["winner"] == expect, mirror
+
+    def test_mesh_mirror_and_single_calibration_join(self):
+        from keystone_tpu.obs import calibrate as cal
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        with obs.tracing() as t:
+            cost_mod.choose_mesh_layout(
+                65_000_000, 16_385, 2, nnz_per_row=83, num_devices=8
+            )
+        (mirror,) = _placement_events(t, "placement.mesh_layout")
+        assert mirror["winner"] == "mesh[data=8,model=1]"
+        assert mirror["weights_family"] == "tpu"
+        # The namespaced placement kind must NOT double-join: extending
+        # join_decisions to both event names still yields exactly one
+        # mesh_layout row per decision.
+        rows = cal.join_decisions(t.events)
+        assert len([r for r in rows if r.decision == "mesh_layout"]) == 1
+
+    def test_image_tier_mirror_reproduces_winner(self):
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        with obs.tracing() as t:
+            tier, _ = cost_mod.choose_image_tier(
+                50_000, 3072, 10, host_budget_bytes=4 << 30
+            )
+        (mirror,) = _placement_events(t, "placement.image_tier")
+        assert mirror["winner"] == tier
+        legacy = [
+            e["args"] for e in t.events
+            if e["type"] == "event" and e["name"] == "cost.decision"
+            and e["args"]["decision"] == "image_tier"
+        ]
+        assert legacy[0]["winner"] == tier
+
+    def test_all_six_streams_carry_weights_family(self):
+        from keystone_tpu.serving.autoscale import AutoscaleDecision
+        from keystone_tpu.serving.lifecycle import LifecycleDecision
+        from keystone_tpu.serving.zoo import ZooDecision
+
+        a = AutoscaleDecision(
+            action="scale_up", reason="r", ok=True, t_s=0.0,
+            inputs={}, thresholds={}, winner="replicas=2",
+            candidates=({"label": "replicas=2"},), weights_family="tpu",
+        ).to_args()
+        z = ZooDecision(
+            action="page_in", tenant="t", reason="r", ok=True, t_s=0.0,
+            inputs={}, weights_family="tpu",
+        ).to_args()
+        lc = LifecycleDecision(
+            action="publish", reason="r", fingerprint="f", ok=True,
+            t_s=0.0, inputs={}, thresholds={}, weights_family="tpu",
+        ).to_args()
+        for args in (a, z, lc):
+            assert args["weights_family"] == "tpu"
+            assert "winner" in args and "candidates" in args
+        # cost.decision + the placement stream (covered live above)
+        # carry it via CostDecision.to_args / PlacementEngine._emit.
+        dec = obs.CostDecision(
+            decision="least_squares_solver", winner="w", candidates=[],
+            reason="argmin", context={"weights": {"family": "ec2"}},
+        )
+        assert dec.to_args()["weights_family"] == "ec2"
+
+    def test_engine_first_minimum_tie_and_fallback(self):
+        from keystone_tpu.placement.engine import (
+            KIND_SOLVER, PlacementEngine,
+        )
+
+        eng = PlacementEngine(weights_family="tpu")
+        tie = eng.decide(KIND_SOLVER, [
+            {"label": "a", "cost_s": 1.0, "feasible": True},
+            {"label": "b", "cost_s": 1.0, "feasible": True},
+        ])
+        assert tie.winner == "a" and tie.index == 0  # first minimum
+        fb = eng.decide(KIND_SOLVER, [
+            {"label": "big", "cost_s": None, "feasible": False,
+             "resident_bytes": 9e9},
+            {"label": "small", "cost_s": None, "feasible": False,
+             "resident_bytes": 1e9},
+        ], fallback="least_resident")
+        assert fb.winner == "small"
+        assert fb.reason == "least_resident_fallback"
+        with pytest.raises(ValueError):
+            eng.decide(KIND_SOLVER, [
+                {"label": "x", "cost_s": None, "feasible": False},
+            ])
+
+
+class TestCapacityPlannerGoldenTrace:
+    """ISSUE 19 planner pin: replaying a recorded storm through
+    :class:`keystone_tpu.placement.planner.CapacityPlanner` reproduces
+    every recorded argmin winner, predicts the 1x p99 within the
+    calibration plane's error bars, and degrades monotonically under
+    2x traffic."""
+
+    @pytest.fixture()
+    def golden_dir(self, tmp_path):
+        import time
+
+        from keystone_tpu.placement.engine import (
+            KIND_ZOO_PAGE_IN, PlacementEngine,
+        )
+        from keystone_tpu.ops.learning import cost as cost_mod
+
+        td = str(tmp_path / "trace")
+        rng = np.random.default_rng(0)
+        s = Dataset.of(rng.normal(size=(24, 16_384)).astype(np.float32))
+        s.total_n = 262_144
+        s.source_row_bytes = 4.0 * 440
+        ls = Dataset.of(rng.normal(size=(24, 147)).astype(np.float32))
+        with obs.tracing(td) as tracer:
+            est = LeastSquaresEstimator(
+                lam=1e-4, hbm_bytes=48 << 30, num_machines=1
+            )
+            est.optimize(s, ls)
+            cost_mod.choose_mesh_layout(
+                65_000_000, 16_385, 2, nnz_per_row=83, num_devices=8
+            )
+            eng = PlacementEngine()
+            priced = eng.price_page_in(1 << 28)
+            ref = eng.audit(
+                KIND_ZOO_PAGE_IN, "tenant-a",
+                [{"label": "tenant-a", "cost_s": priced,
+                  "feasible": True, "resident_bytes": float(1 << 28)}],
+                reason="page_fault", context={},
+            )
+            ref.stamp(priced * 1.05, timing="single_run_cold")
+            # The storm's occupancy snapshots: replicas ramp to 4 with
+            # the backlog peaking at queue=6 / outstanding=6.
+            for replicas, queue, outstanding in (
+                (1, 2.0, 2.0), (2, 4.0, 4.0), (4, 6.0, 6.0),
+            ):
+                obs.event(
+                    "autoscale.decision", action="scale_up",
+                    reason="queue_pressure", ok=True,
+                    winner=f"replicas={replicas}", candidates=[],
+                    weights_family="tpu",
+                    inputs={"replicas": replicas, "queue_depth": queue,
+                            "outstanding": outstanding},
+                )
+            # Batch latencies: p50 = 10 ms service floor, measured tail
+            # stretched to 35 ms by the storm.
+            t0 = time.perf_counter()
+            for i in range(100):
+                dur = 0.010 if i < 98 else 0.035
+                start = t0 + i * 0.05
+                tracer.add_span("serving.batch", start, start + dur)
+        return td
+
+    def _planner(self, golden_dir):
+        from keystone_tpu.obs.export import load_events
+        from keystone_tpu.placement.planner import CapacityPlanner
+
+        return CapacityPlanner(load_events(golden_dir))
+
+    def test_one_x_replay_reproduces_and_stays_in_error_bars(
+        self, golden_dir
+    ):
+        from keystone_tpu.obs.calibrate import DEFAULT_DRIFT_THRESHOLD
+
+        planner = self._planner(golden_dir)
+        fid = planner.fidelity()
+        assert fid["num_replayed"] >= 4  # solver + mesh, both streams
+        assert fid["num_reproduced"] == fid["num_replayed"], fid
+        assert fid["num_outcomes"] >= 1  # the stamped page-in
+        assert fid["max_abs_log_error"] < DEFAULT_DRIFT_THRESHOLD
+        row = planner.whatif_traffic(1.0)
+        assert row["abs_log_error_1x"] < DEFAULT_DRIFT_THRESHOLD, row
+
+    def test_two_x_traffic_monotonically_degrades_p99(self, golden_dir):
+        planner = self._planner(golden_dir)
+        row = planner.whatif_traffic(2.0)
+        assert row["predicted_p99_s"] > row["predicted_p99_1x_s"]
+        assert row["predicted_p99_1x_s"] >= row["measured_p99_s"] * 0.5
+        # Self-auditing shape (the bench _whatif_violations contract).
+        assert row["num_decisions"] > 0
+        assert isinstance(row["weights_family"], str)
+        assert row["measured_p99_s"] is not None
+
+    def test_half_hbm_flips_the_resident_winner(self, golden_dir):
+        planner = self._planner(golden_dir)
+        row = planner.whatif_hbm(0.5)
+        assert row["whatif_changed_winners"] >= 1, row
+        flipped = {c["kind"] for c in row["changed"]}
+        assert "least_squares_solver" in flipped
+        assert "placement.solver" in flipped  # both streams agree
+
+    def test_added_tenant_priced_from_calibrated_family(self, golden_dir):
+        planner = self._planner(golden_dir)
+        row = planner.whatif_tenants(1)
+        assert row["whatif_added_page_seconds"] > 0
+        assert row["predicted_page_in_s"] == pytest.approx(
+            row["whatif_added_page_seconds"]
+        )
+        # Predicted within the measured page-in's error bars (stamped
+        # at 1.05x the priced seconds above).
+        assert row["measured_page_in_p50_s"] == pytest.approx(
+            row["predicted_page_in_s"] * 1.05
+        )
+
+    def test_mesh_whatif_prices_requested_vs_winner(self, golden_dir):
+        planner = self._planner(golden_dir)
+        row = planner.whatif_mesh("mesh[data=4,model=1]")
+        assert row["recorded_winner"] == "mesh[data=8,model=1]"
+        assert row["whatif_slowdown_x"] > 1.0
+
+    def test_bin_plan_cli_runs_the_whatifs(self, golden_dir, capsys):
+        from keystone_tpu.tools.plan import main as plan_main
+
+        rc = plan_main([
+            golden_dir, "--whatif", "traffic=2x", "--whatif", "hbm=0.5x",
+            "--whatif", "tenants=+1", "--whatif", "mesh=8x1",
+        ])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "1x fidelity" in out and "OK" in out
+        assert "traffic=2x" in out and "hbm=0.5x" in out
+
+    def test_cli_json_plan_is_machine_readable(self, golden_dir, capsys):
+        import json
+
+        from keystone_tpu.tools.plan import main as plan_main
+
+        rc = plan_main([golden_dir, "--whatif", "traffic=2x", "--json"])
+        assert rc == 0
+        plan = json.loads(capsys.readouterr().out)
+        assert plan["fidelity"]["num_reproduced"] \
+            == plan["fidelity"]["num_replayed"]
+        assert plan["whatifs"][0]["whatif"] == "traffic=2x"

@@ -706,6 +706,90 @@ def use():
         assert "explicit-seed" in RULES
 
 
+class TestDecisionEventRule:
+    VIOLATION = """
+def emit(tracer):
+    tracer.event("zoo.decision", action="evict", tenant="t1")
+"""
+
+    def test_bare_decision_event_is_flagged(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path, self.VIOLATION, rules=["decision-event"]
+        )
+        assert _codes(findings) == ["decision-event"]
+        msg = findings[0].message
+        for key in ("candidates", "winner", "reason"):
+            assert key in msg
+
+    def test_literal_kwargs_schema_is_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+def emit(tracer, cands):
+    tracer.event("placement.decision", decision="placement.solver",
+                 winner="x", reason="argmin", candidates=cands)
+""", rules=["decision-event"])
+
+    def test_to_args_spread_resolves_through_module(self, tmp_path):
+        # The serving-plane idiom: rec = decision.to_args() then
+        # obs.event(..., **rec) — resolved against the module's
+        # to_args key set.
+        assert not _lint_snippet(tmp_path, """
+class Decision:
+    def to_args(self):
+        return {"winner": self.w, "reason": self.r,
+                "candidates": list(self.c)}
+
+
+def emit(obs, decision):
+    rec = decision.to_args()
+    obs.event("autoscale.decision", **rec)
+""", rules=["decision-event"])
+
+    def test_dict_literal_spread_missing_keys_is_flagged(self, tmp_path):
+        findings = _lint_snippet(tmp_path, """
+def emit(obs):
+    rec = {"action": "scale_up", "ok": True}
+    obs.event("autoscale.decision", **rec)
+""", rules=["decision-event"])
+        assert _codes(findings) == ["decision-event"]
+
+    def test_unresolvable_spread_makes_no_claim(self, tmp_path):
+        # Static honesty: a spread the linter cannot see through could
+        # provide anything.
+        assert not _lint_snippet(tmp_path, """
+def emit(obs, ctx):
+    obs.event("lifecycle.decision", winner="w", **dict(ctx))
+""", rules=["decision-event"])
+
+    def test_event_name_via_module_constant(self, tmp_path):
+        # The placement engine names its event through a module
+        # constant; the rule resolves it without importing.
+        findings = _lint_snippet(tmp_path, """
+EV = "placement.decision"
+
+
+def emit(tracer):
+    tracer.event(EV, winner="x")
+""", rules=["decision-event"])
+        assert _codes(findings) == ["decision-event"]
+
+    def test_non_decision_events_ignored(self, tmp_path):
+        assert not _lint_snippet(tmp_path, """
+def emit(tracer):
+    tracer.event("ingest.progress", rows=10)
+""", rules=["decision-event"])
+
+    def test_benches_scripts_and_tests_are_exempt(self, tmp_path):
+        for rel in ("scripts/sweep.py", "tests/helper.py",
+                    "test_demo.py", "bench.py", "conftest.py"):
+            f = tmp_path / rel
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_text(self.VIOLATION)
+            assert not lint_file(f, rules=["decision-event"]), rel
+
+    def test_rule_is_registered(self):
+        assert "decision-event" in RULES
+
+
 class TestDriver:
     def test_unparseable_file_is_a_finding(self, tmp_path):
         findings = _lint_snippet(tmp_path, "def broken(:\n")
