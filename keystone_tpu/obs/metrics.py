@@ -396,6 +396,64 @@ class BucketedHistogram:
             self._max = max(self._max, mx)
         return self
 
+    def state_dict(self) -> Dict[str, Any]:
+        """JSON-safe serialized form for CROSS-PROCESS merge (ISSUE 20:
+        the fleet router merges per-plane histograms scraped over
+        ``/snapshot.json``). Bucket keys are stringified indices; the
+        shared class-level geometry means :meth:`merge_state` on the
+        receiving side is exactly :meth:`merge` — counts add, no
+        resampling, the PR-10 exact-merge property preserved over the
+        wire. Exemplars ride along (latest-wins on merge)."""
+        with self._lock:
+            return {
+                "geometry": {"lo": self._LO, "growth": self._GROWTH},
+                "count": self.count,
+                "sum": self.total,
+                "min": self._min if self.count else None,
+                "max": self._max if self.count else None,
+                "buckets": {str(i): c for i, c in self._buckets.items()},
+                "exemplars": dict(self._exemplars),
+            }
+
+    @classmethod
+    def from_state(cls, state: Dict[str, Any]) -> "BucketedHistogram":
+        """Rebuild from :meth:`state_dict` (e.g. after a JSON round
+        trip). Raises ValueError on a geometry mismatch — merging
+        histograms bucketed under different geometries would silently
+        misplace every count."""
+        h = cls()
+        h.merge_state(state)
+        return h
+
+    def merge_state(self, state: Dict[str, Any]) -> "BucketedHistogram":
+        """Fold a serialized peer into self — the cross-process form of
+        :meth:`merge`, with the same exactness (counts add)."""
+        geo = state.get("geometry") or {}
+        if (float(geo.get("lo", self._LO)) != self._LO
+                or float(geo.get("growth", self._GROWTH)) != self._GROWTH):
+            raise ValueError(
+                f"histogram geometry mismatch: peer {geo} vs local "
+                f"lo={self._LO} growth={self._GROWTH}"
+            )
+        buckets = {int(i): int(c)
+                   for i, c in (state.get("buckets") or {}).items()}
+        count = int(state.get("count", 0))
+        total = float(state.get("sum", 0.0))
+        mn = state.get("min")
+        mx = state.get("max")
+        with self._lock:
+            for idx, c in buckets.items():
+                self._buckets[idx] = self._buckets.get(idx, 0) + c
+            for idx, ex in (state.get("exemplars") or {}).items():
+                self._exemplars[int(idx)] = str(ex)
+            self.count += count
+            self.total += total
+            if mn is not None:
+                self._min = min(self._min, float(mn))
+            if mx is not None:
+                self._max = max(self._max, float(mx))
+        return self
+
     def _percentile_locked(self, q: float) -> Optional[float]:
         if not self.count:
             return None

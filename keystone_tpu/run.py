@@ -12,7 +12,14 @@ micro-batch server under open-loop Poisson load, and print the p50/p99
 latency + throughput summary line (docs/serving.md). ``--replicas N``
 serves through the replicated plane instead (least-loaded routing,
 per-replica breakers, watchdog restarts, hot-swap — docs/serving.md's
-replicated section).
+replicated section). ``--fleet N`` serves through N crash-contained
+plane PROCESSES behind the FleetRouter's admission door (each plane a
+full replicated stack fed the plan over the fingerprint-verified ship;
+process kills survived with exact books — docs/serving.md fleet
+section). ``--from-plan artifact.json`` consumes a ``bin/plan --apply``
+serving-defaults artifact: flags left at their defaults are filled
+from the planner's measured baseline, and the summary line stamps the
+artifact's provenance (docs/placement.md).
 
 Global reliability flags (any pipeline, and serve — docs/reliability.md):
 ``--checkpoint-dir=DIR`` makes segmented streamed fits snapshot their
@@ -219,6 +226,19 @@ def _serve(argv):
                         "here every --metrics-interval-s (scrape-less "
                         "environments; bin/slo reads them)")
     parser.add_argument("--metrics-interval-s", type=float, default=1.0)
+    parser.add_argument("--from-plan", default="", metavar="PATH",
+                        help="consume a bin/plan --apply defaults "
+                        "artifact: its measured-baseline knobs "
+                        "(replicas, queue depth, SLO bound) fill in "
+                        "any flag left at its default, and the summary "
+                        "line stamps the artifact's provenance "
+                        "(docs/placement.md planner cookbook)")
+    parser.add_argument("--fleet", type=int, default=1,
+                        help="serve through a FleetRouter fronting N "
+                        "crash-contained plane PROCESSES (each plane = "
+                        "a full ReplicatedServer with --replicas "
+                        "replicas); process kills are survived with "
+                        "exact books (docs/serving.md fleet section)")
     args = parser.parse_args(argv)
 
     import numpy as np
@@ -231,6 +251,17 @@ def _serve(argv):
         export_plan,
         run_open_loop,
     )
+
+    plan_stamp = None
+    if args.from_plan:
+        try:
+            plan_stamp = _serve_apply_plan_defaults(args, parser)
+        except (OSError, ValueError, KeyError) as e:
+            print(
+                f"serve: --from-plan failed: {type(e).__name__}: {e}",
+                file=sys.stderr,
+            )
+            return 2
 
     if args.autoscale and args.slo_p99_ms <= 0:
         print(
@@ -252,7 +283,27 @@ def _serve(argv):
         )
         return 2
 
+    if args.fleet < 1:
+        print(f"serve: need --fleet >= 1 (got {args.fleet})",
+              file=sys.stderr)
+        return 2
+    if args.fleet > 1 and args.autoscale:
+        print(
+            "serve: --fleet and --autoscale are mutually exclusive "
+            "(the fleet's planes do their own admission; router-level "
+            "elasticity is ROADMAP work)",
+            file=sys.stderr,
+        )
+        return 2
+
     tenant_specs = _serve_tenant_specs(args)
+    if tenant_specs is not None and args.fleet > 1:
+        print(
+            "serve: --fleet and --tenants/--tenant-spec are mutually "
+            "exclusive (the zoo's multi-tenant plane is in-process)",
+            file=sys.stderr,
+        )
+        return 2
     if tenant_specs is not None and args.autoscale:
         print(
             "serve: --tenants/--tenant-spec and --autoscale are "
@@ -277,7 +328,8 @@ def _serve(argv):
                 file=sys.stderr,
             )
             return 1
-        return _serve_zoo(args, fitted, d_in, tenant_specs)
+        return _serve_zoo(args, fitted, d_in, tenant_specs,
+                          plan_stamp=plan_stamp)
     try:
         fitted, d_in = _serve_build_fitted(args)
         phase = "export"
@@ -295,6 +347,10 @@ def _serve(argv):
     single_s = plan.measure_single_request_s()
     rng = np.random.default_rng(args.seed + 1)
     pool = rng.normal(size=(256, d_in)).astype(np.float32)
+
+    if args.fleet > 1:
+        return _serve_fleet(args, fitted, plan, single_s, pool,
+                            plan_stamp)
 
     # Live SLO objectives (docs/observability.md): a p99 latency bound
     # plus availability, publishing slo.state/burn gauges into their
@@ -384,6 +440,8 @@ def _serve(argv):
         "max_wait_ms": args.max_wait_ms,
         "plan_fingerprint": plan.fingerprint,
     })
+    if plan_stamp is not None:
+        summary["plan_artifact"] = plan_stamp
     if slo_tracker is not None:
         # The verdict and the budget, on the one line an operator reads.
         verdict = report.slo or slo_tracker.verdict()
@@ -424,6 +482,120 @@ def _serve(argv):
             "breaker_state": stats.get("breaker_state"),
         })
     print(json.dumps(summary))
+    return 0
+
+
+def _serve_apply_plan_defaults(args, parser):
+    """Consume a ``bin/plan --apply`` artifact: every serve flag the
+    operator left at its parser default is filled from the artifact's
+    measured-baseline ``serve_defaults`` block (an explicit flag always
+    wins — the operator outranks the planner). Returns the provenance
+    stamp the serve summary line carries, so the plane's configuration
+    is auditable back to the trace it was sized from."""
+    import json
+
+    from keystone_tpu.tools.plan import PLAN_ARTIFACT_KIND
+
+    with open(args.from_plan) as f:
+        doc = json.load(f)
+    if doc.get("artifact") != PLAN_ARTIFACT_KIND:
+        raise ValueError(
+            f"{args.from_plan!r} is not a bin/plan --apply artifact "
+            f"(artifact={doc.get('artifact')!r})"
+        )
+    applied = {}
+    for key, value in sorted(doc["serve_defaults"].items()):
+        if not hasattr(args, key):
+            continue
+        if getattr(args, key) == parser.get_default(key):
+            setattr(args, key, value)
+            applied[key] = value
+    return {
+        "path": args.from_plan,
+        "applied": applied,
+        "source_traces": doc.get("source_traces", []),
+        "fidelity_max_abs_log_error": doc.get("fidelity", {}).get(
+            "max_abs_log_error"
+        ),
+        "written_at_unix_s": doc.get("written_at_unix_s"),
+    }
+
+
+def _serve_fleet(args, fitted, plan, single_s, pool, plan_stamp):
+    """``serve --fleet N``: the exported plan shipped (split-plane
+    encoded, fingerprint-verified on arrival) to N crash-contained
+    plane PROCESSES behind the FleetRouter's admission door, driven
+    with the same open-loop Poisson storm, summarized with the fleet's
+    exact books (docs/serving.md fleet section)."""
+    import json
+
+    from keystone_tpu.serving import run_open_loop
+    from keystone_tpu.serving.fleet import FleetRouter
+    from keystone_tpu.serving.fleet_plane import encode_plan_ship
+
+    try:
+        ship = encode_plan_ship(fitted, plan)
+    except Exception as e:  # noqa: BLE001 — one-line serve contract
+        print(
+            f"serve: plan ship encode failed: {type(e).__name__}: {e}",
+            file=sys.stderr,
+        )
+        return 1
+    fleet = FleetRouter(
+        ship,
+        num_planes=args.fleet,
+        replicas_per_plane=max(1, args.replicas),
+        max_outstanding=args.queue_depth,
+        restart_budget=args.restart_budget,
+        plane_cfg={
+            "max_wait_ms": args.max_wait_ms,
+            "max_queue_depth": args.queue_depth,
+        },
+    )
+    try:
+        report = run_open_loop(
+            fleet.submit, lambda i: pool[i % len(pool)],
+            rate_hz=args.rate, duration_s=args.duration_s,
+            seed=args.seed,
+        )
+        stats = fleet.stats()
+        books_ok = fleet.accounting_ok()
+    finally:
+        fleet.close()
+    summary = report.to_row_dict()
+    summary.update({
+        "single_request_s": round(single_s, 6),
+        "buckets": plan.buckets,
+        "plan_fingerprint": plan.fingerprint,
+        "max_wait_ms": args.max_wait_ms,
+        "num_planes": stats["num_planes"],
+        "replicas_per_plane": max(1, args.replicas),
+        "healthy_planes": stats["healthy_planes"],
+        "evicted_planes": stats["evicted_planes"],
+        "quarantined_planes": stats["quarantined_planes"],
+        "restarts_total": stats["restarts_total"],
+        "aggregate_offered": stats["aggregate_offered"],
+        "fleet_completed": stats["completed"],
+        "fleet_rejected": stats["rejected"],
+        "fleet_failed": stats["failed"],
+        "fleet_p99_latency_s": stats["fleet_p99_latency_s"],
+        "planes": stats["planes"],
+        "fleet_accounting_ok": books_ok,
+    })
+    if plan_stamp is not None:
+        summary["plan_artifact"] = plan_stamp
+    print(json.dumps(summary))
+    if not books_ok:
+        # The fleet invariant is the contract this mode exists for —
+        # a summary with unbalanced books must not exit 0.
+        print(
+            "serve: fleet books do NOT balance (offered "
+            f"{stats['aggregate_offered']} != completed "
+            f"{stats['completed']} + rejected {stats['rejected']} + "
+            f"failed {stats['failed']})",
+            file=sys.stderr,
+        )
+        return 1
     return 0
 
 
@@ -736,7 +908,7 @@ def _serve_tenant_specs(args):
     return None
 
 
-def _serve_zoo(args, fitted, d_in, tenant_specs):
+def _serve_zoo(args, fitted, d_in, tenant_specs, plan_stamp=None):
     """Multi-tenant serve: one zoo, one exported plan per tenant (the
     fitted pipeline is cloned per tenant — paging mutates operator
     state in place, so tenants must never share operator objects), a
@@ -863,6 +1035,8 @@ def _serve_zoo(args, fitted, d_in, tenant_specs):
         summary["tenant_slo_states"] = report.tenant_states()
     if exporter is not None and exporter.port is not None:
         summary["metrics_port"] = exporter.port
+    if plan_stamp is not None:
+        summary["plan_artifact"] = plan_stamp
     print(json.dumps(summary))
     return 0
 

@@ -87,6 +87,19 @@ test over the whole package (``tests/test_lint.py``):
     Benches, ``scripts/`` and the test suite fabricate synthetic
     decision payloads on purpose and are exempt.
 
+``jax-clean-module``
+    A module carrying a ``# lint: jax-clean-module`` marker (in its
+    first 40 lines) must not import jax ANYWHERE — no module-level
+    ``import jax`` / ``from jax import ...``, and no function-local
+    ones either. This is the serving-fleet router discipline
+    (``serving/fleet.py`` / ``serving/fleet_rpc.py``): the front-door
+    process owns no device work and must run on hosts with no
+    accelerator stack, so the modules it is built from never name jax
+    at any scope. The check is per-module AST (the package root
+    imports jax, so transitive cleanliness is a process-architecture
+    property — the fleet plane boots jax only inside the spawned
+    child); the marker makes the contract explicit and greppable.
+
 ``explicit-seed``
     Randomized LIBRARY code must take an explicit integer seed: inside
     ``keystone_tpu/``, an argless ``jax.random.key()`` /
@@ -123,6 +136,7 @@ RULES = (
     "mesh-axis-name",
     "explicit-seed",
     "decision-event",
+    "jax-clean-module",
 )
 
 _JAX_NAMES = {"jax", "jnp"}
@@ -994,6 +1008,47 @@ def _check_decision_events(
 
 
 # ---------------------------------------------------------------------------
+# jax-clean-module rule
+# ---------------------------------------------------------------------------
+
+_CLEAN_MARK = "lint: jax-clean-module"
+
+
+def _has_clean_marker(src: str) -> bool:
+    return any(
+        _CLEAN_MARK in line for line in src.splitlines()[:40]
+    )
+
+
+def _check_jax_clean_module(tree: ast.Module, path: str) -> List[Finding]:
+    """Flag EVERY jax import (any scope) in a marked module — see the
+    module docstring's ``jax-clean-module`` entry."""
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                root = alias.name.split(".")[0]
+                if root in _JAX_NAMES:
+                    findings.append(Finding(
+                        path, node.lineno, "jax-clean-module",
+                        f"import {alias.name!r} in a jax-clean module "
+                        "— the fleet router process must run without "
+                        "jax; move device work into the plane process",
+                    ))
+        elif isinstance(node, ast.ImportFrom):
+            root = (node.module or "").split(".")[0]
+            if root in _JAX_NAMES:
+                findings.append(Finding(
+                    path, node.lineno, "jax-clean-module",
+                    f"from {node.module!r} import ... in a jax-clean "
+                    "module — the fleet router process must run "
+                    "without jax; move device work into the plane "
+                    "process",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # Driver
 # ---------------------------------------------------------------------------
 
@@ -1090,6 +1145,8 @@ def lint_file(
         )
         if not exempt:
             findings.extend(_check_decision_events(tree, sp))
+    if "jax-clean-module" in enabled and _has_clean_marker(src):
+        findings.extend(_check_jax_clean_module(tree, sp))
     return findings
 
 

@@ -21,6 +21,16 @@ Feeds one or more trace dirs (``KEYSTONE_TRACE=dir`` /
     same dict — the shape bench.py's ``_whatif_violations`` enforces).
 
 ``--json`` emits the full plan dict instead (the scriptable surface).
+
+``--apply PATH`` closes ROADMAP item 3's loop: when (and ONLY when)
+the 1x fidelity gate passes, write an auditable serving-defaults
+artifact — replica count / queue depth / admission bound sized off the
+measured occupancy peaks, an SLO p99 bound calibrated off the measured
+tail — that ``run.py serve --from-plan PATH`` consumes, so planner
+verdicts reach the serving plane without an operator retyping them.
+A planner that cannot reproduce the past must not configure the
+future: a failed fidelity gate refuses to write (exit 2).
+
 See docs/placement.md (planner cookbook).
 """
 
@@ -28,7 +38,10 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
+import os
 import sys
+import time
 from typing import Any, Dict, List, Optional, Sequence
 
 from keystone_tpu.obs.export import load_events
@@ -102,6 +115,80 @@ def _render(plan: Dict[str, Any], drift_threshold: float) -> List[str]:
     return lines
 
 
+PLAN_ARTIFACT_KIND = "keystone-plan-defaults"
+
+
+def serve_defaults_from_plan(plan: Dict[str, Any]) -> Dict[str, Any]:
+    """Derive the serving-defaults block from a planner verdict: every
+    knob is a function of a MEASURED baseline quantity (the occupancy
+    peaks the autoscale stream recorded, the batch-latency tail), never
+    a guess — the same measured-over-assumed discipline the what-if
+    rows follow."""
+    base = plan["baseline"]
+    replicas_peak = max(1, int(base.get("replicas_peak") or 1))
+    # Admission knobs: headroom of 2x over the RECORDED backlog peaks,
+    # floored so a quiet trace still yields a servable door.
+    occ_peak = max(
+        float(base.get("queue_peak") or 0.0),
+        float(base.get("outstanding_peak") or 0.0),
+        1.0,
+    )
+    queue_depth = max(64, 1 << math.ceil(math.log2(2.0 * occ_peak)))
+    defaults: Dict[str, Any] = {
+        "replicas": replicas_peak,
+        "queue_depth": queue_depth,
+        "min_replicas": 1,
+        # Brownout threshold: the ladder engages past the ceiling, set
+        # one doubling above the storm's recorded replica peak.
+        "max_replicas": 2 * replicas_peak,
+    }
+    p99_s = base.get("measured_p99_s")
+    if p99_s:
+        # The SLO bound the brownout/autoscale loop pages on: 3x the
+        # measured tail (the calibrated-bound convention bench.py's
+        # chaos rows use), floored at 1 ms so a microbenchmark trace
+        # cannot write an unservable objective.
+        defaults["slo_p99_ms"] = round(max(3e3 * float(p99_s), 1.0), 3)
+        defaults["slo_target"] = 0.99
+    return defaults
+
+
+def write_apply_artifact(path: str, plan: Dict[str, Any],
+                         trace_dirs: Sequence[str],
+                         drift_threshold: float) -> Dict[str, Any]:
+    """Write the ``--apply`` artifact atomically (tmp + rename) and
+    return it. The artifact carries its own provenance: the source
+    traces, the fidelity verdict it was gated on, and the measured
+    baseline each default was derived from."""
+    fid = plan["fidelity"]
+    doc = {
+        "artifact": PLAN_ARTIFACT_KIND,
+        "version": 1,
+        "written_at_unix_s": round(time.time(), 3),
+        "source_traces": [os.path.abspath(d) for d in trace_dirs],
+        "fidelity": {
+            "num_reproduced": fid["num_reproduced"],
+            "num_replayed": fid["num_replayed"],
+            "num_outcomes": fid["num_outcomes"],
+            "max_abs_log_error": fid["max_abs_log_error"],
+            "drift_threshold": drift_threshold,
+        },
+        "baseline": {
+            k: plan["baseline"].get(k)
+            for k in ("num_decisions", "weights_family", "num_batches",
+                      "measured_p50_s", "measured_p99_s",
+                      "replicas_peak", "queue_peak", "outstanding_peak")
+        },
+        "serve_defaults": serve_defaults_from_plan(plan),
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         "keystone-plan", description=__doc__,
@@ -119,6 +206,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "(the calibration plane's default)")
     parser.add_argument("--json", action="store_true",
                         help="emit the plan dict as JSON")
+    parser.add_argument("--apply", default="", metavar="PATH",
+                        help="write the serving-defaults artifact here "
+                             "(replicas / queue depth / SLO bound sized "
+                             "off the measured baseline) for run.py "
+                             "serve --from-plan; REFUSED (exit 2) when "
+                             "the fidelity gate fails")
     args = parser.parse_args(list(argv) if argv is not None else None)
 
     try:
@@ -148,9 +241,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print("\n".join(_render(plan, args.drift_threshold)))
     fid = plan["fidelity"]
     worst = fid["max_abs_log_error"]
-    if fid["num_reproduced"] != fid["num_replayed"] or (
+    fidelity_ok = fid["num_reproduced"] == fid["num_replayed"] and not (
         worst is not None and worst > args.drift_threshold
-    ):
+    )
+    if args.apply:
+        if not fidelity_ok:
+            # The apply gate: a planner that cannot reproduce the past
+            # must not configure the future.
+            print(
+                f"plan: --apply REFUSED: the 1x fidelity gate failed "
+                f"({fid['num_reproduced']}/{fid['num_replayed']} "
+                f"reproduced, worst |log error| {worst}) — no defaults "
+                "written",
+                file=sys.stderr,
+            )
+            return 2
+        doc = write_apply_artifact(args.apply, plan, args.trace_dirs,
+                                   args.drift_threshold)
+        d = doc["serve_defaults"]
+        print(
+            f"apply: wrote {args.apply} ("
+            + ", ".join(f"{k}={d[k]}" for k in sorted(d))
+            + ")"
+        )
+    if not fidelity_ok:
         return 2
     return 0
 

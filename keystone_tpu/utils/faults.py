@@ -81,6 +81,19 @@ Instrumented sites (each site counts its own calls, 0-based):
                         the publication loudly while the incumbent plan
                         keeps serving (zero-drop — the swap machinery
                         re-enters the old plan on failure).
+  - ``fleet.plane.spawn`` — one plane-process (re)spawn attempt in the
+                        fleet router's watchdog (``serving/fleet.py``):
+                        injected errors are absorbed by paced bounded
+                        retries inside the per-plane restart budget;
+                        exhaustion evicts the plane LOUDLY while the
+                        surviving fleet keeps serving.
+  - ``fleet.rpc.send`` — one router→plane RPC send
+                        (``serving/fleet_rpc.py``), fired BEFORE any
+                        bytes hit the wire so error rules are safely
+                        retried (at-most-once preserved); corrupt rules
+                        model wire corruption of a shipped weight plane
+                        — the split-plane per-tensor CRCs must catch it
+                        and quarantine the plane, never serve.
 
 Activation is either lexical (``with plan.active():``) or ambient via
 the ``KEYSTONE_FAULT_PLAN`` env var (a JSON plan, or ``@/path/to.json``)
@@ -112,6 +125,8 @@ __all__ = [
     "RetryPolicy",
     "SITE_AUTOSCALE_SPAWN",
     "SITE_CHECKPOINT_WRITE",
+    "SITE_FLEET_PLANE_SPAWN",
+    "SITE_FLEET_RPC_SEND",
     "SITE_IMAGE_AUGMENT",
     "SITE_IMAGE_DECODE",
     "SITE_LIFECYCLE_PUBLISH",
@@ -148,6 +163,8 @@ SITE_ZOO_PAGE_OUT = "serving.zoo.page_out"
 SITE_TRAINER_FIT = "trainer.fit"
 SITE_LIFECYCLE_VALIDATE = "lifecycle.validate"
 SITE_LIFECYCLE_PUBLISH = "lifecycle.publish"
+SITE_FLEET_PLANE_SPAWN = "fleet.plane.spawn"
+SITE_FLEET_RPC_SEND = "fleet.rpc.send"
 
 _KINDS = ("error", "corrupt", "latency")
 _EXC_TYPES: Dict[str, type] = {

@@ -737,3 +737,121 @@ class TestRooflineAuditability:
             {"decode_busy_s": 0.5, "augment_busy_s": 0.1,
              "bytes_read": 3_145_728},
         )
+
+    # -- the serving-fleet rule (ISSUE 20 satellite) -----------------------
+
+    def test_fleet_claims_require_num_planes_and_per_plane_books(self):
+        """ISSUE 20 satellite: any dict claiming a fleet-wide latency
+        merge (``fleet_p99*``) or fleet-wide load
+        (``aggregate_offered*``) must carry a numeric ``num_planes``
+        AND a ``planes`` mapping whose blocks each carry numeric
+        completed/rejected/failed accounting in the SAME dict — a
+        cross-process p99 with no plane count and no per-plane books
+        behind it is not a fleet measurement."""
+        bench = _load_bench()
+        good = {
+            "fleet_p99_latency_s": 0.004,
+            "aggregate_offered": 4000,
+            "num_planes": 4,
+            "planes": {
+                f"plane{i}": {"completed": 990, "rejected": 6,
+                              "failed": 4}
+                for i in range(4)
+            },
+        }
+        row = bench.make_row(
+            "fleet_probe", 0.004, "s", None, "open_loop_latency",
+            {"fleet": dict(good), "num_samples": 3960,
+             "offered_rate_hz": 1000.0},
+        )
+        assert row["detail"]["fleet"]["num_planes"] == 4
+        # Missing num_planes beside the claim.
+        d = {k: v for k, v in good.items() if k != "num_planes"}
+        with pytest.raises(ValueError, match="num_planes"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"fleet": d, "num_samples": 3960,
+                 "offered_rate_hz": 1000.0},
+            )
+        # Missing the planes mapping entirely.
+        d = {k: v for k, v in good.items() if k != "planes"}
+        with pytest.raises(ValueError, match="planes mapping"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"fleet": d, "num_samples": 3960,
+                 "offered_rate_hz": 1000.0},
+            )
+        # A per-plane block missing part of its accounting triple.
+        d = dict(good)
+        d["planes"] = dict(good["planes"])
+        d["planes"]["plane0"] = {"completed": 990, "rejected": 6}
+        with pytest.raises(ValueError, match="plane0"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"fleet": d, "num_samples": 3960,
+                 "offered_rate_hz": 1000.0},
+            )
+        # A prose plane count must not satisfy the rule.
+        d = dict(good)
+        d["num_planes"] = "four"
+        with pytest.raises(ValueError, match="num_planes"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"fleet": d, "num_samples": 3960,
+                 "offered_rate_hz": 1000.0},
+            )
+        # Claims trigger at any nesting depth (a legs list).
+        with pytest.raises(ValueError, match="num_planes"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"legs": [{"aggregate_offered": 100}],
+                 "num_samples": 3960, "offered_rate_hz": 1000.0},
+            )
+        # Either claim key alone carries the burden.
+        with pytest.raises(ValueError, match="num_planes"):
+            bench.make_row(
+                "fleet_probe", 0.004, "s", None, "open_loop_latency",
+                {"fleet": {"fleet_p99_latency_s": 0.004},
+                 "num_samples": 3960, "offered_rate_hz": 1000.0},
+            )
+        # Per-plane books with NO fleet claims ride free.
+        bench.make_row(
+            "fleet_probe", 0.004, "s", None, "min_of_N_warm",
+            {"planes": {"plane0": {"completed": 5}}},
+        )
+
+    def test_fleet_router_stats_passes_the_audit_as_is(self):
+        """The contract the rule states: ``FleetRouter.stats()`` emits
+        num_planes + per-plane accounting beside every fleet claim, so
+        a stats dict drops into a row unmodified. Proven against the
+        STATIC shape here (the live fleet is exercised in
+        tests/test_chaos_fleet.py — no processes in tier-1 bench
+        convention tests)."""
+        bench = _load_bench()
+        stats = {
+            "num_planes": 2,
+            "healthy_planes": 2,
+            "evicted_planes": [],
+            "quarantined_planes": [],
+            "restarts_total": 1,
+            "aggregate_offered": 120,
+            "completed": 118,
+            "rejected": 1,
+            "failed": 1,
+            "inflight": 0,
+            "fleet_latency_count": 118,
+            "fleet_p50_latency_s": 0.002,
+            "fleet_p99_latency_s": 0.011,
+            "planes": {
+                "plane0": {"pid": 101, "offered": 60, "completed": 59,
+                           "rejected": 1, "failed": 0, "restarts": 1},
+                "plane1": {"pid": 102, "offered": 60, "completed": 59,
+                           "rejected": 0, "failed": 1, "restarts": 0},
+            },
+        }
+        row = bench.make_row(
+            "fleet_probe", 0.011, "s", None, "open_loop_latency",
+            {"fleet": stats, "num_samples": 118,
+             "offered_rate_hz": 120.0},
+        )
+        assert row["detail"]["fleet"]["num_planes"] == 2

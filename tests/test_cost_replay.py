@@ -780,3 +780,97 @@ class TestCapacityPlannerGoldenTrace:
         assert plan["fidelity"]["num_reproduced"] \
             == plan["fidelity"]["num_replayed"]
         assert plan["whatifs"][0]["whatif"] == "traffic=2x"
+
+    # ---- ROADMAP item 3's last loop: --apply -> serve --from-plan ----
+
+    def test_apply_writes_gated_defaults_artifact(self, golden_dir,
+                                                  tmp_path, capsys):
+        import json
+
+        from keystone_tpu.tools.plan import (
+            PLAN_ARTIFACT_KIND, main as plan_main,
+        )
+
+        out_path = str(tmp_path / "defaults.json")
+        rc = plan_main([golden_dir, "--apply", out_path])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert f"apply: wrote {out_path}" in out
+        with open(out_path) as f:
+            doc = json.load(f)
+        assert doc["artifact"] == PLAN_ARTIFACT_KIND
+        # Every default is a function of the MEASURED baseline.
+        d = doc["serve_defaults"]
+        assert d["replicas"] == doc["baseline"]["replicas_peak"] == 4
+        assert d["max_replicas"] == 8
+        assert d["queue_depth"] >= 64  # 2x headroom over peak, floored
+        assert d["slo_p99_ms"] == pytest.approx(
+            3e3 * doc["baseline"]["measured_p99_s"], rel=1e-6
+        )
+        # Provenance: the artifact names its sources and the fidelity
+        # verdict it was gated on.
+        assert doc["source_traces"] and doc["fidelity"]["num_replayed"]
+
+    def test_apply_refused_when_fidelity_gate_fails(self, golden_dir,
+                                                    tmp_path, capsys):
+        import os
+
+        from keystone_tpu.tools.plan import main as plan_main
+
+        out_path = str(tmp_path / "defaults.json")
+        # An absurd drift threshold fails the gate: the planner must
+        # REFUSE to configure the future it cannot reproduce.
+        rc = plan_main([golden_dir, "--apply", out_path,
+                        "--drift-threshold", "1e-12"])
+        err = capsys.readouterr().err
+        assert rc == 2
+        assert "REFUSED" in err
+        assert not os.path.exists(out_path)
+
+    def test_serve_from_plan_fills_only_untouched_flags(
+        self, golden_dir, tmp_path, capsys
+    ):
+        import argparse
+
+        from keystone_tpu.run import _serve_apply_plan_defaults
+        from keystone_tpu.tools.plan import main as plan_main
+
+        out_path = str(tmp_path / "defaults.json")
+        assert plan_main([golden_dir, "--apply", out_path]) == 0
+        capsys.readouterr()
+
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--replicas", type=int, default=1)
+        parser.add_argument("--queue-depth", type=int, default=1024)
+        parser.add_argument("--slo-p99-ms", type=float, default=0.0)
+        parser.add_argument("--slo-target", type=float, default=0.99)
+        parser.add_argument("--min-replicas", type=int, default=1)
+        parser.add_argument("--max-replicas", type=int, default=8)
+        parser.add_argument("--from-plan", default="")
+        args = parser.parse_args(
+            ["--from-plan", out_path, "--replicas", "7"]
+        )
+        stamp = _serve_apply_plan_defaults(args, parser)
+        # The operator's explicit flag OUTRANKS the planner...
+        assert args.replicas == 7
+        assert "replicas" not in stamp["applied"]
+        # ...while untouched flags fill from the measured baseline.
+        assert args.slo_p99_ms > 0
+        assert stamp["applied"]["slo_p99_ms"] == args.slo_p99_ms
+        assert stamp["applied"]["queue_depth"] == args.queue_depth
+        assert stamp["path"] == out_path
+        assert stamp["source_traces"]
+
+    def test_serve_from_plan_rejects_foreign_json(self, tmp_path):
+        import argparse
+        import json
+
+        from keystone_tpu.run import _serve_apply_plan_defaults
+
+        bogus = tmp_path / "notaplan.json"
+        bogus.write_text(json.dumps({"hello": "world"}))
+        parser = argparse.ArgumentParser()
+        parser.add_argument("--from-plan", default="")
+        args = parser.parse_args(["--from-plan", str(bogus)])
+        with pytest.raises(ValueError, match="not a bin/plan"):
+            _serve_apply_plan_defaults(args, parser)

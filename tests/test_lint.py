@@ -50,6 +50,8 @@ class TestPackageIsClean:
             "SITE_TRAINER_FIT": faults.SITE_TRAINER_FIT,
             "SITE_LIFECYCLE_VALIDATE": faults.SITE_LIFECYCLE_VALIDATE,
             "SITE_LIFECYCLE_PUBLISH": faults.SITE_LIFECYCLE_PUBLISH,
+            "SITE_FLEET_PLANE_SPAWN": faults.SITE_FLEET_PLANE_SPAWN,
+            "SITE_FLEET_RPC_SEND": faults.SITE_FLEET_RPC_SEND,
         }
 
     def test_every_registered_fault_site_is_exercised_by_tests(self):
@@ -788,6 +790,83 @@ def emit(tracer):
 
     def test_rule_is_registered(self):
         assert "decision-event" in RULES
+
+
+class TestJaxCleanModuleRule:
+    """ISSUE 20: the fleet router's front-door modules carry a
+    ``# lint: jax-clean-module`` marker and must never name jax at ANY
+    scope — the router process runs without an accelerator stack."""
+
+    VIOLATION = '''
+"""Router module.
+
+# lint: jax-clean-module
+"""
+import jax.numpy as jnp
+
+
+def route(x):
+    return jnp.asarray(x)
+'''
+
+    def test_fires_on_module_level_jax_import(self, tmp_path):
+        findings = _lint_snippet(
+            tmp_path, self.VIOLATION, rules=["jax-clean-module"]
+        )
+        assert _codes(findings) == ["jax-clean-module"]
+
+    def test_fires_on_function_local_jax_import(self, tmp_path):
+        # Unlike jax-off-thread, lazy imports do NOT opt out: the
+        # marked module must be loadable AND runnable jax-free.
+        findings = _lint_snippet(tmp_path, '''
+"""Router module.
+
+# lint: jax-clean-module
+"""
+
+
+def route(x):
+    from jax import numpy as jnp
+
+    return jnp.asarray(x)
+''', rules=["jax-clean-module"])
+        assert _codes(findings) == ["jax-clean-module"]
+
+    def test_unmarked_module_is_ignored(self, tmp_path):
+        unmarked = self.VIOLATION.replace(
+            "# lint: jax-clean-module", ""
+        )
+        assert not _lint_snippet(
+            tmp_path, unmarked, rules=["jax-clean-module"]
+        )
+
+    def test_marked_stdlib_module_is_clean(self, tmp_path):
+        assert not _lint_snippet(tmp_path, '''
+"""Router module.
+
+# lint: jax-clean-module
+"""
+import socket
+import numpy as np
+
+
+def route(x):
+    return np.asarray(x), socket.AF_INET
+''', rules=["jax-clean-module"])
+
+    def test_fleet_router_modules_are_marked(self):
+        """The contract this rule exists for: both front-door modules
+        actually carry the marker (deleting it would silently disable
+        the check)."""
+        from keystone_tpu.tools.lint import _has_clean_marker
+
+        root = Path(__file__).resolve().parent.parent
+        for rel in ("keystone_tpu/serving/fleet.py",
+                    "keystone_tpu/serving/fleet_rpc.py"):
+            assert _has_clean_marker((root / rel).read_text()), rel
+
+    def test_rule_is_registered(self):
+        assert "jax-clean-module" in RULES
 
 
 class TestDriver:
